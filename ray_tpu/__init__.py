@@ -1,0 +1,58 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+A ground-up re-design of the Ray programming model (tasks, actors, objects,
+placement groups, and the ML libraries layered on top) for TPU hardware:
+the scheduler treats TPU chips and ICI slice topology as first-class
+resources, collective communication lowers to XLA collectives over ICI/DCN
+instead of NCCL, training backends shard models with GSPMD/``pjit``, and
+long-context sequence parallelism (ring attention, Ulysses all-to-all) is
+provided natively via pallas kernels and ``shard_map``.
+
+Public API parity target: ``ray.*`` (reference: ``python/ray/__init__.py``).
+"""
+
+from ray_tpu import exceptions
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    list_named_actors,
+    nodes,
+    put,
+    shutdown,
+    wait,
+)
+from ray_tpu.actor import exit_actor, method
+from ray_tpu.remote_function import make_remote as remote
+from ray_tpu.runtime_context import get_runtime_context
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ObjectRef",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "exit_actor",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "list_named_actors",
+    "method",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+    "__version__",
+]

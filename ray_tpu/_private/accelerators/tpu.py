@@ -1,0 +1,95 @@
+"""TPU accelerator manager: detection, visibility, and slice topology labels.
+
+Re-design of the reference TPU accelerator support (reference:
+``python/ray/_private/accelerators/tpu.py:70`` — ``TPUAcceleratorManager``:
+GCE metadata/env detection :47-118, ``TPU`` + per-pod ``TPU-<type>-head``
+resources :330, ``TPU_VISIBLE_CHIPS`` :154, pod-type → accelerator-type
+mapping :307). Here TPU chips are *the* first-class accelerator: the
+scheduler accounts individual chips, and slice topology (ICI neighborhoods)
+is exposed as ``TPU-slice:<name>`` resources so placement groups can request
+ICI-connected chips.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional
+
+VISIBLE_CHIPS_ENV = "TPU_VISIBLE_CHIPS"
+NUM_CHIPS_OVERRIDE_ENV = "RAY_TPU_NUM_CHIPS"
+ACCELERATOR_TYPE_ENV = "TPU_ACCELERATOR_TYPE"  # e.g. "v5litepod-256"
+WORKER_ID_ENV = "TPU_WORKER_ID"
+
+# chips per host for known generations (host = TPU VM).
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5litepod": 8, "v5p": 4, "v6e": 8}
+
+
+class TPUAcceleratorManager:
+    """Static helpers; mirrors the reference AcceleratorManager ABC surface
+    (``_private/accelerators/accelerator.py:5``)."""
+
+    resource_name = "TPU"
+
+    @staticmethod
+    def detect_num_chips() -> int:
+        """Number of TPU chips visible to this host, without importing jax
+        unless it is already loaded."""
+        override = os.environ.get(NUM_CHIPS_OVERRIDE_ENV)
+        if override is not None:
+            return int(override)
+        visible = os.environ.get(VISIBLE_CHIPS_ENV)
+        if visible:
+            return len([c for c in visible.split(",") if c != ""])
+        if "jax" in sys.modules:
+            try:
+                jax = sys.modules["jax"]
+                return len([d for d in jax.devices() if d.platform != "cpu"])
+            except Exception:
+                pass
+        acc_type = os.environ.get(ACCELERATOR_TYPE_ENV)
+        if acc_type:
+            gen = acc_type.split("-")[0]
+            return _CHIPS_PER_HOST.get(gen, 4)
+        return 0
+
+    @staticmethod
+    def accelerator_type() -> Optional[str]:
+        return os.environ.get(ACCELERATOR_TYPE_ENV)
+
+    @staticmethod
+    def pod_name() -> Optional[str]:
+        """Logical slice/pod name this host belongs to (for TPU-<pod>-head)."""
+        return os.environ.get("TPU_NAME") or os.environ.get("TPU_POD_NAME")
+
+    @staticmethod
+    def worker_id() -> int:
+        return int(os.environ.get(WORKER_ID_ENV, "0"))
+
+    @staticmethod
+    def set_visible_chips(chip_ids: List[int]) -> None:
+        """Restrict this process (and its jax) to the given chips — the analog
+        of CUDA_VISIBLE_DEVICES sharing in the reference
+        (``worker.py:991``, ``backend_executor.py:278``)."""
+        os.environ[VISIBLE_CHIPS_ENV] = ",".join(str(c) for c in chip_ids)
+        # jax reads TPU_VISIBLE_CHIPS via libtpu at first init.
+
+    @staticmethod
+    def node_resources() -> Dict[str, float]:
+        """Resources this host contributes to the cluster."""
+        n = TPUAcceleratorManager.detect_num_chips()
+        if n == 0:
+            return {}
+        res: Dict[str, float] = {"TPU": float(n)}
+        acc = TPUAcceleratorManager.accelerator_type()
+        if acc:
+            res[f"accelerator_type:{acc}"] = 1.0
+            # The host with worker id 0 of a slice carries the slice-head
+            # resource so exactly one actor per slice can claim coordination
+            # (reference: TPU-<pod>-head resource, tpu.py:330).
+            if TPUAcceleratorManager.worker_id() == 0:
+                res[f"TPU-{acc}-head"] = 1.0
+        pod = TPUAcceleratorManager.pod_name()
+        if pod:
+            res[f"TPU-slice:{pod}"] = float(n)
+        return res

@@ -1,0 +1,99 @@
+"""ObjectRef: a future-like handle to a (possibly remote, possibly pending) object.
+
+Re-design of the reference ObjectRef (reference: ``python/ray/_raylet.pyx``
+``ObjectRef``): carries the 28-byte ``ObjectID`` (task lineage + index) and the
+owner's address. Refcounting hooks (``_register``/``_release``) notify the
+runtime on creation/GC so distributed reference counting can free the value.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ray_tpu._private.ids import ObjectID, TaskID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner_address", "_call_site", "_registered", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner_address: str = "", call_site: str = "",
+                 skip_ref_count: bool = False):
+        self._id = object_id
+        self._owner_address = owner_address
+        self._call_site = call_site
+        self._registered = False
+        if not skip_ref_count:
+            from ray_tpu._private import worker as _worker
+
+            w = _worker.global_worker_or_none()
+            if w is not None:
+                w.core.add_local_reference(self)
+                self._registered = True
+
+    # -- identity ---------------------------------------------------------
+    def id(self) -> ObjectID:
+        return self._id
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def task_id(self) -> TaskID:
+        return self._id.task_id()
+
+    def owner_address(self) -> str:
+        return self._owner_address
+
+    def call_site(self) -> str:
+        return self._call_site
+
+    @classmethod
+    def from_binary(cls, binary: bytes, owner_address: str = "") -> "ObjectRef":
+        return cls(ObjectID(binary), owner_address)
+
+    @classmethod
+    def nil(cls) -> "ObjectRef":
+        return cls(ObjectID.nil(), skip_ref_count=True)
+
+    # -- semantics --------------------------------------------------------
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def __reduce__(self):
+        # Plain pickling (outside the framework serializer) keeps id + owner.
+        return (_rebuild_ref, (self._id.binary(), self._owner_address))
+
+    def __del__(self):
+        if getattr(self, "_registered", False):
+            try:
+                from ray_tpu._private import worker as _worker
+
+                w = _worker.global_worker_or_none()
+                if w is not None:
+                    w.core.remove_local_reference(self._id)
+            except Exception:
+                pass
+
+    def future(self):
+        """Return a concurrent.futures.Future resolving to the object's value."""
+        from ray_tpu._private import worker as _worker
+
+        return _worker.global_worker().core.as_future(self)
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+
+def _rebuild_ref(binary: bytes, owner_address: str) -> ObjectRef:
+    ref = ObjectRef(ObjectID(binary), owner_address)
+    return ref

@@ -1,0 +1,87 @@
+"""Task / actor submission options.
+
+Re-design of the reference options plumbing (reference:
+``python/ray/_private/ray_option_utils.py``): a validated dataclass shared by
+``@remote`` decorators and ``.options(...)`` overrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class RemoteOptions:
+    # Resources. ``num_tpus`` is first-class: a task/actor holding N tpu chips
+    # gets TPU_VISIBLE_CHIPS set for its process (reference analog:
+    # num_gpus + CUDA_VISIBLE_DEVICES in worker.py:991).
+    num_cpus: Optional[float] = None
+    num_gpus: Optional[float] = None
+    num_tpus: Optional[float] = None
+    memory: Optional[float] = None
+    resources: Optional[Dict[str, float]] = None
+
+    # Task behavior.
+    num_returns: int = 1
+    max_retries: Optional[int] = None
+    retry_exceptions: Any = False  # False | True | list of exception types
+    name: Optional[str] = None
+
+    # Actor behavior.
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    max_pending_calls: int = -1
+    lifetime: Optional[str] = None  # None | "detached"
+    namespace: Optional[str] = None
+    get_if_exists: bool = False
+
+    # Placement.
+    scheduling_strategy: Any = None  # None|"DEFAULT"|"SPREAD"|strategy object
+    placement_group: Any = None
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+    # Environment.
+    runtime_env: Optional[Dict[str, Any]] = None
+
+    # Concurrency groups for actors: {"group": max_concurrency}.
+    concurrency_groups: Optional[Dict[str, int]] = None
+
+    # Internal.
+    _is_actor: bool = False
+
+    def merged_with(self, overrides: Dict[str, Any]) -> "RemoteOptions":
+        known = {f.name for f in dataclasses.fields(self)}
+        bad = set(overrides) - known
+        if bad:
+            raise ValueError(f"Unknown options: {sorted(bad)}")
+        return dataclasses.replace(self, **overrides)
+
+    def task_resources(self, default_num_cpus: float = 1.0) -> Dict[str, float]:
+        """Resolve the resource demand of one invocation."""
+        req: Dict[str, float] = {}
+        cpus = self.num_cpus
+        if cpus is None:
+            cpus = 0.0 if self._is_actor else default_num_cpus
+        if cpus:
+            req["CPU"] = float(cpus)
+        if self.num_gpus:
+            req["GPU"] = float(self.num_gpus)
+        if self.num_tpus:
+            req["TPU"] = float(self.num_tpus)
+        if self.memory:
+            req["memory"] = float(self.memory)
+        for k, v in (self.resources or {}).items():
+            if k in ("CPU", "GPU", "TPU"):
+                raise ValueError(
+                    f"Use num_cpus/num_gpus/num_tpus instead of resources[{k!r}]"
+                )
+            req[k] = float(v)
+        return req
+
+
+def options_from_decorator_kwargs(kwargs: Dict[str, Any], is_actor: bool) -> RemoteOptions:
+    opts = RemoteOptions(_is_actor=is_actor)
+    return opts.merged_with(kwargs)

@@ -1,0 +1,17 @@
+"""ClusterRuntime: client of a multi-process ray_tpu cluster.
+
+Connects the driver/worker process to this node's daemon and the cluster
+control plane (reference analog: the Cython CoreWorker connecting to the
+raylet + GCS, ``python/ray/_raylet.pyx:2953``).
+"""
+
+from __future__ import annotations
+
+
+class ClusterRuntime:
+    @classmethod
+    def connect(cls, address: str, namespace: str = "default"):
+        raise RuntimeError(
+            "ray_tpu cluster mode is not available yet in this build: "
+            f"cannot connect to {address!r}. Use ray_tpu.init() with no "
+            "address for the in-process runtime.")

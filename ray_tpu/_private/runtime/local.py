@@ -1,0 +1,692 @@
+"""LocalRuntime: in-process task/actor execution with real future semantics.
+
+Re-design of the reference single-process paths (reference: local mode in
+``python/ray/_private/worker.py`` + the CoreWorker task lifecycle in
+``src/ray/core_worker/core_worker.cc``): tasks run on a thread pool once their
+``ObjectRef`` dependencies are ready (dependency-resolution mirrors
+``transport/dependency_resolver.h`` — top-level args are resolved to values,
+nested refs are passed through); errors become ``RayTaskError`` values stored
+in the task's return objects and re-raised at ``get``; retries honour
+``max_retries``/``retry_exceptions`` (reference: ``task_manager.h:212``);
+actors are threads with ordered (or concurrent) inboxes mirroring the actor
+scheduling queues of ``transport/actor_scheduling_queue.h``.
+
+Resource admission mirrors the raylet's local resource manager
+(reference: ``raylet/local_task_manager.cc``): a dispatcher admits queued
+tasks only when their resource demand fits the node's available resources,
+and — like the reference raylet — a task blocked in ``get()`` temporarily
+returns its CPU resources so nested task trees cannot deadlock the node.
+
+This runtime backs single-process usage and is the execution engine unit tests
+run against; the cluster runtime reuses its executor pieces worker-side.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextvars
+import inspect
+import logging
+import queue
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_tpu import exceptions
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ray_tpu._private.memory_store import MemoryStore
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.options import RemoteOptions
+from ray_tpu._private.runtime.interface import CoreRuntime
+
+logger = logging.getLogger(__name__)
+
+_context: contextvars.ContextVar[Optional["_TaskCtx"]] = contextvars.ContextVar(
+    "ray_tpu_task_ctx", default=None)
+
+
+def current_task_context() -> Optional["_TaskCtx"]:
+    return _context.get()
+
+
+class _TaskCtx:
+    __slots__ = ("task_id", "actor_id", "attempt", "name", "resources")
+
+    def __init__(self, task_id, actor_id=None, attempt=0, name="", resources=None):
+        self.task_id = task_id
+        self.actor_id = actor_id
+        self.attempt = attempt
+        self.name = name
+        self.resources = resources or {}
+
+
+def _resolve_retry(exc: BaseException, retry_exceptions, retries_left: int) -> bool:
+    if retries_left <= 0:
+        return False
+    if isinstance(exc, exceptions.TaskCancelledError):
+        return False
+    if retry_exceptions is False:
+        # Only system failures are retried by default; in-process execution
+        # has no worker crashes, so application errors never retry.
+        return False
+    if retry_exceptions is True:
+        return True
+    return isinstance(exc, tuple(retry_exceptions))
+
+
+class _ResourceLedger:
+    """Node-local resource accounting with blocking-release semantics."""
+
+    def __init__(self, total: Dict[str, float]):
+        self.total = dict(total)
+        self.available = dict(total)
+        self.cv = threading.Condition()
+
+    def feasible(self, demand: Dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+    def try_acquire(self, demand: Dict[str, float]) -> bool:
+        with self.cv:
+            if all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
+                for k, v in demand.items():
+                    self.available[k] = self.available.get(k, 0.0) - v
+                return True
+            return False
+
+    def release(self, demand: Dict[str, float]) -> None:
+        with self.cv:
+            for k, v in demand.items():
+                self.available[k] = self.available.get(k, 0.0) + v
+            self.cv.notify_all()
+
+    def snapshot(self) -> Dict[str, float]:
+        with self.cv:
+            return {k: round(v, 6) for k, v in self.available.items()}
+
+
+class _LocalActor:
+    """An actor instance executing methods on its own thread(s).
+
+    Ordered single-thread execution for ``max_concurrency == 1`` (the
+    reference's ordered actor scheduling queue); a small pool when more
+    concurrency is requested; an asyncio loop when the class defines any
+    coroutine methods (reference: fibers / async actors).
+    """
+
+    def __init__(self, runtime: "LocalRuntime", actor_id: ActorID, cls: type,
+                 args: tuple, kwargs: dict, options: RemoteOptions):
+        self.runtime = runtime
+        self.actor_id = actor_id
+        self.cls = cls
+        self.init_args = args
+        self.init_kwargs = kwargs
+        self.options = options
+        self.instance = None
+        self.dead = False
+        self.death_cause: Optional[BaseException] = None
+        # Inherited coroutine methods count too.
+        self.is_async = any(
+            inspect.iscoroutinefunction(getattr(cls, name, None))
+            for name in dir(cls))
+        self.max_concurrency = options.max_concurrency
+        if self.is_async and options.max_concurrency == 1:
+            self.max_concurrency = 1000  # async actors default to high concurrency
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        t = threading.Thread(target=self._run, name=f"actor-{self.actor_id.hex()[:8]}",
+                             daemon=True)
+        self._thread = t
+        t.start()
+
+    # -- thread bodies ----------------------------------------------------
+    def _run(self):
+        try:
+            self.instance = self.cls(*self.init_args, **self.init_kwargs)
+        except BaseException as e:  # noqa: BLE001
+            self._die(exceptions.RayTaskError.from_exception(
+                e, f"{self.cls.__name__}.__init__"))
+            return
+        self.runtime._actor_started(self.actor_id)
+        if self.is_async:
+            self._run_async_loop()
+        elif self.max_concurrency > 1:
+            self._run_concurrent()
+        else:
+            self._run_ordered()
+
+    def _run_ordered(self):
+        while True:
+            item = self._inbox.get()
+            if item is None:
+                return
+            self._execute(*item)
+
+    def _run_concurrent(self):
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.max_concurrency,
+            thread_name_prefix=f"actor-{self.actor_id.hex()[:6]}")
+        while True:
+            item = self._inbox.get()
+            if item is None:
+                self._pool.shutdown(wait=False)
+                return
+            self._pool.submit(self._execute, *item)
+
+    def _run_async_loop(self):
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        sem = asyncio.Semaphore(self.max_concurrency)
+
+        async def pump():
+            while True:
+                item = await loop.run_in_executor(None, self._inbox.get)
+                if item is None:
+                    return
+                await sem.acquire()
+
+                async def run(item=item):
+                    try:
+                        await self._execute_async(*item)
+                    finally:
+                        sem.release()
+
+                loop.create_task(run())
+
+        try:
+            loop.run_until_complete(pump())
+        finally:
+            try:
+                pending = asyncio.all_tasks(loop)
+                for t in pending:
+                    t.cancel()
+                loop.run_until_complete(asyncio.gather(*pending, return_exceptions=True))
+            except Exception:
+                pass
+            loop.close()
+
+    # -- execution --------------------------------------------------------
+    def _execute(self, method_name: str, args, kwargs, return_ids: List[ObjectID],
+                 task_id: TaskID):
+        token = _context.set(_TaskCtx(task_id, self.actor_id,
+                                      name=f"{self.cls.__name__}.{method_name}"))
+        try:
+            method = getattr(self.instance, method_name)
+            result = method(*args, **kwargs)
+            if inspect.isgenerator(result):
+                self.runtime._store_generator(result, return_ids, task_id)
+            else:
+                self.runtime._store_results(result, return_ids)
+        except exceptions.AsyncioActorExit:
+            self.runtime._store_results(None, return_ids)
+            self.terminate()
+        except BaseException as e:  # noqa: BLE001
+            err = exceptions.RayTaskError.from_exception(
+                e, f"{self.cls.__name__}.{method_name}", task_id)
+            self.runtime._store_error(err, return_ids)
+        finally:
+            _context.reset(token)
+
+    async def _execute_async(self, method_name, args, kwargs, return_ids, task_id):
+        # ContextVar set inside an asyncio task is task-local, so concurrent
+        # coroutines keep distinct task contexts.
+        token = _context.set(_TaskCtx(task_id, self.actor_id,
+                                      name=f"{self.cls.__name__}.{method_name}"))
+        try:
+            method = getattr(self.instance, method_name)
+            result = method(*args, **kwargs)
+            if inspect.iscoroutine(result):
+                result = await result
+            self.runtime._store_results(result, return_ids)
+        except exceptions.AsyncioActorExit:
+            self.runtime._store_results(None, return_ids)
+            self.terminate()
+        except BaseException as e:  # noqa: BLE001
+            err = exceptions.RayTaskError.from_exception(
+                e, f"{self.cls.__name__}.{method_name}", task_id)
+            self.runtime._store_error(err, return_ids)
+        finally:
+            _context.reset(token)
+
+    # -- lifecycle --------------------------------------------------------
+    def submit(self, method_name, args, kwargs, return_ids, task_id):
+        with self._lock:
+            if self.dead:
+                err = exceptions.ActorDiedError(
+                    self.actor_id,
+                    f"Actor {self.actor_id.hex()} is dead: {self.death_cause}")
+                self.runtime._store_error(err, return_ids)
+                return
+            if (self.options.max_pending_calls >= 0
+                    and self._inbox.qsize() >= self.options.max_pending_calls):
+                raise exceptions.PendingCallsLimitExceeded(
+                    f"Actor {self.actor_id.hex()} has "
+                    f">={self.options.max_pending_calls} pending calls")
+            self._inbox.put((method_name, args, kwargs, return_ids, task_id))
+
+    def _die(self, cause: Optional[BaseException]):
+        with self._lock:
+            if self.dead:
+                return
+            self.dead = True
+            self.death_cause = cause
+        # Fail everything still queued, then unblock the worker thread.
+        while True:
+            try:
+                item = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            _, _, _, return_ids, _ = item
+            self.runtime._store_error(
+                exceptions.ActorDiedError(self.actor_id, f"Actor died: {cause}"),
+                return_ids)
+        self._inbox.put(None)
+        self.runtime._actor_died(self.actor_id, cause)
+
+    def terminate(self, no_restart: bool = True):
+        with self._lock:
+            if self.dead:
+                return
+            self.dead = True
+        self._inbox.put(None)
+        self.runtime._actor_died(self.actor_id, None)
+
+
+class _PendingTask:
+    __slots__ = ("fn", "demand", "return_ids", "warned")
+
+    def __init__(self, fn, demand, return_ids):
+        self.fn = fn
+        self.demand = demand
+        self.return_ids = return_ids
+        self.warned = False
+
+
+class LocalRuntime(CoreRuntime):
+    def __init__(self, num_cpus: float = 8, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 node_ip: str = "127.0.0.1"):
+        self.job_id = JobID.from_int(1)
+        self.node_id = NodeID.from_random()
+        self.node_ip = node_ip
+        self.store = MemoryStore()
+        # Elastic pool: tasks may block on nested get(); true parallelism is
+        # limited by resource admission, not pool size.
+        self.pool = ThreadPoolExecutor(max_workers=max(64, int(num_cpus) * 8),
+                                       thread_name_prefix="task")
+        total: Dict[str, float] = {"CPU": float(num_cpus)}
+        if num_tpus:
+            total["TPU"] = float(num_tpus)
+        total.update(resources or {})
+        self.ledger = _ResourceLedger(total)
+        self._dispatch_queue: "queue.Queue[Optional[_PendingTask]]" = queue.Queue()
+        self._pending: List[_PendingTask] = []
+        self._actors: Dict[ActorID, _LocalActor] = {}
+        self._named_actors: Dict[Tuple[str, str], ActorID] = {}
+        self._actor_meta: Dict[ActorID, Dict[str, Any]] = {}
+        self._cancelled: set = set()
+        self._lock = threading.Lock()
+        self._shutdown = False
+        self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                            name="dispatcher", daemon=True)
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch_loop(self):
+        """Admit queued tasks when their resource demand fits (reference:
+        ``LocalTaskManager::DispatchScheduledTasksToWorkers``)."""
+        while True:
+            # Block for new arrivals or a resource release.
+            try:
+                item = self._dispatch_queue.get(timeout=0.1)
+            except queue.Empty:
+                item = False  # timeout: re-scan pending (resources may be free)
+            if self._shutdown:
+                return
+            if item is None:
+                return
+            if item is not False:
+                self._pending.append(item)
+            still_pending = []
+            for t in self._pending:
+                if not self.ledger.feasible(t.demand):
+                    if not t.warned:
+                        t.warned = True
+                        logger.warning(
+                            "Task demands %s which exceeds total cluster resources"
+                            " %s; it will hang until resources are added (parity"
+                            " with reference infeasible tasks).",
+                            t.demand, self.ledger.total)
+                    still_pending.append(t)
+                elif self.ledger.try_acquire(t.demand):
+                    self.pool.submit(t.fn)
+                else:
+                    still_pending.append(t)
+            self._pending = still_pending
+
+    def _enqueue(self, fn, demand, return_ids):
+        self._dispatch_queue.put(_PendingTask(fn, demand, return_ids))
+
+    # ---------------------------------------------------------------- objects
+    def put(self, value: Any, owner_ref: Optional[ObjectRef] = None) -> ObjectRef:
+        ctx = current_task_context()
+        task_id = ctx.task_id if ctx else TaskID.for_driver(self.job_id)
+        with self._lock:
+            oid = ObjectID.from_task(task_id, self._next_put_index())
+        self.store.put(oid, value)
+        return ObjectRef(oid, owner_address="local")
+
+    _put_index = 0
+
+    def _next_put_index(self) -> int:
+        self._put_index += 1
+        return 2**31 + (self._put_index % 2**30)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
+        ctx = current_task_context()
+        release = {}
+        if ctx is not None and ctx.resources:
+            # A task blocked in get() returns its CPU so dependents can run
+            # (reference: raylet releases CPU of blocked workers).
+            release = {k: v for k, v in ctx.resources.items() if k == "CPU"}
+        if release:
+            self.ledger.release(release)
+        try:
+            deadline = None if timeout is None else time.monotonic() + timeout
+            out = []
+            for ref in refs:
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.monotonic()))
+                value = self.store.get(ref.id(), remaining)
+                if isinstance(value, exceptions.RayTaskError):
+                    raise value.as_instanceof_cause()
+                if isinstance(value, exceptions.RayTpuError):
+                    raise value
+                out.append(value)
+            return out
+        finally:
+            if release:
+                self._reacquire(release)
+
+    def _reacquire(self, demand):
+        while not self.ledger.try_acquire(demand):
+            with self.ledger.cv:
+                self.ledger.cv.wait(timeout=0.05)
+
+    def wait(self, refs, num_returns, timeout, fetch_local):
+        ids = [r.id() for r in refs]
+        ready_ids, _ = self.store.wait(ids, num_returns, timeout)
+        ready_set = set(ready_ids)
+        ready = [r for r in refs if r.id() in ready_set]
+        not_ready = [r for r in refs if r.id() not in ready_set]
+        return ready, not_ready
+
+    def free(self, refs):
+        self.store.delete([r.id() for r in refs])
+
+    # ---------------------------------------------------------------- tasks
+    def submit_task(self, function, function_name, args, kwargs, options):
+        task_id = TaskID.for_normal_task(self.job_id)
+        nreturns = options.num_returns
+        return_ids = [ObjectID.from_task(task_id, i) for i in range(max(nreturns, 1))]
+        retries = options.max_retries
+        if retries is None:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            retries = GLOBAL_CONFIG.task_max_retries
+        demand = options.task_resources()
+
+        def on_ready(rargs, rkwargs):
+            self._enqueue(
+                lambda: self._run_task(function, function_name, rargs, rkwargs,
+                                       return_ids, task_id, retries, options,
+                                       demand),
+                demand, return_ids)
+
+        self._schedule_when_ready(args, kwargs, on_ready, return_ids)
+        return [ObjectRef(oid, owner_address="local") for oid in return_ids]
+
+    def _schedule_when_ready(self, args, kwargs, submit, return_ids):
+        """Resolve top-level ObjectRef args, then call ``submit``."""
+        deps: List[ObjectRef] = [a for a in args if isinstance(a, ObjectRef)]
+        deps += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+
+        def finish(rargs, rkwargs):
+            try:
+                submit(rargs, rkwargs)
+            except BaseException as e:  # noqa: BLE001
+                self._store_error(
+                    e if isinstance(e, exceptions.RayTpuError)
+                    else exceptions.RayTaskError.from_exception(e, "submit"),
+                    return_ids)
+
+        if not deps:
+            finish(args, kwargs)
+            return
+        pending = [len(deps)]
+        lock = threading.Lock()
+
+        def on_dep(_oid, _value):
+            with lock:
+                pending[0] -= 1
+                if pending[0] != 0:
+                    return
+            resolved: Dict[ObjectID, Any] = {}
+            failed = None
+            for d in deps:
+                v = self.store.get_if_ready(d.id())
+                if isinstance(v, (exceptions.RayTaskError, exceptions.RayTpuError)):
+                    failed = v
+                resolved[d.id()] = v
+            if failed is not None:
+                # Dependency failed -> propagate the error without executing.
+                self._store_error(failed, return_ids)
+                return
+            rargs = tuple(resolved[a.id()] if isinstance(a, ObjectRef) else a
+                          for a in args)
+            rkwargs = {k: (resolved[v.id()] if isinstance(v, ObjectRef) else v)
+                       for k, v in kwargs.items()}
+            finish(rargs, rkwargs)
+
+        for d in deps:
+            self.store.on_ready(d.id(), on_dep)
+
+    def _run_task(self, function, function_name, args, kwargs, return_ids,
+                  task_id, retries_left, options, demand, attempt=0):
+        retried = False
+        try:
+            if task_id in self._cancelled:
+                self._cancelled.discard(task_id)
+                self._store_error(exceptions.TaskCancelledError(task_id), return_ids)
+                return
+            token = _context.set(_TaskCtx(task_id, attempt=attempt,
+                                          name=function_name, resources=demand))
+            try:
+                result = function(*args, **kwargs)
+                if inspect.isgenerator(result):
+                    self._store_generator(result, return_ids, task_id)
+                else:
+                    self._store_results(result, return_ids)
+            except BaseException as e:  # noqa: BLE001
+                if _resolve_retry(e, options.retry_exceptions, retries_left):
+                    # Resources stay held across the immediate in-place retry.
+                    retried = True
+                    self.pool.submit(self._run_task, function, function_name,
+                                     args, kwargs, return_ids, task_id,
+                                     retries_left - 1, options, demand,
+                                     attempt + 1)
+                else:
+                    self._store_error(
+                        exceptions.RayTaskError.from_exception(
+                            e, function_name, task_id),
+                        return_ids)
+            finally:
+                _context.reset(token)
+        finally:
+            if not retried:
+                self.ledger.release(demand)
+                # Wake the dispatcher so freed resources admit pending tasks.
+                self._dispatch_queue.put(False)
+
+    def _store_results(self, result, return_ids: List[ObjectID]):
+        n = len(return_ids)
+        if n == 1:
+            self.store.put(return_ids[0], result)
+            return
+        if not isinstance(result, (tuple, list)) or len(result) != n:
+            err = exceptions.RayTpuError(
+                f"Task declared num_returns={n} but returned "
+                f"{type(result).__name__} of length "
+                f"{len(result) if isinstance(result, (tuple, list)) else 'n/a'}")
+            self._store_error(err, return_ids)
+            return
+        for oid, v in zip(return_ids, result):
+            self.store.put(oid, v)
+
+    def _store_generator(self, gen, return_ids: List[ObjectID], task_id):
+        # num_returns="streaming" is modeled as eager drain in local mode.
+        values = list(gen)
+        self._store_results(tuple(values) if len(return_ids) > 1 else values,
+                            return_ids)
+
+    def _store_error(self, err, return_ids: List[ObjectID]):
+        for oid in return_ids:
+            self.store.put(oid, err)
+
+    def cancel(self, ref: ObjectRef, force: bool, recursive: bool):
+        task_id = ref.task_id()
+        if self.store.contains(ref.id()):
+            return  # already finished; cancel is a no-op
+        self._cancelled.add(task_id)
+        # Pending (not yet dispatched) tasks observe the flag in _run_task and
+        # store TaskCancelledError; a task already running on a thread cannot
+        # be preempted in-process (the cluster runtime force-kills the worker).
+
+    # ---------------------------------------------------------------- actors
+    def create_actor(self, cls, args, kwargs, options) -> ActorID:
+        actor_id = ActorID.of(self.job_id)
+        name = options.name
+        ns = options.namespace or "default"
+        actor = _LocalActor(self, actor_id, cls, args, kwargs, options)
+        with self._lock:
+            if name:
+                key = (ns, name)
+                if key in self._named_actors:
+                    if options.get_if_exists:
+                        return self._named_actors[key]
+                    raise ValueError(f"Actor with name {name!r} already exists "
+                                     f"in namespace {ns!r}")
+                self._named_actors[key] = actor_id
+            self._actors[actor_id] = actor
+            self._actor_meta[actor_id] = {
+                "name": name or "", "namespace": ns, "class_name": cls.__name__,
+                "state": "STARTING", "pid": 0,
+            }
+        actor.start()
+        return actor_id
+
+    def _actor_started(self, actor_id):
+        with self._lock:
+            meta = self._actor_meta.get(actor_id)
+            if meta and meta["state"] == "STARTING":
+                meta["state"] = "ALIVE"
+
+    def _actor_died(self, actor_id, cause):
+        with self._lock:
+            meta = self._actor_meta.get(actor_id)
+            if meta:
+                meta["state"] = "DEAD"
+                key = (meta["namespace"], meta["name"])
+                if self._named_actors.get(key) == actor_id:
+                    del self._named_actors[key]
+
+    def submit_actor_task(self, actor_id, method_name, args, kwargs, options):
+        actor = self._actors.get(actor_id)
+        task_id = TaskID.for_actor_task(actor_id)
+        nreturns = max(options.num_returns, 1)
+        return_ids = [ObjectID.from_task(task_id, i) for i in range(nreturns)]
+        if actor is None:
+            self._store_error(
+                exceptions.ActorDiedError(actor_id, "Actor handle is invalid."),
+                return_ids)
+        else:
+            self._schedule_when_ready(
+                args, kwargs,
+                lambda rargs, rkwargs: actor.submit(method_name, rargs, rkwargs,
+                                                    return_ids, task_id),
+                return_ids)
+        return [ObjectRef(oid, owner_address="local") for oid in return_ids]
+
+    def kill_actor(self, actor_id, no_restart):
+        actor = self._actors.get(actor_id)
+        if actor is None:
+            return
+        actor._die(exceptions.ActorDiedError(
+            actor_id, f"Actor {actor_id.hex()} was killed via kill()."))
+
+    def get_named_actor(self, name: str, namespace: Optional[str]):
+        ns = namespace or "default"
+        if "/" in name:
+            ns, name = name.split("/", 1)
+        with self._lock:
+            actor_id = self._named_actors.get((ns, name))
+            if actor_id is None:
+                raise ValueError(f"Failed to look up actor {name!r} in "
+                                 f"namespace {ns!r}")
+            actor = self._actors[actor_id]
+        return actor_id, actor.cls, actor.options
+
+    def list_named_actors(self, all_namespaces: bool):
+        with self._lock:
+            if all_namespaces:
+                return [{"name": n, "namespace": ns} for ns, n in self._named_actors]
+            return [n for ns, n in self._named_actors if ns == "default"]
+
+    def actor_state(self, actor_id: ActorID) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._actor_meta.get(actor_id, {}))
+
+    # ---------------------------------------------------------------- misc
+    def as_future(self, ref: ObjectRef) -> Future:
+        fut: Future = Future()
+
+        def cb(_oid, value):
+            if isinstance(value, exceptions.RayTaskError):
+                fut.set_exception(value.as_instanceof_cause())
+            elif isinstance(value, exceptions.RayTpuError):
+                fut.set_exception(value)
+            else:
+                fut.set_result(value)
+
+        self.store.on_ready(ref.id(), cb)
+        return fut
+
+    def nodes(self):
+        return [{
+            "NodeID": self.node_id.hex(),
+            "Alive": True,
+            "NodeManagerAddress": self.node_ip,
+            "Resources": dict(self.ledger.total),
+            "alive": True,
+        }]
+
+    def cluster_resources(self):
+        return dict(self.ledger.total)
+
+    def available_resources(self):
+        return self.ledger.snapshot()
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self._dispatch_queue.put(None)
+        for actor in list(self._actors.values()):
+            actor.terminate()
+        self.pool.shutdown(wait=False)

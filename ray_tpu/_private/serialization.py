@@ -1,0 +1,163 @@
+"""Object serialization: cloudpickle + pickle-5 out-of-band buffers.
+
+Re-design of the reference serializer (reference: ``python/ray/_private/
+serialization.py`` + the vendored cloudpickle fork): values are pickled with
+protocol 5; large contiguous buffers (numpy arrays, jax host arrays, bytes)
+are split out as zero-copy out-of-band buffers so they can be written straight
+into the shared-memory store without an extra copy. ``ObjectRef`` instances
+nested inside a value are recorded so the owner can track borrowed references.
+
+Wire format of a serialized object:
+    [u32 meta_len][meta msgpack][u32 nbuf][u64 len_i ...][buf_0][buf_1]...
+meta = {"pickle": <bytes>, "refs": [ref binaries], "error": bool}
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any, List, Tuple
+
+import cloudpickle
+
+from ray_tpu import exceptions
+from ray_tpu._private.ids import ObjectID
+
+
+class SerializedObject:
+    """A pickled value plus its out-of-band buffers and contained ObjectRefs."""
+
+    __slots__ = ("pickled", "buffers", "contained_refs", "is_error")
+
+    def __init__(self, pickled: bytes, buffers: List[pickle.PickleBuffer],
+                 contained_refs: List[bytes], is_error: bool):
+        self.pickled = pickled
+        self.buffers = buffers
+        self.contained_refs = contained_refs
+        self.is_error = is_error
+
+    def total_bytes(self) -> int:
+        n = len(self.pickled)
+        for b in self.buffers:
+            n += memoryview(b).nbytes
+        return n
+
+    def to_bytes(self) -> bytes:
+        views = [memoryview(b).cast("B") for b in self.buffers]
+        header = struct.pack(
+            "<IBI", len(self.pickled), 1 if self.is_error else 0, len(views)
+        )
+        parts = [header, struct.pack("<I", len(self.contained_refs))]
+        for r in self.contained_refs:
+            parts.append(struct.pack("<I", len(r)))
+            parts.append(r)
+        for v in views:
+            parts.append(struct.pack("<Q", v.nbytes))
+        parts.append(self.pickled)
+        parts.extend(views)
+        return b"".join(parts)
+
+    def write_into(self, buf: memoryview) -> int:
+        data = self.to_bytes()
+        buf[: len(data)] = data
+        return len(data)
+
+    @staticmethod
+    def parse(data) -> "SerializedObject":
+        mv = memoryview(data)
+        plen, is_err, nbuf = struct.unpack_from("<IBI", mv, 0)
+        off = struct.calcsize("<IBI")
+        (nrefs,) = struct.unpack_from("<I", mv, off)
+        off += 4
+        refs = []
+        for _ in range(nrefs):
+            (rlen,) = struct.unpack_from("<I", mv, off)
+            off += 4
+            refs.append(bytes(mv[off : off + rlen]))
+            off += rlen
+        blens = []
+        for _ in range(nbuf):
+            (blen,) = struct.unpack_from("<Q", mv, off)
+            off += 8
+            blens.append(blen)
+        pickled = bytes(mv[off : off + plen])
+        off += plen
+        buffers = []
+        for blen in blens:
+            buffers.append(pickle.PickleBuffer(mv[off : off + blen]))
+            off += blen
+        return SerializedObject(pickled, buffers, refs, bool(is_err))
+
+
+_OOB_THRESHOLD = 4096  # buffers smaller than this are kept in-band
+
+
+class Serializer:
+    """Pickles/unpickles values, tracking nested ObjectRefs.
+
+    A fresh ``contained`` list is captured per call, so one Serializer instance
+    is safe to share within a worker (calls are not recursive across threads
+    holding state: state is per-invocation).
+    """
+
+    def __init__(self, ref_deserializer=None):
+        # Called with an ObjectRef binary when a ref is deserialized, so the
+        # runtime can register a borrowed reference.
+        self.ref_deserializer = ref_deserializer
+
+    def serialize(self, value: Any) -> SerializedObject:
+        from ray_tpu._private.object_ref import ObjectRef
+
+        contained: List[bytes] = []
+        buffers: List[pickle.PickleBuffer] = []
+
+        def buffer_callback(pb: pickle.PickleBuffer) -> bool:
+            if memoryview(pb).nbytes < _OOB_THRESHOLD:
+                return True  # keep small buffers in-band
+            buffers.append(pb)
+            return False
+
+        is_error = isinstance(value, exceptions.RayTaskError) or isinstance(
+            value, exceptions.RayTpuError
+        )
+
+        class _Pickler(cloudpickle.CloudPickler):
+            def persistent_id(self, obj):  # noqa: N802 (pickle API)
+                if isinstance(obj, ObjectRef):
+                    contained.append(obj.binary())
+                    return ("ray_tpu.ObjectRef", obj.binary(), obj.owner_address())
+                return None
+
+        import io
+
+        f = io.BytesIO()
+        p = _Pickler(f, protocol=5, buffer_callback=buffer_callback)
+        p.dump(value)
+        return SerializedObject(f.getvalue(), buffers, contained, is_error)
+
+    def deserialize(self, s: SerializedObject) -> Any:
+        serializer = self
+
+        class _Unpickler(pickle.Unpickler):
+            def persistent_load(self, pid):  # noqa: N802 (pickle API)
+                tag, binary, owner = pid
+                if tag != "ray_tpu.ObjectRef":
+                    raise pickle.UnpicklingError(f"unknown persistent id {tag!r}")
+                from ray_tpu._private.object_ref import ObjectRef
+
+                ref = ObjectRef(ObjectID(binary), owner_address=owner)
+                if serializer.ref_deserializer is not None:
+                    serializer.ref_deserializer(ref)
+                return ref
+
+        import io
+
+        up = _Unpickler(io.BytesIO(s.pickled), buffers=s.buffers)
+        return up.load()
+
+
+def serialize_error(exc: BaseException, function_name: str, task_id=None) -> Any:
+    """Wrap an executor-side exception as a storable RayTaskError value."""
+    if isinstance(exc, exceptions.RayTaskError):
+        return exc
+    return exceptions.RayTaskError.from_exception(exc, function_name, task_id)

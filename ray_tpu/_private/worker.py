@@ -1,0 +1,207 @@
+"""The per-process Worker singleton and the public init/get/put/wait surface.
+
+Re-design of the reference driver/worker plumbing (reference:
+``python/ray/_private/worker.py`` — ``init`` :1275, ``get`` :2636, global
+``Worker`` :427). The Worker owns a :class:`CoreRuntime`; in single-process
+mode that is a :class:`LocalRuntime`, in cluster mode a ``ClusterRuntime``
+connected to this node's daemon.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.runtime.interface import CoreRuntime
+
+_global_lock = threading.Lock()
+_global_worker: Optional["Worker"] = None
+
+
+class Worker:
+    def __init__(self, core: CoreRuntime, mode: str, namespace: str = "default"):
+        self.core = core
+        self.mode = mode  # "local" | "driver" | "worker"
+        self.namespace = namespace
+        self.session_name = f"session_{os.getpid()}"
+
+
+def global_worker() -> Worker:
+    w = _global_worker
+    if w is None:
+        # Auto-init like the reference does on first API use.
+        init()
+        w = _global_worker
+        assert w is not None
+    return w
+
+
+def global_worker_or_none() -> Optional[Worker]:
+    return _global_worker
+
+
+def is_initialized() -> bool:
+    return _global_worker is not None
+
+
+def init(
+    address: Optional[str] = None,
+    *,
+    num_cpus: Optional[float] = None,
+    num_gpus: Optional[float] = None,
+    num_tpus: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    namespace: Optional[str] = None,
+    ignore_reinit_error: bool = False,
+    _system_config: Optional[Dict[str, Any]] = None,
+    **kwargs,
+):
+    """Initialize the runtime.
+
+    ``address=None`` starts an in-process runtime (or a local cluster when
+    ``RAY_TPU_START_CLUSTER=1``); ``address="host:port"`` connects to an
+    existing cluster's control plane; ``address="auto"`` discovers one.
+    """
+    global _global_worker
+    with _global_lock:
+        if _global_worker is not None:
+            if ignore_reinit_error:
+                return RuntimeContextInfo(_global_worker)
+            raise RuntimeError(
+                "ray_tpu.init() has already been called. "
+                "Pass ignore_reinit_error=True to ignore.")
+
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        GLOBAL_CONFIG.initialize(_system_config)
+
+        if address is None and os.environ.get("RAY_TPU_ADDRESS"):
+            address = os.environ["RAY_TPU_ADDRESS"]
+
+        if address is None:
+            if num_cpus is None:
+                num_cpus = os.cpu_count() or 1
+            from ray_tpu._private.accelerators.tpu import TPUAcceleratorManager
+
+            if num_tpus is None:
+                num_tpus = TPUAcceleratorManager.detect_num_chips()
+            res = dict(resources or {})
+            if num_gpus:
+                res["GPU"] = float(num_gpus)
+            from ray_tpu._private.runtime.local import LocalRuntime
+
+            core: CoreRuntime = LocalRuntime(
+                num_cpus=num_cpus, num_tpus=num_tpus, resources=res)
+            mode = "local"
+        else:
+            from ray_tpu._private.runtime.cluster import ClusterRuntime
+
+            core = ClusterRuntime.connect(address, namespace=namespace or "default")
+            mode = "driver"
+
+        _global_worker = Worker(core, mode, namespace or "default")
+        atexit.register(shutdown)
+        return RuntimeContextInfo(_global_worker)
+
+
+class RuntimeContextInfo:
+    """Value returned by init(); mirrors the reference's ClientContext dict-ish."""
+
+    def __init__(self, worker: Worker):
+        self.worker = worker
+        self.address_info = {"node_id": getattr(worker.core, "node_id", None)}
+
+    def __getitem__(self, k):
+        return self.address_info[k]
+
+    def disconnect(self):
+        shutdown()
+
+
+def shutdown():
+    global _global_worker
+    with _global_lock:
+        w = _global_worker
+        if w is None:
+            return
+        _global_worker = None
+    try:
+        w.core.shutdown()
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------- public ops
+def put(value: Any, *, _owner=None) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put() on an ObjectRef is not allowed.")
+    return global_worker().core.put(value, _owner)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *,
+        timeout: Optional[float] = None):
+    is_single = isinstance(refs, ObjectRef)
+    if is_single:
+        refs = [refs]
+    refs = list(refs)
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(
+                f"get() expects ObjectRef or list of ObjectRefs, got {type(r)}")
+    values = global_worker().core.get(refs, timeout)
+    return values[0] if is_single else values
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: Optional[float] = None, fetch_local: bool = True):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs.")
+    refs = list(refs)
+    if len(set(refs)) != len(refs):
+        raise ValueError("wait() expects a list of unique ObjectRefs.")
+    if num_returns > len(refs):
+        raise ValueError(
+            f"num_returns ({num_returns}) exceeds number of refs ({len(refs)})")
+    if num_returns <= 0:
+        raise ValueError("num_returns must be > 0")
+    return global_worker().core.wait(refs, num_returns, timeout, fetch_local)
+
+
+def kill(actor, *, no_restart: bool = True):
+    from ray_tpu.actor import ActorHandle
+
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle.")
+    global_worker().core.kill_actor(actor._actor_id, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    if not isinstance(ref, ObjectRef):
+        raise TypeError("cancel() expects an ObjectRef.")
+    global_worker().core.cancel(ref, force, recursive)
+
+
+def get_actor(name: str, namespace: Optional[str] = None):
+    from ray_tpu.actor import ActorHandle
+
+    actor_id, cls, options = global_worker().core.get_named_actor(name, namespace)
+    return ActorHandle._from_actor_id(actor_id, cls, options)
+
+
+def list_named_actors(all_namespaces: bool = False):
+    return global_worker().core.list_named_actors(all_namespaces)
+
+
+def nodes() -> List[Dict[str, Any]]:
+    return global_worker().core.nodes()
+
+
+def cluster_resources() -> Dict[str, float]:
+    return global_worker().core.cluster_resources()
+
+
+def available_resources() -> Dict[str, float]:
+    return global_worker().core.available_resources()

@@ -1,0 +1,166 @@
+"""Public exception types of the ray_tpu framework.
+
+Re-design of the reference error model (reference: ``python/ray/exceptions.py``,
+``src/ray/common/status.h``): errors raised inside a remote task are captured,
+stored as the task's return object, and re-raised at ``ray_tpu.get`` on the
+caller, wrapped so the remote traceback is preserved.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class RayTaskError(RayTpuError):
+    """Wraps an exception raised inside a remote task/actor method.
+
+    Stored as the value of the task's return object; re-raised on ``get``.
+    The remote traceback string is carried so the user sees the real failure
+    site (reference: ``python/ray/exceptions.py::RayTaskError``).
+    """
+
+    def __init__(
+        self,
+        function_name: str = "",
+        traceback_str: str = "",
+        cause: Optional[BaseException] = None,
+        task_id=None,
+    ):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        self.task_id = task_id
+        super().__init__(function_name, traceback_str)
+
+    @classmethod
+    def from_exception(cls, exc: BaseException, function_name: str, task_id=None):
+        tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+        return cls(function_name=function_name, traceback_str=tb, cause=exc, task_id=task_id)
+
+    def as_instanceof_cause(self) -> BaseException:
+        """Return an exception that is-a instance of the original cause's class.
+
+        Allows ``except ValueError`` on the caller to catch a remote ValueError.
+        """
+        cause = self.cause
+        if cause is None:
+            return self
+        if isinstance(cause, RayTaskError):
+            return cause.as_instanceof_cause()
+
+        cause_cls = type(cause)
+        if issubclass(cause_cls, RayTpuError):
+            return cause
+        try:
+
+            class _cls(RayTaskError, cause_cls):  # type: ignore[misc, valid-type]
+                def __init__(self, inner: RayTaskError):
+                    self._inner = inner
+
+                def __getattr__(self, name):
+                    return getattr(self._inner, name)
+
+                def __str__(self):
+                    return str(self._inner)
+
+            _cls.__name__ = f"RayTaskError({cause_cls.__name__})"
+            _cls.__qualname__ = _cls.__name__
+            return _cls(self)
+        except TypeError:
+            return self
+
+    def __str__(self):
+        return (
+            f"{type(self).__name__}: task {self.function_name!r} failed\n"
+            f"{self.traceback_str}"
+        )
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before or during execution."""
+
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__(task_id)
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get`` did not complete within the requested timeout."""
+
+
+class ActorDiedError(RayTaskError):
+    """The actor died before or while executing the task (reference:
+    ``python/ray/exceptions.py::RayActorError``)."""
+
+    def __init__(self, actor_id=None, error_msg: str = "The actor died unexpectedly."):
+        self.actor_id = actor_id
+        self.error_msg = error_msg
+        self.function_name = ""
+        self.traceback_str = error_msg
+        self.cause = None
+        self.task_id = None
+        RayTpuError.__init__(self, error_msg)
+
+    def __str__(self):
+        return self.error_msg
+
+
+# Compatibility alias matching the reference public name.
+RayActorError = ActorDiedError
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """The object's value was lost and could not be reconstructed."""
+
+    def __init__(self, object_ref=None, message: str = ""):
+        self.object_ref = object_ref
+        super().__init__(message or f"Object {object_ref} was lost.")
+
+
+class ObjectReconstructionFailedError(ObjectLostError):
+    """Lineage reconstruction for a lost object failed (e.g. retries exhausted)."""
+
+
+class OwnerDiedError(ObjectLostError):
+    """The worker that owned this object died, taking its metadata with it."""
+
+
+class ObjectStoreFullError(RayTpuError):
+    """The local shared-memory object store is out of memory."""
+
+
+class OutOfMemoryError(RayTpuError):
+    """A worker was killed by the memory monitor to avoid node OOM."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Creating the runtime environment for a task/actor failed."""
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class NodeDiedError(RayTpuError):
+    """The node running the task/actor died."""
+
+
+class RaySystemError(RayTpuError):
+    """Internal framework failure (deserialization, protocol, ...)."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """An actor's pending call queue exceeded ``max_pending_calls``."""
+
+
+class AsyncioActorExit(RayTpuError):
+    """Internal: signals an async actor to exit."""

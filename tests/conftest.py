@@ -1,0 +1,40 @@
+"""Test configuration: force jax onto a virtual 8-device CPU mesh.
+
+Must set XLA flags before jax initializes its backends (mirrors the reference
+strategy of testing multi-node logic without hardware — SURVEY.md §4: in-process
+multi-"node" fixtures + fake topology providers).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    """In-process runtime, fresh per test (reference: conftest.py::ray_start_regular)."""
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    info = ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield info
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def shutdown_only():
+    import ray_tpu
+
+    yield None
+    ray_tpu.shutdown()
