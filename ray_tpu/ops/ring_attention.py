@@ -1,0 +1,137 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has no long-context support of its own (SURVEY.md §5 —
+"absent in the reference"); this module provides it natively, TPU-first:
+
+* :func:`ring_attention` — blockwise attention where K/V shards rotate
+  around the ``seq`` mesh axis via ``jax.lax.ppermute`` (nearest-neighbor on
+  the ICI torus) while each step's partial softmax is merged online. Peak
+  memory per chip is O((S/N)^2) logits + one in-flight K/V shard, and the
+  permute overlaps with the block compute (XLA schedules the collective
+  asynchronously).
+* :func:`ulysses_attention` — all-to-all head-scatter/seq-gather: resharding
+  [B, S/N, H, D] → [B, S, H/N, D], running dense (flash) attention on full
+  sequences for a subset of heads, and resharding back.
+
+Both are meant to be called INSIDE ``shard_map`` over a mesh with a ``seq``
+axis; :func:`ray_tpu.models` wires them into the flagship model when the
+mesh has seq > 1.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _block_attend(q, k, v, q_pos, k_pos, scale, causal):
+    """One blockwise partial-attention step.
+
+    q: [B, Sq, Hq, D]; k/v: [B, Sk, Hkv, D]; positions are global token ids.
+    Returns unnormalized (acc [B, Sq, Hq, D] f32, m, l [B, Sq, Hq, 1] f32).
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    group = hq // hkv
+    qg = q.reshape(b, sq, hkv, group, d)
+    s = jnp.einsum(
+        "bqhgd,bkhd->bqhgk", qg.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        mask = q_pos[:, None] >= k_pos[None, :]            # [Sq, Sk]
+        s = jnp.where(mask[None, :, None, None, :], s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)                 # [b,sq,hkv,g,1]
+    # Rows with no visible keys in this block: exp(-inf - -inf) guards.
+    m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(s - m_safe)
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bqhgk,bkhd->bqhgd", p, v.astype(jnp.float32))
+    return (
+        acc.reshape(b, sq, hq, d),
+        m_safe.reshape(b, sq, hq, 1),
+        l.reshape(b, sq, hq, 1),
+    )
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Ring attention over a sharded sequence axis (call inside shard_map).
+
+    ``q/k/v``: local shards [B, S_local, H, D] ([B, S_local, Hkv, D] for k/v);
+    the global sequence is the concatenation over ``axis_name`` in mesh order.
+    """
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    s_local = q.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    q_pos = my_idx * s_local + jnp.arange(s_local)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, i):
+        acc, m, l, k_cur, v_cur = carry
+        src_chunk = (my_idx - i) % axis_size
+        k_pos = src_chunk * s_local + jnp.arange(s_local)
+        a, m_blk, l_blk = _block_attend(q, k_cur, v_cur, q_pos, k_pos, scale, causal)
+        # Merge online-softmax partials. Blocks fully above the causal
+        # diagonal produce l_blk == 0 and contribute nothing.
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        acc = acc * alpha + a * beta
+        l = l * alpha + l_blk * beta
+        # Rotate K/V to the next ring position (nearest-neighbor on ICI).
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (acc, m_new, l, k_next, v_next), None
+
+    b, sq, hq, d = q.shape
+    init = (
+        jnp.zeros((b, sq, hq, d), jnp.float32),
+        jnp.full((b, sq, hq, 1), -jnp.inf, jnp.float32),
+        jnp.zeros((b, sq, hq, 1), jnp.float32),
+    )
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        step, init + (k, v), jnp.arange(axis_size)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    attn_fn=None,
+) -> jnp.ndarray:
+    """Ulysses sequence parallelism (call inside shard_map).
+
+    All-to-all converts the sequence sharding into a head sharding, dense
+    attention runs over the full sequence for H/N heads, and the result is
+    converted back. Requires both Hq and Hkv divisible by the axis size.
+    """
+    from ray_tpu.ops.attention import flash_attention
+
+    attn_fn = attn_fn or functools.partial(flash_attention)
+    # [B, S/N, H, D] -> [B, S, H/N, D]
+    qg = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    kg = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    vg = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    out = attn_fn(qg, kg, vg, causal=causal, scale=scale)
+    # [B, S, H/N, D] -> [B, S/N, H, D]
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)

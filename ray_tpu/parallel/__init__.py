@@ -1,0 +1,31 @@
+"""Mesh / sharding / parallelism primitives (TPU-native core of ray_tpu).
+
+Replaces the reference's NCCL-process-group world view (reference:
+``python/ray/util/collective``, ``python/ray/train/torch/config.py``) with
+named device meshes + GSPMD sharding rules + XLA collectives.
+"""
+
+from ray_tpu.parallel.mesh import (
+    MESH_AXES,
+    MeshConfig,
+    batch_axes,
+    make_mesh,
+    mesh_shape,
+    num_model_replicas,
+    single_device_mesh,
+)
+from ray_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    constrain,
+    logical_to_spec,
+    shard_tree,
+    tree_shardings,
+    tree_specs,
+)
+
+__all__ = [
+    "MESH_AXES", "MeshConfig", "batch_axes", "make_mesh", "mesh_shape",
+    "num_model_replicas", "single_device_mesh",
+    "DEFAULT_RULES", "constrain", "logical_to_spec", "shard_tree",
+    "tree_shardings", "tree_specs",
+]

@@ -1,0 +1,24 @@
+"""Host-tier collective communication (reference: ``python/ray/util/collective``)."""
+
+from ray_tpu.util.collective.collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_collective_group_size,
+    get_rank,
+    init_collective_group,
+    is_group_initialized,
+    recv,
+    reduce,
+    reducescatter,
+    send,
+)
+
+__all__ = [
+    "allgather", "allreduce", "barrier", "broadcast",
+    "destroy_collective_group", "get_collective_group_size", "get_rank",
+    "init_collective_group", "is_group_initialized", "recv", "reduce",
+    "reducescatter", "send",
+]

@@ -1,0 +1,253 @@
+"""Collective groups for ray_tpu (reference: ``python/ray/util/collective/collective.py``).
+
+Two tiers, mirroring the reference's NCCL/Gloo split but TPU-native:
+
+* **Device tier** (inside ``jit``/``shard_map``): collectives are XLA ops over
+  ICI — use :mod:`ray_tpu.parallel` meshes and ``jax.lax.psum/all_gather/...``
+  directly. Nothing to "initialize"; the mesh is the group.
+* **Host tier** (this module): CPU/numpy collectives between ray_tpu actors,
+  the Gloo-equivalent (reference ``gloo_collective_group.py``). Rendezvous is a
+  named store actor (reference ``nccl_collective_group.py:29``); data moves
+  through the object store. Used for coordinator-style reductions (metrics,
+  rendezvous, weight broadcast between actor groups), not the training hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_REDUCE_OPS = {
+    "sum": lambda xs: np.sum(xs, axis=0),
+    "product": lambda xs: np.prod(xs, axis=0),
+    "min": lambda xs: np.min(xs, axis=0),
+    "max": lambda xs: np.max(xs, axis=0),
+}
+
+
+class _RendezvousStore:
+    """Named actor used as the group rendezvous + data plane.
+
+    One instance per collective group; ranks post numpy buffers keyed by
+    (sequence-number, rank) and poll for peers' contributions.
+    """
+
+    def __init__(self, world_size: int):
+        self._world_size = world_size
+        self._buffers: Dict[str, Dict[int, object]] = {}
+        self._arrived: Dict[str, set] = {}
+
+    def put(self, seq: str, rank: int, value) -> None:
+        self._buffers.setdefault(seq, {})[rank] = value
+
+    def collect(self, seq: str, num: Optional[int] = None):
+        want = num if num is not None else self._world_size
+        bufs = self._buffers.get(seq, {})
+        if len(bufs) < want:
+            return None
+        return [bufs[r] for r in sorted(bufs)]
+
+    def arrive(self, seq: str, rank: int) -> int:
+        self._arrived.setdefault(seq, set()).add(rank)
+        return len(self._arrived[seq])
+
+    def gc(self, seq: str) -> None:
+        self._buffers.pop(seq, None)
+        self._arrived.pop(seq, None)
+
+    def world_size(self) -> int:
+        return self._world_size
+
+
+class CollectiveGroup:
+    """Per-process handle to one collective group (one per rank)."""
+
+    def __init__(self, name: str, world_size: int, rank: int, store):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self._store = store
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def _next_seq(self, op: str) -> str:
+        with self._lock:
+            self._seq += 1
+            return f"{op}:{self._seq}"
+
+    def _poll(self, fn, timeout_s: float = 120.0):
+        deadline = time.monotonic() + timeout_s
+        backoff = 0.0005
+        while True:
+            out = ray_tpu.get(fn())
+            if out is not None:
+                return out
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"collective on group {self.name!r} timed out")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.05)
+
+    # -- ops ---------------------------------------------------------------
+    def allreduce(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
+        parts = self.allgather(tensor)
+        return _REDUCE_OPS[op](np.stack(parts))
+
+    def allgather(self, tensor: np.ndarray) -> List[np.ndarray]:
+        seq = self._next_seq("ag")
+        ray_tpu.get(self._store.put.remote(seq, self.rank, np.asarray(tensor)))
+        out = self._poll(lambda: self._store.collect.remote(seq))
+        self._store.gc.remote(seq)
+        return out
+
+    def reduce(self, tensor: np.ndarray, dst_rank: int = 0, op: str = "sum"):
+        reduced = self.allreduce(tensor, op)
+        return reduced if self.rank == dst_rank else tensor
+
+    def broadcast(self, tensor: np.ndarray, src_rank: int = 0) -> np.ndarray:
+        seq = self._next_seq("bc")
+        if self.rank == src_rank:
+            ray_tpu.get(self._store.put.remote(seq, src_rank, np.asarray(tensor)))
+        out = self._poll(lambda: self._store.collect.remote(seq, 1))
+        self.barrier()
+        if self.rank == src_rank:
+            self._store.gc.remote(seq)
+        return out[0]
+
+    def reducescatter(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
+        reduced = self.allreduce(tensor, op)
+        return np.array_split(reduced, self.world_size)[self.rank]
+
+    def send(self, tensor: np.ndarray, dst_rank: int, tag: str = "") -> None:
+        ray_tpu.get(
+            self._store.put.remote(f"p2p:{self.rank}->{dst_rank}:{tag}",
+                                   self.rank, np.asarray(tensor))
+        )
+
+    def recv(self, src_rank: int, tag: str = "") -> np.ndarray:
+        seq = f"p2p:{src_rank}->{self.rank}:{tag}"
+        out = self._poll(lambda: self._store.collect.remote(seq, 1))
+        self._store.gc.remote(seq)
+        return out[0]
+
+    def barrier(self) -> None:
+        # arrive() is idempotent per rank; poll until everyone has arrived.
+        seq = self._next_seq("bar")
+        deadline = time.monotonic() + 120.0
+        while True:
+            n = ray_tpu.get(self._store.arrive.remote(seq, self.rank))
+            if n >= self.world_size:
+                return
+            if time.monotonic() > deadline:
+                raise TimeoutError("barrier timed out")
+            time.sleep(0.001)
+
+
+class GroupManager:
+    """Process-local registry of collective groups (reference ``collective.py:40``)."""
+
+    def __init__(self):
+        self._groups: Dict[str, CollectiveGroup] = {}
+        self._lock = threading.Lock()
+
+    def create_group(self, name: str, world_size: int, rank: int) -> CollectiveGroup:
+        store_name = f"__ray_tpu_collective_store__{name}"
+        store_cls = ray_tpu.remote(_RendezvousStore)
+        if rank == 0:
+            store = store_cls.options(name=store_name, lifetime="detached").remote(
+                world_size
+            )
+        else:
+            deadline = time.monotonic() + 60.0
+            while True:
+                try:
+                    store = ray_tpu.get_actor(store_name)
+                    break
+                except Exception:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.01)
+        group = CollectiveGroup(name, world_size, rank, store)
+        with self._lock:
+            self._groups[name] = group
+        return group
+
+    def get_group(self, name: str) -> CollectiveGroup:
+        try:
+            return self._groups[name]
+        except KeyError:
+            raise ValueError(f"collective group {name!r} is not initialized") from None
+
+    def destroy_group(self, name: str) -> None:
+        with self._lock:
+            group = self._groups.pop(name, None)
+        if group is not None and group.rank == 0:
+            try:
+                store = ray_tpu.get_actor(f"__ray_tpu_collective_store__{name}")
+                ray_tpu.kill(store)
+            except Exception:
+                pass
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(world_size: int, rank: int,
+                          group_name: str = "default") -> CollectiveGroup:
+    return _manager.create_group(group_name, world_size, rank)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _manager.destroy_group(group_name)
+
+
+def is_group_initialized(group_name: str = "default") -> bool:
+    try:
+        _manager.get_group(group_name)
+        return True
+    except ValueError:
+        return False
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.get_group(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get_group(group_name).world_size
+
+
+def allreduce(tensor, group_name: str = "default", op: str = "sum"):
+    return _manager.get_group(group_name).allreduce(tensor, op)
+
+
+def allgather(tensor, group_name: str = "default"):
+    return _manager.get_group(group_name).allgather(tensor)
+
+
+def reduce(tensor, dst_rank: int = 0, group_name: str = "default", op: str = "sum"):
+    return _manager.get_group(group_name).reduce(tensor, dst_rank, op)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    return _manager.get_group(group_name).broadcast(tensor, src_rank)
+
+
+def reducescatter(tensor, group_name: str = "default", op: str = "sum"):
+    return _manager.get_group(group_name).reducescatter(tensor, op)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", tag: str = ""):
+    return _manager.get_group(group_name).send(tensor, dst_rank, tag)
+
+
+def recv(src_rank: int, group_name: str = "default", tag: str = ""):
+    return _manager.get_group(group_name).recv(src_rank, tag)
+
+
+def barrier(group_name: str = "default"):
+    return _manager.get_group(group_name).barrier()

@@ -1,14 +1,16 @@
 """Test configuration: force jax onto a virtual 8-device CPU mesh.
 
-Must set XLA flags before jax initializes its backends (mirrors the reference
-strategy of testing multi-node logic without hardware — SURVEY.md §4: in-process
-multi-"node" fixtures + fake topology providers).
+Mirrors the reference strategy of testing multi-node logic without hardware
+(SURVEY.md §4: in-process multi-"node" fixtures + fake topology providers).
+The env vars alone are not enough when a PJRT plugin pins ``JAX_PLATFORMS``
+at interpreter startup (sitecustomize), so we also override via jax.config
+before any backend is initialized.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +18,13 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
 
 import pytest  # noqa: E402
 
@@ -38,3 +47,13 @@ def shutdown_only():
 
     yield None
     ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh8():
+    """8-device CPU mesh for sharding tests."""
+    from ray_tpu.parallel import MeshConfig, make_mesh
+
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual CPU devices, got {len(devices)}"
+    return make_mesh(MeshConfig(fsdp=-1), devices=devices)
