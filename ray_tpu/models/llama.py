@@ -1,0 +1,275 @@
+"""Flagship model: Llama-family decoder, pure JAX, GSPMD-sharded.
+
+This is the BASELINE.md north-star workload (Llama-2-7B fine-tune on a TPU
+pod). Where the reference framework hosts external engines for the model
+itself (SURVEY.md §2.3 — TP/PP arrive via vLLM / HF integrations), ray_tpu
+ships the model natively, TPU-first:
+
+* parameters are plain pytrees with a parallel pytree of *logical axis
+  names*; :mod:`ray_tpu.parallel.sharding` rules map them onto any mesh
+  (DP / FSDP / TP / SP hybrids are rule-table changes, not model changes);
+* the layer stack is a ``jax.lax.scan`` over stacked layer params (one
+  compiled layer body regardless of depth) with optional ``jax.checkpoint``
+  rematerialization;
+* attention auto-selects: pallas flash attention on a local sequence, ring
+  attention over the ``seq`` mesh axis when the sequence is context-parallel;
+* activations/params default to bfloat16 with fp32 logits/loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.ops.attention import flash_attention, mha_reference
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ray_tpu.parallel import constrain, mesh_shape
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # attention: "auto" | "flash" | "ring" | "reference"
+    attention: str = "auto"
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        return LlamaConfig(hidden_size=5120, intermediate_size=13824,
+                           num_layers=40, num_heads=40, num_kv_heads=40, **kw)
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(vocab_size=128256, hidden_size=4096,
+                           intermediate_size=14336, num_layers=32,
+                           num_heads=32, num_kv_heads=8,
+                           rope_theta=500000.0, max_seq_len=8192, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """CPU-runnable config for tests (BASELINE.md config #1 analog)."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 128)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("num_kv_heads", 2)
+        kw.setdefault("head_dim", 16)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("remat", False)
+        return LlamaConfig(**kw)
+
+
+def logical_axes(config: LlamaConfig) -> Params:
+    """Pytree of logical-axis tuples matching :func:`init_params`."""
+    layer = {
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
+    }
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": ("embed",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> Params:
+    """Random init (normal / scaled), stacked over layers for lax.scan."""
+    c = config
+    k_embed, k_head, k_layers = jax.random.split(key, 3)
+
+    def norm_init(*shape):
+        return jnp.ones(shape, c.dtype)
+
+    def dense_init(key, *shape, scale=None):
+        fan_in = shape[0] if len(shape) == 2 else int(jnp.prod(jnp.array(shape[:-1])))
+        scale = scale if scale is not None else fan_in ** -0.5
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(c.dtype)
+
+    keys = jax.random.split(k_layers, 7)
+    L, E, M = c.num_layers, c.hidden_size, c.intermediate_size
+    H, KV, D = c.num_heads, c.num_kv_heads, c.head_dim
+
+    def stacked(key, fan_in, *shape):
+        scale = fan_in ** -0.5
+        out = jax.random.normal(key, (L,) + shape, jnp.float32) * scale
+        return out.astype(c.dtype)
+
+    layers = {
+        "attn_norm": jnp.ones((L, E), c.dtype),
+        "wq": stacked(keys[0], E, E, H, D),
+        "wk": stacked(keys[1], E, E, KV, D),
+        "wv": stacked(keys[2], E, E, KV, D),
+        "wo": stacked(keys[3], H * D, H, D, E),
+        "mlp_norm": jnp.ones((L, E), c.dtype),
+        "w_gate": stacked(keys[4], E, E, M),
+        "w_up": stacked(keys[5], E, E, M),
+        "w_down": stacked(keys[6], M, M, E),
+    }
+    return {
+        "embed": dense_init(k_embed, c.vocab_size, E, scale=1.0),
+        "layers": layers,
+        "final_norm": jnp.ones((E,), c.dtype),
+        "lm_head": dense_init(k_head, E, c.vocab_size),
+    }
+
+
+def _select_attention(config: LlamaConfig, mesh: Optional[Mesh]):
+    mode = config.attention
+    if mode == "auto":
+        if mesh is not None and not mesh.empty and mesh_shape(mesh).get("seq", 1) > 1:
+            mode = "ring"
+        else:
+            mode = "flash"
+    return mode
+
+
+def _attend(q, k, v, config: LlamaConfig, mesh: Optional[Mesh]):
+    mode = _select_attention(config, mesh)
+    if mode == "reference":
+        return mha_reference(q, k, v, causal=True)
+    if mode == "ring":
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        qspec = P(("data", "fsdp"), "seq", "tensor", None)
+        kvspec = P(("data", "fsdp"), "seq", "tensor", None)
+        fn = shard_map(
+            functools.partial(ring_attention, axis_name="seq", causal=True),
+            mesh=mesh,
+            in_specs=(qspec, kvspec, kvspec),
+            out_specs=qspec,
+            check_vma=False,
+        )
+        return fn(q, k, v)
+    return flash_attention(q, k, v, causal=True)
+
+
+def forward(
+    params: Params,
+    tokens: jnp.ndarray,
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """Compute logits [B, S, V] (fp32) for int32 tokens [B, S]."""
+    c = config
+    seq_len = tokens.shape[1]
+    cos, sin = rope_frequencies(c.head_dim, seq_len, c.rope_theta)
+
+    x = params["embed"].astype(c.dtype)[tokens]
+    x = constrain(x, mesh, "batch", "seq", "act_embed") if mesh is not None else x
+
+    def layer_fn(x, layer):
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(c.dtype))
+        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(c.dtype))
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(c.dtype))
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if mesh is not None:
+            q = constrain(q, mesh, "batch", "seq", "act_heads", None)
+            k = constrain(k, mesh, "batch", "seq", "act_kv_heads", None)
+            v = constrain(v, mesh, "batch", "seq", "act_kv_heads", None)
+        o = _attend(q, k, v, c, mesh)
+        o = jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(c.dtype))
+        x = x + o
+        if mesh is not None:
+            x = constrain(x, mesh, "batch", "seq", "act_embed")
+
+        h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+        gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(c.dtype))
+        up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
+        act = jax.nn.silu(gate) * up
+        if mesh is not None:
+            act = constrain(act, mesh, "batch", "seq", "act_mlp")
+        down = jnp.einsum("bsm,me->bse", act, layer["w_down"].astype(c.dtype))
+        x = x + down
+        if mesh is not None:
+            x = constrain(x, mesh, "batch", "seq", "act_embed")
+        return x, None
+
+    body = layer_fn
+    if c.remat:
+        body = jax.checkpoint(
+            layer_fn,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        )
+    x, _ = jax.lax.scan(lambda carry, lp: body(carry, lp), x, params["layers"])
+
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = jnp.einsum(
+        "bse,ev->bsv", x.astype(jnp.float32), params["lm_head"].astype(jnp.float32)
+    )
+    if mesh is not None:
+        logits = constrain(logits, mesh, "batch", "seq", "act_vocab")
+    return logits
+
+
+def loss_fn(
+    params: Params,
+    batch: Dict[str, jnp.ndarray],
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Next-token cross-entropy. batch: {"tokens": [B,S] int32, "mask": [B,S]}."""
+    tokens = batch["tokens"]
+    mask = batch.get("mask")
+    logits = forward(params, tokens, config, mesh)
+    targets = tokens[:, 1:]
+    logits = logits[:, :-1]
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        m = mask[:, 1:].astype(jnp.float32)
+    else:
+        m = jnp.ones_like(nll)
+    total = jnp.maximum(jnp.sum(m), 1.0)
+    loss = jnp.sum(nll * m) / total
+    acc = jnp.sum((jnp.argmax(logits, -1) == targets) * m) / total
+    return loss, {"loss": loss, "accuracy": acc, "tokens": total}
+
+
+def num_params(config: LlamaConfig) -> int:
+    c = config
+    per_layer = (
+        2 * c.hidden_size
+        + c.hidden_size * c.num_heads * c.head_dim * 2
+        + c.hidden_size * c.num_kv_heads * c.head_dim * 2
+        + 3 * c.hidden_size * c.intermediate_size
+    )
+    return (
+        c.vocab_size * c.hidden_size * 2
+        + c.hidden_size
+        + c.num_layers * per_layer
+    )

@@ -1,0 +1,200 @@
+"""Sharded training step for ray_tpu models.
+
+Builds the jitted GSPMD train step the Train library and the benchmarks run:
+parameters/optimizer state are sharded by the logical-axis rule table
+(:mod:`ray_tpu.parallel.sharding`), the batch is sharded over the data axes,
+and XLA inserts all collectives (reduce-scatter/all-gather for FSDP, psum for
+DP) — the TPU-native equivalent of the reference's DDP/FSDP wrappers
+(reference: ``python/ray/train/torch/train_loop_utils.py:162-201``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.models import llama
+from ray_tpu.parallel import sharding as shd
+
+
+@dataclasses.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    opt_state: Any
+
+    def tree_flatten(self):  # pragma: no cover - registered below
+        return (self.step, self.params, self.opt_state), None
+
+
+jax.tree_util.register_pytree_node(
+    TrainState,
+    lambda s: ((s.step, s.params, s.opt_state), None),
+    lambda _, c: TrainState(step=c[0], params=c[1], opt_state=c[2]),
+)
+
+
+def _spec_tree_for_state(state_shapes, params_treedef, param_specs):
+    """Map PartitionSpecs onto an arbitrary (optax) state pytree.
+
+    Any subtree structurally identical to the params pytree gets the param
+    specs (optimizer moments mirror params); every other leaf is replicated.
+    """
+
+    def visit(node):
+        try:
+            if jax.tree.structure(node) == params_treedef:
+                return param_specs
+        except Exception:
+            pass
+        if hasattr(node, "_fields"):  # namedtuple (optax states)
+            return type(node)(*[visit(x) for x in node])
+        if isinstance(node, tuple):
+            return tuple(visit(x) for x in node)
+        if isinstance(node, list):
+            return [visit(x) for x in node]
+        if isinstance(node, dict):
+            return {k: visit(v) for k, v in node.items()}
+        return P()  # scalar leaf (e.g. count) — replicated
+
+    return visit(state_shapes)
+
+
+def default_optimizer(
+    learning_rate: float = 3e-4,
+    weight_decay: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.95,
+    grad_clip: float = 1.0,
+    warmup_steps: int = 100,
+    total_steps: int = 10000,
+) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(grad_clip),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+    )
+
+
+class ShardedTrainer:
+    """Compiled sharded train step + state management for one model family.
+
+    ``rules`` defaults to :data:`ray_tpu.parallel.sharding.DEFAULT_RULES`
+    (FSDP on embed, TP on heads/mlp/vocab, batch over (data, fsdp)).
+    """
+
+    def __init__(
+        self,
+        config: llama.LlamaConfig,
+        mesh: Mesh,
+        optimizer: Optional[optax.GradientTransformation] = None,
+        rules: Optional[shd.LogicalRules] = None,
+    ):
+        self.config = config
+        self.mesh = mesh
+        self.rules = rules
+        self.optimizer = optimizer or default_optimizer()
+
+        axes = llama.logical_axes(config)
+        self.param_specs = shd.tree_specs(axes, rules)
+        self.param_shardings = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), self.param_specs
+        )
+        self.batch_spec = P(("data", "fsdp"))
+        self.batch_sharding = NamedSharding(mesh, self.batch_spec)
+        self._build()
+
+    def _build(self):
+        config, mesh, optimizer = self.config, self.mesh, self.optimizer
+
+        def init_fn(key):
+            params = llama.init_params(config, key)
+            params = jax.tree.map(
+                jax.lax.with_sharding_constraint, params, self.param_shardings
+            )
+            opt_state = optimizer.init(params)
+            return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                              opt_state=opt_state)
+
+        # Derive opt-state shardings structurally, then jit init with explicit
+        # output shardings so even the first state materializes sharded
+        # (never a full replica per host).
+        state_shapes = jax.eval_shape(init_fn, jax.random.PRNGKey(0))
+        params_treedef = jax.tree.structure(
+            jax.eval_shape(functools.partial(llama.init_params, config),
+                           jax.random.PRNGKey(0))
+        )
+        opt_specs = _spec_tree_for_state(
+            state_shapes.opt_state, params_treedef, self.param_specs
+        )
+        self.state_shardings = TrainState(
+            step=NamedSharding(mesh, P()),
+            params=self.param_shardings,
+            opt_state=jax.tree.map(
+                lambda s: NamedSharding(mesh, s), opt_specs,
+                is_leaf=lambda x: isinstance(x, P),
+            ),
+        )
+        self._init = jax.jit(init_fn, out_shardings=self.state_shardings)
+
+        def step_fn(state: TrainState, batch: Dict[str, jnp.ndarray]):
+            def loss(params):
+                return llama.loss_fn(params, batch, config, mesh)
+
+            (loss_val, metrics), grads = jax.value_and_grad(
+                loss, has_aux=True
+            )(state.params)
+            updates, new_opt = optimizer.update(
+                grads, state.opt_state, state.params
+            )
+            new_params = optax.apply_updates(state.params, updates)
+            new_params = jax.tree.map(
+                jax.lax.with_sharding_constraint, new_params, self.param_shardings
+            )
+            new_state = TrainState(
+                step=state.step + 1, params=new_params, opt_state=new_opt
+            )
+            metrics = dict(metrics)
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return new_state, metrics
+
+        self._step = jax.jit(
+            step_fn,
+            in_shardings=(self.state_shardings,
+                          {"tokens": self.batch_sharding,
+                           "mask": self.batch_sharding}),
+            out_shardings=(self.state_shardings, None),
+            donate_argnums=(0,),
+        )
+
+    # -- public API --------------------------------------------------------
+    def init_state(self, seed: int = 0) -> TrainState:
+        with self.mesh:
+            return self._init(jax.random.PRNGKey(seed))
+
+    def train_step(
+        self, state: TrainState, batch: Dict[str, jnp.ndarray]
+    ) -> Tuple[TrainState, Dict[str, jnp.ndarray]]:
+        with self.mesh:
+            return self._step(state, batch)
+
+    def shard_batch(self, batch: Dict[str, jnp.ndarray]):
+        return jax.tree.map(
+            lambda x: jax.device_put(x, self.batch_sharding), batch
+        )
+
+
+def synthetic_batch(
+    batch_size: int, seq_len: int, vocab_size: int, seed: int = 0
+) -> Dict[str, jnp.ndarray]:
+    key = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(key, (batch_size, seq_len), 0, vocab_size, jnp.int32)
+    return {"tokens": tokens, "mask": jnp.ones_like(tokens)}

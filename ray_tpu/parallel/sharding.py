@@ -35,17 +35,25 @@ LogicalRules = Tuple[Tuple[str, Union[str, Tuple[str, ...], None]], ...]
 # batch over (data, fsdp), sequence over seq. This is the Llama-2-7B
 # "FSDP + optional TP" north-star layout (BASELINE.md) expressed as rules.
 DEFAULT_RULES: LogicalRules = (
-    ("batch", ("data", "fsdp")),
-    ("seq", "seq"),
+    # parameter axes
     ("embed", "fsdp"),
     ("mlp", "tensor"),
     ("heads", "tensor"),
     ("kv_heads", "tensor"),
     ("head_dim", None),
     ("vocab", "tensor"),
-    ("kv_seq", None),
     ("experts", "expert"),
     ("layers", None),
+    # activation axes (distinct from param axes: an activation's feature dim
+    # stays unsharded on the fsdp axis — fsdp gathers params for compute)
+    ("batch", ("data", "fsdp")),
+    ("seq", "seq"),
+    ("kv_seq", None),
+    ("act_embed", None),
+    ("act_mlp", "tensor"),
+    ("act_heads", "tensor"),
+    ("act_kv_heads", "tensor"),
+    ("act_vocab", "tensor"),
 )
 
 
