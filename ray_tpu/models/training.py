@@ -40,6 +40,26 @@ jax.tree_util.register_pytree_node(
 )
 
 
+def _divisible_spec(spec: P, shape: Tuple[int, ...], mesh: Mesh) -> P:
+    """Remove mesh axes from a PartitionSpec where they don't divide the dim."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def axis_size(entry) -> int:
+        names = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for name in names:
+            n *= sizes.get(name, 1)
+        return n
+
+    fixed = []
+    for dim, entry in zip(shape, tuple(spec) + (None,) * (len(shape) - len(spec))):
+        if entry is None or dim % axis_size(entry) == 0:
+            fixed.append(entry)
+        else:
+            fixed.append(None)
+    return P(*fixed)
+
+
 def _spec_tree_for_state(state_shapes, params_treedef, param_specs):
     """Map PartitionSpecs onto an arbitrary (optax) state pytree.
 
@@ -104,7 +124,17 @@ class ShardedTrainer:
         self.optimizer = optimizer or default_optimizer()
 
         axes = llama.logical_axes(config)
-        self.param_specs = shd.tree_specs(axes, rules)
+        param_specs = shd.tree_specs(axes, rules)
+        param_shapes = jax.eval_shape(
+            functools.partial(llama.init_params, config), jax.random.PRNGKey(0)
+        )
+        # Drop mesh axes that do not divide the corresponding dim (e.g. 2 kv
+        # heads on a tensor=4 mesh): those dims stay replicated, matching
+        # GSPMD's divisibility requirement.
+        self.param_specs = jax.tree.map(
+            lambda spec, shape: _divisible_spec(spec, shape.shape, mesh),
+            param_specs, param_shapes,
+        )
         self.param_shardings = jax.tree.map(
             lambda s: NamedSharding(mesh, s), self.param_specs
         )

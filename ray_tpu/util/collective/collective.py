@@ -10,6 +10,11 @@ Two tiers, mirroring the reference's NCCL/Gloo split but TPU-native:
   named store actor (reference ``nccl_collective_group.py:29``); data moves
   through the object store. Used for coordinator-style reductions (metrics,
   rendezvous, weight broadcast between actor groups), not the training hot path.
+
+Every op is built on a gc-safe gather in the store actor: buffers for a
+sequence number are deleted only after every expected reader has consumed
+them, so fast ranks can never garbage-collect a round out from under slow
+ranks.
 """
 
 from __future__ import annotations
@@ -31,41 +36,46 @@ _REDUCE_OPS = {
 
 
 class _RendezvousStore:
-    """Named actor used as the group rendezvous + data plane.
-
-    One instance per collective group; ranks post numpy buffers keyed by
-    (sequence-number, rank) and poll for peers' contributions.
-    """
+    """Named actor used as the group rendezvous + data plane."""
 
     def __init__(self, world_size: int):
         self._world_size = world_size
         self._buffers: Dict[str, Dict[int, object]] = {}
-        self._arrived: Dict[str, set] = {}
+        self._reads: Dict[str, set] = {}
 
     def put(self, seq: str, rank: int, value) -> None:
         self._buffers.setdefault(seq, {})[rank] = value
 
-    def collect(self, seq: str, num: Optional[int] = None):
+    def collect(self, seq: str, reader: int, num: Optional[int] = None,
+                num_readers: Optional[int] = None):
+        """Return all contributions once ``num`` arrived, else None.
+
+        The entry is deleted only after ``num_readers`` distinct readers have
+        received it.
+        """
         want = num if num is not None else self._world_size
         bufs = self._buffers.get(seq, {})
         if len(bufs) < want:
             return None
-        return [bufs[r] for r in sorted(bufs)]
-
-    def arrive(self, seq: str, rank: int) -> int:
-        self._arrived.setdefault(seq, set()).add(rank)
-        return len(self._arrived[seq])
-
-    def gc(self, seq: str) -> None:
-        self._buffers.pop(seq, None)
-        self._arrived.pop(seq, None)
+        out = [bufs[r] for r in sorted(bufs)]
+        reads = self._reads.setdefault(seq, set())
+        reads.add(reader)
+        if len(reads) >= (num_readers if num_readers is not None
+                          else self._world_size):
+            del self._buffers[seq]
+            del self._reads[seq]
+        return out
 
     def world_size(self) -> int:
         return self._world_size
 
 
 class CollectiveGroup:
-    """Per-process handle to one collective group (one per rank)."""
+    """Per-process handle to one collective group (one per rank).
+
+    All ranks must issue the same sequence of collective ops (the standard
+    collective-programming contract); sequence numbers align rounds.
+    """
 
     def __init__(self, name: str, world_size: int, rank: int, store):
         self.name = name
@@ -92,59 +102,52 @@ class CollectiveGroup:
             time.sleep(backoff)
             backoff = min(backoff * 2, 0.05)
 
+    def _gather_round(self, value, contribute: bool = True) -> List:
+        seq = self._next_seq("rnd")
+        if contribute:
+            ray_tpu.get(self._store.put.remote(seq, self.rank, value))
+        return self._poll(
+            lambda: self._store.collect.remote(seq, self.rank)
+        )
+
     # -- ops ---------------------------------------------------------------
+    def allgather(self, tensor: np.ndarray) -> List[np.ndarray]:
+        return self._gather_round(np.asarray(tensor))
+
     def allreduce(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
         parts = self.allgather(tensor)
         return _REDUCE_OPS[op](np.stack(parts))
-
-    def allgather(self, tensor: np.ndarray) -> List[np.ndarray]:
-        seq = self._next_seq("ag")
-        ray_tpu.get(self._store.put.remote(seq, self.rank, np.asarray(tensor)))
-        out = self._poll(lambda: self._store.collect.remote(seq))
-        self._store.gc.remote(seq)
-        return out
 
     def reduce(self, tensor: np.ndarray, dst_rank: int = 0, op: str = "sum"):
         reduced = self.allreduce(tensor, op)
         return reduced if self.rank == dst_rank else tensor
 
     def broadcast(self, tensor: np.ndarray, src_rank: int = 0) -> np.ndarray:
-        seq = self._next_seq("bc")
-        if self.rank == src_rank:
-            ray_tpu.get(self._store.put.remote(seq, src_rank, np.asarray(tensor)))
-        out = self._poll(lambda: self._store.collect.remote(seq, 1))
-        self.barrier()
-        if self.rank == src_rank:
-            self._store.gc.remote(seq)
-        return out[0]
+        # Implemented as a gather of (rank == src contributions); every rank
+        # participates in the round so sequence numbers stay aligned.
+        parts = self.allgather(
+            np.asarray(tensor) if self.rank == src_rank else np.zeros(0, np.int8)
+        )
+        # parts are ordered by rank; src's contribution is at src_rank.
+        return parts[src_rank]
 
     def reducescatter(self, tensor: np.ndarray, op: str = "sum") -> np.ndarray:
         reduced = self.allreduce(tensor, op)
         return np.array_split(reduced, self.world_size)[self.rank]
 
+    def barrier(self) -> None:
+        self._gather_round(np.zeros(0, np.int8))
+
     def send(self, tensor: np.ndarray, dst_rank: int, tag: str = "") -> None:
-        ray_tpu.get(
-            self._store.put.remote(f"p2p:{self.rank}->{dst_rank}:{tag}",
-                                   self.rank, np.asarray(tensor))
-        )
+        seq = f"p2p:{self.rank}->{dst_rank}:{tag}"
+        ray_tpu.get(self._store.put.remote(seq, self.rank, np.asarray(tensor)))
 
     def recv(self, src_rank: int, tag: str = "") -> np.ndarray:
         seq = f"p2p:{src_rank}->{self.rank}:{tag}"
-        out = self._poll(lambda: self._store.collect.remote(seq, 1))
-        self._store.gc.remote(seq)
+        out = self._poll(
+            lambda: self._store.collect.remote(seq, self.rank, 1, 1)
+        )
         return out[0]
-
-    def barrier(self) -> None:
-        # arrive() is idempotent per rank; poll until everyone has arrived.
-        seq = self._next_seq("bar")
-        deadline = time.monotonic() + 120.0
-        while True:
-            n = ray_tpu.get(self._store.arrive.remote(seq, self.rank))
-            if n >= self.world_size:
-                return
-            if time.monotonic() > deadline:
-                raise TimeoutError("barrier timed out")
-            time.sleep(0.001)
 
 
 class GroupManager:

@@ -1,0 +1,82 @@
+"""Tests for host-tier collective groups (reference: test_collective_*.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective
+
+
+@pytest.fixture
+def ray4():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class Worker:
+    def __init__(self, rank, world_size, group="g"):
+        self.rank = rank
+        self.group = collective.init_collective_group(world_size, rank, group)
+
+    def allreduce(self, value):
+        return self.group.allreduce(np.asarray(value, np.float32))
+
+    def broadcast(self, value):
+        return self.group.broadcast(np.asarray(value, np.float32), src_rank=0)
+
+    def allgather(self, value):
+        return self.group.allgather(np.asarray(value, np.float32))
+
+    def reducescatter(self, value):
+        return self.group.reducescatter(np.asarray(value, np.float32))
+
+    def p2p(self, peer, send_first):
+        if send_first:
+            self.group.send(np.full((4,), self.rank, np.float32), peer)
+            return None
+        return self.group.recv(peer)
+
+
+def _spawn(n):
+    return [Worker.remote(i, n) for i in range(n)]
+
+
+def test_allreduce(ray4):
+    workers = _spawn(4)
+    outs = ray_tpu.get([w.allreduce.remote([float(i)] * 3) for i, w in enumerate(workers)])
+    for out in outs:
+        np.testing.assert_allclose(out, np.full((3,), 0.0 + 1 + 2 + 3))
+
+
+def test_broadcast(ray4):
+    workers = _spawn(3)
+    outs = ray_tpu.get([w.broadcast.remote([float(i + 1)] * 2) for i, w in enumerate(workers)])
+    for out in outs:
+        np.testing.assert_allclose(out, np.full((2,), 1.0))
+
+
+def test_allgather(ray4):
+    workers = _spawn(3)
+    outs = ray_tpu.get([w.allgather.remote([float(i)]) for i, w in enumerate(workers)])
+    for out in outs:
+        np.testing.assert_allclose(np.concatenate(out), [0.0, 1.0, 2.0])
+
+
+def test_reducescatter(ray4):
+    workers = _spawn(2)
+    outs = ray_tpu.get([w.reducescatter.remote([float(i), float(i)]) for i, w in enumerate(workers)])
+    np.testing.assert_allclose(outs[0], [1.0])
+    np.testing.assert_allclose(outs[1], [1.0])
+
+
+def test_send_recv(ray4):
+    workers = _spawn(2)
+    r0 = workers[0].p2p.remote(1, True)
+    r1 = workers[1].p2p.remote(0, False)
+    out = ray_tpu.get(r1)
+    np.testing.assert_allclose(out, np.zeros(4))
+    ray_tpu.get(r0)
