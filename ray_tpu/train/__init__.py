@@ -1,0 +1,35 @@
+"""ray_tpu.train: distributed training library (reference: ``python/ray/train``).
+
+TorchTrainer-shaped API whose backend is jax: workers jointly run one SPMD
+program over a device mesh; DP/FSDP/TP/SP are sharding-rule choices
+(:mod:`ray_tpu.models.training`), not module wrappers.
+"""
+
+from ray_tpu.train.backend_executor import (
+    BackendExecutor,
+    JaxBackend,
+    TrainWorker,
+    WorkerGroup,
+)
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    load_pytree,
+    save_pytree,
+)
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.trainer import JaxTrainer
+
+__all__ = [
+    "BackendExecutor", "Checkpoint", "CheckpointConfig", "CheckpointManager",
+    "FailureConfig", "JaxBackend", "JaxTrainer", "Result", "RunConfig",
+    "ScalingConfig", "TrainWorker", "WorkerGroup", "get_checkpoint",
+    "get_context", "load_pytree", "report", "save_pytree",
+]

@@ -1,0 +1,168 @@
+"""Worker group + backend executor for distributed training.
+
+Reference call stack (SURVEY.md §3.4): ``BackendExecutor.start``
+(``train/_internal/backend_executor.py:142``) creates a placement group,
+spawns N worker actors (``_internal/worker_group.py``), shares accelerator
+visibility among colocated workers, assigns ranks, runs
+``train_loop_per_worker`` and polls a session queue for results.
+
+TPU-native differences:
+
+* ``JaxBackend.on_start`` is where multi-host SPMD bootstrap happens
+  (``jax.distributed.initialize`` with a coordinator chosen from worker 0 —
+  the analog of the reference's MASTER_ADDR + ``dist.init_process_group``,
+  ``train/torch/config.py:153``). In single-process runtimes it is a no-op.
+* Accelerator visibility shares ``TPU_VISIBLE_CHIPS`` (the reference shares
+  ``CUDA_VISIBLE_DEVICES``, ``backend_executor.py:278``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+
+logger = logging.getLogger(__name__)
+
+
+class TrainWorker:
+    """Actor hosting one training process (reference: ``RayTrainWorker``)."""
+
+    def __init__(self, world_rank: int, world_size: int, local_rank: int,
+                 local_world_size: int, env: Optional[Dict[str, str]] = None):
+        self.rank = world_rank
+        for k, v in (env or {}).items():
+            os.environ[k] = v
+        self._ctx = session_mod.TrainContext(
+            world_rank, world_size, local_rank, local_world_size)
+        self._session: Optional[session_mod._Session] = None
+        self._lock = threading.Lock()
+
+    def setup(self, env: Dict[str, str]):
+        for k, v in env.items():
+            os.environ[k] = v
+        return True
+
+    def node_ip(self) -> str:
+        return "127.0.0.1"
+
+    def run(self, fn: Callable, config: Optional[Dict[str, Any]],
+            restore_checkpoint_path: Optional[str]):
+        """Run the user train loop to completion (blocking actor call)."""
+        ckpt = (Checkpoint(restore_checkpoint_path)
+                if restore_checkpoint_path else None)
+        s = session_mod._Session(self._ctx, ckpt)
+        with self._lock:
+            self._session = s
+        session_mod._set_session(s)
+        try:
+            s.result = fn(config) if config is not None else fn()
+            return s.result
+        finally:
+            s.finished.set()
+            session_mod._set_session(None)
+
+    def poll(self) -> Dict[str, Any]:
+        """Drain pending reports (runs concurrently with ``run``)."""
+        with self._lock:
+            s = self._session
+        if s is None:
+            return {"reports": [], "finished": False}
+        reports = []
+        while True:
+            try:
+                r = s.reports.get_nowait()
+            except queue.Empty:
+                break
+            # Checkpoints cross the actor boundary as paths.
+            if r.get("checkpoint") is not None:
+                r = dict(r, checkpoint_path=r.pop("checkpoint").path)
+            reports.append(r)
+        return {"reports": reports, "finished": s.finished.is_set()}
+
+
+class WorkerGroup:
+    """Reference: ``train/_internal/worker_group.py``."""
+
+    def __init__(self, scaling: ScalingConfig):
+        self.scaling = scaling
+        worker_cls = ray_tpu.remote(TrainWorker)
+        n = scaling.num_workers
+        self.workers = [
+            worker_cls.options(
+                num_cpus=scaling.worker_resources().get("CPU", 1),
+                resources={k: v for k, v in scaling.worker_resources().items()
+                           if k not in ("CPU", "GPU")},
+                max_concurrency=2,  # run() + poll() concurrently
+            ).remote(rank, n, rank, n)
+            for rank in range(n)
+        ]
+
+    def execute(self, method: str, *args, **kwargs) -> List[Any]:
+        return ray_tpu.get(
+            [getattr(w, method).remote(*args, **kwargs) for w in self.workers]
+        )
+
+    def execute_async(self, method: str, *args, **kwargs):
+        return [getattr(w, method).remote(*args, **kwargs) for w in self.workers]
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []
+
+
+class JaxBackend:
+    """Backend plugin (reference ABC: ``train/backend.py``)."""
+
+    def on_start(self, worker_group: WorkerGroup, scaling: ScalingConfig):
+        # Multi-host bootstrap: worker 0 is the jax.distributed coordinator.
+        # In the in-process runtime all workers share one jax client, so the
+        # only thing to share is TPU visibility (reference shares
+        # CUDA_VISIBLE_DEVICES across colocated workers).
+        env = {"RAY_TPU_TRAIN_WORLD_SIZE": str(scaling.num_workers)}
+        worker_group.execute("setup", env)
+
+    def on_shutdown(self, worker_group: WorkerGroup):
+        pass
+
+
+class BackendExecutor:
+    """Reference: ``train/_internal/backend_executor.py:69``."""
+
+    def __init__(self, scaling: ScalingConfig, backend: Optional[JaxBackend] = None):
+        self.scaling = scaling
+        self.backend = backend or JaxBackend()
+        self.worker_group: Optional[WorkerGroup] = None
+
+    def start(self):
+        self.worker_group = WorkerGroup(self.scaling)
+        self.backend.on_start(self.worker_group, self.scaling)
+
+    def start_training(self, train_fn: Callable,
+                       config: Optional[Dict[str, Any]],
+                       restore_checkpoint_path: Optional[str]) -> List[Any]:
+        assert self.worker_group is not None
+        return self.worker_group.execute_async(
+            "run", train_fn, config, restore_checkpoint_path)
+
+    def poll(self) -> List[Dict[str, Any]]:
+        assert self.worker_group is not None
+        return self.worker_group.execute("poll")
+
+    def shutdown(self):
+        if self.worker_group is not None:
+            self.backend.on_shutdown(self.worker_group)
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,152 @@
+"""Checkpoint: directory-backed training state (reference: ``train/_checkpoint.py:56``).
+
+A ``Checkpoint`` is a handle to a directory (``from_directory``/
+``to_directory``/``as_directory`` mirror the reference API at
+``train/_checkpoint.py:179,190,234``). Helpers save/restore jax pytrees with
+numpy container files; sharded arrays are fetched to host before writing and
+re-sharded by the caller on restore (orbax-style async/multi-host checkpointing
+layers on top in the cluster runtime).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # -- constructors ------------------------------------------------------
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_dict(cls, data: Dict[str, Any]) -> "Checkpoint":
+        d = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        with open(os.path.join(d, "data.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(d)
+
+    # -- accessors ---------------------------------------------------------
+    def to_directory(self, path: Optional[str] = None) -> str:
+        if path is None:
+            return self.path
+        path = os.path.abspath(path)
+        if path != self.path:
+            shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextlib.contextmanager
+    def as_directory(self) -> Iterator[str]:
+        yield self.path
+
+    def to_dict(self) -> Dict[str, Any]:
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def update_metadata(self, metadata: Dict[str, Any]) -> None:
+        with open(os.path.join(self.path, "metadata.json"), "w") as f:
+            json.dump(metadata, f)
+
+    def get_metadata(self) -> Dict[str, Any]:
+        p = os.path.join(self.path, "metadata.json")
+        if not os.path.exists(p):
+            return {}
+        with open(p) as f:
+            return json.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+
+def save_pytree(tree: Any, directory: str, name: str = "state") -> None:
+    """Save a jax pytree: arrays to .npz, structure via pickle of treedef paths."""
+    import jax
+    import numpy as np
+
+    os.makedirs(directory, exist_ok=True)
+    leaves, treedef = jax.tree.flatten(tree)
+    host_leaves = [np.asarray(x) for x in leaves]
+    np.savez(os.path.join(directory, f"{name}.npz"),
+             **{str(i): a for i, a in enumerate(host_leaves)})
+    with open(os.path.join(directory, f"{name}.treedef.pkl"), "wb") as f:
+        pickle.dump(treedef, f)
+
+
+def load_pytree(directory: str, name: str = "state") -> Any:
+    import jax
+    import numpy as np
+
+    with open(os.path.join(directory, f"{name}.treedef.pkl"), "rb") as f:
+        treedef = pickle.load(f)
+    data = np.load(os.path.join(directory, f"{name}.npz"))
+    leaves = [data[str(i)] for i in range(len(data.files))]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+class CheckpointManager:
+    """Top-k checkpoint retention (reference: ``_internal/checkpoint_manager.py``)."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._ckpts: list = []  # (score, path, metrics)
+
+    def register(self, checkpoint: Checkpoint,
+                 metrics: Optional[Dict[str, Any]] = None) -> Checkpoint:
+        metrics = metrics or {}
+        dest = os.path.join(self.root, f"checkpoint_{uuid.uuid4().hex[:8]}")
+        persisted = Checkpoint(checkpoint.to_directory(dest))
+        score = metrics.get(self.score_attribute) if self.score_attribute else None
+        self._ckpts.append((score, persisted, metrics))
+        self._evict()
+        return persisted
+
+    def _evict(self):
+        if self.num_to_keep is None or len(self._ckpts) <= self.num_to_keep:
+            return
+        if self.score_attribute:
+            reverse = self.score_order == "max"
+            ordered = sorted(
+                self._ckpts,
+                key=lambda t: (t[0] is not None, t[0]),
+                reverse=reverse,
+            )
+        else:
+            ordered = list(self._ckpts)  # FIFO: oldest evicted first
+            ordered.reverse()
+        keep = ordered[: self.num_to_keep] if self.score_attribute else \
+            self._ckpts[-self.num_to_keep:]
+        drop = [c for c in self._ckpts if not any(c[1] is k[1] for k in keep)]
+        for _, ckpt, _ in drop:
+            shutil.rmtree(ckpt.path, ignore_errors=True)
+        self._ckpts = [c for c in self._ckpts if any(c[1] is k[1] for k in keep)]
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        return self._ckpts[-1][1] if self._ckpts else None
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        if not self._ckpts:
+            return None
+        if not self.score_attribute:
+            return self.latest
+        scored = [c for c in self._ckpts if c[0] is not None]
+        if not scored:
+            return self.latest
+        pick = max if self.score_order == "max" else min
+        return pick(scored, key=lambda t: t[0])[1]

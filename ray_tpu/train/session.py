@@ -1,0 +1,100 @@
+"""Per-worker training session: ``report`` / ``get_context`` / ``get_checkpoint``.
+
+Reference: ``python/ray/train/_internal/session.py`` — the session is the
+channel between the user's ``train_loop_per_worker`` and the controller:
+metrics/checkpoints flow out through a queue polled by the BackendExecutor
+(reference ``backend_executor.py:585``), and the restore checkpoint flows in.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import queue
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+class TrainContext:
+    def __init__(self, world_rank: int, world_size: int, local_rank: int,
+                 local_world_size: int, node_rank: int = 0,
+                 experiment_name: str = "", trial_name: str = ""):
+        self._world_rank = world_rank
+        self._world_size = world_size
+        self._local_rank = local_rank
+        self._local_world_size = local_world_size
+        self._node_rank = node_rank
+        self._experiment_name = experiment_name
+        self._trial_name = trial_name
+
+    def get_world_rank(self) -> int:
+        return self._world_rank
+
+    def get_world_size(self) -> int:
+        return self._world_size
+
+    def get_local_rank(self) -> int:
+        return self._local_rank
+
+    def get_local_world_size(self) -> int:
+        return self._local_world_size
+
+    def get_node_rank(self) -> int:
+        return self._node_rank
+
+    def get_experiment_name(self) -> str:
+        return self._experiment_name
+
+    def get_trial_name(self) -> str:
+        return self._trial_name
+
+
+class _Session:
+    def __init__(self, context: TrainContext,
+                 checkpoint: Optional[Checkpoint] = None):
+        self.context = context
+        self.restore_checkpoint = checkpoint
+        self.reports: "queue.Queue" = queue.Queue()
+        self.finished = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.result: Any = None
+
+
+_session_var: contextvars.ContextVar[Optional[_Session]] = contextvars.ContextVar(
+    "ray_tpu_train_session", default=None)
+
+
+def _set_session(session: Optional[_Session]):
+    _session_var.set(session)
+
+
+def _get_session(strict: bool = True) -> Optional[_Session]:
+    s = _session_var.get()
+    if s is None and strict:
+        raise RuntimeError(
+            "No training session active. `ray_tpu.train.report()` and "
+            "`get_context()` must be called inside `train_loop_per_worker`.")
+    return s
+
+
+def report(metrics: Dict[str, Any],
+           checkpoint: Optional[Checkpoint] = None) -> None:
+    """Report metrics (and optionally a checkpoint) to the trainer.
+
+    Reference semantics (``ray.train.report``): all workers should call it at
+    the same cadence; only rank-0's checkpoint is persisted by default.
+    """
+    s = _get_session()
+    s.reports.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+
+def get_context() -> TrainContext:
+    s = _get_session()
+    return s.context
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """Checkpoint to restore from (set when recovering from failure)."""
+    s = _get_session()
+    return s.restore_checkpoint

@@ -1,0 +1,156 @@
+"""JaxTrainer: the TorchTrainer-shaped entry point for distributed training.
+
+Reference: ``train/torch/torch_trainer.py:11`` + ``DataParallelTrainer``
+(``train/data_parallel_trainer.py``) + the controller loop of
+``train/v2/_internal/execution/controller/controller.py:85``. The fit loop:
+start worker group → run ``train_loop_per_worker`` on every worker → poll the
+session queues for reported metrics/checkpoints → persist checkpoints (top-k)
+→ on worker failure, restart the group from the latest checkpoint while
+``FailureConfig.max_failures`` allows (reference ``backend_executor.py:705``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu import exceptions
+from ray_tpu.train.backend_executor import BackendExecutor, JaxBackend
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class JaxTrainer:
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict[str, Any]] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        backend: Optional[JaxBackend] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+    ):
+        self.train_loop = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.backend = backend
+        self.resume_from_checkpoint = resume_from_checkpoint
+
+    def fit(self) -> Result:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        rc = self.run_config
+        storage = rc.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results")
+        name = rc.name or f"JaxTrainer_{int(time.time())}"
+        exp_dir = os.path.join(storage, name)
+        os.makedirs(exp_dir, exist_ok=True)
+
+        ckpt_cfg: CheckpointConfig = rc.checkpoint_config
+        manager = CheckpointManager(
+            os.path.join(exp_dir, "checkpoints"),
+            num_to_keep=ckpt_cfg.num_to_keep,
+            score_attribute=ckpt_cfg.checkpoint_score_attribute,
+            score_order=ckpt_cfg.checkpoint_score_order,
+        )
+
+        failure_cfg: FailureConfig = rc.failure_config
+        failures = 0
+        restore: Optional[Checkpoint] = self.resume_from_checkpoint
+        latest_metrics: Optional[Dict[str, Any]] = None
+        history: List[Dict[str, Any]] = []
+        error: Optional[BaseException] = None
+
+        while True:
+            executor = BackendExecutor(self.scaling_config, self.backend)
+            executor.start()
+            run_refs = executor.start_training(
+                self.train_loop, self.train_loop_config,
+                restore.path if restore else None)
+            try:
+                self._drive(executor, run_refs, manager, history)
+                latest_metrics = history[-1]["metrics"] if history else None
+                error = None
+                executor.shutdown()
+                break
+            except (exceptions.RayTaskError, exceptions.ActorDiedError,
+                    exceptions.WorkerCrashedError) as e:
+                executor.shutdown()
+                failures += 1
+                recoverable = (failure_cfg.max_failures < 0
+                               or failures <= failure_cfg.max_failures)
+                if not recoverable:
+                    error = e
+                    latest_metrics = history[-1]["metrics"] if history else None
+                    break
+                restore = manager.latest or restore
+                logger.warning(
+                    "Training attempt %d failed (%s); restarting from %s",
+                    failures, e,
+                    restore.path if restore else "scratch")
+
+        return Result(
+            metrics=latest_metrics,
+            checkpoint=manager.best,
+            path=exp_dir,
+            error=error,
+            metrics_history=history,
+        )
+
+    # ------------------------------------------------------------------
+    def _drive(self, executor: BackendExecutor, run_refs,
+               manager: CheckpointManager, history: List[Dict[str, Any]]):
+        """Poll session queues until every worker's run() completes."""
+        while True:
+            polls = executor.poll()
+            # Merge this round's reports: workers report at the same cadence;
+            # rank 0's metrics win, any rank's checkpoint is persisted
+            # (reference keeps rank-0 checkpoints by default).
+            max_reports = max((len(p["reports"]) for p in polls), default=0)
+            for i in range(max_reports):
+                metrics = None
+                ckpt_path = None
+                for rank, p in enumerate(polls):
+                    if i < len(p["reports"]):
+                        r = p["reports"][i]
+                        if metrics is None:
+                            metrics = r["metrics"]
+                        if ckpt_path is None and r.get("checkpoint_path"):
+                            ckpt_path = r["checkpoint_path"]
+                entry: Dict[str, Any] = {"metrics": metrics}
+                if ckpt_path:
+                    persisted = manager.register(
+                        Checkpoint(ckpt_path), metrics or {})
+                    entry["checkpoint"] = persisted
+                history.append(entry)
+
+            done, _ = ray_tpu.wait(run_refs, num_returns=len(run_refs),
+                                   timeout=0.02)
+            if len(done) == len(run_refs):
+                # Raises through to fit() on worker failure.
+                ray_tpu.get(run_refs)
+                # Final drain.
+                final = executor.poll()
+                for rank, p in enumerate(final):
+                    for r in p["reports"]:
+                        entry = {"metrics": r["metrics"]}
+                        if r.get("checkpoint_path"):
+                            entry["checkpoint"] = manager.register(
+                                Checkpoint(r["checkpoint_path"]),
+                                r["metrics"] or {})
+                        history.append(entry)
+                return
