@@ -1,0 +1,445 @@
+"""GCS server: the cluster control plane.
+
+Reference: ``src/ray/gcs/gcs_server`` (SURVEY.md C22) — one process hosting
+node manager, actor manager + scheduler, KV, pubsub, placement-group manager
+(2PC), health-check manager, and the object directory. This build keeps the
+same responsibilities in one asyncio-free threaded gRPC process; persistence
+is in-memory with an optional JSON snapshot (the Redis-backed fault-tolerance
+mode of the reference maps to snapshot-restore — ``redis_store_client.h:107``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import pickle
+import queue
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+HEALTH_CHECK_PERIOD_S = 0.5
+HEALTH_FAILURE_THRESHOLD_S = 3.0
+
+
+class GcsServer:
+    def __init__(self, port: int = 0):
+        # nodes
+        self._nodes: Dict[str, pb.NodeInfo] = {}
+        self._last_heartbeat: Dict[str, float] = {}
+        # kv
+        self._kv: Dict[Tuple[str, str], bytes] = {}
+        # actors
+        self._actors: Dict[bytes, pb.ActorInfo] = {}
+        self._actor_names: Dict[Tuple[str, str], bytes] = {}
+        # pubsub
+        self._subscribers: Dict[str, List[queue.Queue]] = defaultdict(list)
+        # placement groups
+        self._pgroups: Dict[bytes, pb.PlacementGroupInfo] = {}
+        # object directory
+        self._locations: Dict[bytes, Set[str]] = defaultdict(set)
+        self._object_sizes: Dict[bytes, int] = {}
+
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._server, self.port = rpc.serve("GcsService", self, port=port)
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="gcs-health")
+        self._health_thread.start()
+
+    # ------------------------------------------------------------- helpers
+    def _publish(self, channel: str, data: bytes):
+        with self._lock:
+            subs = list(self._subscribers.get(channel, []))
+        for q in subs:
+            q.put(pb.PubsubMessage(channel=channel, data=data))
+
+    def _node_stub(self, node_id: str) -> Optional[rpc.Stub]:
+        with self._lock:
+            info = self._nodes.get(node_id)
+        if info is None or not info.alive:
+            return None
+        return rpc.get_stub("NodeService", info.address)
+
+    # ------------------------------------------------------------- nodes
+    def RegisterNode(self, request, context):
+        info = request.info
+        with self._lock:
+            info.alive = True
+            self._nodes[info.node_id] = info
+            self._last_heartbeat[info.node_id] = time.monotonic()
+        logger.info("node %s registered at %s", info.node_id[:8], info.address)
+        self._publish("NODE", pickle.dumps(
+            {"event": "alive", "node_id": info.node_id}))
+        return pb.RegisterNodeReply(ok=True)
+
+    def DrainNode(self, request, context):
+        self._mark_dead(request.node_id, "drained")
+        return pb.Empty()
+
+    def Heartbeat(self, request, context):
+        with self._lock:
+            info = self._nodes.get(request.node_id)
+            if info is None:
+                return pb.HeartbeatReply(ok=False)  # unknown: re-register
+            self._last_heartbeat[request.node_id] = time.monotonic()
+            for k, v in request.available.items():
+                info.available[k] = v
+        return pb.HeartbeatReply(ok=True)
+
+    def GetNodes(self, request, context):
+        with self._lock:
+            return pb.GetNodesReply(nodes=list(self._nodes.values()))
+
+    def _health_loop(self):
+        """Reference: GcsHealthCheckManager (gcs_health_check_manager.h:45)."""
+        while not self._stop.wait(HEALTH_CHECK_PERIOD_S):
+            now = time.monotonic()
+            dead = []
+            with self._lock:
+                for node_id, info in self._nodes.items():
+                    if not info.alive:
+                        continue
+                    if now - self._last_heartbeat.get(node_id, now) \
+                            > HEALTH_FAILURE_THRESHOLD_S:
+                        dead.append(node_id)
+            for node_id in dead:
+                self._mark_dead(node_id, "missed heartbeats")
+
+    def _mark_dead(self, node_id: str, reason: str):
+        with self._lock:
+            info = self._nodes.get(node_id)
+            if info is None or not info.alive:
+                return
+            info.alive = False
+        logger.warning("node %s marked dead: %s", node_id[:8], reason)
+        self._publish("NODE", pickle.dumps(
+            {"event": "dead", "node_id": node_id, "reason": reason}))
+        self._on_node_dead(node_id)
+
+    # ------------------------------------------------------------- kv
+    def KvPut(self, request, context):
+        key = (request.ns, request.key)
+        with self._lock:
+            if not request.overwrite and key in self._kv:
+                return pb.KvReply(ok=False)
+            self._kv[key] = request.value
+        return pb.KvReply(ok=True)
+
+    def KvGet(self, request, context):
+        with self._lock:
+            val = self._kv.get((request.ns, request.key))
+        if val is None:
+            return pb.KvReply(found=False)
+        return pb.KvReply(found=True, value=val)
+
+    def KvDel(self, request, context):
+        with self._lock:
+            existed = self._kv.pop((request.ns, request.key), None) is not None
+        return pb.KvReply(ok=existed)
+
+    def KvKeys(self, request, context):
+        with self._lock:
+            keys = [k for ns, k in self._kv
+                    if ns == request.ns and k.startswith(request.prefix)]
+        return pb.KvReply(keys=keys, ok=True)
+
+    # ------------------------------------------------------------- actors
+    def RegisterActor(self, request, context):
+        info = request.info
+        with self._lock:
+            if info.name:
+                key = (info.namespace or "default", info.name)
+                existing = self._actor_names.get(key)
+                if existing is not None and \
+                        self._actors[existing].state != "DEAD":
+                    return pb.RegisterActorReply(
+                        ok=False,
+                        error=f"Actor name {info.name!r} already taken")
+                self._actor_names[key] = info.actor_id
+            self._actors[info.actor_id] = info
+        self._publish("ACTOR", info.SerializeToString())
+        if info.state == "PENDING":
+            # GCS-direct actor creation (reference: GcsActorScheduler
+            # ScheduleByGcs, gcs_actor_scheduler.cc:60).
+            threading.Thread(target=self._restart_actor, args=(info,),
+                             daemon=True).start()
+        return pb.RegisterActorReply(ok=True)
+
+    def UpdateActor(self, request, context):
+        info = request.info
+        restart = False
+        with self._lock:
+            if info.state == "RESTARTING":
+                # A node manager reported the actor's worker died; GCS owns
+                # the restart budget (gcs_actor_manager.cc:1372).
+                if info.num_restarts < info.max_restarts or info.max_restarts < 0:
+                    info.num_restarts += 1
+                    restart = True
+                else:
+                    info.state = "DEAD"
+                    info.death_cause = info.death_cause or "worker died"
+            self._actors[info.actor_id] = info
+            if info.name and info.state == "DEAD":
+                key = (info.namespace or "default", info.name)
+                if self._actor_names.get(key) == info.actor_id:
+                    del self._actor_names[key]
+        self._publish("ACTOR", info.SerializeToString())
+        if restart:
+            threading.Thread(target=self._restart_actor, args=(info,),
+                             daemon=True).start()
+        return pb.Empty()
+
+    def GetActor(self, request, context):
+        with self._lock:
+            if request.actor_id:
+                info = self._actors.get(request.actor_id)
+            else:
+                aid = self._actor_names.get(
+                    (request.namespace or "default", request.name))
+                info = self._actors.get(aid) if aid else None
+        if info is None:
+            return pb.GetActorReply(found=False)
+        return pb.GetActorReply(found=True, info=info)
+
+    def ListActors(self, request, context):
+        with self._lock:
+            actors = [a for a in self._actors.values()
+                      if request.all_namespaces
+                      or a.namespace == (request.namespace or "default")]
+        return pb.ListActorsReply(actors=actors)
+
+    def _on_node_dead(self, node_id: str):
+        """Restart or kill actors of a dead node (reference:
+        GcsActorManager::OnNodeDead, gcs_actor_manager.cc:1279)."""
+        with self._lock:
+            affected = [a for a in self._actors.values()
+                        if a.node_id == node_id and a.state == "ALIVE"]
+        for info in affected:
+            if info.num_restarts < info.max_restarts or info.max_restarts < 0:
+                info.num_restarts += 1
+                info.state = "RESTARTING"
+                self._publish("ACTOR", info.SerializeToString())
+                threading.Thread(
+                    target=self._restart_actor, args=(info,), daemon=True
+                ).start()
+            else:
+                info.state = "DEAD"
+                info.death_cause = f"node {node_id[:8]} died"
+                self.UpdateActor(pb.UpdateActorRequest(info=info), None)
+
+    def _restart_actor(self, info: pb.ActorInfo):
+        """Reference: GcsActorManager RestartActor (gcs_actor_manager.cc:1372)."""
+        node_id = self._schedule_actor(info)
+        if node_id is None:
+            info.state = "DEAD"
+            info.death_cause = "no feasible node for restart"
+            self.UpdateActor(pb.UpdateActorRequest(info=info), None)
+            return
+        stub = self._node_stub(node_id)
+        try:
+            reply = stub.CreateActorOnNode(
+                pb.CreateActorOnNodeRequest(info=info), timeout=60)
+            if reply.ok:
+                info.state = "ALIVE"
+                info.node_id = node_id
+                info.address = reply.worker_address
+            else:
+                info.state = "DEAD"
+                info.death_cause = reply.error
+        except Exception as e:  # noqa: BLE001
+            info.state = "DEAD"
+            info.death_cause = f"restart failed: {e}"
+        self.UpdateActor(pb.UpdateActorRequest(info=info), None)
+
+    def _schedule_actor(self, info: pb.ActorInfo) -> Optional[str]:
+        """Pick a live node with available resources (GcsActorScheduler)."""
+        spec = pickle.loads(info.spec)
+        demand: Dict[str, float] = spec.get("resources", {})
+        with self._lock:
+            candidates = [
+                n for n in self._nodes.values()
+                if n.alive and all(
+                    n.available.get(k, 0.0) + 1e-9 >= v
+                    for k, v in demand.items())
+            ]
+        if not candidates:
+            return None
+        best = max(candidates,
+                   key=lambda n: sum(n.available.values()))
+        return best.node_id
+
+    # ------------------------------------------------------------- pubsub
+    def Publish(self, request, context):
+        self._publish(request.channel, request.data)
+        return pb.Empty()
+
+    def Subscribe(self, request, context):
+        q: "queue.Queue" = queue.Queue()
+        with self._lock:
+            for ch in request.channels:
+                self._subscribers[ch].append(q)
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = q.get(timeout=0.5)
+                    yield msg
+                except queue.Empty:
+                    if context is not None and not context.is_active():
+                        break
+        finally:
+            with self._lock:
+                for ch in request.channels:
+                    if q in self._subscribers.get(ch, []):
+                        self._subscribers[ch].remove(q)
+
+    # ---------------------------------------------------- placement groups
+    def CreatePlacementGroup(self, request, context):
+        info = pb.PlacementGroupInfo(
+            group_id=request.group_id, name=request.name,
+            strategy=request.strategy, bundles=list(request.bundles),
+            state="PENDING")
+        with self._lock:
+            self._pgroups[request.group_id] = info
+        threading.Thread(target=self._place_group, args=(info,),
+                         daemon=True).start()
+        return pb.Empty()
+
+    def _place_group(self, info: pb.PlacementGroupInfo):
+        """2PC bundle placement (reference: GcsPlacementGroupScheduler
+        prepare/commit across raylets, gcs_placement_group_scheduler.cc)."""
+        from ray_tpu._private.scheduler.policies import place_bundles
+
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not self._stop.is_set():
+            with self._lock:
+                nodes = [n for n in self._nodes.values() if n.alive]
+            # Permanently infeasible (by total, not available, resources):
+            # fail fast rather than burning the retry window.
+            from ray_tpu._private.scheduler.policies import feasible_anywhere
+
+            if nodes and not all(
+                    feasible_anywhere(nodes, dict(b.resources))
+                    for b in info.bundles):
+                break
+            assignment = place_bundles(info, nodes)
+            if assignment is None:
+                time.sleep(0.2)  # retry loop (gcs_placement_group_manager.cc:405)
+                continue
+            # Phase 1: prepare on every involved node.
+            by_node: Dict[str, List[pb.Bundle]] = defaultdict(list)
+            for bundle, node_id in zip(info.bundles, assignment):
+                b = pb.Bundle(index=bundle.index, node_id=node_id)
+                for k, v in bundle.resources.items():
+                    b.resources[k] = v
+                by_node[node_id].append(b)
+            prepared = []
+            ok = True
+            for node_id, bundles in by_node.items():
+                stub = self._node_stub(node_id)
+                try:
+                    r = stub.PrepareBundle(pb.PrepareBundleRequest(
+                        group_id=info.group_id, bundles=bundles))
+                    if not r.success:
+                        ok = False
+                        break
+                    prepared.append(node_id)
+                except Exception:  # noqa: BLE001
+                    ok = False
+                    break
+            if not ok:
+                for node_id in prepared:
+                    stub = self._node_stub(node_id)
+                    if stub:
+                        try:
+                            stub.CancelBundle(pb.CancelBundleRequest(
+                                group_id=info.group_id))
+                        except Exception:  # noqa: BLE001
+                            pass
+                time.sleep(0.2)
+                continue
+            # Phase 2: commit.
+            for node_id, bundles in by_node.items():
+                stub = self._node_stub(node_id)
+                stub.CommitBundle(pb.CommitBundleRequest(
+                    group_id=info.group_id, bundles=bundles))
+            with self._lock:
+                for bundle, node_id in zip(info.bundles, assignment):
+                    bundle.node_id = node_id
+                info.state = "CREATED"
+            self._publish("PLACEMENT_GROUP", info.SerializeToString())
+            return
+        with self._lock:
+            info.state = "INFEASIBLE"
+        self._publish("PLACEMENT_GROUP", info.SerializeToString())
+
+    def GetPlacementGroup(self, request, context):
+        with self._lock:
+            info = self._pgroups.get(request.group_id)
+        if info is None:
+            return pb.GetPlacementGroupReply(found=False)
+        return pb.GetPlacementGroupReply(found=True, info=info)
+
+    def RemovePlacementGroup(self, request, context):
+        with self._lock:
+            info = self._pgroups.get(request.group_id)
+            if info is None:
+                return pb.Empty()
+            info.state = "REMOVED"
+            nodes = {b.node_id for b in info.bundles if b.node_id}
+        for node_id in nodes:
+            stub = self._node_stub(node_id)
+            if stub:
+                try:
+                    stub.CancelBundle(pb.CancelBundleRequest(
+                        group_id=request.group_id))
+                except Exception:  # noqa: BLE001
+                    pass
+        self._publish("PLACEMENT_GROUP", info.SerializeToString())
+        return pb.Empty()
+
+    # ------------------------------------------------------ object directory
+    def UpdateObjectLocation(self, request, context):
+        with self._lock:
+            if request.added:
+                self._locations[request.object_id].add(request.node_id)
+                if request.size:
+                    self._object_sizes[request.object_id] = request.size
+            else:
+                self._locations[request.object_id].discard(request.node_id)
+        return pb.Empty()
+
+    def GetObjectLocations(self, request, context):
+        with self._lock:
+            locs = list(self._locations.get(request.object_id, ()))
+            size = self._object_sizes.get(request.object_id, 0)
+        return pb.GetObjectLocationsReply(node_ids=locs, size=size)
+
+    # ------------------------------------------------------------- lifecycle
+    def shutdown(self):
+        self._stop.set()
+        self._server.stop(grace=0.2)
+
+
+def main():  # pragma: no cover - exercised as a subprocess
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    server = GcsServer(port=args.port)
+    print(f"GCS_PORT={server.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.shutdown()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

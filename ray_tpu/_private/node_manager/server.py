@@ -1,0 +1,501 @@
+"""Node manager: the per-node daemon (raylet-equivalent).
+
+Reference: ``src/ray/raylet`` (SURVEY.md C15-C21) — one process per node
+running: a worker pool (spawn/reuse/idle-kill of Python worker processes,
+reference ``worker_pool.h:216``), the local+cluster scheduler with spillback
+(``cluster_task_manager.cc:44`` / ``local_task_manager.cc:121``), placement
+bundle 2PC reservations (``placement_group_resource_manager.h``), and the
+node object store + transfer endpoint (plasma + object manager, C12/C13; the
+python dict store here is the interim data plane the C++ shm store replaces).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from ray_tpu._private import rpc
+from ray_tpu._private.scheduler import policies
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+HEARTBEAT_PERIOD_S = 0.5
+CLUSTER_VIEW_TTL_S = 1.0
+IDLE_WORKER_TTL_S = 60.0
+CHUNK_SIZE = 8 * 1024 * 1024
+
+
+class _Worker:
+    def __init__(self, worker_id: str, proc: subprocess.Popen):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.address: Optional[str] = None
+        self.ready = threading.Event()
+        self.leased_for: Optional[bytes] = None  # lease id
+        self.is_actor_worker = False
+        self.idle_since = time.monotonic()
+
+
+class NodeManager:
+    def __init__(self, gcs_address: str, port: int = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 node_id: Optional[str] = None):
+        self.node_id = node_id or uuid.uuid4().hex
+        self.gcs_address = gcs_address
+        self.gcs = rpc.get_stub("GcsService", gcs_address)
+
+        resources = dict(resources or {"CPU": float(os.cpu_count() or 4)})
+        self.total = resources
+        self.available = dict(resources)
+        self._res_lock = threading.RLock()
+
+        # object store (interim in-memory; owner plane for the shm store)
+        self._objects: Dict[bytes, bytes] = {}
+        self._obj_lock = threading.RLock()
+
+        # worker pool
+        self._workers: Dict[str, _Worker] = {}
+        self._idle: List[str] = []
+        self._pool_lock = threading.RLock()
+
+        # placement bundles: group -> reserved resources
+        self._prepared: Dict[bytes, Dict[str, float]] = {}
+        self._committed: Dict[bytes, Dict[str, float]] = {}
+        # outstanding leases / actor resource holds
+        self._leases: Dict[bytes, Tuple[str, Dict[str, float]]] = {}
+        self._actor_demands: Dict[bytes, Tuple[str, Dict[str, float]]] = {}
+
+        # cluster view cache (ray_syncer analog: polled via GCS)
+        self._view: List[pb.NodeInfo] = []
+        self._view_ts = 0.0
+
+        self._stop = threading.Event()
+        self._server, self.port = rpc.serve("NodeService", self, port=port)
+        self.address = f"127.0.0.1:{self.port}"
+
+        info = pb.NodeInfo(node_id=self.node_id, address=self.address,
+                           alive=True)
+        for k, v in self.total.items():
+            info.resources[k] = v
+            info.available[k] = v
+        for k, v in (labels or {}).items():
+            info.labels[k] = v
+        self.labels = dict(labels or {})
+        self.gcs.RegisterNode(pb.RegisterNodeRequest(info=info))
+
+        self._hb_thread = threading.Thread(target=self._heartbeat_loop,
+                                           daemon=True, name="nm-heartbeat")
+        self._hb_thread.start()
+        # Prestart workers so first leases don't pay process-spawn latency
+        # (reference: worker pool prestart, worker_pool.h:216).
+        threading.Thread(target=self._prestart_workers, daemon=True).start()
+
+    def _prestart_workers(self):
+        n = min(int(self.total.get("CPU", 1)), 4)
+        workers = []
+        for _ in range(n):
+            if self._stop.is_set():
+                return
+            workers.append(self._spawn_worker())
+        for w in workers:
+            if w.ready.wait(30) and not self._stop.is_set():
+                with self._pool_lock:
+                    if w.worker_id not in self._idle and w.leased_for is None:
+                        self._idle.append(w.worker_id)
+
+    # ------------------------------------------------------------ resources
+    def _try_acquire(self, demand: Dict[str, float]) -> bool:
+        with self._res_lock:
+            if all(self.available.get(k, 0.0) + 1e-9 >= v
+                   for k, v in demand.items()):
+                for k, v in demand.items():
+                    self.available[k] = self.available.get(k, 0.0) - v
+                return True
+            return False
+
+    def _release(self, demand: Dict[str, float]):
+        with self._res_lock:
+            for k, v in demand.items():
+                self.available[k] = min(
+                    self.available.get(k, 0.0) + v, self.total.get(k, 0.0))
+
+    def _heartbeat_loop(self):
+        seq = 0
+        while not self._stop.wait(HEARTBEAT_PERIOD_S):
+            seq += 1
+            req = pb.HeartbeatRequest(node_id=self.node_id, seq=seq)
+            with self._res_lock:
+                for k, v in self.available.items():
+                    req.available[k] = v
+            try:
+                reply = self.gcs.Heartbeat(req, timeout=2)
+                if not reply.ok:
+                    # GCS restarted / lost us: re-register.
+                    info = pb.NodeInfo(node_id=self.node_id,
+                                       address=self.address, alive=True)
+                    for k, v in self.total.items():
+                        info.resources[k] = v
+                    with self._res_lock:
+                        for k, v in self.available.items():
+                            info.available[k] = v
+                    for k, v in self.labels.items():
+                        info.labels[k] = v
+                    self.gcs.RegisterNode(pb.RegisterNodeRequest(info=info))
+            except Exception:  # noqa: BLE001
+                pass
+            self._reap_idle_workers()
+            self._check_dead_workers()
+
+    def _cluster_view(self) -> List[pb.NodeInfo]:
+        now = time.monotonic()
+        if now - self._view_ts > CLUSTER_VIEW_TTL_S:
+            try:
+                self._view = list(
+                    self.gcs.GetNodes(pb.GetNodesRequest(), timeout=2).nodes)
+                self._view_ts = now
+            except Exception:  # noqa: BLE001
+                pass
+        return self._view
+
+    # ------------------------------------------------------------ worker pool
+    def _spawn_worker(self) -> _Worker:
+        worker_id = uuid.uuid4().hex
+        cmd = [
+            sys.executable, "-m", "ray_tpu._private.workers.default_worker",
+            "--node-address", self.address,
+            "--gcs-address", self.gcs_address,
+            "--worker-id", worker_id,
+            "--node-id", self.node_id,
+        ]
+        env = dict(os.environ)
+        # Workers must resolve pickled-by-reference functions from the same
+        # module universe as the submitting process (includes pytest's
+        # sys.path injections when the node manager runs in a test process).
+        env["PYTHONPATH"] = os.pathsep.join(
+            dict.fromkeys(filter(None, list(sys.path)
+                                 + [env.get("PYTHONPATH", ""), os.getcwd()])))
+        if not self.total.get("TPU"):
+            # CPU-only node: skip the TPU PJRT plugin registration in
+            # sitecustomize (it imports jax at interpreter start, ~2s per
+            # worker process).
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(cmd, env=env)
+        worker = _Worker(worker_id, proc)
+        with self._pool_lock:
+            self._workers[worker_id] = worker
+        return worker
+
+    def _pop_worker(self, timeout_s: float = 30.0) -> Optional[_Worker]:
+        """Reference: WorkerPool::PopWorker (worker_pool.cc:1355)."""
+        with self._pool_lock:
+            while self._idle:
+                wid = self._idle.pop()
+                w = self._workers.get(wid)
+                if w and w.proc.poll() is None:
+                    return w
+        worker = self._spawn_worker()
+        if worker.ready.wait(timeout_s):
+            return worker
+        return None
+
+    def _reap_idle_workers(self):
+        now = time.monotonic()
+        with self._pool_lock:
+            keep = []
+            for wid in self._idle:
+                w = self._workers.get(wid)
+                if w is None or w.proc.poll() is not None:
+                    continue
+                if now - w.idle_since > IDLE_WORKER_TTL_S:
+                    w.proc.terminate()
+                    self._workers.pop(wid, None)
+                else:
+                    keep.append(wid)
+            self._idle = keep
+
+    def _check_dead_workers(self):
+        """Detect crashed actor workers and hand the restart decision to the
+        GCS (reference: raylet worker-death notification →
+        GcsActorManager::OnWorkerDead)."""
+        with self._pool_lock:
+            dead = [w for w in self._workers.values()
+                    if w.proc.poll() is not None]
+            for w in dead:
+                self._workers.pop(w.worker_id, None)
+                if w.worker_id in self._idle:
+                    self._idle.remove(w.worker_id)
+        for w in dead:
+            for actor_id, (wid, demand) in list(self._actor_demands.items()):
+                if wid != w.worker_id:
+                    continue
+                del self._actor_demands[actor_id]
+                self._release(demand)
+                try:
+                    reply = self.gcs.GetActor(
+                        pb.GetActorRequest(actor_id=actor_id), timeout=5)
+                    if reply.found and reply.info.state == "ALIVE" \
+                            and reply.info.node_id == self.node_id:
+                        info = reply.info
+                        info.state = "RESTARTING"
+                        info.death_cause = "worker process died"
+                        self.gcs.UpdateActor(
+                            pb.UpdateActorRequest(info=info), timeout=5)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def AnnounceWorker(self, request, context):
+        with self._pool_lock:
+            w = self._workers.get(request.worker_id)
+            if w is None:
+                # Unknown worker (e.g. an orphan from a dead node manager that
+                # hit a reused port): reject — it will exit on its own.
+                logger.warning("rejecting unknown worker %s",
+                               request.worker_id[:8])
+                return pb.Empty()
+            w.address = request.address
+            w.ready.set()
+        return pb.Empty()
+
+    # ------------------------------------------------------------ leases
+    def RequestWorkerLease(self, request, context):
+        """Reference: NodeManager::HandleRequestWorkerLease
+        (raylet/node_manager.cc:1868) + ClusterTaskManager scheduling."""
+        spec = request.spec
+        demand = dict(spec.resources)
+        if self._try_acquire(demand):
+            worker = self._pop_worker()
+            if worker is None:
+                self._release(demand)
+                return pb.LeaseReply(granted=False,
+                                     error="worker start timeout")
+            lease_id = uuid.uuid4().bytes
+            worker.leased_for = lease_id
+            with self._pool_lock:
+                if worker.worker_id in self._idle:
+                    self._idle.remove(worker.worker_id)
+            # Stash demand so ReturnWorker releases it.
+            self._leases[lease_id] = (worker.worker_id, demand)
+            return pb.LeaseReply(granted=True,
+                                 worker_address=worker.address,
+                                 worker_id=worker.worker_id)
+        # Spillback: pick another node from the cluster view.
+        nodes = [n for n in self._cluster_view() if n.node_id != self.node_id]
+        target = policies.pick_node_hybrid(nodes, demand)
+        if target is None:
+            if not policies.feasible_anywhere(self._cluster_view(), demand):
+                return pb.LeaseReply(granted=False, error="infeasible")
+            return pb.LeaseReply(granted=False)  # retry locally later
+        addr = next(n.address for n in nodes if n.node_id == target)
+        return pb.LeaseReply(granted=False, spillback_node_id=target,
+                             spillback_address=addr)
+
+    def ReturnWorker(self, request, context):
+        lease = self._leases.pop(request.lease_id, None)
+        if lease is None:
+            # Fall back to any lease held by that worker.
+            for lid, (wid, demand) in list(self._leases.items()):
+                if wid == request.worker_id:
+                    lease = self._leases.pop(lid)
+                    break
+        if lease is not None:
+            _, demand = lease
+            self._release(demand)
+        with self._pool_lock:
+            w = self._workers.get(request.worker_id)
+            if w and w.proc.poll() is None and not w.is_actor_worker:
+                w.leased_for = None
+                w.idle_since = time.monotonic()
+                if request.worker_id not in self._idle:
+                    self._idle.append(request.worker_id)
+        return pb.Empty()
+
+    def CreateActorOnNode(self, request, context):
+        """Lease a dedicated worker and instantiate the actor on it
+        (reference: GcsActorScheduler raylet leg, gcs_actor_scheduler.cc:107)."""
+        info = request.info
+        spec = pickle.loads(info.spec)
+        demand = dict(spec.get("resources", {}))
+        if not self._try_acquire(demand):
+            return pb.CreateActorOnNodeReply(
+                ok=False, error="insufficient resources")
+        worker = self._pop_worker()
+        if worker is None:
+            self._release(demand)
+            return pb.CreateActorOnNodeReply(ok=False,
+                                             error="worker start timeout")
+        worker.is_actor_worker = True
+        with self._pool_lock:
+            if worker.worker_id in self._idle:
+                self._idle.remove(worker.worker_id)
+        self._actor_demands[info.actor_id] = (worker.worker_id, demand)
+        stub = rpc.get_stub("WorkerService", worker.address)
+        info.node_id = self.node_id
+        info.address = worker.address
+        try:
+            reply = stub.CreateActor(pb.CreateActorRequest(info=info),
+                                     timeout=60)
+        except Exception as e:  # noqa: BLE001
+            self._release(demand)
+            return pb.CreateActorOnNodeReply(ok=False, error=str(e))
+        if not reply.ok:
+            self._release(demand)
+            return pb.CreateActorOnNodeReply(ok=False, error=reply.error)
+        return pb.CreateActorOnNodeReply(ok=True,
+                                         worker_address=worker.address)
+
+    # ------------------------------------------------------------ bundles
+    def PrepareBundle(self, request, context):
+        total_demand: Dict[str, float] = defaultdict(float)
+        for b in request.bundles:
+            for k, v in b.resources.items():
+                total_demand[k] += v
+        if self._try_acquire(dict(total_demand)):
+            self._prepared[request.group_id] = dict(total_demand)
+            return pb.PrepareBundleReply(success=True)
+        return pb.PrepareBundleReply(success=False)
+
+    def CommitBundle(self, request, context):
+        demand = self._prepared.pop(request.group_id, None)
+        if demand is not None:
+            self._committed[request.group_id] = demand
+        return pb.Empty()
+
+    def CancelBundle(self, request, context):
+        demand = self._prepared.pop(request.group_id, None)
+        if demand is None:
+            demand = self._committed.pop(request.group_id, None)
+        if demand is not None:
+            self._release(demand)
+        return pb.Empty()
+
+    # ------------------------------------------------------------ objects
+    def PutObject(self, request, context):
+        with self._obj_lock:
+            self._objects[request.object_id] = request.data
+        try:
+            self.gcs.UpdateObjectLocation(pb.ObjectLocationUpdate(
+                object_id=request.object_id, node_id=self.node_id,
+                added=True, size=len(request.data)))
+        except Exception:  # noqa: BLE001
+            pass
+        return pb.Empty()
+
+    def GetObject(self, request, context):
+        with self._obj_lock:
+            data = self._objects.get(request.object_id)
+        if data is None:
+            return pb.GetObjectReply(found=False)
+        return pb.GetObjectReply(found=True, data=data)
+
+    def PullObject(self, request, context):
+        """Chunked streaming transfer (reference: ObjectManager 64MB chunks,
+        object_manager.h:117)."""
+        with self._obj_lock:
+            data = self._objects.get(request.object_id)
+        if data is None:
+            yield pb.ObjectChunk(object_id=request.object_id, found=False,
+                                 eof=True)
+            return
+        total = len(data)
+        for off in range(0, max(total, 1), CHUNK_SIZE):
+            chunk = data[off:off + CHUNK_SIZE]
+            yield pb.ObjectChunk(object_id=request.object_id,
+                                 total_size=total, offset=off, data=chunk,
+                                 found=True, eof=off + CHUNK_SIZE >= total)
+
+    def FreeObjects(self, request, context):
+        with self._obj_lock:
+            for oid in request.object_ids:
+                self._objects.pop(oid, None)
+        for oid in request.object_ids:
+            try:
+                self.gcs.UpdateObjectLocation(pb.ObjectLocationUpdate(
+                    object_id=oid, node_id=self.node_id, added=False))
+            except Exception:  # noqa: BLE001
+                pass
+        return pb.Empty()
+
+    # ------------------------------------------------------------ lifecycle
+    def shutdown(self, graceful: bool = True):
+        """Stop the node. ``graceful=False`` simulates a node crash: no drain
+        notification, so the GCS health checker must discover the death."""
+        self._stop.set()
+        if graceful:
+            try:
+                self.gcs.DrainNode(pb.DrainNodeRequest(node_id=self.node_id),
+                                   timeout=2)
+            except Exception:  # noqa: BLE001
+                pass
+        # Kill twice with a grace gap so workers mid-spawn in the prestart
+        # thread are also reaped.
+        for _ in range(2):
+            with self._pool_lock:
+                workers = list(self._workers.values())
+            for w in workers:
+                try:
+                    w.proc.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+            time.sleep(0.1)
+        self._server.stop(grace=0.2)
+
+
+class _DummyProc:
+    def __init__(self, pid: int):
+        self.pid = pid
+
+    def poll(self):
+        try:
+            os.kill(self.pid, 0)
+            return None
+        except OSError:
+            return 1
+
+    def terminate(self):
+        try:
+            os.kill(self.pid, 15)
+        except OSError:
+            pass
+
+
+def main():  # pragma: no cover - run as subprocess
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--num-cpus", type=float, default=float(os.cpu_count() or 4))
+    parser.add_argument("--num-tpus", type=float, default=0.0)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--labels", default="{}")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    import json
+
+    resources = {"CPU": args.num_cpus}
+    if args.num_tpus:
+        resources["TPU"] = args.num_tpus
+    resources.update(json.loads(args.resources))
+    nm = NodeManager(args.gcs_address, port=args.port, resources=resources,
+                     labels=json.loads(args.labels))
+    print(f"NODE_PORT={nm.port}", flush=True)
+    print(f"NODE_ID={nm.node_id}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        nm.shutdown()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

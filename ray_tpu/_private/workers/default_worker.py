@@ -1,0 +1,259 @@
+"""Worker process: executes tasks and hosts actors.
+
+Reference: ``python/ray/_private/workers/default_worker.py`` + the executor
+side of the CoreWorker (``core_worker.cc:3813`` HandlePushTask →
+``ExecuteTask`` :3239 → language callback). This process:
+
+* starts a ``WorkerService`` gRPC server and announces itself to the node
+  manager (the raylet's worker-registration handshake);
+* executes pushed normal tasks one at a time (the reference leases a worker
+  to one owner at a time);
+* hosts actor instances with per-caller sequence ordering (reference:
+  ``actor_scheduling_queue.h`` — out-of-order arrivals wait for their
+  sequence number);
+* resolves top-level ``ObjectRef`` args through the cluster runtime before
+  invoking user code (reference: ``dependency_resolver.h``), and returns
+  results inline when small or via the node object store when large.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import pickle
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import exceptions
+from ray_tpu._private import rpc
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.runtime.cluster import (
+    ClusterRuntime,
+    INLINE_RESULT_MAX,
+    dumps,
+    loads,
+)
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+
+class _ActorRunner:
+    """Per-caller sequence ordering + single-slot execution for one actor."""
+
+    def __init__(self, instance: Any):
+        self.instance = instance
+        self.cond = threading.Condition()
+        self.next_seq: Dict[bytes, int] = {}
+        self.dead = False
+
+    def wait_turn(self, caller: bytes, seq: int) -> bool:
+        deadline = time.monotonic() + 120.0
+        with self.cond:
+            while not self.dead and self.next_seq.get(caller, 0) != seq:
+                if self.next_seq.get(caller, 0) > seq:
+                    return False  # duplicate/stale
+                if time.monotonic() > deadline:
+                    return False  # ordering gap (stale session) — fail task
+                self.cond.wait(timeout=1.0)
+            return not self.dead
+
+    def complete(self, caller: bytes, seq: int):
+        with self.cond:
+            self.next_seq[caller] = max(self.next_seq.get(caller, 0), seq + 1)
+            self.cond.notify_all()
+
+
+class WorkerServer:
+    def __init__(self, node_address: str, gcs_address: str, worker_id: str,
+                 node_id: str):
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.runtime = ClusterRuntime(gcs_address, node_address,
+                                      is_worker=True, worker_id=worker_id)
+        worker_mod._global_worker = worker_mod.Worker(self.runtime, "worker")
+        self._actors: Dict[bytes, _ActorRunner] = {}
+        self._task_lock = threading.Lock()  # one normal task at a time
+        self._exit = threading.Event()
+        self._server, self.port = rpc.serve("WorkerService", self)
+        self.address = f"127.0.0.1:{self.port}"
+        self.node = rpc.get_stub("NodeService", node_address)
+        self.node.AnnounceWorker(pb.AnnounceWorkerRequest(
+            worker_id=worker_id, address=self.address, pid=os.getpid()))
+
+    # ------------------------------------------------------------- helpers
+    def _resolve_args(self, args, kwargs):
+        """Top-level ObjectRef resolution (nested refs pass through)."""
+        refs = [a for a in args if isinstance(a, ObjectRef)]
+        refs += [v for v in kwargs.values() if isinstance(v, ObjectRef)]
+        if refs:
+            values = self.runtime.get(refs, timeout=300.0)
+            table = {r.id(): v for r, v in zip(refs, values)}
+            args = tuple(table[a.id()] if isinstance(a, ObjectRef) else a
+                         for a in args)
+            kwargs = {k: (table[v.id()] if isinstance(v, ObjectRef) else v)
+                      for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _package_results(self, result, return_ids) -> pb.PushTaskResult:
+        n = len(return_ids)
+        if n == 1:
+            values = [result]
+        elif isinstance(result, (tuple, list)) and len(result) == n:
+            values = list(result)
+        else:
+            err = exceptions.RayTpuError(
+                f"Task declared num_returns={n} but returned "
+                f"{type(result).__name__}")
+            return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
+        out = pb.PushTaskResult(ok=True)
+        for oid, value in zip(return_ids, values):
+            data = dumps(value)
+            if len(data) <= INLINE_RESULT_MAX:
+                out.inline_results.append(data)
+                out.in_store.append(False)
+            else:
+                self.node.PutObject(pb.PutObjectRequest(
+                    object_id=bytes(oid), data=data, owner=self.worker_id))
+                out.inline_results.append(b"")
+                out.in_store.append(True)
+        return out
+
+    def _error_result(self, e: BaseException, name: str) -> pb.PushTaskResult:
+        if isinstance(e, exceptions.RayTpuError):
+            err: BaseException = e
+        else:
+            err = exceptions.RayTaskError.from_exception(e, name)
+        try:
+            blob = pickle.dumps(err)
+        except Exception:  # unpicklable exception chain — degrade to text
+            err = exceptions.RayTaskError(
+                name, "".join(traceback.format_exception(e)))
+            blob = pickle.dumps(err)
+        return pb.PushTaskResult(ok=False, error=blob)
+
+    # ------------------------------------------------------------- service
+    def PushTask(self, request, context):
+        spec = request.spec
+        if spec.actor_id:
+            return self._push_actor_task(spec)
+        return self._push_normal_task(spec)
+
+    def _push_normal_task(self, spec) -> pb.PushTaskResult:
+        with self._task_lock:
+            try:
+                fn, args, kwargs = loads(spec.payload)
+                args, kwargs = self._resolve_args(args, kwargs)
+                result = fn(*args, **kwargs)
+                if hasattr(result, "__next__"):  # generator tasks
+                    result = tuple(result) if len(spec.return_ids) > 1 \
+                        else list(result)
+                return self._package_results(result, spec.return_ids)
+            except BaseException as e:  # noqa: BLE001
+                return self._error_result(e, spec.name)
+
+    def _push_actor_task(self, spec) -> pb.PushTaskResult:
+        runner = self._actors.get(spec.actor_id)
+        if runner is None or runner.dead:
+            err = exceptions.ActorDiedError(
+                ActorID(bytes(spec.actor_id)), "actor not hosted here")
+            return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
+        caller = bytes(spec.caller_address)
+        if not runner.wait_turn(caller, spec.sequence_no):
+            err = exceptions.ActorDiedError(
+                ActorID(bytes(spec.actor_id)), "actor died")
+            return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
+        try:
+            _, args, kwargs = loads(spec.payload)
+            args, kwargs = self._resolve_args(args, kwargs)
+            method = getattr(runner.instance, spec.method_name)
+            result = method(*args, **kwargs)
+            return self._package_results(result, spec.return_ids)
+        except exceptions.AsyncioActorExit:
+            self._terminate_actor(spec.actor_id, "exit_actor() called")
+            return self._package_results(None, spec.return_ids)
+        except BaseException as e:  # noqa: BLE001
+            return self._error_result(e, f"{spec.method_name}")
+        finally:
+            runner.complete(caller, spec.sequence_no)
+
+    def CreateActor(self, request, context):
+        info = request.info
+        try:
+            outer = pickle.loads(info.spec)
+            cls, args, kwargs, options = loads(outer["payload"])
+            instance = cls(*args, **kwargs)
+            self._actors[bytes(info.actor_id)] = _ActorRunner(instance)
+            return pb.CreateActorReply(ok=True)
+        except BaseException as e:  # noqa: BLE001
+            return pb.CreateActorReply(
+                ok=False,
+                error="".join(traceback.format_exception(e)))
+
+    def KillActor(self, request, context):
+        self._terminate_actor(request.actor_id, "killed")
+        return pb.Empty()
+
+    def _terminate_actor(self, actor_id: bytes, reason: str):
+        runner = self._actors.pop(bytes(actor_id), None)
+        if runner is not None:
+            runner.dead = True
+            with runner.cond:
+                runner.cond.notify_all()
+        # An actor worker is dedicated; exit so the pool reaps it.
+        threading.Thread(target=self._delayed_exit, daemon=True).start()
+
+    def _delayed_exit(self):
+        time.sleep(0.2)
+        os._exit(0)
+
+    def Stacktrace(self, request, context):
+        import faulthandler
+        import io
+
+        buf = io.StringIO()
+        faulthandler.dump_traceback(file=buf)
+        return pb.WorkerStacktraceReply(stacktrace=buf.getvalue())
+
+    def run_forever(self):
+        """Serve until exit; a worker whose node manager dies exits too
+        (reference: workers die with their raylet)."""
+        misses = 0
+        try:
+            while not self._exit.is_set():
+                time.sleep(2)
+                try:
+                    self.node.GetObject(
+                        pb.GetObjectRequest(object_id=b"\x00" * 28), timeout=2)
+                    misses = 0
+                except Exception:  # noqa: BLE001
+                    misses += 1
+                    if misses >= 3:
+                        logger.warning("node manager unreachable; exiting")
+                        os._exit(0)
+        except KeyboardInterrupt:
+            pass
+
+
+def main():  # pragma: no cover - runs as a subprocess
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--node-address", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--node-id", required=True)
+    args = parser.parse_args()
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[worker {args.worker_id[:8]}] %(message)s")
+    server = WorkerServer(args.node_address, args.gcs_address,
+                          args.worker_id, args.node_id)
+    server.run_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

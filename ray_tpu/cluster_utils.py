@@ -1,0 +1,64 @@
+"""Multi-node test clusters on one host.
+
+Reference: ``python/ray/cluster_utils.py:135`` (``Cluster.add_node`` :202,
+``remove_node`` :286) — the fixture the reference uses for multi-node and
+kill/failover tests without real machines. Here the GCS and node managers run
+in-process (each with a real gRPC server); worker processes are real OS
+subprocesses, so task execution crosses real process boundaries.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private.gcs.server import GcsServer
+from ray_tpu._private.node_manager.server import NodeManager
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: Optional[Dict] = None):
+        self.gcs = GcsServer(port=0)
+        self.address = f"127.0.0.1:{self.gcs.port}"
+        self.nodes: List[NodeManager] = []
+        self.head_node: Optional[NodeManager] = None
+        if initialize_head:
+            self.head_node = self.add_node(**(head_node_args or {}))
+
+    def add_node(self, num_cpus: float = 4, num_tpus: float = 0,
+                 resources: Optional[Dict[str, float]] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 **kwargs) -> NodeManager:
+        res = {"CPU": float(num_cpus)}
+        if num_tpus:
+            res["TPU"] = float(num_tpus)
+        res.update(resources or {})
+        node = NodeManager(self.address, resources=res, labels=labels)
+        self.nodes.append(node)
+        return node
+
+    def remove_node(self, node: NodeManager, allow_graceful: bool = True):
+        node.shutdown(graceful=allow_graceful)
+        if node in self.nodes:
+            self.nodes.remove(node)
+
+    def wait_for_nodes(self, timeout_s: float = 30.0) -> None:
+        from ray_tpu._private import rpc
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        want = len(self.nodes)
+        gcs = rpc.get_stub("GcsService", self.address)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            alive = [n for n in gcs.GetNodes(pb.GetNodesRequest()).nodes
+                     if n.alive]
+            if len(alive) >= want:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"cluster did not reach {want} alive nodes")
+
+    def shutdown(self):
+        for node in list(self.nodes):
+            self.remove_node(node)
+        self.gcs.shutdown()

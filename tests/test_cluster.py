@@ -1,0 +1,182 @@
+"""Multi-process cluster tests (reference: python/ray/tests/test_basic*.py,
+test_actor_failures.py, test_gcs_fault_tolerance.py — via cluster_utils)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = Cluster(head_node_args={"num_cpus": 4})
+    c.add_node(num_cpus=4, resources={"special": 2.0})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@ray_tpu.remote
+def add(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def make_big(n):
+    return np.arange(n)
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.value = start
+
+    def incr(self, by=1):
+        self.value += by
+        return self.value
+
+    def get(self):
+        return self.value
+
+    def crash(self):
+        os._exit(1)
+
+
+def test_cluster_resources(cluster):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 8.0
+    assert res["special"] == 2.0
+    assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 2
+
+
+def test_simple_task(cluster):
+    assert ray_tpu.get(add.remote(1, 2), timeout=60) == 3
+
+
+def test_many_parallel_tasks(cluster):
+    refs = [add.remote(i, i) for i in range(100)]
+    assert sum(ray_tpu.get(refs, timeout=120)) == sum(2 * i for i in range(100))
+
+
+def test_task_errors_propagate(cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bad input")
+
+    with pytest.raises(ValueError, match="bad input"):
+        ray_tpu.get(boom.remote(), timeout=60)
+
+
+def test_large_object_and_dependency(cluster):
+    ref = make_big.remote(500_000)  # ~4MB: goes through the node store
+    out = ray_tpu.get(add.remote(ref, 1), timeout=60)
+    np.testing.assert_array_equal(out, np.arange(500_000) + 1)
+
+
+def test_put_get_roundtrip(cluster):
+    data = {"x": np.random.rand(1000), "y": [1, 2, 3]}
+    got = ray_tpu.get(ray_tpu.put(data))
+    np.testing.assert_array_equal(got["x"], data["x"])
+    assert got["y"] == data["y"]
+
+
+def test_spillback_to_node_with_resource(cluster):
+    @ray_tpu.remote(resources={"special": 1.0}, num_cpus=0.1)
+    def where_am_i():
+        return os.getpid()
+
+    # "special" exists only on the second node: the local lease must spill.
+    pid = ray_tpu.get(where_am_i.remote(), timeout=60)
+    assert isinstance(pid, int)
+
+
+def test_infeasible_task_raises(cluster):
+    @ray_tpu.remote(resources={"nonexistent": 1.0})
+    def impossible():
+        return 1
+
+    with pytest.raises(Exception, match="satisfy|infeasible"):
+        ray_tpu.get(impossible.remote(), timeout=60)
+
+
+def test_actor_lifecycle(cluster):
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 11
+    assert ray_tpu.get(c.incr.remote(5), timeout=30) == 16
+    assert ray_tpu.get(c.get.remote(), timeout=30) == 16
+
+
+def test_actor_ordering(cluster):
+    c = Counter.remote(0)
+    refs = [c.incr.remote() for _ in range(20)]
+    values = ray_tpu.get(refs, timeout=60)
+    assert values == list(range(1, 21))
+
+
+def test_named_actor(cluster):
+    c = Counter.options(name="global_counter").remote(100)
+    ray_tpu.get(c.get.remote(), timeout=60)
+    handle = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(handle.get.remote(), timeout=30) == 100
+    names = ray_tpu.list_named_actors()
+    assert "global_counter" in names
+    ray_tpu.kill(handle)
+
+
+def test_actor_restart_on_worker_crash(cluster):
+    c = Counter.options(max_restarts=1).remote(5)
+    assert ray_tpu.get(c.get.remote(), timeout=60) == 5
+    try:
+        ray_tpu.get(c.crash.remote(), timeout=30)
+    except Exception:
+        pass
+    # GCS restarts the actor on worker death; state resets to __init__ args.
+    deadline = time.monotonic() + 60
+    value = None
+    while time.monotonic() < deadline:
+        try:
+            value = ray_tpu.get(c.get.remote(), timeout=30)
+            break
+        except Exception:
+            time.sleep(0.5)
+    assert value == 5
+
+
+def test_actor_error_propagates(cluster):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+    b = Bad.remote()
+    with pytest.raises(RuntimeError, match="actor method failed"):
+        ray_tpu.get(b.fail.remote(), timeout=60)
+
+
+def test_nested_tasks(cluster):
+    @ray_tpu.remote
+    def outer(x):
+        inner_ref = add.remote(x, 1)
+        return ray_tpu.get(inner_ref, timeout=60) * 2
+
+    assert ray_tpu.get(outer.remote(5), timeout=60) == 12
+
+
+def test_wait(cluster):
+    @ray_tpu.remote
+    def slow(t):
+        time.sleep(t)
+        return t
+
+    fast_ref = slow.remote(0.01)
+    slow_ref = slow.remote(5.0)
+    ready, not_ready = ray_tpu.wait([fast_ref, slow_ref], num_returns=1,
+                                    timeout=30)
+    assert ready == [fast_ref]
+    assert not_ready == [slow_ref]

@@ -1,0 +1,158 @@
+"""Node-death, actor-restart-across-nodes, and placement-group tests
+(reference: test_actor_failures.py, test_placement_group*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+
+@pytest.fixture
+def fresh_cluster():
+    c = Cluster(head_node_args={"num_cpus": 4})
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@ray_tpu.remote
+class Pinned:
+    def __init__(self):
+        self.n = 0
+
+    def ping(self):
+        self.n += 1
+        return self.n
+
+
+def test_node_death_detected_and_actor_restarts(fresh_cluster):
+    c = fresh_cluster
+    second = c.add_node(num_cpus=2, resources={"pin": 1.0})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+
+    a = Pinned.options(resources={"pin": 1.0}, max_restarts=1).remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+
+    # Crash the second node (no drain): the GCS health checker must notice.
+    c.remove_node(second, allow_graceful=False)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        alive = [n for n in ray_tpu.nodes() if n["Alive"]]
+        if len(alive) == 1:
+            break
+        time.sleep(0.25)
+    assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 1
+
+    # The actor demanded {"pin": 1} which only the dead node had -> DEAD after
+    # restart attempt fails (no feasible node).
+    deadline = time.monotonic() + 30
+    died = False
+    while time.monotonic() < deadline:
+        try:
+            ray_tpu.get(a.ping.remote(), timeout=10)
+        except Exception:
+            died = True
+            break
+        time.sleep(0.25)
+    assert died
+
+
+def test_actor_restarts_on_surviving_node(fresh_cluster):
+    c = fresh_cluster
+    second = c.add_node(num_cpus=2)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+
+    # No placement constraint: restart can land on the surviving node.
+    actors = [Pinned.options(max_restarts=2).remote() for _ in range(3)]
+    for a in actors:
+        assert ray_tpu.get(a.ping.remote(), timeout=60) == 1
+
+    c.remove_node(second, allow_graceful=False)
+
+    # Every actor must eventually answer again (some restarted on node 1).
+    for a in actors:
+        deadline = time.monotonic() + 60
+        ok = False
+        while time.monotonic() < deadline:
+            try:
+                ray_tpu.get(a.ping.remote(), timeout=10)
+                ok = True
+                break
+            except Exception:
+                time.sleep(0.5)
+        assert ok, "actor did not recover after node death"
+
+
+def _make_pg(gcs_address, group_id, strategy, bundles):
+    gcs = rpc.get_stub("GcsService", gcs_address)
+    req = pb.CreatePlacementGroupRequest(
+        group_id=group_id, name="pg", strategy=strategy)
+    for i, res in enumerate(bundles):
+        b = pb.Bundle(index=i)
+        for k, v in res.items():
+            b.resources[k] = v
+        req.bundles.append(b)
+    gcs.CreatePlacementGroup(req)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        reply = gcs.GetPlacementGroup(
+            pb.GetPlacementGroupRequest(group_id=group_id))
+        if reply.found and reply.info.state in ("CREATED", "INFEASIBLE"):
+            return reply.info
+        time.sleep(0.1)
+    raise TimeoutError("placement group did not settle")
+
+
+def test_placement_group_pack_and_spread(fresh_cluster):
+    c = fresh_cluster
+    c.add_node(num_cpus=4)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+
+    info = _make_pg(c.address, b"pg1" + b"\x00" * 13, "PACK",
+                    [{"CPU": 1.0}, {"CPU": 1.0}])
+    assert info.state == "CREATED"
+    # PACK prefers one node for both bundles.
+    assert len({b.node_id for b in info.bundles}) == 1
+
+    info = _make_pg(c.address, b"pg2" + b"\x00" * 13, "STRICT_SPREAD",
+                    [{"CPU": 1.0}, {"CPU": 1.0}])
+    assert info.state == "CREATED"
+    assert len({b.node_id for b in info.bundles}) == 2
+
+    # Bundles consumed resources: 4 CPUs reserved across the cluster
+    # (the GCS view refreshes with heartbeats, so poll).
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ray_tpu.available_resources().get("CPU", 8.0) <= 4.0:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources()["CPU"] <= 4.0
+
+    # Removing the groups releases resources.
+    gcs = rpc.get_stub("GcsService", c.address)
+    gcs.RemovePlacementGroup(
+        pb.RemovePlacementGroupRequest(group_id=b"pg1" + b"\x00" * 13))
+    gcs.RemovePlacementGroup(
+        pb.RemovePlacementGroupRequest(group_id=b"pg2" + b"\x00" * 13))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if ray_tpu.available_resources().get("CPU", 0) >= 8.0:
+            break
+        time.sleep(0.2)
+    assert ray_tpu.available_resources()["CPU"] >= 8.0
+
+
+def test_placement_group_infeasible(fresh_cluster):
+    c = fresh_cluster
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    info = _make_pg(c.address, b"pg3" + b"\x00" * 13, "STRICT_PACK",
+                    [{"CPU": 100.0}])
+    assert info.state == "INFEASIBLE"
