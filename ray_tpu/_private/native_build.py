@@ -1,0 +1,53 @@
+"""On-demand build of the native components (g++ → .so, loaded via ctypes).
+
+The reference ships prebuilt C++ via bazel + Cython; this build compiles at
+first use (results cached next to the sources) because the distribution is a
+source tree. Set ``RAY_TPU_DISABLE_NATIVE=1`` to force the pure-python
+fallbacks.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_cached: dict = {}
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+
+
+def native_lib_path(name: str = "shm_store") -> Optional[str]:
+    """Return the path to ``lib<name>.so``, building it if necessary."""
+    if os.environ.get("RAY_TPU_DISABLE_NATIVE"):
+        return None
+    with _lock:
+        if name in _cached:
+            return _cached[name]
+        so = os.path.join(_NATIVE_DIR, "build", f"lib{name}.so")
+        src = os.path.join(_NATIVE_DIR, f"{name}.cpp")
+        if not os.path.exists(src):
+            _cached[name] = None
+            return None
+        if not os.path.exists(so) or \
+                os.path.getmtime(so) < os.path.getmtime(src):
+            os.makedirs(os.path.dirname(so), exist_ok=True)
+            cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-Wall",
+                   "-o", so, src, "-lrt"]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+            except Exception as e:  # noqa: BLE001
+                logger.warning("native build failed (%s); using python "
+                               "fallback", e)
+                _cached[name] = None
+                return None
+        _cached[name] = so
+        return so

@@ -60,9 +60,21 @@ class NodeManager:
         self.available = dict(resources)
         self._res_lock = threading.RLock()
 
-        # object store (interim in-memory; owner plane for the shm store)
+        # object store: native shared-memory data plane (plasma-equivalent,
+        # native/shm_store.cpp) with a python-dict fallback. The dict also
+        # backs values received without a local shm segment.
         self._objects: Dict[bytes, bytes] = {}
         self._obj_lock = threading.RLock()
+        self._shm = None
+        try:
+            from ray_tpu._private.shm import ShmStore
+
+            self._shm = ShmStore(
+                capacity_bytes=int(os.environ.get(
+                    "RAY_TPU_OBJECT_STORE_BYTES", 4 << 30)))
+        except Exception as e:  # noqa: BLE001
+            logger.warning("native shm store unavailable (%s); "
+                           "using in-memory store", e)
 
         # worker pool
         self._workers: Dict[str, _Worker] = {}
@@ -381,28 +393,49 @@ class NodeManager:
 
     # ------------------------------------------------------------ objects
     def PutObject(self, request, context):
-        with self._obj_lock:
-            self._objects[request.object_id] = request.data
+        size = request.size or len(request.data)
+        if request.shm_name and self._shm is not None:
+            # Zero-copy put: the client already created+sealed the segment;
+            # only the metadata is registered (plasma Create/Seal protocol).
+            self._shm.register(request.object_id.hex(), request.shm_name,
+                               request.size)
+        elif self._shm is not None and request.data:
+            self._shm.put(request.object_id.hex(), request.data)
+        else:
+            with self._obj_lock:
+                self._objects[request.object_id] = request.data
         try:
             self.gcs.UpdateObjectLocation(pb.ObjectLocationUpdate(
                 object_id=request.object_id, node_id=self.node_id,
-                added=True, size=len(request.data)))
+                added=True, size=size))
         except Exception:  # noqa: BLE001
             pass
         return pb.Empty()
 
     def GetObject(self, request, context):
+        if self._shm is not None:
+            meta = self._shm.get(request.object_id.hex())
+            if meta is not None:
+                name, size = meta
+                return pb.GetObjectReply(found=True, shm_name=name, size=size)
         with self._obj_lock:
             data = self._objects.get(request.object_id)
         if data is None:
             return pb.GetObjectReply(found=False)
         return pb.GetObjectReply(found=True, data=data)
 
+    def _read_object_bytes(self, object_id: bytes) -> Optional[bytes]:
+        if self._shm is not None:
+            data = self._shm.read(object_id.hex())
+            if data is not None:
+                return data
+        with self._obj_lock:
+            return self._objects.get(object_id)
+
     def PullObject(self, request, context):
         """Chunked streaming transfer (reference: ObjectManager 64MB chunks,
         object_manager.h:117)."""
-        with self._obj_lock:
-            data = self._objects.get(request.object_id)
+        data = self._read_object_bytes(request.object_id)
         if data is None:
             yield pb.ObjectChunk(object_id=request.object_id, found=False,
                                  eof=True)
@@ -419,6 +452,8 @@ class NodeManager:
             for oid in request.object_ids:
                 self._objects.pop(oid, None)
         for oid in request.object_ids:
+            if self._shm is not None:
+                self._shm.delete(oid.hex())
             try:
                 self.gcs.UpdateObjectLocation(pb.ObjectLocationUpdate(
                     object_id=oid, node_id=self.node_id, added=False))
@@ -449,6 +484,11 @@ class NodeManager:
                     pass
             time.sleep(0.1)
         self._server.stop(grace=0.2)
+        if self._shm is not None:
+            try:
+                self._shm.close()
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class _DummyProc:

@@ -46,8 +46,38 @@ def dumps(value: Any) -> bytes:
     return cloudpickle.dumps(value)
 
 
-def loads(data: bytes) -> Any:
+def loads(data) -> Any:
     return cloudpickle.loads(data)
+
+
+def put_bytes_to_node(node_stub, oid_binary: bytes, data: bytes,
+                      owner: str) -> None:
+    """Store serialized bytes on a node: large payloads go through a
+    client-created shm segment (zero-copy data plane, metadata-only RPC);
+    small ones ride inline in the RPC."""
+    from ray_tpu._private.shm import ShmClient
+
+    if len(data) > INLINE_RESULT_MAX and ShmClient.available():
+        seg = f"/rtpu.{oid_binary.hex()[:48]}"
+        if ShmClient.create_segment(seg, data):
+            node_stub.PutObject(pb.PutObjectRequest(
+                object_id=oid_binary, shm_name=seg, size=len(data),
+                owner=owner))
+            return
+    node_stub.PutObject(pb.PutObjectRequest(
+        object_id=oid_binary, data=data, owner=owner))
+
+
+def read_object_reply(reply) -> Any:
+    """Materialize a GetObjectReply: map the shm segment when present."""
+    from ray_tpu._private.shm import ShmClient
+
+    if reply.shm_name:
+        data = ShmClient.read_segment(reply.shm_name, reply.size)
+        if data is None:
+            return None
+        return loads(data)
+    return loads(reply.data)
 
 
 class ClusterRuntime(CoreRuntime):
@@ -115,13 +145,11 @@ class ClusterRuntime(CoreRuntime):
         oid = ObjectID.from_task(self._put_task_id, self._next_put_index())
         data = dumps(value)
         try:
-            self.node.PutObject(pb.PutObjectRequest(
-                object_id=oid.binary(), data=data, owner=self.worker_id))
+            put_bytes_to_node(self.node, oid.binary(), data, self.worker_id)
         except Exception:  # noqa: BLE001
             if not self._refresh_local_node():
                 raise
-            self.node.PutObject(pb.PutObjectRequest(
-                object_id=oid.binary(), data=data, owner=self.worker_id))
+            put_bytes_to_node(self.node, oid.binary(), data, self.worker_id)
         self.memory.put(oid, value)
         return ObjectRef(oid, owner_address=self.node_address)
 
@@ -140,9 +168,10 @@ class ClusterRuntime(CoreRuntime):
             self._refresh_local_node()
             reply = pb.GetObjectReply(found=False)
         if reply.found:
-            value = loads(reply.data)
-            self.memory.put(oid, value)
-            return True, value
+            value = read_object_reply(reply)
+            if value is not None or not reply.shm_name:
+                self.memory.put(oid, value)
+                return True, value
         candidates = []
         if ref.owner_address() and ref.owner_address() != self.node_address:
             candidates.append(ref.owner_address())
@@ -174,9 +203,8 @@ class ClusterRuntime(CoreRuntime):
                     value = loads(bytes(buf))
                     self.memory.put(oid, value)
                     try:  # cache on this node for future consumers
-                        self.node.PutObject(pb.PutObjectRequest(
-                            object_id=oid.binary(), data=bytes(buf),
-                            owner=self.worker_id))
+                        put_bytes_to_node(self.node, oid.binary(),
+                                          bytes(buf), self.worker_id)
                     except Exception:  # noqa: BLE001
                         pass
                     return True, value

@@ -1,0 +1,175 @@
+"""ctypes binding to the native shared-memory object store (native/shm_store.cpp).
+
+Two roles (mirroring plasma store vs plasma client, reference C12):
+
+* :class:`ShmStore` — lives in the node manager; owns the index, LRU
+  eviction, and segment lifecycle.
+* :class:`ShmClient` — lives in workers/drivers; creates sealed segments
+  directly (zero-copy put: data never crosses a socket) and maps segments
+  read-only for get.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+import uuid
+from typing import Optional, Tuple
+
+from ray_tpu._private.native_build import native_lib_path
+
+logger = logging.getLogger(__name__)
+
+_NAME_CAP = 192
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    path = native_lib_path("shm_store")
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.shm_store_create.restype = ctypes.c_void_p
+    lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shm_store_destroy.argtypes = [ctypes.c_void_p]
+    lib.shm_store_put.restype = ctypes.c_int
+    lib.shm_store_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.c_char_p, ctypes.c_uint64]
+    lib.shm_store_register.restype = ctypes.c_int
+    lib.shm_store_register.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_char_p, ctypes.c_uint64]
+    lib.shm_store_get.restype = ctypes.c_int
+    lib.shm_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_char_p, ctypes.c_uint64,
+                                  ctypes.POINTER(ctypes.c_uint64)]
+    lib.shm_store_contains.restype = ctypes.c_int
+    lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_delete.restype = ctypes.c_int
+    lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_used.restype = ctypes.c_uint64
+    lib.shm_store_used.argtypes = [ctypes.c_void_p]
+    lib.shm_store_count.restype = ctypes.c_uint64
+    lib.shm_store_count.argtypes = [ctypes.c_void_p]
+    lib.shm_client_map.restype = ctypes.c_void_p
+    lib.shm_client_map.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.shm_client_unmap.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.shm_client_create.restype = ctypes.c_int
+    lib.shm_client_create.argtypes = [ctypes.c_char_p, ctypes.c_char_p,
+                                      ctypes.c_uint64]
+    return lib
+
+
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_loaded = False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_loaded
+    with _lib_lock:
+        if not _lib_loaded:
+            try:
+                _lib = _load()
+            except Exception as e:  # noqa: BLE001
+                logger.warning("shm native lib unavailable: %s", e)
+                _lib = None
+            _lib_loaded = True
+        return _lib
+
+
+class ShmStore:
+    """Node-manager-side store (index + eviction + lifecycle)."""
+
+    def __init__(self, capacity_bytes: int = 4 << 30,
+                 prefix: Optional[str] = None):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native shm store unavailable")
+        self._lib = lib
+        self.prefix = prefix or f"raytpu.{uuid.uuid4().hex[:12]}"
+        self._h = ctypes.c_void_p(
+            lib.shm_store_create(self.prefix.encode(), capacity_bytes))
+
+    def put(self, oid_hex: str, data: bytes) -> Optional[str]:
+        name = ctypes.create_string_buffer(_NAME_CAP)
+        rc = self._lib.shm_store_put(self._h, oid_hex.encode(), data,
+                                     len(data), name, _NAME_CAP)
+        return name.value.decode() if rc == 0 else None
+
+    def register(self, oid_hex: str, name: str, size: int) -> bool:
+        return self._lib.shm_store_register(
+            self._h, oid_hex.encode(), name.encode(), size) == 0
+
+    def get(self, oid_hex: str) -> Optional[Tuple[str, int]]:
+        name = ctypes.create_string_buffer(_NAME_CAP)
+        size = ctypes.c_uint64()
+        rc = self._lib.shm_store_get(self._h, oid_hex.encode(), name,
+                                     _NAME_CAP, ctypes.byref(size))
+        if rc != 0:
+            return None
+        return name.value.decode(), size.value
+
+    def read(self, oid_hex: str) -> Optional[bytes]:
+        """Copy an object out (used by the remote-pull streaming path)."""
+        meta = self.get(oid_hex)
+        if meta is None:
+            return None
+        name, size = meta
+        return ShmClient.read_segment(name, size)
+
+    def contains(self, oid_hex: str) -> bool:
+        return bool(self._lib.shm_store_contains(self._h, oid_hex.encode()))
+
+    def delete(self, oid_hex: str) -> bool:
+        return self._lib.shm_store_delete(self._h, oid_hex.encode()) == 0
+
+    def stats(self) -> Tuple[int, int]:
+        return (self._lib.shm_store_used(self._h),
+                self._lib.shm_store_count(self._h))
+
+    def close(self):
+        if self._h:
+            self._lib.shm_store_destroy(self._h)
+            self._h = None
+
+
+class ShmClient:
+    """Worker/driver-side access: direct create + read-only map."""
+
+    @staticmethod
+    def available() -> bool:
+        return get_lib() is not None
+
+    @staticmethod
+    def create_segment(name: str, data: bytes) -> bool:
+        lib = get_lib()
+        if lib is None:
+            return False
+        return lib.shm_client_create(name.encode(), data, len(data)) == 0
+
+    @staticmethod
+    def read_segment(name: str, size: int) -> Optional[bytes]:
+        lib = get_lib()
+        if lib is None:
+            return None
+        ptr = lib.shm_client_map(name.encode(), size)
+        if not ptr:
+            return None
+        try:
+            return ctypes.string_at(ptr, size)
+        finally:
+            lib.shm_client_unmap(ptr, size)
+
+    @staticmethod
+    def map_segment(name: str, size: int) -> Optional[memoryview]:
+        """Zero-copy read-only view (caller must keep the view referenced)."""
+        lib = get_lib()
+        if lib is None:
+            return None
+        ptr = lib.shm_client_map(name.encode(), size)
+        if not ptr:
+            return None
+        array = (ctypes.c_char * size).from_address(ptr)
+        return memoryview(array)

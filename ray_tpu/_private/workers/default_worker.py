@@ -37,6 +37,7 @@ from ray_tpu._private.runtime.cluster import (
     INLINE_RESULT_MAX,
     dumps,
     loads,
+    put_bytes_to_node,
 )
 from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
@@ -118,8 +119,7 @@ class WorkerServer:
                 out.inline_results.append(data)
                 out.in_store.append(False)
             else:
-                self.node.PutObject(pb.PutObjectRequest(
-                    object_id=bytes(oid), data=data, owner=self.worker_id))
+                put_bytes_to_node(self.node, bytes(oid), data, self.worker_id)
                 out.inline_results.append(b"")
                 out.in_store.append(True)
         return out
