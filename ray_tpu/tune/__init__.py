@@ -1,0 +1,30 @@
+"""ray_tpu.tune: hyperparameter tuning (reference: ``python/ray/tune``)."""
+
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+)
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import (
+    ResultGrid,
+    TrialResult,
+    TuneConfig,
+    Tuner,
+    report,
+    run,
+)
+
+__all__ = [
+    "AsyncHyperBandScheduler", "FIFOScheduler", "MedianStoppingRule",
+    "ResultGrid", "TrialResult", "TuneConfig", "Tuner", "choice",
+    "grid_search", "loguniform", "randint", "report", "run", "sample_from",
+    "uniform",
+]

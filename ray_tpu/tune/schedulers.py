@@ -1,0 +1,85 @@
+"""Trial schedulers: FIFO, ASHA (async successive halving), median stopping.
+
+Reference: ``python/ray/tune/schedulers`` — ``AsyncHyperBandScheduler``
+(async_hyperband.py) promotes trials through rungs, stopping those below the
+rung's top-1/reduction_factor quantile; ``MedianStoppingRule`` stops trials
+whose best result is below the median of peers at the same step.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        return CONTINUE
+
+
+class AsyncHyperBandScheduler:
+    """ASHA. ``time_attr`` steps are reported results (1-indexed)."""
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: int = 4):
+        assert mode in ("max", "min")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace = grace_period
+        self.rf = reduction_factor
+        # rung thresholds: milestones grace * rf^k up to max_t
+        self.rungs: List[int] = []
+        t = grace_period
+        while t < max_t:
+            self.rungs.append(t)
+            t *= reduction_factor
+        self.recorded: Dict[int, List[float]] = collections.defaultdict(list)
+
+    def _better(self, a: float, b: float) -> bool:
+        return a >= b if self.mode == "max" else a <= b
+
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        if self.mode == "min":
+            pass
+        for rung in reversed(self.rungs):
+            if step == rung:
+                values = self.recorded[rung]
+                values.append(value)
+                if len(values) < self.rf:
+                    return CONTINUE  # not enough peers yet: be permissive
+                ordered = sorted(values, reverse=(self.mode == "max"))
+                cutoff = ordered[max(len(ordered) // self.rf - 1, 0)]
+                if not self._better(value, cutoff):
+                    return STOP
+        return CONTINUE
+
+
+class MedianStoppingRule:
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.metric = metric
+        self.mode = mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self.best: Dict[str, float] = {}
+        self.histories: Dict[str, List[float]] = collections.defaultdict(list)
+
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        self.histories[trial_id].append(value)
+        if step <= self.grace:
+            return CONTINUE
+        peers = [max(h) if self.mode == "max" else min(h)
+                 for tid, h in self.histories.items() if tid != trial_id]
+        if len(peers) < self.min_samples:
+            return CONTINUE
+        peers_sorted = sorted(peers)
+        median = peers_sorted[len(peers_sorted) // 2]
+        mine = max(self.histories[trial_id]) if self.mode == "max" \
+            else min(self.histories[trial_id])
+        ok = mine >= median if self.mode == "max" else mine <= median
+        return CONTINUE if ok else STOP

@@ -1,0 +1,120 @@
+"""Search spaces + basic variant generation.
+
+Reference: ``python/ray/tune/search`` — the sampling primitives
+(``tune.choice/uniform/loguniform/randint``), ``tune.grid_search``, and the
+``BasicVariantGenerator`` that expands grid axes into the cross product and
+draws ``num_samples`` random samples of the remaining distributions.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high):
+        import math
+
+        self.log_low, self.log_high = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.log_low, self.log_high))
+
+
+class RandInt(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class GridSearch:
+    def __init__(self, values):
+        self.values = list(values)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def sample_from(fn: Callable[[Dict], Any]):
+    return _SampleFrom(fn)
+
+
+class _SampleFrom(Domain):
+    def __init__(self, fn):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn  # resolved after the rest of the config
+
+
+class BasicVariantGenerator:
+    """Cross product of grid axes × num_samples draws of distributions."""
+
+    def __init__(self, num_samples: int = 1, seed: Optional[int] = None):
+        self.num_samples = num_samples
+        self.rng = random.Random(seed)
+
+    def variants(self, param_space: Dict[str, Any]) -> Iterator[Dict[str, Any]]:
+        grid_keys = [k for k, v in param_space.items()
+                     if isinstance(v, GridSearch)]
+        grids = [param_space[k].values for k in grid_keys]
+        for _ in range(self.num_samples):
+            for combo in itertools.product(*grids) if grids else [()]:
+                cfg: Dict[str, Any] = {}
+                for k, v in param_space.items():
+                    if isinstance(v, GridSearch):
+                        cfg[k] = combo[grid_keys.index(k)]
+                    elif isinstance(v, _SampleFrom):
+                        cfg[k] = None  # placeholder, resolved below
+                    elif isinstance(v, Domain):
+                        cfg[k] = v.sample(self.rng)
+                    else:
+                        cfg[k] = v
+                for k, v in param_space.items():
+                    if isinstance(v, _SampleFrom):
+                        cfg[k] = v.fn(cfg)
+                yield cfg

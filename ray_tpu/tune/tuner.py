@@ -1,0 +1,210 @@
+"""Tuner + TuneController: trial orchestration over actors.
+
+Reference: ``python/ray/tune/tuner.py:44`` (Tuner) and
+``tune/execution/tune_controller.py:68`` — the event loop that launches trial
+actors up to the resource/concurrency budget, consumes their reported
+results, feeds the scheduler (early stopping), and assembles a ResultGrid.
+Trials here are actors running the user function in a thread with a report
+queue (the same session shape as ray_tpu.train's workers).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.search import BasicVariantGenerator
+
+_report_queue_var = threading.local()
+
+
+def report(metrics: Dict[str, Any], checkpoint=None) -> None:
+    """Report intermediate metrics from inside a trainable
+    (reference: ``ray.tune.report`` / ``session.report``)."""
+    q = getattr(_report_queue_var, "queue", None)
+    if q is None:
+        raise RuntimeError("tune.report() called outside a trial")
+    q.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+
+class _TrialActor:
+    """Runs one trial function; polled for reports (max_concurrency=2)."""
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+        self._done = threading.Event()
+        self._error: Optional[str] = None
+        self._final: Any = None
+
+    def run(self, fn: Callable, config: Dict[str, Any]):
+        _report_queue_var.queue = self._q
+        try:
+            self._final = fn(config)
+            if isinstance(self._final, dict):
+                self._q.put({"metrics": dict(self._final), "checkpoint": None})
+            return self._final
+        finally:
+            self._done.set()
+
+    def poll(self):
+        out = []
+        while True:
+            try:
+                out.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        return {"reports": out, "finished": self._done.is_set()}
+
+
+@dataclasses.dataclass
+class TuneConfig:
+    metric: Optional[str] = None
+    mode: str = "max"
+    num_samples: int = 1
+    max_concurrent_trials: Optional[int] = None
+    scheduler: Any = None
+    search_seed: Optional[int] = None
+
+
+@dataclasses.dataclass
+class TrialResult:
+    trial_id: str
+    config: Dict[str, Any]
+    metrics: Optional[Dict[str, Any]]
+    metrics_history: List[Dict[str, Any]]
+    error: Optional[str] = None
+    stopped_early: bool = False
+
+    @property
+    def last_result(self):
+        return self.metrics
+
+
+class ResultGrid:
+    def __init__(self, results: List[TrialResult], metric: Optional[str],
+                 mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    @property
+    def errors(self):
+        return [r for r in self._results if r.error]
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        scored = [r for r in self._results
+                  if r.metrics and metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        pick = max if mode == "max" else min
+        return pick(scored, key=lambda r: r.metrics[metric])
+
+
+class Tuner:
+    def __init__(self, trainable: Callable, *,
+                 param_space: Optional[Dict[str, Any]] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config=None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config
+
+    def fit(self) -> ResultGrid:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self.tune_config
+        generator = BasicVariantGenerator(tc.num_samples, tc.search_seed)
+        configs = list(generator.variants(self.param_space))
+        scheduler = tc.scheduler or sched_mod.FIFOScheduler()
+        limit = tc.max_concurrent_trials or len(configs)
+
+        trial_cls = ray_tpu.remote(_TrialActor)
+        pending = [(f"trial_{i:05d}_{uuid.uuid4().hex[:6]}", cfg)
+                   for i, cfg in enumerate(configs)]
+        running: Dict[str, Dict[str, Any]] = {}
+        results: List[TrialResult] = []
+
+        while pending or running:
+            # Launch up to the concurrency limit.
+            while pending and len(running) < limit:
+                trial_id, cfg = pending.pop(0)
+                actor = trial_cls.options(max_concurrency=2).remote()
+                run_ref = actor.run.remote(self.trainable, cfg)
+                running[trial_id] = {
+                    "actor": actor, "config": cfg, "run_ref": run_ref,
+                    "history": [], "steps": 0, "stopped": False,
+                }
+            # Poll every running trial.
+            for trial_id, st in list(running.items()):
+                try:
+                    poll = ray_tpu.get(st["actor"].poll.remote(), timeout=30)
+                except Exception as e:  # actor died
+                    results.append(TrialResult(
+                        trial_id, st["config"],
+                        st["history"][-1] if st["history"] else None,
+                        st["history"], error=str(e)))
+                    del running[trial_id]
+                    continue
+                stop = False
+                for r in poll["reports"]:
+                    st["steps"] += 1
+                    st["history"].append(r["metrics"])
+                    if tc.metric and tc.metric in r["metrics"]:
+                        verdict = scheduler.on_result(
+                            trial_id, st["steps"],
+                            float(r["metrics"][tc.metric]))
+                        if verdict == sched_mod.STOP:
+                            stop = True
+                if stop and not poll["finished"]:
+                    ray_tpu.kill(st["actor"])
+                    results.append(TrialResult(
+                        trial_id, st["config"],
+                        st["history"][-1] if st["history"] else None,
+                        st["history"], stopped_early=True))
+                    del running[trial_id]
+                    continue
+                if poll["finished"]:
+                    error = None
+                    try:
+                        ray_tpu.get(st["run_ref"], timeout=30)
+                    except Exception as e:  # noqa: BLE001
+                        error = str(e)
+                    results.append(TrialResult(
+                        trial_id, st["config"],
+                        st["history"][-1] if st["history"] else None,
+                        st["history"], error=error))
+                    ray_tpu.kill(st["actor"])
+                    del running[trial_id]
+            time.sleep(0.02)
+
+        return ResultGrid(results, tc.metric, tc.mode)
+
+
+def run(trainable: Callable, *, config: Optional[Dict[str, Any]] = None,
+        num_samples: int = 1, metric: Optional[str] = None, mode: str = "max",
+        scheduler=None, **_) -> ResultGrid:
+    """``tune.run`` compatibility wrapper."""
+    return Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler),
+    ).fit()

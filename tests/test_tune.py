@@ -1,0 +1,95 @@
+"""Tune tests (reference: python/ray/tune/tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+
+
+@pytest.fixture
+def ray8():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_grid_search_runs_all_variants(ray8):
+    def trainable(config):
+        return {"score": config["x"] * config["y"]}
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3]),
+                     "y": tune.grid_search([10, 100])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(results) == 6
+    best = results.get_best_result()
+    assert best.metrics["score"] == 300
+    assert best.config == {"x": 3, "y": 100}
+
+
+def test_random_sampling(ray8):
+    def trainable(config):
+        return {"score": config["lr"]}
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.loguniform(1e-5, 1e-1)},
+        tune_config=tune.TuneConfig(metric="score", mode="min", num_samples=8,
+                                    search_seed=0),
+    ).fit()
+    assert len(results) == 8
+    assert all(1e-5 <= r.metrics["score"] <= 1e-1 for r in results)
+
+
+def test_intermediate_reports_and_asha(ray8):
+    def trainable(config):
+        import time
+
+        # Weaker configs are slower, so they reach each ASHA rung after the
+        # strong peers have recorded it — the deterministic async-halving
+        # setup (in production, stragglers are exactly who ASHA prunes).
+        for step in range(8):
+            time.sleep(0.05 * (5 - config["q"]))
+            tune.report({"score": config["q"] * (step + 1)})
+
+    scheduler = tune.AsyncHyperBandScheduler(
+        metric="score", mode="max", max_t=8, grace_period=2,
+        reduction_factor=2)
+    results = tune.Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([1, 2, 3, 4])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=scheduler),
+    ).fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.config["q"] == 4
+    assert any(r.stopped_early for r in results)
+
+
+def test_trial_errors_are_captured(ray8):
+    def trainable(config):
+        if config["x"] == 1:
+            raise RuntimeError("boom")
+        return {"score": config["x"]}
+
+    results = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([0, 1, 2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(results.errors) == 1
+    assert results.get_best_result().metrics["score"] == 2
+
+
+def test_tune_run_wrapper(ray8):
+    def trainable(config):
+        return {"v": config["a"] + 1}
+
+    results = tune.run(trainable, config={"a": tune.grid_search([5, 7])},
+                       metric="v", mode="max")
+    assert results.get_best_result().metrics["v"] == 8
