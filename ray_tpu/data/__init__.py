@@ -1,0 +1,19 @@
+"""ray_tpu.data: distributed data processing (reference: ``python/ray/data``)."""
+
+from ray_tpu.data.dataset import (
+    DataIterator,
+    Dataset,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_csv,
+    read_json,
+    read_parquet,
+)
+
+__all__ = [
+    "DataIterator", "Dataset", "from_arrow", "from_items", "from_numpy",
+    "from_pandas", "range", "read_csv", "read_json", "read_parquet",
+]

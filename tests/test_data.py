@@ -1,0 +1,104 @@
+"""ray_tpu.data tests (reference: python/ray/data/tests)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rdata
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray8():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_take():
+    ds = rdata.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+    assert ds.num_blocks() == 4
+
+
+def test_map_filter_flatmap_pipeline():
+    ds = (rdata.range(50)
+          .map(lambda r: {"id": r["id"], "sq": r["id"] ** 2})
+          .filter(lambda r: r["sq"] % 2 == 0)
+          .flat_map(lambda r: [r, r]))
+    rows = ds.take_all()
+    assert len(rows) == 50  # 25 even squares, duplicated
+    assert all(r["sq"] == r["id"] ** 2 for r in rows)
+
+
+def test_map_batches_numpy():
+    ds = rdata.range(64).map_batches(
+        lambda b: {"id": b["id"], "double": b["id"] * 2})
+    rows = ds.take_all()
+    assert rows[10]["double"] == 20
+
+
+def test_limit():
+    assert rdata.range(1000).limit(17).count() == 17
+
+
+def test_repartition_and_split():
+    ds = rdata.range(90, parallelism=3).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.count() == 90
+    parts = rdata.range(10).split(2)
+    assert sum(p.count() for p in parts) == 10
+
+
+def test_random_shuffle_preserves_rows():
+    ds = rdata.range(100).random_shuffle(seed=7)
+    ids = sorted(r["id"] for r in ds.take_all())
+    assert ids == list(range(100))
+    assert [r["id"] for r in ds.take(5)] != [0, 1, 2, 3, 4]
+
+
+def test_sort():
+    ds = rdata.from_items([{"v": x} for x in [5, 3, 9, 1]]).sort("v")
+    assert [r["v"] for r in ds.take_all()] == [1, 3, 5, 9]
+
+
+def test_groupby_agg():
+    items = [{"k": i % 3, "v": i} for i in range(30)]
+    out = rdata.from_items(items).groupby("k").sum("v").sort("k").take_all()
+    assert out[0]["v_sum"] == sum(i for i in range(30) if i % 3 == 0)
+
+
+def test_iter_batches_shapes():
+    batches = list(rdata.range(100, parallelism=3).iter_batches(batch_size=32))
+    sizes = [len(b["id"]) for b in batches]
+    assert sum(sizes) == 100
+    assert sizes[:3] == [32, 32, 32]
+
+
+def test_streaming_split_for_train():
+    its = rdata.range(64).streaming_split(4)
+    counts = [sum(len(b["id"]) for b in it.iter_batches(batch_size=8))
+              for it in its]
+    assert sum(counts) == 64
+    assert all(c == 16 for c in counts)
+
+
+def test_pandas_roundtrip():
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    out = rdata.from_pandas(df).to_pandas()
+    pd.testing.assert_frame_equal(out, df)
+
+
+def test_read_write_parquet(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for i in range(3):
+        pq.write_table(pa.table({"x": np.arange(10) + i * 10}),
+                       tmp_path / f"part{i}.parquet")
+    ds = rdata.read_parquet(str(tmp_path / "*.parquet"))
+    assert ds.count() == 30
+    assert sorted(r["x"] for r in ds.take_all()) == list(range(30))
