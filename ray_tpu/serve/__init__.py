@@ -1,0 +1,22 @@
+"""ray_tpu.serve: scalable model serving (reference: ``python/ray/serve``)."""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    DeploymentHandle,
+    DeploymentResponse,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start_http,
+    stop_http,
+)
+from ray_tpu.serve.batching import batch
+
+__all__ = [
+    "Application", "Deployment", "DeploymentHandle", "DeploymentResponse",
+    "batch", "delete", "deployment", "get_deployment_handle", "run",
+    "shutdown", "start_http", "stop_http",
+]

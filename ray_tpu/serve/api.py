@@ -1,0 +1,354 @@
+"""ray_tpu.serve: model serving (reference: ``python/ray/serve``).
+
+Condensed re-design of SURVEY.md §3.5's architecture:
+
+* ``ServeController`` (named actor, ``serve/_private/controller.py:84``):
+  holds deployment specs, reconciles replica actors (create/kill/restart on
+  death), serves the routing table to handles.
+* ``Replica`` actors (``replica.py:879``): host the user callable with high
+  max_concurrency (async-replica analog); ``@serve.batch`` methods batch
+  concurrent calls.
+* ``DeploymentHandle`` (``handle.py:625``): routes each call with
+  power-of-two-choices on per-replica in-flight counts
+  (``replica_scheduler/pow_2_scheduler.py:813``'s local approximation).
+* HTTP ingress: an aiohttp proxy thread mapping ``POST /<deployment>`` to
+  handle calls (``proxy.py:752``).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+CONTROLLER_NAME = "__serve_controller__"
+
+
+class Replica:
+    """Hosts one copy of the user callable."""
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs, is_function: bool):
+        self.is_function = is_function
+        if is_function:
+            self.instance = cls_or_fn
+        else:
+            self.instance = cls_or_fn(*init_args, **(init_kwargs or {}))
+
+    def handle_request(self, method: str, args, kwargs):
+        if self.is_function:
+            return self.instance(*args, **kwargs)
+        target = getattr(self.instance, method or "__call__")
+        return target(*args, **kwargs)
+
+    def health(self):
+        return True
+
+
+class ServeController:
+    """Reconciles deployment specs → replica actors."""
+
+    def __init__(self):
+        self.deployments: Dict[str, Dict[str, Any]] = {}
+        self.replicas: Dict[str, List[Any]] = {}
+        self._stop = False
+        threading.Thread(target=self._reconcile_loop, daemon=True).start()
+
+    def deploy(self, name: str, cls_or_fn, init_args, init_kwargs,
+               num_replicas: int, is_function: bool,
+               max_concurrency: int) -> bool:
+        self.deployments[name] = {
+            "cls": cls_or_fn, "args": init_args, "kwargs": init_kwargs,
+            "num_replicas": num_replicas, "is_function": is_function,
+            "max_concurrency": max_concurrency,
+        }
+        self._reconcile_once(name)
+        return True
+
+    def delete(self, name: str) -> bool:
+        self.deployments.pop(name, None)
+        for r in self.replicas.pop(name, []):
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+    def get_replicas(self, name: str):
+        return list(self.replicas.get(name, []))
+
+    def list_deployments(self):
+        return {name: {"num_replicas": spec["num_replicas"]}
+                for name, spec in self.deployments.items()}
+
+    def _reconcile_once(self, name: str):
+        spec = self.deployments.get(name)
+        if spec is None:
+            return
+        replica_cls = ray_tpu.remote(Replica)
+        current = self.replicas.setdefault(name, [])
+        # Remove dead replicas (probe with a cheap health call).
+        live = []
+        for r in current:
+            try:
+                ray_tpu.get(r.health.remote(), timeout=5)
+                live.append(r)
+            except Exception:  # noqa: BLE001
+                pass
+        current = live
+        while len(current) < spec["num_replicas"]:
+            current.append(replica_cls.options(
+                max_concurrency=spec["max_concurrency"]).remote(
+                spec["cls"], spec["args"], spec["kwargs"],
+                spec["is_function"]))
+        while len(current) > spec["num_replicas"]:
+            victim = current.pop()
+            try:
+                ray_tpu.kill(victim)
+            except Exception:  # noqa: BLE001
+                pass
+        self.replicas[name] = current
+
+    def _reconcile_loop(self):
+        while not self._stop:
+            time.sleep(1.0)
+            for name in list(self.deployments):
+                try:
+                    self._reconcile_once(name)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def shutdown(self):
+        self._stop = True
+        for name in list(self.deployments):
+            self.delete(name)
+
+
+class DeploymentResponse:
+    """Future-like response (reference: ``DeploymentResponse``)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = 60.0):
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    @property
+    def ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, method_name: Optional[str] = None):
+        self._name = deployment_name
+        self._method = method_name
+        self._replicas: List[Any] = []
+        self._replicas_ts = 0.0
+        self._inflight: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self._name, method_name)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _HandleMethod(self, name)
+
+    def _refresh(self):
+        now = time.monotonic()
+        if now - self._replicas_ts > 2.0 or not self._replicas:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            self._replicas = ray_tpu.get(
+                controller.get_replicas.remote(self._name), timeout=30)
+            self._replicas_ts = now
+
+    def _choose(self):
+        """Power-of-two-choices over in-flight counts."""
+        self._refresh()
+        if not self._replicas:
+            raise RuntimeError(f"deployment {self._name!r} has no replicas")
+        with self._lock:
+            if len(self._replicas) == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(len(self._replicas)), 2)
+                idx = a if self._inflight.get(a, 0) <= \
+                    self._inflight.get(b, 0) else b
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+        return idx, self._replicas[idx]
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        idx, replica = self._choose()
+        ref = replica.handle_request.remote(self._method, args, kwargs)
+
+        def _done(_fut):
+            with self._lock:
+                self._inflight[idx] = max(self._inflight.get(idx, 1) - 1, 0)
+
+        try:
+            ref.future().add_done_callback(_done)
+        except Exception:  # noqa: BLE001
+            with self._lock:
+                self._inflight[idx] = max(self._inflight.get(idx, 1) - 1, 0)
+        return DeploymentResponse(ref)
+
+
+class _HandleMethod:
+    def __init__(self, handle: DeploymentHandle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle.options(self._method).remote(*args, **kwargs)
+
+
+class Application:
+    def __init__(self, deployment: "Deployment", args, kwargs):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+class Deployment:
+    def __init__(self, cls_or_fn, name: str, num_replicas: int = 1,
+                 max_ongoing_requests: int = 100,
+                 ray_actor_options: Optional[Dict] = None):
+        self._cls_or_fn = cls_or_fn
+        self.name = name
+        self.num_replicas = num_replicas
+        self.max_ongoing_requests = max_ongoing_requests
+        self.ray_actor_options = ray_actor_options or {}
+
+    def options(self, *, num_replicas: Optional[int] = None,
+                name: Optional[str] = None,
+                max_ongoing_requests: Optional[int] = None,
+                **_) -> "Deployment":
+        return Deployment(
+            self._cls_or_fn, name or self.name,
+            num_replicas or self.num_replicas,
+            max_ongoing_requests or self.max_ongoing_requests,
+            self.ray_actor_options)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+
+def deployment(_cls=None, *, name: Optional[str] = None, num_replicas: int = 1,
+               max_ongoing_requests: int = 100, **kwargs):
+    """``@serve.deployment`` decorator (class or function)."""
+
+    def decorate(cls_or_fn):
+        return Deployment(cls_or_fn, name or cls_or_fn.__name__,
+                          num_replicas, max_ongoing_requests)
+
+    if _cls is not None:
+        return decorate(_cls)
+    return decorate
+
+
+def _get_or_start_controller():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        controller_cls = ray_tpu.remote(ServeController)
+        return controller_cls.options(
+            name=CONTROLLER_NAME, lifetime="detached", max_concurrency=16,
+            get_if_exists=True).remote()
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
+    controller = _get_or_start_controller()
+    dep = app.deployment
+    import inspect
+
+    is_function = not inspect.isclass(dep._cls_or_fn)
+    ray_tpu.get(controller.deploy.remote(
+        dep.name, dep._cls_or_fn, app.args, app.kwargs, dep.num_replicas,
+        is_function, dep.max_ongoing_requests), timeout=120)
+    return DeploymentHandle(dep.name)
+
+
+def get_deployment_handle(name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(name)
+
+
+def delete(name: str):
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.delete.remote(name), timeout=30)
+    except ValueError:
+        pass
+
+
+def shutdown():
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.shutdown.remote(), timeout=30)
+        ray_tpu.kill(controller)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# ---------------------------------------------------------------- HTTP proxy
+class _HttpProxy:
+    def __init__(self, host: str, port: int):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        handles: Dict[str, DeploymentHandle] = {}
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                name = self.path.strip("/").split("/")[0]
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b"{}"
+                try:
+                    payload = json.loads(body) if body else {}
+                    handle = handles.get(name)
+                    if handle is None:
+                        handle = DeploymentHandle(name)
+                        handles[name] = handle
+                    result = handle.remote(payload).result(timeout_s=60)
+                    data = json.dumps(result).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001
+                    data = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def log_message(self, *a):  # silence
+                pass
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+
+
+_proxy: Optional[_HttpProxy] = None
+
+
+def start_http(host: str = "127.0.0.1", port: int = 8000) -> int:
+    """Start the HTTP ingress; returns the bound port."""
+    global _proxy
+    if _proxy is None:
+        _proxy = _HttpProxy(host, port)
+    return _proxy.port
+
+
+def stop_http():
+    global _proxy
+    if _proxy is not None:
+        _proxy.stop()
+        _proxy = None

@@ -1,0 +1,138 @@
+"""Serve tests (reference: python/ray/serve/tests)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray8():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_class_deployment_roundtrip():
+    @serve.deployment
+    class Greeter:
+        def __init__(self, greeting):
+            self.greeting = greeting
+
+        def __call__(self, name):
+            return f"{self.greeting}, {name}!"
+
+    handle = serve.run(Greeter.bind("Hello"))
+    assert handle.remote("world").result() == "Hello, world!"
+    serve.delete("Greeter")
+
+
+def test_function_deployment():
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind())
+    assert handle.remote(21).result() == 42
+    serve.delete("double")
+
+
+def test_multi_replica_load_balancing():
+    @serve.deployment(num_replicas=3)
+    class InstanceEcho:
+        def __call__(self, _):
+            return id(self)
+
+    handle = serve.run(InstanceEcho.bind())
+    instances = {handle.remote(None).result() for _ in range(30)}
+    assert len(instances) >= 2  # pow-2 routing spreads across replicas
+    serve.delete("InstanceEcho")
+
+
+def test_method_call():
+    @serve.deployment
+    class Model:
+        def __init__(self):
+            self.count = 0
+
+        def predict(self, x):
+            return x + 1
+
+        def stats(self, _=None):
+            return "ok"
+
+    handle = serve.run(Model.bind())
+    assert handle.predict.remote(5).result() == 6
+    assert handle.stats.remote().result() == "ok"
+    serve.delete("Model")
+
+
+def test_batching():
+    @serve.deployment
+    class Batched:
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        def __call__(self, xs):
+            # xs is a list; record batch size in each result
+            return [(x, len(xs)) for x in xs]
+
+    handle = serve.run(Batched.bind())
+    responses = [handle.remote(i) for i in range(8)]
+    results = [r.result() for r in responses]
+    assert sorted(x for x, _ in results) == list(range(8))
+    assert max(bs for _, bs in results) >= 2  # some batching happened
+    serve.delete("Batched")
+
+
+def test_replica_recovery():
+    @serve.deployment(num_replicas=1)
+    class Fragile:
+        def __call__(self, x):
+            return x
+
+        def die(self, _):
+            ray_tpu.exit_actor()
+
+    handle = serve.run(Fragile.bind())
+    assert handle.remote(1).result() == 1
+    try:
+        handle.die.remote(None).result(timeout_s=10)
+    except Exception:
+        pass
+    # Controller reconciliation replaces the dead replica.
+    deadline = time.monotonic() + 30
+    ok = False
+    while time.monotonic() < deadline:
+        try:
+            handle._replicas_ts = 0  # force refresh
+            if handle.remote(2).result(timeout_s=10) == 2:
+                ok = True
+                break
+        except Exception:
+            time.sleep(0.5)
+    assert ok
+    serve.delete("Fragile")
+
+
+def test_http_proxy():
+    @serve.deployment
+    def echo(payload):
+        return {"got": payload}
+
+    serve.run(echo.bind())
+    port = serve.start_http(port=0)
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/echo",
+        data=json.dumps({"a": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    assert out == {"got": {"a": 1}}
+    serve.stop_http()
+    serve.delete("echo")
