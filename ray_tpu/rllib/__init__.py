@@ -1,0 +1,10 @@
+"""ray_tpu.rllib: reinforcement learning (reference: ``rllib/``)."""
+
+from ray_tpu.rllib.core import PPOLearner, PPOModule, SampleBatch, compute_gae
+from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+
+__all__ = [
+    "EnvRunnerGroup", "PPO", "PPOConfig", "PPOLearner", "PPOModule",
+    "SampleBatch", "SingleAgentEnvRunner", "compute_gae",
+]

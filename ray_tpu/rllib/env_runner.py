@@ -1,0 +1,127 @@
+"""Env runners: distributed rollout collection.
+
+Reference: ``rllib/env/single_agent_env_runner.py:65`` (``sample`` :140 —
+vectorized gymnasium envs stepped with the current policy) and
+``EnvRunnerGroup`` (env_runner_group.py:71) with the fault-tolerant actor
+manager (utils/actor_manager.py:198): dead runners are dropped from a sample
+round and respawned.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core import PPOModule, SampleBatch, compute_gae
+
+
+class SingleAgentEnvRunner:
+    def __init__(self, env_creator: Callable, module_spec: Dict[str, Any],
+                 num_envs: int = 1, seed: int = 0,
+                 gamma: float = 0.99, lam: float = 0.95):
+        import gymnasium as gym
+        import jax
+
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda i=i: env_creator() for i in range(num_envs)])
+        self.num_envs = num_envs
+        self.gamma = gamma
+        self.lam = lam
+        self.module = PPOModule(**module_spec)
+        self.params = None
+        self.rng = np.random.default_rng(seed)
+        self._jax = jax
+        self._forward = jax.jit(
+            lambda p, o: (jax.nn.log_softmax(self.module.logits(p, o)),
+                          self.module.value(p, o)))
+        self.obs, _ = self.envs.reset(seed=seed)
+        self._episode_returns = np.zeros(num_envs, dtype=np.float64)
+        self._finished_returns: List[float] = []
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+
+        self.params = self._jax.tree.map(jnp.asarray, weights)
+        return True
+
+    def sample(self, num_steps: int) -> Tuple[SampleBatch, List[float]]:
+        """Collect ``num_steps`` per env; returns batch + episode returns."""
+        T, N = num_steps, self.num_envs
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+
+        for t in range(T):
+            logp_all, values = self._forward(self.params,
+                                             self.obs.astype(np.float32))
+            logp_all = np.asarray(logp_all)
+            probs = np.exp(logp_all)
+            probs /= probs.sum(-1, keepdims=True)
+            actions = np.array([self.rng.choice(len(p), p=p) for p in probs])
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = logp_all[np.arange(N), actions]
+            val_buf[t] = np.asarray(values)
+            self.obs, rewards, terms, truncs, _ = self.envs.step(actions)
+            dones = np.logical_or(terms, truncs)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            self._episode_returns += rewards
+            for i, d in enumerate(dones):
+                if d:
+                    self._finished_returns.append(self._episode_returns[i])
+                    self._episode_returns[i] = 0.0
+
+        _, last_values = self._forward(self.params,
+                                       self.obs.astype(np.float32))
+        adv, ret = compute_gae(rew_buf, val_buf, done_buf,
+                               np.asarray(last_values), self.gamma, self.lam)
+        flat = lambda x: x.reshape((-1,) + x.shape[2:])  # noqa: E731
+        batch = SampleBatch(
+            obs=flat(obs_buf), actions=flat(act_buf),
+            logprobs=flat(logp_buf), values=flat(val_buf),
+            advantages=flat(adv).astype(np.float32),
+            returns=flat(ret).astype(np.float32))
+        finished, self._finished_returns = self._finished_returns, []
+        return batch, finished
+
+    def ping(self):
+        return True
+
+
+class EnvRunnerGroup:
+    """Fault-tolerant group of env-runner actors."""
+
+    def __init__(self, env_creator, module_spec, num_runners: int,
+                 num_envs_per_runner: int, gamma: float, lam: float):
+        self._make = lambda seed: ray_tpu.remote(
+            SingleAgentEnvRunner).remote(
+            env_creator, module_spec, num_envs_per_runner, seed, gamma, lam)
+        self.runners = [self._make(i) for i in range(num_runners)]
+        self._seed = num_runners
+
+    def sync_weights(self, weights):
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners],
+                    timeout=120)
+
+    def sample(self, num_steps: int):
+        """Gather from all runners; drop+respawn dead ones (reference:
+        FaultTolerantActorManager.foreach with restarts)."""
+        refs = [(r, r.sample.remote(num_steps)) for r in self.runners]
+        batches, episode_returns, alive = [], [], []
+        for runner, ref in refs:
+            try:
+                batch, finished = ray_tpu.get(ref, timeout=300)
+                batches.append(batch)
+                episode_returns.extend(finished)
+                alive.append(runner)
+            except Exception:  # noqa: BLE001
+                self._seed += 1
+                alive.append(self._make(self._seed))
+        self.runners = alive
+        return batches, episode_returns
