@@ -1,0 +1,206 @@
+"""ray_tpu.dag: lazy task/actor DAGs + compiled execution.
+
+Reference: ``python/ray/dag`` (SURVEY.md §2.3 aDAG) — ``.bind()`` builds a
+lazy graph, ``.execute()`` submits it, and ``experimental_compile`` turns a
+static graph into a reusable executable whose channels avoid per-call
+(re)submission overhead. TPU-native perspective: a compiled ray_tpu DAG over
+actors is the *host-side* orchestration analog of one jitted XLA program —
+per-chip programs are already fused by jit; this layer chains multi-actor
+pipelines (e.g. pipeline-parallel stages) with the minimum per-step control
+overhead, mirroring how aDAG's NCCL channels chain GPU stages.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class DAGNode:
+    """Base lazy node. ``execute`` submits the whole upstream graph."""
+
+    def __init__(self, args: tuple, kwargs: dict):
+        self._bound_args = args
+        self._bound_kwargs = kwargs
+
+    # -- graph plumbing ----------------------------------------------------
+    def _resolve_arg(self, arg, cache: Dict[int, Any]):
+        if isinstance(arg, DAGNode):
+            return arg._execute_cached(cache)
+        return arg
+
+    def _resolved(self, cache: Dict[int, Any]):
+        args = tuple(self._resolve_arg(a, cache) for a in self._bound_args)
+        kwargs = {k: self._resolve_arg(v, cache)
+                  for k, v in self._bound_kwargs.items()}
+        return args, kwargs
+
+    def _execute_cached(self, cache: Dict[int, Any]):
+        key = id(self)
+        if key not in cache:
+            cache[key] = self._execute_impl(cache)
+        return cache[key]
+
+    def _execute_impl(self, cache: Dict[int, Any]):
+        raise NotImplementedError
+
+    def execute(self, *input_args, **input_kwargs):
+        cache: Dict[int, Any] = {
+            id(n): v for n, v in zip(_collect_input_nodes(self), input_args)}
+        return self._execute_cached(cache)
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+
+class InputNode(DAGNode):
+    """Placeholder for per-execution input (reference: ``ray.dag.InputNode``)."""
+
+    _tls = threading.local()
+
+    def __init__(self):
+        super().__init__((), {})
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def _execute_impl(self, cache):
+        raise ValueError("InputNode value missing: pass it to execute(...)")
+
+
+class FunctionNode(DAGNode):
+    def __init__(self, remote_fn, args, kwargs):
+        super().__init__(args, kwargs)
+        self._remote_fn = remote_fn
+
+    def _execute_impl(self, cache):
+        args, kwargs = self._resolved(cache)
+        return self._remote_fn.remote(*args, **kwargs)
+
+
+class ClassNode(DAGNode):
+    """Lazy actor construction; methods of the (future) actor can be bound."""
+
+    def __init__(self, actor_cls, args, kwargs):
+        super().__init__(args, kwargs)
+        self._actor_cls = actor_cls
+        self._handle = None
+        self._lock = threading.Lock()
+
+    def _ensure_actor(self, cache):
+        with self._lock:
+            if self._handle is None:
+                args, kwargs = self._resolved(cache)
+                self._handle = self._actor_cls.remote(*args, **kwargs)
+        return self._handle
+
+    def _execute_impl(self, cache):
+        return self._ensure_actor(cache)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClassMethodBinder(self, name)
+
+
+class _ClassMethodBinder:
+    def __init__(self, class_node: ClassNode, method: str):
+        self._class_node = class_node
+        self._method = method
+
+    def bind(self, *args, **kwargs) -> "ActorMethodNode":
+        return ActorMethodNode(self._class_node, self._method, args, kwargs)
+
+
+class ActorMethodNode(DAGNode):
+    def __init__(self, target, method_name: str, args, kwargs):
+        super().__init__(args, kwargs)
+        self._target = target  # ActorHandle or ClassNode
+        self._method_name = method_name
+
+    def _execute_impl(self, cache):
+        args, kwargs = self._resolved(cache)
+        target = self._target
+        if isinstance(target, ClassNode):
+            target = target._ensure_actor(cache)
+        return getattr(target, self._method_name).remote(*args, **kwargs)
+
+
+class MultiOutputNode(DAGNode):
+    def __init__(self, nodes: List[DAGNode]):
+        super().__init__(tuple(nodes), {})
+
+    def _execute_impl(self, cache):
+        return [self._resolve_arg(n, cache) for n in self._bound_args]
+
+
+def _collect_input_nodes(root: DAGNode) -> List[InputNode]:
+    seen: List[InputNode] = []
+    visited = set()
+
+    def visit(node):
+        if id(node) in visited:
+            return
+        visited.add(id(node))
+        if isinstance(node, InputNode) and node not in seen:
+            seen.append(node)
+        for a in list(node._bound_args) + list(node._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                visit(a)
+
+    visit(root)
+    return seen
+
+
+class CompiledDAG:
+    """Reusable executable of a static DAG (reference:
+    ``dag/compiled_dag_node.py:767``). Actors are created once at compile
+    time; each ``execute`` only submits the per-call method chain."""
+
+    def __init__(self, root: DAGNode):
+        self._root = root
+        # Materialize all ClassNodes now (actor startup off the hot path).
+        warm: Dict[int, Any] = {}
+        for node in _walk(root):
+            if isinstance(node, ClassNode):
+                node._ensure_actor(warm)
+
+    def execute(self, *input_args) -> Any:
+        return self._root.execute(*input_args)
+
+    def teardown(self):
+        for node in _walk(self._root):
+            if isinstance(node, ClassNode) and node._handle is not None:
+                try:
+                    ray_tpu.kill(node._handle)
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+def _walk(root: DAGNode):
+    visited = set()
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        yield node
+        for a in list(node._bound_args) + list(node._bound_kwargs.values()):
+            if isinstance(a, DAGNode):
+                stack.append(a)
+        if isinstance(node, ActorMethodNode) and \
+                isinstance(node._target, ClassNode):
+            stack.append(node._target)
+
+
+__all__ = [
+    "ActorMethodNode", "ClassNode", "CompiledDAG", "DAGNode", "FunctionNode",
+    "InputNode", "MultiOutputNode",
+]

@@ -1,0 +1,61 @@
+"""ray_tpu.llm: LLM serving + batch inference on ray_tpu serve.
+
+Reference: ``python/ray/llm`` — vLLM-backed deployments
+(``llm/_internal/serve``) and batch processors (``llm/_internal/batch``).
+ray_tpu serves its own jit-compiled models (``ray_tpu.models.inference``)
+instead of hosting an external engine: a deployment wraps a
+``LlamaGenerator`` whose prefill/decode are one compiled program per shape,
+with ``@serve.batch`` merging concurrent requests into one batched decode
+(the continuous-batching analog at request granularity).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu import serve
+from ray_tpu.models import llama
+from ray_tpu.models.inference import LlamaGenerator
+
+
+@serve.deployment
+class LlamaDeployment:
+    """Batched text-completion replica (token-id interface; tokenizers are
+    the caller's concern, as in the reference's processor configs)."""
+
+    def __init__(self, config: Optional[llama.LlamaConfig] = None,
+                 params=None, max_len: int = 512,
+                 max_batch_size: int = 8):
+        self.config = config or llama.LlamaConfig.tiny()
+        self.generator = LlamaGenerator(self.config, params=params,
+                                        max_len=max_len)
+        self.max_batch_size = max_batch_size
+
+    @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.02)
+    def __call__(self, requests: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        # Pad prompts to a common length, run one batched generate.
+        prompts = [np.asarray(r["prompt_token_ids"], np.int32)
+                   for r in requests]
+        max_new = max(int(r.get("max_tokens", 16)) for r in requests)
+        temperature = float(requests[0].get("temperature", 0.0))
+        plen = max(len(p) for p in prompts)
+        batch = np.zeros((len(prompts), plen), np.int32)
+        for i, p in enumerate(prompts):
+            batch[i, plen - len(p):] = p  # left-pad
+        out = np.asarray(self.generator.generate(
+            batch, max_new_tokens=max_new, temperature=temperature))
+        return [
+            {"token_ids": out[i, : int(r.get("max_tokens", 16))].tolist()}
+            for i, r in enumerate(requests)
+        ]
+
+
+def build_llama_app(config: Optional[llama.LlamaConfig] = None,
+                    num_replicas: int = 1, max_len: int = 512):
+    dep = LlamaDeployment.options(num_replicas=num_replicas)
+    return dep.bind(config, None, max_len)
+
+
+__all__ = ["LlamaDeployment", "build_llama_app"]

@@ -1,0 +1,159 @@
+"""Llama inference: KV-cache prefill + single-token decode, jit-compiled.
+
+The reference serves LLMs by hosting vLLM (``python/ray/llm/_internal/serve``
+— SURVEY.md §2.4); ray_tpu serves its own models natively. TPU-shaped
+decisions:
+
+* the KV cache is a static-shape ring of ``[L, B, S_max, KVH, D]`` arrays —
+  no dynamic shapes ever reach XLA; position masking handles partial fill;
+* prefill processes the whole (padded) prompt in one batched pass (MXU
+  utilization) and decode is one jitted step with donated cache buffers (no
+  HBM churn);
+* cache layout is shardable with the same logical-axis rules as training
+  (batch on data axes, heads on tensor) so a TP-sharded server is a rule
+  change, not new code.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+from ray_tpu.ops.norms import rms_norm
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, S_max, KVH, D]
+    v: jnp.ndarray
+
+    @classmethod
+    def create(cls, config: llama.LlamaConfig, batch_size: int,
+               max_len: int) -> "KVCache":
+        shape = (config.num_layers, batch_size, max_len,
+                 config.num_kv_heads, config.head_dim)
+        return cls(k=jnp.zeros(shape, config.dtype),
+                   v=jnp.zeros(shape, config.dtype))
+
+
+def _attend_cached(q, cache_k, cache_v, q_positions, scale):
+    """q: [B, S, H, D] at absolute positions; cache: [B, S_max, KVH, D].
+
+    Causal masking is positional: query at position p sees cache slots
+    [0..p]. Unfilled slots are masked out by the same rule.
+    """
+    b, s, hq, d = q.shape
+    s_max, hkv = cache_k.shape[1], cache_k.shape[2]
+    group = hq // hkv
+    qg = q.reshape(b, s, hkv, group, d).astype(jnp.float32)
+    logits = jnp.einsum("bqhgd,bkhd->bqhgk", qg,
+                        cache_k.astype(jnp.float32)) * scale
+    slots = jnp.arange(s_max)
+    mask = q_positions[:, None] >= slots[None, :]           # [S, S_max]
+    logits = jnp.where(mask[None, :, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bqhgk,bkhd->bqhgd", probs,
+                     cache_v.astype(jnp.float32))
+    return out.reshape(b, s, hq, d).astype(q.dtype)
+
+
+def _block(x, layer, cache_k, cache_v, positions, cos, sin, c):
+    """One decoder layer over tokens at ``positions``, updating the cache."""
+    scale = c.head_dim ** -0.5
+    h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+    q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(c.dtype))
+    k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(c.dtype))
+    v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(c.dtype))
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    # Scatter new K/V into the cache at their absolute positions.
+    cache_k = jax.lax.dynamic_update_slice(
+        cache_k, k.astype(cache_k.dtype), (0, positions[0], 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(
+        cache_v, v.astype(cache_v.dtype), (0, positions[0], 0, 0))
+    o = _attend_cached(q, cache_k, cache_v, positions, scale)
+    x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(c.dtype))
+    h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+    gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(c.dtype))
+    up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
+    x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(gate) * up,
+                       layer["w_down"].astype(c.dtype))
+    return x, cache_k, cache_v
+
+
+def _forward_cached(params, tokens, positions, cache: KVCache,
+                    config: llama.LlamaConfig):
+    """tokens [B, S] at absolute ``positions`` [S]; returns (logits, cache)."""
+    c = config
+    cos, sin = rope_frequencies(c.head_dim, tokens.shape[1], c.rope_theta,
+                                positions=positions)
+    x = params["embed"].astype(c.dtype)[tokens]
+
+    def layer_fn(carry, inputs):
+        x = carry
+        layer, ck, cv = inputs
+        x, ck, cv = _block(x, layer, ck, cv, positions, cos, sin, c)
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = jnp.einsum("bse,ev->bsv", x.astype(jnp.float32),
+                        params["lm_head"].astype(jnp.float32))
+    return logits, KVCache(k=new_k, v=new_v)
+
+
+class LlamaGenerator:
+    """Compiled prefill + decode loops for one model instance."""
+
+    def __init__(self, config: llama.LlamaConfig, params=None,
+                 max_len: int = 512, seed: int = 0):
+        self.config = config
+        self.max_len = max_len
+        self.params = params if params is not None else llama.init_params(
+            config, jax.random.PRNGKey(seed))
+
+        cfg = config
+
+        @jax.jit
+        def prefill(params, tokens, cache):
+            positions = jnp.arange(tokens.shape[1])
+            return _forward_cached(params, tokens, positions, cache, cfg)
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def decode(params, token, cache, pos):
+            positions = jnp.asarray([pos])
+            logits, cache = _forward_cached(
+                params, token[:, None], positions, cache, cfg)
+            return logits[:, -1], cache
+
+        self._prefill = prefill
+        self._decode = decode
+
+    def generate(self, prompt_tokens, max_new_tokens: int = 32,
+                 temperature: float = 0.0, seed: int = 0):
+        """prompt_tokens: [B, P] int32. Returns [B, max_new_tokens]."""
+        tokens = jnp.asarray(prompt_tokens, jnp.int32)
+        b, p = tokens.shape
+        assert p + max_new_tokens <= self.max_len
+        cache = KVCache.create(self.config, b, self.max_len)
+        logits, cache = self._prefill(self.params, tokens, cache)
+        last = logits[:, p - 1]
+        key = jax.random.PRNGKey(seed)
+        out = []
+        pos = p
+        for _ in range(max_new_tokens):
+            if temperature > 0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(sub, last / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(last, axis=-1)
+            nxt = nxt.astype(jnp.int32)
+            out.append(nxt)
+            last, cache = self._decode(self.params, nxt, cache, pos)
+            pos += 1
+        return jnp.stack(out, axis=1)

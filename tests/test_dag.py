@@ -1,0 +1,78 @@
+"""DAG / compiled-graph tests (reference: python/ray/dag tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray8():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+def plus(a, b):
+    return a + b
+
+
+@ray_tpu.remote
+def times(a, b):
+    return a * b
+
+
+@ray_tpu.remote
+class Stage:
+    def __init__(self, offset):
+        self.offset = offset
+
+    def forward(self, x):
+        return x + self.offset
+
+
+def test_task_dag():
+    with InputNode() as x:
+        dag = times.bind(plus.bind(x, 1), 10)
+    assert ray_tpu.get(dag.execute(4)) == 50
+    assert ray_tpu.get(dag.execute(0)) == 10
+
+
+def test_actor_pipeline_dag():
+    with InputNode() as x:
+        s1 = Stage.bind(100)
+        s2 = Stage.bind(1000)
+        dag = s2.forward.bind(s1.forward.bind(x))
+    assert ray_tpu.get(dag.execute(5)) == 1105
+
+
+def test_multi_output():
+    with InputNode() as x:
+        dag = MultiOutputNode([plus.bind(x, 1), times.bind(x, 2)])
+    out = [ray_tpu.get(r) for r in dag.execute(10)]
+    assert out == [11, 20]
+
+
+def test_compiled_dag_reuses_actors():
+    with InputNode() as x:
+        stage = Stage.bind(7)
+        dag = stage.forward.bind(x)
+    compiled = dag.experimental_compile()
+    try:
+        ids = set()
+        for i in range(3):
+            assert ray_tpu.get(compiled.execute(i)) == i + 7
+        # the same actor served all executions
+        assert compiled._root._target._handle is not None
+    finally:
+        compiled.teardown()
+
+
+def test_bound_actor_handle_method():
+    actor = Stage.remote(3)
+    with InputNode() as x:
+        dag = actor.forward.bind(x)
+    assert ray_tpu.get(dag.execute(1)) == 4

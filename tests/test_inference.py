@@ -1,0 +1,49 @@
+"""Inference + LLM serving tests."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models import llama
+from ray_tpu.models.inference import LlamaGenerator
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray8():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_kv_cache_decode_matches_full_forward():
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    gen = LlamaGenerator(cfg, max_len=64, seed=0)
+    prompt = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)), jnp.int32)
+    out = gen.generate(prompt, max_new_tokens=6, temperature=0.0)
+
+    seq = prompt
+    for _ in range(6):
+        logits = llama.forward(gen.params, seq, cfg)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq[:, 8:]))
+
+
+def test_llm_serve_deployment_batches():
+    from ray_tpu.llm import build_llama_app
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    handle = serve.run(build_llama_app(cfg, max_len=64))
+    reqs = [
+        handle.remote({"prompt_token_ids": [1, 2, 3 + i], "max_tokens": 4})
+        for i in range(6)
+    ]
+    outs = [r.result(timeout_s=120) for r in reqs]
+    assert all(len(o["token_ids"]) == 4 for o in outs)
+    serve.delete("LlamaDeployment")
