@@ -1,0 +1,103 @@
+"""Minimal dashboard: JSON endpoints + Prometheus metrics.
+
+Reference: ``python/ray/dashboard`` (head.py:65 aiohttp app + modules). The
+React frontend is out of scope; the API surface the CLI/users consume is
+here: ``/api/cluster_status``, ``/api/nodes``, ``/api/actors``,
+``/api/jobs``, ``/metrics`` (Prometheus text).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+
+class Dashboard:
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 8265):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from ray_tpu._private import rpc
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        gcs = rpc.get_stub("GcsService", gcs_address)
+
+        def nodes():
+            return [{
+                "node_id": n.node_id, "address": n.address, "alive": n.alive,
+                "resources": dict(n.resources), "available": dict(n.available),
+                "labels": dict(n.labels),
+            } for n in gcs.GetNodes(pb.GetNodesRequest()).nodes]
+
+        def actors():
+            return [{
+                "actor_id": a.actor_id.hex(), "class_name": a.class_name,
+                "state": a.state, "name": a.name, "node_id": a.node_id,
+                "num_restarts": a.num_restarts,
+            } for a in gcs.ListActors(
+                pb.ListActorsRequest(all_namespaces=True)).actors]
+
+        def jobs():
+            keys = gcs.KvKeys(pb.KvRequest(ns="job", prefix="")).keys
+            out = []
+            for k in keys:
+                r = gcs.KvGet(pb.KvRequest(ns="job", key=k))
+                if r.found:
+                    out.append(json.loads(r.value))
+            return out
+
+        def cluster_status():
+            ns = nodes()
+            total, avail = {}, {}
+            for n in ns:
+                if not n["alive"]:
+                    continue
+                for k, v in n["resources"].items():
+                    total[k] = total.get(k, 0) + v
+                for k, v in n["available"].items():
+                    avail[k] = avail.get(k, 0) + v
+            return {"nodes_alive": sum(n["alive"] for n in ns),
+                    "nodes_total": len(ns),
+                    "resources_total": total, "resources_available": avail}
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802
+                try:
+                    if self.path == "/metrics":
+                        from ray_tpu.util.metrics import prometheus_text
+
+                        body = prometheus_text().encode()
+                        ctype = "text/plain; version=0.0.4"
+                    else:
+                        route = {
+                            "/api/cluster_status": cluster_status,
+                            "/api/nodes": nodes,
+                            "/api/actors": actors,
+                            "/api/jobs": jobs,
+                        }.get(self.path)
+                        if route is None:
+                            self.send_response(404)
+                            self.end_headers()
+                            return
+                        body = json.dumps(route()).encode()
+                        ctype = "application/json"
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001
+                    body = json.dumps({"error": str(e)}).encode()
+                    ctype = "application/json"
+                    self.send_response(500)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()

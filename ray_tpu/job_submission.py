@@ -1,0 +1,140 @@
+"""Job submission: run driver scripts against a cluster.
+
+Reference: ``python/ray/dashboard/modules/job`` — ``JobManager``
+(job_manager.py:59) launches each job's entrypoint as a supervised
+subprocess, tracks status + logs, and exposes a client
+(``JobSubmissionClient``). Here job metadata lives in the GCS KV store
+(namespace "job"), so any client connected to the cluster sees the same job
+table; the entrypoint subprocess gets ``RAY_TPU_ADDRESS`` so its
+``ray_tpu.init()`` joins the cluster.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+KV_NS = "job"
+
+
+class JobStatus:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    STOPPED = "STOPPED"
+
+
+class JobSubmissionClient:
+    def __init__(self, address: str):
+        """``address``: the cluster GCS address (host:port)."""
+        self.address = address
+        self.gcs = rpc.get_stub("GcsService", address)
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    # ------------------------------------------------------------- kv helpers
+    def _save(self, job_id: str, info: Dict[str, Any]):
+        self.gcs.KvPut(pb.KvRequest(ns=KV_NS, key=job_id,
+                                    value=json.dumps(info).encode(),
+                                    overwrite=True))
+
+    def _load(self, job_id: str) -> Optional[Dict[str, Any]]:
+        reply = self.gcs.KvGet(pb.KvRequest(ns=KV_NS, key=job_id))
+        if not reply.found:
+            return None
+        return json.loads(reply.value)
+
+    # ------------------------------------------------------------- public api
+    def submit_job(self, *, entrypoint: str,
+                   runtime_env: Optional[Dict[str, Any]] = None,
+                   submission_id: Optional[str] = None,
+                   metadata: Optional[Dict[str, str]] = None) -> str:
+        job_id = submission_id or f"raytpu_job_{uuid.uuid4().hex[:10]}"
+        logdir = os.path.join("/tmp", "ray_tpu_jobs", job_id)
+        os.makedirs(logdir, exist_ok=True)
+        info = {
+            "job_id": job_id, "entrypoint": entrypoint,
+            "status": JobStatus.PENDING, "metadata": metadata or {},
+            "start_time": time.time(), "end_time": None,
+            "log_path": os.path.join(logdir, "driver.log"),
+        }
+        self._save(job_id, info)
+
+        env = dict(os.environ)
+        env["RAY_TPU_ADDRESS"] = self.address
+        env.update((runtime_env or {}).get("env_vars", {}))
+        if "working_dir" in (runtime_env or {}):
+            cwd = runtime_env["working_dir"]
+        else:
+            cwd = os.getcwd()
+        log_f = open(info["log_path"], "wb")
+        proc = subprocess.Popen(entrypoint, shell=True, cwd=cwd, env=env,
+                                stdout=log_f, stderr=subprocess.STDOUT)
+        self._procs[job_id] = proc
+        info["status"] = JobStatus.RUNNING
+        info["pid"] = proc.pid
+        self._save(job_id, info)
+        threading.Thread(target=self._supervise, args=(job_id, proc),
+                         daemon=True).start()
+        return job_id
+
+    def _supervise(self, job_id: str, proc: subprocess.Popen):
+        rc = proc.wait()
+        info = self._load(job_id) or {}
+        info["status"] = JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
+        info["end_time"] = time.time()
+        info["return_code"] = rc
+        self._save(job_id, info)
+
+    def get_job_status(self, job_id: str) -> str:
+        info = self._load(job_id)
+        if info is None:
+            raise ValueError(f"unknown job {job_id!r}")
+        return info["status"]
+
+    def get_job_info(self, job_id: str) -> Dict[str, Any]:
+        info = self._load(job_id)
+        if info is None:
+            raise ValueError(f"unknown job {job_id!r}")
+        return info
+
+    def get_job_logs(self, job_id: str) -> str:
+        info = self.get_job_info(job_id)
+        try:
+            with open(info["log_path"]) as f:
+                return f.read()
+        except OSError:
+            return ""
+
+    def list_jobs(self) -> List[Dict[str, Any]]:
+        reply = self.gcs.KvKeys(pb.KvRequest(ns=KV_NS, prefix=""))
+        return [self._load(k) for k in reply.keys]
+
+    def stop_job(self, job_id: str) -> bool:
+        proc = self._procs.get(job_id)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+            info = self._load(job_id) or {}
+            info["status"] = JobStatus.STOPPED
+            info["end_time"] = time.time()
+            self._save(job_id, info)
+            return True
+        return False
+
+    def wait_until_finished(self, job_id: str, timeout_s: float = 300.0) -> str:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            status = self.get_job_status(job_id)
+            if status in (JobStatus.SUCCEEDED, JobStatus.FAILED,
+                          JobStatus.STOPPED):
+                return status
+            time.sleep(0.25)
+        raise TimeoutError(f"job {job_id} still {status} after {timeout_s}s")

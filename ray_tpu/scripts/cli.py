@@ -1,0 +1,198 @@
+"""ray_tpu CLI (reference: ``python/ray/scripts/scripts.py`` — ray
+start/stop/status/submit/...). Run as ``python -m ray_tpu.scripts.cli``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+STATE_DIR = "/tmp/ray_tpu"
+ADDRESS_FILE = os.path.join(STATE_DIR, "address")
+PIDS_FILE = os.path.join(STATE_DIR, "pids")
+
+
+def _save_pid(pid: int):
+    os.makedirs(STATE_DIR, exist_ok=True)
+    with open(PIDS_FILE, "a") as f:
+        f.write(f"{pid}\n")
+
+
+def _read_port(proc, tag: str, timeout_s: float = 30.0) -> int:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline().decode().strip()
+        if line.startswith(f"{tag}="):
+            return int(line.split("=", 1)[1])
+        if not line and proc.poll() is not None:
+            break
+    raise RuntimeError(f"failed to read {tag} from subprocess")
+
+
+def cmd_start(args):
+    os.makedirs(STATE_DIR, exist_ok=True)
+    env = dict(os.environ)
+    if args.head:
+        gcs = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.gcs.server",
+             "--port", str(args.port or 0)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
+        _save_pid(gcs.pid)
+        gcs_port = _read_port(gcs, "GCS_PORT")
+        address = f"127.0.0.1:{gcs_port}"
+        with open(ADDRESS_FILE, "w") as f:
+            f.write(address)
+        print(f"GCS started at {address}")
+    else:
+        address = args.address or _auto_address()
+
+    nm_cmd = [sys.executable, "-m", "ray_tpu._private.node_manager.server",
+              "--gcs-address", address,
+              "--num-cpus", str(args.num_cpus or os.cpu_count())]
+    if args.num_tpus:
+        nm_cmd += ["--num-tpus", str(args.num_tpus)]
+    if args.resources:
+        nm_cmd += ["--resources", args.resources]
+    if args.labels:
+        nm_cmd += ["--labels", args.labels]
+    nm = subprocess.Popen(nm_cmd, stdout=subprocess.PIPE,
+                          stderr=subprocess.DEVNULL, env=env)
+    _save_pid(nm.pid)
+    nm_port = _read_port(nm, "NODE_PORT")
+    print(f"Node manager started at 127.0.0.1:{nm_port}")
+
+    if args.head and args.dashboard:
+        from ray_tpu.dashboard import Dashboard
+
+        dash = Dashboard(address, port=args.dashboard_port)
+        print(f"Dashboard at http://127.0.0.1:{dash.port}")
+        print(f"\nConnect with: ray_tpu.init(address={address!r})")
+        print("Press Ctrl-C to keep running in foreground, or re-run with "
+              "--block to stay attached.")
+        if args.block:
+            try:
+                while True:
+                    time.sleep(3600)
+            except KeyboardInterrupt:
+                pass
+    elif args.head:
+        print(f"\nConnect with: ray_tpu.init(address={address!r})")
+
+
+def _auto_address() -> str:
+    if os.environ.get("RAY_TPU_ADDRESS"):
+        return os.environ["RAY_TPU_ADDRESS"]
+    if os.path.exists(ADDRESS_FILE):
+        with open(ADDRESS_FILE) as f:
+            return f.read().strip()
+    raise SystemExit("no cluster address: pass --address or start a head")
+
+
+def cmd_stop(args):
+    if not os.path.exists(PIDS_FILE):
+        print("nothing to stop")
+        return
+    with open(PIDS_FILE) as f:
+        pids = [int(line) for line in f if line.strip()]
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+            print(f"stopped {pid}")
+        except OSError:
+            pass
+    os.remove(PIDS_FILE)
+    if os.path.exists(ADDRESS_FILE):
+        os.remove(ADDRESS_FILE)
+
+
+def cmd_status(args):
+    address = args.address or _auto_address()
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    gcs = rpc.get_stub("GcsService", address)
+    nodes = gcs.GetNodes(pb.GetNodesRequest()).nodes
+    print(f"Cluster at {address}: "
+          f"{sum(n.alive for n in nodes)}/{len(nodes)} nodes alive")
+    for n in nodes:
+        state = "ALIVE" if n.alive else "DEAD"
+        print(f"  {n.node_id[:12]} {state:6} {n.address:22} "
+              f"resources={dict(n.resources)}")
+    actors = gcs.ListActors(pb.ListActorsRequest(all_namespaces=True)).actors
+    if actors:
+        print(f"Actors ({len(actors)}):")
+        for a in actors:
+            print(f"  {a.actor_id.hex()[:12]} {a.state:10} {a.class_name}")
+
+
+def cmd_submit(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    address = args.address or _auto_address()
+    client = JobSubmissionClient(address)
+    job_id = client.submit_job(entrypoint=" ".join(args.entrypoint))
+    print(f"submitted {job_id}")
+    if args.wait:
+        status = client.wait_until_finished(job_id, timeout_s=args.timeout)
+        print(f"{job_id}: {status}")
+        print(client.get_job_logs(job_id))
+        if status != "SUCCEEDED":
+            raise SystemExit(1)
+
+
+def cmd_jobs(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(args.address or _auto_address())
+    for info in client.list_jobs():
+        if info:
+            print(f"{info['job_id']:32} {info['status']:10} "
+                  f"{info['entrypoint']}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray-tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start cluster processes on this host")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--num-cpus", type=float)
+    p.add_argument("--num-tpus", type=float, default=0)
+    p.add_argument("--resources", help='JSON, e.g. \'{"special": 2}\'')
+    p.add_argument("--labels", help='JSON, e.g. \'{"tpu-slice": "s0"}\'')
+    p.add_argument("--dashboard", action="store_true", default=True)
+    p.add_argument("--dashboard-port", type=int, default=8265)
+    p.add_argument("--block", action="store_true")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop all local cluster processes")
+    p.set_defaults(fn=cmd_stop)
+
+    p = sub.add_parser("status", help="show cluster status")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("submit", help="submit a job entrypoint")
+    p.add_argument("--address")
+    p.add_argument("--wait", action="store_true")
+    p.add_argument("--timeout", type=float, default=600)
+    p.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    p.set_defaults(fn=cmd_submit)
+
+    p = sub.add_parser("jobs", help="list jobs")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_jobs)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""State API: cluster introspection (reference: ``ray.util.state`` — api.py,
+backed by dashboard StateHead + ``_private/state.py`` GlobalState).
+
+Works against both runtimes: the in-process LocalRuntime answers from its own
+tables; cluster mode queries the GCS.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import ray_tpu
+from ray_tpu._private import worker as _worker
+
+
+def _core():
+    return _worker.global_worker().core
+
+
+def list_nodes() -> List[Dict[str, Any]]:
+    return ray_tpu.nodes()
+
+
+def list_actors(detail: bool = False) -> List[Dict[str, Any]]:
+    core = _core()
+    # Cluster runtime: ask the GCS.
+    if hasattr(core, "gcs"):
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        reply = core.gcs.ListActors(pb.ListActorsRequest(all_namespaces=True))
+        return [{
+            "actor_id": a.actor_id.hex(),
+            "class_name": a.class_name,
+            "state": a.state,
+            "name": a.name,
+            "namespace": a.namespace,
+            "node_id": a.node_id,
+            "num_restarts": a.num_restarts,
+            "death_cause": a.death_cause,
+        } for a in reply.actors]
+    # Local runtime.
+    out = []
+    for actor_id, meta in getattr(core, "_actor_meta", {}).items():
+        out.append({
+            "actor_id": actor_id.hex(),
+            "class_name": meta.get("class_name", ""),
+            "state": meta.get("state", ""),
+            "name": meta.get("name", ""),
+            "namespace": meta.get("namespace", ""),
+            "node_id": core.node_id.hex(),
+            "num_restarts": 0,
+            "death_cause": "",
+        })
+    return out
+
+
+def list_placement_groups() -> List[Dict[str, Any]]:
+    core = _core()
+    if hasattr(core, "gcs"):
+        # The GCS keeps groups in-process; expose what the proto directory has.
+        return getattr(core, "_pg_cache", [])
+    return []
+
+
+def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
+    core = _core()
+    store = getattr(core, "store", None) or getattr(core, "memory", None)
+    out = []
+    if store is not None:
+        with store._lock:
+            for oid, entry in list(store._objects.items())[:limit]:
+                out.append({
+                    "object_id": oid.hex(),
+                    "ready": entry.ready.is_set(),
+                    "task_id": oid.task_id().hex(),
+                })
+    return out
+
+
+def summarize_cluster() -> Dict[str, Any]:
+    return {
+        "nodes": len([n for n in ray_tpu.nodes() if n.get("Alive", n.get("alive"))]),
+        "total_resources": ray_tpu.cluster_resources(),
+        "available_resources": ray_tpu.available_resources(),
+        "actors": len(list_actors()),
+        "timestamp": time.time(),
+    }

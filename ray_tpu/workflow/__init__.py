@@ -1,0 +1,121 @@
+"""ray_tpu.workflow: durable workflow execution.
+
+Reference: ``python/ray/workflow`` (SURVEY.md §2.4) — DAGs whose step results
+are checkpointed to storage, so a crashed/resumed run re-executes only the
+steps without a persisted result. Steps are the same ``.bind()`` DAG nodes as
+:mod:`ray_tpu.dag`; ``workflow.run`` walks the graph, consults the on-disk
+result store keyed by (workflow_id, step hash), executes missing steps as
+remote tasks, and records results durably before proceeding.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+from typing import Any, Dict, Optional
+
+import ray_tpu
+from ray_tpu.dag import DAGNode, FunctionNode, InputNode
+
+_storage_root: Optional[str] = None
+
+
+def init(storage: Optional[str] = None) -> None:
+    global _storage_root
+    _storage_root = storage or os.path.join(tempfile.gettempdir(),
+                                            "ray_tpu_workflows")
+    os.makedirs(_storage_root, exist_ok=True)
+
+
+def _storage() -> str:
+    if _storage_root is None:
+        init()
+    return _storage_root  # type: ignore[return-value]
+
+
+def _step_key(node: DAGNode, resolved_args, resolved_kwargs) -> str:
+    """Content-address a step by function name + argument repr."""
+    fn_name = getattr(getattr(node, "_remote_fn", None), "_function_name",
+                      type(node).__name__)
+    payload = repr((fn_name, resolved_args, sorted(resolved_kwargs.items())))
+    return hashlib.sha256(payload.encode()).hexdigest()[:24]
+
+
+class _WorkflowRunner:
+    def __init__(self, workflow_id: str):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(_storage(), workflow_id)
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _result_path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}.pkl")
+
+    def has(self, key: str) -> bool:
+        return os.path.exists(self._result_path(key))
+
+    def load(self, key: str) -> Any:
+        with open(self._result_path(key), "rb") as f:
+            return pickle.load(f)
+
+    def save(self, key: str, value: Any) -> None:
+        tmp = self._result_path(key) + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, self._result_path(key))  # atomic commit
+
+    def run_node(self, node, cache: Dict[int, Any]) -> Any:
+        if not isinstance(node, DAGNode):
+            return node
+        if id(node) in cache:
+            return cache[id(node)]
+        args = tuple(self.run_node(a, cache) for a in node._bound_args)
+        kwargs = {k: self.run_node(v, cache)
+                  for k, v in node._bound_kwargs.items()}
+        if isinstance(node, InputNode):
+            raise ValueError("workflow DAGs take inputs via bind()")
+        if isinstance(node, FunctionNode):
+            key = _step_key(node, args, kwargs)
+            if self.has(key):
+                value = self.load(key)
+            else:
+                value = ray_tpu.get(node._remote_fn.remote(*args, **kwargs))
+                self.save(key, value)
+        else:
+            raise TypeError(
+                f"workflow steps must be task nodes, got {type(node).__name__}")
+        cache[id(node)] = value
+        return value
+
+
+def run(dag: DAGNode, *, workflow_id: str) -> Any:
+    """Run (or resume) a workflow; completed steps are skipped on resume."""
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    runner = _WorkflowRunner(workflow_id)
+    result = runner.run_node(dag, {})
+    runner.save("__result__", result)
+    return result
+
+
+def get_output(workflow_id: str) -> Any:
+    runner = _WorkflowRunner(workflow_id)
+    if not runner.has("__result__"):
+        raise ValueError(f"workflow {workflow_id!r} has no recorded output")
+    return runner.load("__result__")
+
+
+def list_all():
+    root = _storage()
+    return [d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d))]
+
+
+def delete(workflow_id: str):
+    import shutil
+
+    shutil.rmtree(os.path.join(_storage(), workflow_id), ignore_errors=True)
+
+
+__all__ = ["delete", "get_output", "init", "list_all", "run"]
