@@ -1,0 +1,183 @@
+"""Autoscaler: provider-backed cluster scaling reconciler.
+
+Reference: ``python/ray/autoscaler`` — v2's reconciler shape
+(``v2/autoscaler.py:42`` + ``instance_manager``): each tick reads the
+cluster's state (alive nodes, utilization, explicit resource requests) and
+drives the node count toward the target through a ``NodeProvider``
+(``autoscaler/node_provider.py:13``). ``FakeNodeProvider`` mirrors the
+reference's fake_multi_node provider (node_provider.py:236): nodes are
+in-process NodeManagers, so scaling logic is testable with no cloud.
+
+Explicit demand (``request_resources`` —
+``ray.autoscaler.sdk.request_resources``) is stored in the GCS KV so any
+client can post it.
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import rpc
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+KV_NS = "autoscaler"
+
+
+class NodeProvider(abc.ABC):
+    @abc.abstractmethod
+    def create_node(self, node_config: Dict[str, Any]) -> str: ...
+
+    @abc.abstractmethod
+    def terminate_node(self, node_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def non_terminated_nodes(self) -> List[str]: ...
+
+
+class FakeNodeProvider(NodeProvider):
+    """Nodes are in-process NodeManagers (reference FakeMultiNodeProvider)."""
+
+    def __init__(self, gcs_address: str):
+        self.gcs_address = gcs_address
+        self._nodes: Dict[str, Any] = {}
+
+    def create_node(self, node_config: Dict[str, Any]) -> str:
+        from ray_tpu._private.node_manager.server import NodeManager
+
+        nm = NodeManager(self.gcs_address,
+                         resources=dict(node_config.get("resources",
+                                                        {"CPU": 4.0})),
+                         labels=node_config.get("labels"))
+        self._nodes[nm.node_id] = nm
+        return nm.node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        nm = self._nodes.pop(node_id, None)
+        if nm is not None:
+            nm.shutdown()
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._nodes)
+
+
+def request_resources(gcs_address: str,
+                      bundles: List[Dict[str, float]]) -> None:
+    """Post an explicit resource ask the autoscaler must satisfy."""
+    gcs = rpc.get_stub("GcsService", gcs_address)
+    gcs.KvPut(pb.KvRequest(ns=KV_NS, key="requests",
+                           value=json.dumps(bundles).encode(),
+                           overwrite=True))
+
+
+class Autoscaler:
+    def __init__(self, gcs_address: str, provider: NodeProvider,
+                 node_config: Optional[Dict[str, Any]] = None,
+                 min_workers: int = 0, max_workers: int = 8,
+                 target_utilization: float = 0.8,
+                 idle_timeout_s: float = 30.0,
+                 tick_interval_s: float = 1.0):
+        self.gcs = rpc.get_stub("GcsService", gcs_address)
+        self.provider = provider
+        self.node_config = node_config or {"resources": {"CPU": 4.0}}
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.target_utilization = target_utilization
+        self.idle_timeout_s = idle_timeout_s
+        self.tick_interval_s = tick_interval_s
+        self._idle_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------------- logic
+    def _demand_bundles(self) -> List[Dict[str, float]]:
+        reply = self.gcs.KvGet(pb.KvRequest(ns=KV_NS, key="requests"))
+        if not reply.found:
+            return []
+        return json.loads(reply.value)
+
+    def reconcile_once(self) -> Dict[str, int]:
+        """One tick: returns {"launched": n, "terminated": m}."""
+        nodes = [n for n in self.gcs.GetNodes(pb.GetNodesRequest()).nodes
+                 if n.alive]
+        managed = set(self.provider.non_terminated_nodes())
+        managed_nodes = [n for n in nodes if n.node_id in managed]
+        launched = terminated = 0
+
+        # 1) explicit resource requests: bin-pack onto current capacity,
+        #    launch nodes for what does not fit.
+        unfit = 0
+        avail = [dict(n.available) for n in nodes]
+        for bundle in self._demand_bundles():
+            placed = False
+            for a in avail:
+                if all(a.get(k, 0.0) >= v for k, v in bundle.items()):
+                    for k, v in bundle.items():
+                        a[k] -= v
+                    placed = True
+                    break
+            if not placed:
+                unfit += 1
+        per_node = self.node_config.get("resources", {}).get("CPU", 4.0)
+        needed_for_demand = unfit  # conservatively one node per unfit bundle
+
+        # 2) utilization pressure.
+        total = sum(n.resources.get("CPU", 0) for n in nodes)
+        free = sum(n.available.get("CPU", 0) for n in nodes)
+        util = 1.0 - (free / total) if total else 0.0
+        pressure = 1 if util > self.target_utilization else 0
+
+        want = max(self.min_workers,
+                   len(managed_nodes) + needed_for_demand + pressure)
+        want = min(want, self.max_workers)
+
+        while len(self.provider.non_terminated_nodes()) < want:
+            self.provider.create_node(self.node_config)
+            launched += 1
+
+        # 3) scale down: managed nodes fully idle past the timeout.
+        now = time.monotonic()
+        if needed_for_demand == 0 and pressure == 0:
+            over = len(self.provider.non_terminated_nodes()) - max(
+                self.min_workers, 0)
+            for n in managed_nodes:
+                if over <= 0:
+                    break
+                fully_idle = all(
+                    abs(n.available.get(k, 0.0) - v) < 1e-6
+                    for k, v in n.resources.items())
+                if fully_idle:
+                    first = self._idle_since.setdefault(n.node_id, now)
+                    if now - first > self.idle_timeout_s:
+                        self.provider.terminate_node(n.node_id)
+                        self._idle_since.pop(n.node_id, None)
+                        terminated += 1
+                        over -= 1
+                else:
+                    self._idle_since.pop(n.node_id, None)
+        return {"launched": launched, "terminated": terminated}
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.tick_interval_s):
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("autoscaler tick failed")
+
+    def stop(self):
+        self._stop.set()
+
+
+__all__ = ["Autoscaler", "FakeNodeProvider", "NodeProvider",
+           "request_resources"]

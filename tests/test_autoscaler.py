@@ -1,0 +1,55 @@
+"""Autoscaler tests (reference: python/ray/tests/autoscaler + fake provider)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler import Autoscaler, FakeNodeProvider, request_resources
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 2})
+    c.wait_for_nodes()
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_scale_up_on_resource_request_and_down_when_idle(cluster):
+    provider = FakeNodeProvider(cluster.address)
+    scaler = Autoscaler(cluster.address, provider,
+                        node_config={"resources": {"CPU": 4.0}},
+                        min_workers=0, max_workers=4, idle_timeout_s=1.0)
+
+    # Explicit demand for more CPU than the head has -> launch workers.
+    request_resources(cluster.address, [{"CPU": 4.0}, {"CPU": 4.0}])
+    out = scaler.reconcile_once()
+    assert out["launched"] >= 1
+    time.sleep(1.5)  # let new nodes register + heartbeat
+    ray_tpu.init(address=cluster.address)
+    assert ray_tpu.cluster_resources()["CPU"] >= 6.0
+
+    # Demand cleared -> idle nodes terminate after the timeout.
+    request_resources(cluster.address, [])
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        scaler.reconcile_once()
+        if not provider.non_terminated_nodes():
+            break
+        time.sleep(0.5)
+    assert not provider.non_terminated_nodes()
+
+
+def test_min_workers_maintained(cluster):
+    provider = FakeNodeProvider(cluster.address)
+    scaler = Autoscaler(cluster.address, provider, min_workers=2,
+                        max_workers=4)
+    scaler.reconcile_once()
+    assert len(provider.non_terminated_nodes()) == 2
+    for node_id in provider.non_terminated_nodes():
+        provider.terminate_node(node_id)
