@@ -181,6 +181,7 @@ def forward(
     tokens: jnp.ndarray,
     config: LlamaConfig,
     mesh: Optional[Mesh] = None,
+    return_hidden: bool = False,
 ) -> jnp.ndarray:
     """Compute logits [B, S, V] (fp32) for int32 tokens [B, S]."""
     c = config
@@ -228,6 +229,8 @@ def forward(
     x, _ = jax.lax.scan(lambda carry, lp: body(carry, lp), x, params["layers"])
 
     x = rms_norm(x, params["final_norm"], c.rms_eps)
+    if return_hidden:
+        return x
     logits = jnp.einsum(
         "bse,ev->bsv", x.astype(jnp.float32), params["lm_head"].astype(jnp.float32)
     )
@@ -236,27 +239,68 @@ def forward(
     return logits
 
 
+def hidden_states(
+    params: Params,
+    tokens: jnp.ndarray,
+    config: LlamaConfig,
+    mesh: Optional[Mesh] = None,
+) -> jnp.ndarray:
+    """Final-norm hidden states [B, S, E] (logits head applied separately)."""
+    return forward(params, tokens, config, mesh, return_hidden=True)
+
+
 def loss_fn(
     params: Params,
     batch: Dict[str, jnp.ndarray],
     config: LlamaConfig,
     mesh: Optional[Mesh] = None,
+    vocab_chunks: int = 8,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
-    """Next-token cross-entropy. batch: {"tokens": [B,S] int32, "mask": [B,S]}."""
+    """Next-token cross-entropy. batch: {"tokens": [B,S] int32, "mask": [B,S]}.
+
+    The LM-head matmul + softmax run over *sequence chunks* so the fp32
+    [B, S, V] logits tensor is never materialized (V=32k dominates HBM at
+    long seq) — the standard memory-side optimization for LLM training on
+    16GB-HBM chips; remat recomputes each chunk's logits in the backward.
+    """
     tokens = batch["tokens"]
     mask = batch.get("mask")
-    logits = forward(params, tokens, config, mesh)
+    x = hidden_states(params, tokens, config, mesh)      # [B, S, E]
     targets = tokens[:, 1:]
-    logits = logits[:, :-1]
-    logprobs = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)[..., 0]
-    if mask is not None:
-        m = mask[:, 1:].astype(jnp.float32)
-    else:
-        m = jnp.ones_like(nll)
+    x = x[:, :-1]
+    m = (mask[:, 1:] if mask is not None else
+         jnp.ones_like(targets)).astype(jnp.float32)
+    head = params["lm_head"].astype(jnp.float32)
+
+    s = x.shape[1]
+    n_chunks = vocab_chunks
+    while s % n_chunks:
+        n_chunks -= 1
+    xs = x.reshape(x.shape[0], n_chunks, s // n_chunks, x.shape[2])
+    ts = targets.reshape(targets.shape[0], n_chunks, s // n_chunks)
+    ms = m.reshape(m.shape[0], n_chunks, s // n_chunks)
+
+    @functools.partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
+    def chunk_stats(xc, tc, mc):
+        logits = jnp.einsum("bse,ev->bsv", xc.astype(jnp.float32), head)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
+        nll = (lse - picked) * mc
+        correct = (jnp.argmax(logits, -1) == tc) * mc
+        return jnp.sum(nll), jnp.sum(correct)
+
+    def scan_body(carry, inp):
+        xc, tc, mc = inp
+        nll, correct = chunk_stats(xc, tc, mc)
+        return (carry[0] + nll, carry[1] + correct), None
+
+    (nll_sum, correct_sum), _ = jax.lax.scan(
+        scan_body, (jnp.zeros(()), jnp.zeros(())),
+        (xs.transpose(1, 0, 2, 3), ts.transpose(1, 0, 2),
+         ms.transpose(1, 0, 2)))
     total = jnp.maximum(jnp.sum(m), 1.0)
-    loss = jnp.sum(nll * m) / total
-    acc = jnp.sum((jnp.argmax(logits, -1) == targets) * m) / total
+    loss = nll_sum / total
+    acc = correct_sum / total
     return loss, {"loss": loss, "accuracy": acc, "tokens": total}
 
 

@@ -336,8 +336,8 @@ def flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    block_q: int = 1024,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Flash attention. Layout [B, S, H, D]; supports GQA (Hkv divides Hq).
