@@ -1,0 +1,134 @@
+"""Mixture-of-experts layer with expert parallelism.
+
+The reference has no MoE of its own (experts arrive via hosted engines —
+SURVEY.md §2.3); ray_tpu provides EP natively as the ``expert`` mesh axis:
+
+* router: top-k softmax gating (jittable, static shapes);
+* dispatch: capacity-bounded one-hot combine — tokens over capacity drop
+  (standard Switch/GShard semantics) so shapes stay static for XLA;
+* expert compute: experts stacked on a leading axis sharded over the
+  ``expert`` mesh axis; dispatch/combine einsums become all-to-alls on ICI
+  when sharded (XLA inserts them from the shardings — the
+  ``ragged_all_to_all`` of SURVEY §2.3 expressed GSPMD-style).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def router_topk(
+    gate_logits: jnp.ndarray, k: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k gating. gate_logits [T, E] → (weights [T, k], idx [T, k])."""
+    weights, idx = jax.lax.top_k(gate_logits, k)
+    weights = jax.nn.softmax(weights, axis=-1)
+    return weights, idx
+
+
+def dispatch_mask(
+    expert_idx: jnp.ndarray, num_experts: int, capacity: int
+) -> jnp.ndarray:
+    """[T, k] expert ids → dispatch tensor [T, E, C] (0/1).
+
+    Position within an expert's buffer = running count of tokens routed to
+    that expert; tokens beyond ``capacity`` are dropped (their row is zero).
+    """
+    t, k = expert_idx.shape
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.int32)  # [T,k,E]
+    flat = onehot.reshape(t * k, num_experts)
+    position = jnp.cumsum(flat, axis=0) - 1                  # slot per token
+    in_cap = position < capacity
+    slot_onehot = jax.nn.one_hot(position, capacity, dtype=jnp.float32)
+    disp = (flat[..., None] * in_cap[..., None] * slot_onehot)
+    return disp.reshape(t, k, num_experts, capacity).sum(axis=1)
+
+
+def moe_layer(
+    x: jnp.ndarray,
+    params: Dict[str, jnp.ndarray],
+    *,
+    num_experts: int,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Apply a SwiGLU MoE block. x: [B, S, E_model].
+
+    params: ``w_router`` [E_model, E], stacked expert weights ``w_gate`` /
+    ``w_up`` [E, E_model, M] and ``w_down`` [E, M, E_model] (leading axis
+    logical name "experts" → shard over the ``expert`` mesh axis).
+    Returns (output, aux) where aux carries the load-balancing loss.
+    """
+    b, s, d = x.shape
+    tokens = x.reshape(b * s, d)
+    t = tokens.shape[0]
+    capacity = max(int(capacity_factor * t * top_k / num_experts), top_k)
+
+    gate_logits = tokens.astype(jnp.float32) @ params["w_router"].astype(
+        jnp.float32)
+    weights, idx = router_topk(gate_logits, top_k)
+    disp = dispatch_mask(idx, num_experts, capacity)          # [T, E, C]
+    combine = disp * jnp.zeros(())  # placeholder replaced below
+
+    # Expert buffers: [E, C, D] — this einsum is the dispatch all-to-all when
+    # tokens are batch-sharded and experts are expert-sharded.
+    expert_in = jnp.einsum("tec,td->ecd", disp, tokens.astype(jnp.float32))
+    expert_in = expert_in.astype(x.dtype)
+
+    def expert_fn(buf, wg, wu, wd):
+        act = jax.nn.silu(buf @ wg) * (buf @ wu)
+        return act @ wd
+
+    expert_out = jax.vmap(expert_fn)(
+        expert_in, params["w_gate"].astype(x.dtype),
+        params["w_up"].astype(x.dtype), params["w_down"].astype(x.dtype))
+
+    # Combine weights: scatter the router weight of each kept (token, expert).
+    w_per_expert = jnp.einsum(
+        "tke,tk->te", jax.nn.one_hot(idx, num_experts, dtype=jnp.float32),
+        weights)
+    combine = disp * w_per_expert[:, :, None]                 # [T, E, C]
+    out = jnp.einsum("tec,ecd->td", combine,
+                     expert_out.astype(jnp.float32))
+
+    # Load-balancing aux loss (Switch Transformer eq. 4).
+    probs = jax.nn.softmax(gate_logits, axis=-1)
+    frac_tokens = jnp.mean(
+        jax.nn.one_hot(idx[:, 0], num_experts, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = num_experts * jnp.sum(frac_tokens * frac_probs)
+
+    return out.reshape(b, s, d).astype(x.dtype), {
+        "aux_loss": aux_loss,
+        "dropped_fraction": 1.0 - jnp.sum(disp) / (t * top_k),
+    }
+
+
+def init_moe_params(
+    key: jax.Array, d_model: int, d_ff: int, num_experts: int,
+    dtype=jnp.bfloat16,
+) -> Dict[str, jnp.ndarray]:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    scale_in = d_model ** -0.5
+    scale_out = d_ff ** -0.5
+    return {
+        "w_router": (jax.random.normal(k1, (d_model, num_experts))
+                     * scale_in).astype(jnp.float32),
+        "w_gate": (jax.random.normal(k2, (num_experts, d_model, d_ff))
+                   * scale_in).astype(dtype),
+        "w_up": (jax.random.normal(k3, (num_experts, d_model, d_ff))
+                 * scale_in).astype(dtype),
+        "w_down": (jax.random.normal(k4, (num_experts, d_ff, d_model))
+                   * scale_out).astype(dtype),
+    }
+
+
+MOE_LOGICAL_AXES = {
+    "w_router": ("embed", None),
+    "w_gate": ("experts", "embed", "mlp"),
+    "w_up": ("experts", "embed", "mlp"),
+    "w_down": ("experts", "mlp", "embed"),
+}
