@@ -1,0 +1,73 @@
+"""Task-event recording + chrome-trace timeline export.
+
+Reference: the profile-event path (SURVEY.md §5 tracing) — per-task events
+buffered in the CoreWorker (``task_event_buffer.h:224``) and dumped with
+``ray timeline`` / ``GlobalState.chrome_tracing_dump`` (_private/state.py:442).
+Events here are recorded per process (driver submission spans + local-mode
+execution spans) and rendered in the chrome ``about://tracing`` JSON format.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+_lock = threading.Lock()
+_events: List[Dict[str, Any]] = []
+_enabled = True
+MAX_EVENTS = 200_000
+
+
+def record(name: str, category: str, start_s: float, end_s: float,
+           tid: Optional[int] = None, **extra) -> None:
+    if not _enabled:
+        return
+    ev = {
+        "name": name,
+        "cat": category,
+        "ph": "X",
+        "ts": start_s * 1e6,
+        "dur": (end_s - start_s) * 1e6,
+        "pid": 0,
+        "tid": tid if tid is not None else threading.get_ident() % 100000,
+    }
+    if extra:
+        ev["args"] = extra
+    with _lock:
+        if len(_events) < MAX_EVENTS:
+            _events.append(ev)
+
+
+class span:
+    """Context manager recording one event."""
+
+    def __init__(self, name: str, category: str = "task", **extra):
+        self.name = name
+        self.category = category
+        self.extra = extra
+
+    def __enter__(self):
+        self.start = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        record(self.name, self.category, self.start, time.time(),
+               **self.extra)
+        return False
+
+
+def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Dump recorded events (chrome trace format). Reference: ``ray timeline``."""
+    with _lock:
+        events = list(_events)
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()

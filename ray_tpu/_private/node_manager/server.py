@@ -59,6 +59,11 @@ class NodeManager:
         self.total = resources
         self.available = dict(resources)
         self._res_lock = threading.RLock()
+        # Instance-level TPU slot accounting (reference: per-GPU-slot
+        # resource instances, common/scheduling/resource_instance_set.h):
+        # whole-chip asks get concrete chip indices for TPU_VISIBLE_CHIPS.
+        self._tpu_free: List[int] = list(range(int(resources.get("TPU", 0))))
+        self._tpu_held: Dict[bytes, List[int]] = {}
 
         # object store: native shared-memory data plane (plasma-equivalent,
         # native/shm_store.cpp) with a python-dict fallback. The dict also
@@ -127,20 +132,33 @@ class NodeManager:
                         self._idle.append(w.worker_id)
 
     # ------------------------------------------------------------ resources
-    def _try_acquire(self, demand: Dict[str, float]) -> bool:
+    def _try_acquire(self, demand: Dict[str, float],
+                     holder: Optional[bytes] = None) -> bool:
         with self._res_lock:
             if all(self.available.get(k, 0.0) + 1e-9 >= v
                    for k, v in demand.items()):
                 for k, v in demand.items():
                     self.available[k] = self.available.get(k, 0.0) - v
+                n_chips = int(demand.get("TPU", 0))
+                if holder is not None and n_chips >= 1 and \
+                        n_chips == demand.get("TPU"):
+                    self._tpu_held[holder] = \
+                        [self._tpu_free.pop() for _ in range(n_chips)]
                 return True
             return False
 
-    def _release(self, demand: Dict[str, float]):
+    def _chips_for(self, holder: bytes) -> List[int]:
+        with self._res_lock:
+            return list(self._tpu_held.get(holder, []))
+
+    def _release(self, demand: Dict[str, float],
+                 holder: Optional[bytes] = None):
         with self._res_lock:
             for k, v in demand.items():
                 self.available[k] = min(
                     self.available.get(k, 0.0) + v, self.total.get(k, 0.0))
+            if holder is not None:
+                self._tpu_free.extend(self._tpu_held.pop(holder, []))
 
     def _heartbeat_loop(self):
         seq = 0
@@ -252,7 +270,7 @@ class NodeManager:
                 if wid != w.worker_id:
                     continue
                 del self._actor_demands[actor_id]
-                self._release(demand)
+                self._release(demand, holder=actor_id)
                 try:
                     reply = self.gcs.GetActor(
                         pb.GetActorRequest(actor_id=actor_id), timeout=5)
@@ -285,13 +303,13 @@ class NodeManager:
         (raylet/node_manager.cc:1868) + ClusterTaskManager scheduling."""
         spec = request.spec
         demand = dict(spec.resources)
-        if self._try_acquire(demand):
+        lease_id = uuid.uuid4().bytes
+        if self._try_acquire(demand, holder=lease_id):
             worker = self._pop_worker()
             if worker is None:
-                self._release(demand)
+                self._release(demand, holder=lease_id)
                 return pb.LeaseReply(granted=False,
                                      error="worker start timeout")
-            lease_id = uuid.uuid4().bytes
             worker.leased_for = lease_id
             with self._pool_lock:
                 if worker.worker_id in self._idle:
@@ -300,7 +318,8 @@ class NodeManager:
             self._leases[lease_id] = (worker.worker_id, demand)
             return pb.LeaseReply(granted=True,
                                  worker_address=worker.address,
-                                 worker_id=worker.worker_id)
+                                 worker_id=worker.worker_id,
+                                 tpu_chips=self._chips_for(lease_id))
         # Spillback: pick another node from the cluster view.
         nodes = [n for n in self._cluster_view() if n.node_id != self.node_id]
         target = policies.pick_node_hybrid(nodes, demand)
@@ -323,6 +342,11 @@ class NodeManager:
         if lease is not None:
             _, demand = lease
             self._release(demand)
+        # Chip slots are keyed by lease id; reclaim them too.
+        with self._res_lock:
+            for lid in list(self._tpu_held):
+                if lid not in self._leases:
+                    self._tpu_free.extend(self._tpu_held.pop(lid))
         with self._pool_lock:
             w = self._workers.get(request.worker_id)
             if w and w.proc.poll() is None and not w.is_actor_worker:
@@ -338,7 +362,7 @@ class NodeManager:
         info = request.info
         spec = pickle.loads(info.spec)
         demand = dict(spec.get("resources", {}))
-        if not self._try_acquire(demand):
+        if not self._try_acquire(demand, holder=bytes(info.actor_id)):
             return pb.CreateActorOnNodeReply(
                 ok=False, error="insufficient resources")
         worker = self._pop_worker()
@@ -354,14 +378,21 @@ class NodeManager:
         stub = rpc.get_stub("WorkerService", worker.address)
         info.node_id = self.node_id
         info.address = worker.address
+        env = {}
+        chips = self._chips_for(bytes(info.actor_id))
+        if chips:
+            env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, chips))
+            env["TPU_CHIPS_PER_PROCESS_BOUNDS"] = f"1,1,{len(chips)}"
+        for k, v in spec.get("runtime_env", {}).get("env_vars", {}).items():
+            env[k] = str(v)
         try:
-            reply = stub.CreateActor(pb.CreateActorRequest(info=info),
+            reply = stub.CreateActor(pb.CreateActorRequest(info=info, env=env),
                                      timeout=60)
         except Exception as e:  # noqa: BLE001
-            self._release(demand)
+            self._release(demand, holder=bytes(info.actor_id))
             return pb.CreateActorOnNodeReply(ok=False, error=str(e))
         if not reply.ok:
-            self._release(demand)
+            self._release(demand, holder=bytes(info.actor_id))
             return pb.CreateActorOnNodeReply(ok=False, error=reply.error)
         return pb.CreateActorOnNodeReply(ok=True,
                                          worker_address=worker.address)

@@ -287,6 +287,8 @@ class ClusterRuntime(CoreRuntime):
             return_ids=[oid.binary() for oid in return_ids],
             max_retries=options.max_retries or 0,
         )
+        if options.runtime_env:
+            spec.runtime_env = pickle.dumps(options.runtime_env)
         for k, v in options.task_resources().items():
             spec.resources[k] = v
         self._pool.submit(self._lease_and_push, spec, return_ids,
@@ -344,6 +346,9 @@ class ClusterRuntime(CoreRuntime):
             time.sleep(backoff)
             backoff = min(backoff * 1.5, 0.5)
         worker_stub = rpc.get_stub("WorkerService", reply.worker_address)
+        if reply.tpu_chips:
+            del spec.tpu_chips[:]
+            spec.tpu_chips.extend(reply.tpu_chips)
         try:
             result = worker_stub.PushTask(
                 pb.PushTaskRequest(spec=spec), timeout=PUSH_TIMEOUT_S)
@@ -383,6 +388,7 @@ class ClusterRuntime(CoreRuntime):
         demand = dict(options.task_resources())
         spec = pickle.dumps({
             "resources": demand,
+            "runtime_env": options.runtime_env or {},
             "payload": dumps((cls, args, kwargs, options)),
         })
         info = pb.ActorInfo(

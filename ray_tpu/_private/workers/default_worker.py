@@ -147,6 +147,15 @@ class WorkerServer:
     def _push_normal_task(self, spec) -> pb.PushTaskResult:
         with self._task_lock:
             try:
+                if spec.tpu_chips:
+                    os.environ["TPU_VISIBLE_CHIPS"] = ",".join(
+                        map(str, spec.tpu_chips))
+                if spec.runtime_env:
+                    renv = pickle.loads(spec.runtime_env)
+                    for k, v in renv.get("env_vars", {}).items():
+                        os.environ[k] = str(v)
+                    if renv.get("working_dir"):
+                        os.chdir(renv["working_dir"])
                 fn, args, kwargs = loads(spec.payload)
                 args, kwargs = self._resolve_args(args, kwargs)
                 result = fn(*args, **kwargs)
@@ -185,6 +194,8 @@ class WorkerServer:
     def CreateActor(self, request, context):
         info = request.info
         try:
+            for k, v in request.env.items():
+                os.environ[k] = v
             outer = pickle.loads(info.spec)
             cls, args, kwargs, options = loads(outer["payload"])
             instance = cls(*args, **kwargs)
