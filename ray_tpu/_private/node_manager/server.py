@@ -332,21 +332,21 @@ class NodeManager:
                              spillback_address=addr)
 
     def ReturnWorker(self, request, context):
-        lease = self._leases.pop(request.lease_id, None)
+        lease_id = request.lease_id
+        lease = self._leases.pop(lease_id, None)
         if lease is None:
             # Fall back to any lease held by that worker.
             for lid, (wid, demand) in list(self._leases.items()):
                 if wid == request.worker_id:
                     lease = self._leases.pop(lid)
+                    lease_id = lid
                     break
         if lease is not None:
             _, demand = lease
-            self._release(demand)
-        # Chip slots are keyed by lease id; reclaim them too.
-        with self._res_lock:
-            for lid in list(self._tpu_held):
-                if lid not in self._leases:
-                    self._tpu_free.extend(self._tpu_held.pop(lid))
+            # Release exactly this lease's resources and chip slots. (Chips
+            # held by live actors are keyed by actor_id and must NOT be
+            # reclaimed here — see resource_instance_set.h semantics.)
+            self._release(demand, holder=lease_id)
         with self._pool_lock:
             w = self._workers.get(request.worker_id)
             if w and w.proc.poll() is None and not w.is_actor_worker:
@@ -367,7 +367,7 @@ class NodeManager:
                 ok=False, error="insufficient resources")
         worker = self._pop_worker()
         if worker is None:
-            self._release(demand)
+            self._release(demand, holder=bytes(info.actor_id))
             return pb.CreateActorOnNodeReply(ok=False,
                                              error="worker start timeout")
         worker.is_actor_worker = True

@@ -53,6 +53,20 @@ class RpcChaos:
 
 _chaos = RpcChaos()
 
+# Methods whose duplicate execution is harmful (a timed-out call may have
+# completed server-side): creates/leases/2PC votes. For these only
+# UNAVAILABLE (connection refused — call never reached the server) is
+# retried, never DEADLINE_EXCEEDED. Reference: retryable_grpc_client.h
+# retries are limited to idempotent accessors for the same reason.
+_NON_IDEMPOTENT = frozenset({
+    "NodeService.RequestWorkerLease",
+    "NodeService.CreateActorOnNode",
+    "NodeService.PrepareBundle",
+    "NodeService.CommitBundle",
+    "WorkerService.CreateActor",
+    "WorkerService.PushTask",
+})
+
 
 def reset_chaos() -> None:
     global _chaos
@@ -143,13 +157,18 @@ class Stub:
                 # grpc future; no retry wrapper (callers handle failures).
                 return call.future(request, timeout=timeout or self._timeout)
             last = None
+            retriable = (
+                (grpc.StatusCode.UNAVAILABLE,)
+                if full in _NON_IDEMPOTENT
+                else (grpc.StatusCode.UNAVAILABLE,
+                      grpc.StatusCode.DEADLINE_EXCEEDED)
+            )
             for attempt in range(self._max_attempts):
                 try:
                     return call(request, timeout=timeout or self._timeout)
                 except grpc.RpcError as e:
                     code = e.code() if hasattr(e, "code") else None
-                    if code in (grpc.StatusCode.UNAVAILABLE,
-                                grpc.StatusCode.DEADLINE_EXCEEDED) \
+                    if code in retriable \
                             and attempt + 1 < self._max_attempts:
                         last = e
                         time.sleep(min(0.05 * 2 ** attempt

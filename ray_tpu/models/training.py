@@ -29,9 +29,6 @@ class TrainState:
     params: Any
     opt_state: Any
 
-    def tree_flatten(self):  # pragma: no cover - registered below
-        return (self.step, self.params, self.opt_state), None
-
 
 jax.tree_util.register_pytree_node(
     TrainState,

@@ -70,7 +70,7 @@ def mha_reference(
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, causal, block_q, block_k, num_k_blocks):
+                *, scale, causal, block_q, block_k, num_k_blocks, offs):
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
@@ -79,8 +79,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # For causal masks, k-blocks strictly above the diagonal contribute nothing.
-    run = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+    # For causal masks, k-blocks strictly above the diagonal contribute
+    # nothing. `offs = sk - sq` aligns the mask bottom-right (matching
+    # mha_reference's tril(k=sk-sq)) so sq != sk decode/chunked shapes work.
+    run = (ik * block_k <= iq * block_q + block_q - 1 + offs) if causal else True
 
     @pl.when(run)
     def _body():
@@ -93,7 +95,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (iq * block_q + rows) >= (ik * block_k + cols)
+            mask = (iq * block_q + rows + offs) >= (ik * block_k + cols)
             s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
 
         m_prev = m_ref[:, :1]                                # [bq, 1]
@@ -136,7 +138,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, num_k_blocks=nk,
+        block_q=block_q, block_k=block_k, num_k_blocks=nk, offs=sk - sq,
     )
     scratch = [
         pltpu.VMEM((block_q, d), jnp.float32),
@@ -164,14 +166,14 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k, interpret):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
-               *, scale, causal, block_q, block_k, num_k_blocks):
+               *, scale, causal, block_q, block_k, num_k_blocks, offs):
     iq, ik = pl.program_id(2), pl.program_id(3)
 
     @pl.when(ik == 0)
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    run = (ik * block_k <= iq * block_q + block_q - 1) if causal else True
+    run = (ik * block_k <= iq * block_q + block_q - 1 + offs) if causal else True
 
     @pl.when(run)
     def _body():
@@ -187,7 +189,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (iq * block_q + rows) >= (ik * block_k + cols)
+            mask = (iq * block_q + rows + offs) >= (ik * block_k + cols)
             s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)                                  # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -202,7 +204,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc,
-                *, scale, causal, block_q, block_k, num_q_blocks):
+                *, scale, causal, block_q, block_k, num_q_blocks, offs):
     ik, iq = pl.program_id(2), pl.program_id(3)
 
     @pl.when(iq == 0)
@@ -210,7 +212,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = (iq * block_q + block_q - 1 >= ik * block_k) if causal else True
+    run = (iq * block_q + block_q - 1 + offs >= ik * block_k) if causal else True
 
     @pl.when(run)
     def _body():
@@ -226,7 +228,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (iq * block_q + rows) >= (ik * block_k + cols)
+            mask = (iq * block_q + rows + offs) >= (ik * block_k + cols)
             s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
         p = jnp.exp(s - lse)                                  # [bq, bk]
         # dv += p^T @ do
@@ -265,7 +267,8 @@ def _bwd(res, g, *, scale, causal, block_q, block_k, interpret):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_k_blocks=nk),
+                          block_q=block_q, block_k=block_k, num_k_blocks=nk,
+                          offs=sk - sq),
         grid=(b, hq, nq, nk),
         in_specs=[q_spec, kv_spec_dq, kv_spec_dq, q_spec, row_spec, row_spec],
         out_specs=q_spec,
@@ -286,7 +289,8 @@ def _bwd(res, g, *, scale, causal, block_q, block_k, interpret):
 
     dk_ph, dv_ph = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q_blocks=nq),
+                          block_q=block_q, block_k=block_k, num_q_blocks=nq,
+                          offs=sk - sq),
         grid=(b, hq, nk, nq),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, row_spec2, row_spec2],
         out_specs=[kv_out_spec, kv_out_spec],
@@ -329,6 +333,21 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, g):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+def flash_applicable(
+    sq: int, sk: int, d: int, *, causal: bool = True,
+    block_q: int = 1024, block_k: int = 1024,
+) -> bool:
+    """True when :func:`flash_attention` takes the pallas kernel path for
+    these shapes (vs the XLA reference fallback). Kept next to the kernel so
+    diagnostics (bench.py) can't drift from the real dispatch predicate."""
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    return not (
+        sq < 8 or sq % block_q or sk % block_k or d % 128 or pltpu is None
+        or (causal and sq > sk)
+    )
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -350,10 +369,13 @@ def flash_attention(
     if hq % hkv:
         raise ValueError(f"q heads {hq} not a multiple of kv heads {hkv}")
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    if not flash_applicable(sq, sk, d, causal=causal,
+                            block_q=block_q, block_k=block_k):
+        # Tiny-q (decode), non-tiling shapes, or causal-with-fewer-keys (rows
+        # would be fully masked): XLA handles these well natively.
+        return mha_reference(q, k, v, causal=causal, scale=scale)
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k or d % 128 or pltpu is None:
-        return mha_reference(q, k, v, causal=causal, scale=scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 

@@ -71,7 +71,6 @@ def moe_layer(
         jnp.float32)
     weights, idx = router_topk(gate_logits, top_k)
     disp = dispatch_mask(idx, num_experts, capacity)          # [T, E, C]
-    combine = disp * jnp.zeros(())  # placeholder replaced below
 
     # Expert buffers: [E, C, D] — this einsum is the dispatch all-to-all when
     # tokens are batch-sharded and experts are expert-sharded.

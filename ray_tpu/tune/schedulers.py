@@ -44,8 +44,6 @@ class AsyncHyperBandScheduler:
         return a >= b if self.mode == "max" else a <= b
 
     def on_result(self, trial_id: str, step: int, value: float) -> str:
-        if self.mode == "min":
-            pass
         for rung in reversed(self.rungs):
             if step == rung:
                 values = self.recorded[rung]

@@ -83,6 +83,12 @@ class CollectiveGroup:
         self.rank = rank
         self._store = store
         self._seq = 0
+        # Per-(peer, tag) p2p sequence counters: a second send with the same
+        # tag before the first recv must land in a distinct buffer (no silent
+        # overwrite). Sender and receiver count independently but stay in
+        # lockstep because p2p is pairwise FIFO.
+        self._p2p_send: Dict[tuple, int] = {}
+        self._p2p_recv: Dict[tuple, int] = {}
         self._lock = threading.Lock()
 
     def _next_seq(self, op: str) -> str:
@@ -139,14 +145,26 @@ class CollectiveGroup:
         self._gather_round(np.zeros(0, np.int8))
 
     def send(self, tensor: np.ndarray, dst_rank: int, tag: str = "") -> None:
-        seq = f"p2p:{self.rank}->{dst_rank}:{tag}"
+        with self._lock:
+            n = self._p2p_send.get((dst_rank, tag), 0) + 1
+        seq = f"p2p:{self.rank}->{dst_rank}:{tag}:{n}"
         ray_tpu.get(self._store.put.remote(seq, self.rank, np.asarray(tensor)))
+        # Count only after the put landed: a failed send can be retried
+        # without desyncing the (peer, tag) stream.
+        with self._lock:
+            self._p2p_send[(dst_rank, tag)] = n
 
     def recv(self, src_rank: int, tag: str = "") -> np.ndarray:
-        seq = f"p2p:{src_rank}->{self.rank}:{tag}"
+        with self._lock:
+            n = self._p2p_recv.get((src_rank, tag), 0) + 1
+        seq = f"p2p:{src_rank}->{self.rank}:{tag}:{n}"
         out = self._poll(
             lambda: self._store.collect.remote(seq, self.rank, 1, 1)
         )
+        # Count only after the message arrived: a timed-out recv can be
+        # retried against the same sequence number.
+        with self._lock:
+            self._p2p_recv[(src_rank, tag)] = n
         return out[0]
 
 

@@ -50,6 +50,32 @@ def test_flash_attention_grads(causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
 
 
+@pytest.mark.parametrize("sq,sk", [(128, 256), (64, 256), (256, 128)])
+def test_flash_attention_cross_length_causal(sq, sk):
+    # sq != sk must use bottom-right mask alignment (tril k=sk-sq), matching
+    # mha_reference — the chunked-prefill / decode-with-cache shapes.
+    q, _, _ = _qkv(s=sq)
+    _, k, v = _qkv(s=sk)
+    with jax.default_matmul_precision("highest"):
+        ref = mha_reference(q, k, v, causal=True)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    with jax.default_matmul_precision("highest"):
+        g1 = jax.grad(
+            lambda *a: jnp.sum(
+                flash_attention(*a, causal=True, block_q=64, block_k=64) ** 2
+            ),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        g2 = jax.grad(
+            lambda *a: jnp.sum(mha_reference(*a, causal=True) ** 2),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
+
+
 def test_flash_attention_small_fallback():
     # Sequences below one block fall back to the reference path.
     q, k, v = _qkv(s=32, d=64)
