@@ -3,20 +3,28 @@
 Reference: ``src/ray/gcs/gcs_server`` (SURVEY.md C22) — one process hosting
 node manager, actor manager + scheduler, KV, pubsub, placement-group manager
 (2PC), health-check manager, and the object directory. This build keeps the
-same responsibilities in one asyncio-free threaded gRPC process; persistence
-is in-memory with an optional JSON snapshot (the Redis-backed fault-tolerance
-mode of the reference maps to snapshot-restore — ``redis_store_client.h:107``).
+same responsibilities in one asyncio-free threaded gRPC process.
+
+Fault tolerance (reference: ``redis_store_client.h:107`` Redis-backed GCS
+restart): with ``persist_path`` set (or ``RAY_TPU_GCS_PERSIST_PATH``),
+durable tables (KV, actors, placement groups, object directory, refcounts)
+are snapshotted to disk on mutation (debounced, atomic rename) and reloaded
+on restart. Nodes are NOT persisted: a restarted GCS answers their next
+heartbeat with ``ok=false``, which drives the node's re-register path;
+subscribers reconnect through their streaming-retry loops.
 """
 
 from __future__ import annotations
 
 import argparse
 import logging
+import os
 import pickle
 import queue
 import threading
 import time
 from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set, Tuple
 
 from ray_tpu._private import rpc
@@ -26,10 +34,11 @@ logger = logging.getLogger(__name__)
 
 HEALTH_CHECK_PERIOD_S = 0.5
 HEALTH_FAILURE_THRESHOLD_S = 3.0
+PERSIST_DEBOUNCE_S = 0.1
 
 
 class GcsServer:
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, persist_path: Optional[str] = None):
         # nodes
         self._nodes: Dict[str, pb.NodeInfo] = {}
         self._last_heartbeat: Dict[str, float] = {}
@@ -45,13 +54,99 @@ class GcsServer:
         # object directory
         self._locations: Dict[bytes, Set[str]] = defaultdict(set)
         self._object_sizes: Dict[bytes, int] = {}
+        # distributed refcounts: object -> {holder -> count}. An object is
+        # freed cluster-wide when its summed count returns to zero after
+        # having been positive (reference: reference_count.h:66, collapsed
+        # to a GCS-centric table).
+        self._refcounts: Dict[bytes, Dict[str, int]] = defaultdict(dict)
 
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        # Bounded pool for actor creation/restart and PG placement work
+        # (the reference runs these on the GCS io_context, not a thread per
+        # actor; unbounded spawns collapse at 40k-actor scale).
+        self._work_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="gcs-work")
+        self._persist_path = persist_path or os.environ.get(
+            "RAY_TPU_GCS_PERSIST_PATH") or None
+        self._dirty = threading.Event()
+        if self._persist_path and os.path.exists(self._persist_path):
+            self._load_snapshot()
         self._server, self.port = rpc.serve("GcsService", self, port=port)
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="gcs-health")
         self._health_thread.start()
+        if self._persist_path:
+            self._persist_thread = threading.Thread(
+                target=self._persist_loop, daemon=True, name="gcs-persist")
+            self._persist_thread.start()
+
+    # ------------------------------------------------------------ persistence
+    def _mark_dirty(self):
+        if self._persist_path:
+            self._dirty.set()
+
+    def _persist_loop(self):
+        while not self._stop.is_set():
+            if not self._dirty.wait(timeout=0.5):
+                continue
+            time.sleep(PERSIST_DEBOUNCE_S)  # coalesce mutation bursts
+            self._dirty.clear()
+            try:
+                self._write_snapshot()
+            except Exception:  # noqa: BLE001
+                logger.exception("GCS snapshot write failed")
+
+    def _write_snapshot(self):
+        with self._lock:
+            state = {
+                "kv": dict(self._kv),
+                "actors": {k: v.SerializeToString()
+                           for k, v in self._actors.items()},
+                "actor_names": dict(self._actor_names),
+                "pgroups": {k: v.SerializeToString()
+                            for k, v in self._pgroups.items()},
+                "locations": {k: sorted(v)
+                              for k, v in self._locations.items() if v},
+                "object_sizes": dict(self._object_sizes),
+                "refcounts": {k: dict(v)
+                              for k, v in self._refcounts.items() if v},
+            }
+        blob = pickle.dumps(state)
+        tmp = f"{self._persist_path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._persist_path)
+
+    def _load_snapshot(self):
+        try:
+            with open(self._persist_path, "rb") as f:
+                state = pickle.loads(f.read())
+        except Exception:  # noqa: BLE001
+            logger.exception("GCS snapshot load failed; starting empty")
+            return
+        self._kv = dict(state.get("kv", {}))
+        for k, blob in state.get("actors", {}).items():
+            info = pb.ActorInfo()
+            info.ParseFromString(blob)
+            # Actors that were mid-flight when the GCS died cannot complete
+            # their old transition; surviving workers still host ALIVE ones
+            # (their node re-registers), so keep states as-is.
+            self._actors[k] = info
+        self._actor_names = dict(state.get("actor_names", {}))
+        for k, blob in state.get("pgroups", {}).items():
+            info = pb.PlacementGroupInfo()
+            info.ParseFromString(blob)
+            self._pgroups[k] = info
+        for k, nodes in state.get("locations", {}).items():
+            self._locations[k] = set(nodes)
+        self._object_sizes = dict(state.get("object_sizes", {}))
+        for k, holders in state.get("refcounts", {}).items():
+            self._refcounts[k] = dict(holders)
+        logger.info("GCS state restored from %s (%d actors, %d kv keys)",
+                    self._persist_path, len(self._actors), len(self._kv))
 
     # ------------------------------------------------------------- helpers
     def _publish(self, channel: str, data: bytes):
@@ -130,6 +225,7 @@ class GcsServer:
             if not request.overwrite and key in self._kv:
                 return pb.KvReply(ok=False)
             self._kv[key] = request.value
+        self._mark_dirty()
         return pb.KvReply(ok=True)
 
     def KvGet(self, request, context):
@@ -142,6 +238,7 @@ class GcsServer:
     def KvDel(self, request, context):
         with self._lock:
             existed = self._kv.pop((request.ns, request.key), None) is not None
+        self._mark_dirty()
         return pb.KvReply(ok=existed)
 
     def KvKeys(self, request, context):
@@ -164,12 +261,12 @@ class GcsServer:
                         error=f"Actor name {info.name!r} already taken")
                 self._actor_names[key] = info.actor_id
             self._actors[info.actor_id] = info
+        self._mark_dirty()
         self._publish("ACTOR", info.SerializeToString())
         if info.state == "PENDING":
             # GCS-direct actor creation (reference: GcsActorScheduler
             # ScheduleByGcs, gcs_actor_scheduler.cc:60).
-            threading.Thread(target=self._restart_actor, args=(info,),
-                             daemon=True).start()
+            self._work_pool.submit(self._restart_actor, info)
         return pb.RegisterActorReply(ok=True)
 
     def UpdateActor(self, request, context):
@@ -190,10 +287,10 @@ class GcsServer:
                 key = (info.namespace or "default", info.name)
                 if self._actor_names.get(key) == info.actor_id:
                     del self._actor_names[key]
+        self._mark_dirty()
         self._publish("ACTOR", info.SerializeToString())
         if restart:
-            threading.Thread(target=self._restart_actor, args=(info,),
-                             daemon=True).start()
+            self._work_pool.submit(self._restart_actor, info)
         return pb.Empty()
 
     def GetActor(self, request, context):
@@ -226,9 +323,7 @@ class GcsServer:
                 info.num_restarts += 1
                 info.state = "RESTARTING"
                 self._publish("ACTOR", info.SerializeToString())
-                threading.Thread(
-                    target=self._restart_actor, args=(info,), daemon=True
-                ).start()
+                self._work_pool.submit(self._restart_actor, info)
             else:
                 info.state = "DEAD"
                 info.death_cause = f"node {node_id[:8]} died"
@@ -307,8 +402,8 @@ class GcsServer:
             state="PENDING")
         with self._lock:
             self._pgroups[request.group_id] = info
-        threading.Thread(target=self._place_group, args=(info,),
-                         daemon=True).start()
+        self._mark_dirty()
+        self._work_pool.submit(self._place_group, info)
         return pb.Empty()
 
     def _place_group(self, info: pb.PlacementGroupInfo):
@@ -373,10 +468,12 @@ class GcsServer:
                 for bundle, node_id in zip(info.bundles, assignment):
                     bundle.node_id = node_id
                 info.state = "CREATED"
+            self._mark_dirty()
             self._publish("PLACEMENT_GROUP", info.SerializeToString())
             return
         with self._lock:
             info.state = "INFEASIBLE"
+        self._mark_dirty()
         self._publish("PLACEMENT_GROUP", info.SerializeToString())
 
     def GetPlacementGroup(self, request, context):
@@ -393,6 +490,7 @@ class GcsServer:
                 return pb.Empty()
             info.state = "REMOVED"
             nodes = {b.node_id for b in info.bundles if b.node_id}
+        self._mark_dirty()
         for node_id in nodes:
             stub = self._node_stub(node_id)
             if stub:
@@ -413,6 +511,11 @@ class GcsServer:
                     self._object_sizes[request.object_id] = request.size
             else:
                 self._locations[request.object_id].discard(request.node_id)
+        self._mark_dirty()
+        if request.added:
+            # Wake blocked get()/wait() callers (object-location pubsub,
+            # reference: pubsub/publisher.h:297 object channel).
+            self._publish("OBJECT_LOC", request.object_id)
         return pb.Empty()
 
     def GetObjectLocations(self, request, context):
@@ -421,9 +524,61 @@ class GcsServer:
             size = self._object_sizes.get(request.object_id, 0)
         return pb.GetObjectLocationsReply(node_ids=locs, size=size)
 
+    def UpdateRefCounts(self, request, context):
+        to_free: List[bytes] = []
+        with self._lock:
+            for d in request.deltas:
+                holders = self._refcounts[d.object_id]
+                n = holders.get(request.holder_id, 0) + d.delta
+                if n <= 0:
+                    holders.pop(request.holder_id, None)
+                else:
+                    holders[request.holder_id] = n
+                if not holders:
+                    del self._refcounts[d.object_id]
+                    to_free.append(d.object_id)
+        self._mark_dirty()
+        if to_free:
+            # Grace delay before the actual free: a slow holder's initial +1
+            # may still be in flight (cross-holder flushes are not ordered).
+            t = threading.Timer(0.5, self._free_if_still_zero, args=(to_free,))
+            t.daemon = True
+            t.start()
+        return pb.Empty()
+
+    def _free_if_still_zero(self, oids: List[bytes]):
+        for oid in oids:
+            with self._lock:
+                if self._refcounts.get(oid):
+                    continue  # resurrected by a late-arriving increment
+            self._free_object(oid)
+
+    def _free_object(self, oid: bytes):
+        """Free all stored copies of an object whose refcount hit zero."""
+        with self._lock:
+            nodes = list(self._locations.pop(oid, ()))
+            self._object_sizes.pop(oid, None)
+        self._mark_dirty()
+        for node_id in nodes:
+            stub = self._node_stub(node_id)
+            if stub is None:
+                continue
+            try:
+                stub.FreeObjects(pb.FreeObjectsRequest(object_ids=[oid]),
+                                 timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+        self._publish("OBJECT_FREED", oid)
+
     # ------------------------------------------------------------- lifecycle
     def shutdown(self):
         self._stop.set()
+        self._work_pool.shutdown(wait=False)
+        if self._persist_path and self._dirty.is_set():
+            try:
+                self._write_snapshot()
+            except Exception:  # noqa: BLE001
+                pass
         self._server.stop(grace=0.2)
 
 

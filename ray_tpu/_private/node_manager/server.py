@@ -448,11 +448,15 @@ class NodeManager:
             meta = self._shm.get(request.object_id.hex())
             if meta is not None:
                 name, size = meta
+                if request.metadata_only:
+                    return pb.GetObjectReply(found=True, size=size)
                 return pb.GetObjectReply(found=True, shm_name=name, size=size)
         with self._obj_lock:
             data = self._objects.get(request.object_id)
         if data is None:
             return pb.GetObjectReply(found=False)
+        if request.metadata_only:
+            return pb.GetObjectReply(found=True, size=len(data))
         return pb.GetObjectReply(found=True, data=data)
 
     def _read_object_bytes(self, object_id: bytes) -> Optional[bytes]:

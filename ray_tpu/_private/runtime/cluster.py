@@ -96,12 +96,37 @@ class ClusterRuntime(CoreRuntime):
         self._pool = ThreadPoolExecutor(max_workers=64,
                                         thread_name_prefix="submit")
         self._actor_cache: Dict[bytes, pb.ActorInfo] = {}
+        self._actor_dead: Dict[bytes, str] = {}
         self._actor_seq: Dict[bytes, int] = {}
         self._actor_session: Dict[bytes, int] = {}
         self._actor_lock = threading.Lock()
         self._put_index = 0
         self._put_lock = threading.Lock()
         self._shutdown = False
+        # Ownership: this process owns the objects its tasks/puts create.
+        # Local ObjectRef lifetimes feed the distributed refcount (GCS sums
+        # per-holder counts; zero => cluster-wide free). Lineage (the creating
+        # TaskSpec) stays pinned while this owner holds refs, enabling
+        # re-execution when every stored copy is lost (reference:
+        # reference_count.h:66 + task_manager.h:274 ResubmitTask).
+        from ray_tpu._private.refcount import ReferenceCounter
+
+        self.refs = ReferenceCounter(self.gcs, self.worker_id,
+                                     on_local_zero=self._on_ref_zero)
+        self._lineage: Dict[bytes, pb.TaskSpec] = {}
+        self._lineage_lock = threading.Lock()
+        self._reconstructing: Dict[bytes, threading.Event] = {}
+        # Tasks whose first execution finished (success or error): a fetch
+        # miss on their returns means "produced then lost", not "pending".
+        self._task_done: set = set()
+        # GCS pubsub drives actor-address resolution and object-readiness
+        # wakeups (no sleep-polling on those paths — reference:
+        # pubsub/publisher.h:297). The condition is notified on every
+        # relevant event; waiters use it with a coarse safety timeout.
+        self._ready_cond = threading.Condition()
+        self._sub_thread = threading.Thread(
+            target=self._subscriber_loop, daemon=True, name="gcs-subscriber")
+        self._sub_thread.start()
 
     @classmethod
     def connect(cls, address: str, namespace: str = "default") -> "ClusterRuntime":
@@ -135,6 +160,67 @@ class ClusterRuntime(CoreRuntime):
         self.node_address = pick.address
         self.node = rpc.get_stub("NodeService", pick.address)
         return True
+
+    # ------------------------------------------------------------- pubsub
+    def _subscriber_loop(self):
+        """Long-lived GCS subscription for ACTOR and OBJECT_LOC channels.
+
+        Reconnects with backoff on stream failure (incl. GCS restart — the
+        resubscribe path of the reference's GCS client).
+        """
+        sub_id = f"rt-{self.worker_id[:12]}"
+        while not self._shutdown:
+            try:
+                stream = self.gcs.Subscribe(pb.SubscribeRequest(
+                    channels=["ACTOR", "OBJECT_LOC"], subscriber_id=sub_id))
+                self._sub_stream = stream
+                for msg in stream:
+                    if self._shutdown:
+                        return
+                    if msg.channel == "ACTOR":
+                        self._on_actor_event(msg.data)
+                    else:
+                        with self._ready_cond:
+                            self._ready_cond.notify_all()
+            except Exception:  # noqa: BLE001 — stream broken; resubscribe
+                if self._shutdown:
+                    return
+                time.sleep(0.2)
+
+    def _on_actor_event(self, data: bytes):
+        try:
+            info = pb.ActorInfo()
+            info.ParseFromString(data)
+        except Exception:  # noqa: BLE001
+            return
+        with self._actor_lock:
+            if info.state == "ALIVE":
+                self._actor_cache[bytes(info.actor_id)] = info
+            else:
+                self._actor_cache.pop(bytes(info.actor_id), None)
+                if info.state == "DEAD":
+                    # Remember terminal states so waiters fail fast.
+                    self._actor_dead[bytes(info.actor_id)] = \
+                        info.death_cause or "actor is dead"
+        with self._ready_cond:
+            self._ready_cond.notify_all()
+
+    # ------------------------------------------------------------- references
+    def add_local_reference(self, ref: ObjectRef) -> None:
+        self.refs.incr(ref.id().binary())
+
+    def remove_local_reference(self, object_id) -> None:
+        if not self._shutdown:
+            self.refs.decr(object_id.binary())
+
+    def _on_ref_zero(self, oid: bytes) -> None:
+        """Local count hit zero: evict the in-process copy and unpin lineage.
+        (The cluster-wide free happens at the GCS when *all* holders drop.)"""
+        from ray_tpu._private.ids import ObjectID
+
+        self.memory.delete([ObjectID(oid)])
+        with self._lineage_lock:
+            self._lineage.pop(oid, None)
 
     # ---------------------------------------------------------------- objects
     def put(self, value: Any, owner_ref: Optional[ObjectRef] = None) -> ObjectRef:
@@ -226,7 +312,8 @@ class ClusterRuntime(CoreRuntime):
 
     def _get_one(self, ref: ObjectRef, deadline: Optional[float]) -> Any:
         oid = ref.id()
-        backoff = 0.001
+        backoff = 0.002
+        rebuilds = 0
         while True:
             try:
                 return self.memory.get_if_ready(oid)
@@ -235,26 +322,105 @@ class ClusterRuntime(CoreRuntime):
             found, value = self._fetch_object(ref)
             if found:
                 return value
+            if rebuilds < 3 and self._maybe_reconstruct(ref):
+                rebuilds += 1
+                continue
             if deadline is not None and time.monotonic() >= deadline:
                 raise exceptions.GetTimeoutError(
                     f"Timed out getting object {oid.hex()}")
-            remaining = None if deadline is None else deadline - time.monotonic()
-            step = backoff if remaining is None else min(backoff, max(remaining, 0.0))
-            entry = self.memory._entry(oid)
-            entry.ready.wait(step)
-            backoff = min(backoff * 2, 0.25)
+            # Event-driven wait: OBJECT_LOC pubsub events and local result
+            # arrivals notify the condition; the timeout is only a safety
+            # net for events published before our subscription attached.
+            with self._ready_cond:
+                if not self.memory.contains(oid):
+                    remaining = (None if deadline is None
+                                 else max(0.0, deadline - time.monotonic()))
+                    step = backoff if remaining is None \
+                        else min(backoff, remaining)
+                    self._ready_cond.wait(step)
+            backoff = min(backoff * 2, 0.5)
+
+    def _maybe_reconstruct(self, ref: ObjectRef, depth: int = 0) -> bool:
+        """Re-execute the task that created a produced-then-lost object.
+
+        Reference: lineage reconstruction — TaskManager::ResubmitTask
+        (task_manager.h:274) + ObjectRecoveryManager. Owner-side only: this
+        process must hold the creating TaskSpec (pinned while its refs live).
+        Returns True when a reconstruction ran (caller retries the fetch).
+        """
+        oid = ref.id().binary()
+        with self._lineage_lock:
+            spec = self._lineage.get(oid)
+        if spec is None or depth > 10:
+            return False
+        task_key = bytes(spec.task_id)
+        # Only reconstruct objects whose producing task already completed —
+        # a miss on a still-running task's return just means "pending".
+        if task_key not in self._task_done:
+            return False
+        with self._lineage_lock:
+            ev = self._reconstructing.get(task_key)
+            leader = ev is None
+            if leader:
+                ev = self._reconstructing[task_key] = threading.Event()
+        if not leader:
+            ev.wait(300)
+            return True
+        try:
+            logger.warning("all copies of %s lost; re-executing task %s (%s)",
+                           ref.id().hex()[:12], task_key.hex()[:12], spec.name)
+            # Recursively ensure this task's own ObjectRef args exist.
+            if depth < 10:
+                try:
+                    _, args, kwargs = loads(spec.payload)
+                    for a in list(args) + list(kwargs.values()):
+                        if isinstance(a, ObjectRef) and \
+                                not self._fetch_object(a)[0]:
+                            self._maybe_reconstruct(a, depth + 1)
+                except Exception:  # noqa: BLE001
+                    pass
+            return_ids = [ObjectID(b) for b in spec.return_ids]
+            self._lease_and_push(spec, return_ids, int(spec.max_retries))
+            return True
+        finally:
+            ev.set()
+            with self._lineage_lock:
+                self._reconstructing.pop(task_key, None)
+
+    def _is_ready(self, ref: ObjectRef) -> bool:
+        """Readiness by metadata only — never fetches object data
+        (the reference's Wait checks the store/directory, not contents)."""
+        oid = ref.id()
+        if self.memory.contains(oid):
+            return True
+        try:
+            reply = self.node.GetObject(pb.GetObjectRequest(
+                object_id=oid.binary(), metadata_only=True))
+            if reply.found:
+                return True
+        except Exception:  # noqa: BLE001
+            self._refresh_local_node()
+        try:
+            locs = self.gcs.GetObjectLocations(
+                pb.GetObjectLocationsRequest(object_id=oid.binary()))
+            return bool(locs.node_ids)
+        except Exception:  # noqa: BLE001
+            return False
 
     def wait(self, refs, num_returns, timeout, fetch_local):
         deadline = None if timeout is None else time.monotonic() + timeout
+        ready_ids = set()
+        fetching = set()
         while True:
-            ready_ids = set()
             for ref in refs:
-                if self.memory.contains(ref.id()):
+                if ref.id() in ready_ids:
+                    continue
+                if self._is_ready(ref):
                     ready_ids.add(ref.id())
-                else:
-                    found, _ = self._fetch_object(ref)
-                    if found:
-                        ready_ids.add(ref.id())
+                    if fetch_local and not self.memory.contains(ref.id()) \
+                            and ref.id() not in fetching:
+                        fetching.add(ref.id())
+                        self._pool.submit(self._fetch_object, ref)
                 if len(ready_ids) >= num_returns:
                     break
             if len(ready_ids) >= num_returns or (
@@ -262,7 +428,8 @@ class ClusterRuntime(CoreRuntime):
                 ready = [r for r in refs if r.id() in ready_ids]
                 not_ready = [r for r in refs if r.id() not in ready_ids]
                 return ready, not_ready
-            time.sleep(0.005)
+            with self._ready_cond:
+                self._ready_cond.wait(0.05)
 
     def free(self, refs):
         ids = [r.id().binary() for r in refs]
@@ -291,13 +458,24 @@ class ClusterRuntime(CoreRuntime):
             spec.runtime_env = pickle.dumps(options.runtime_env)
         for k, v in options.task_resources().items():
             spec.resources[k] = v
+        # Pin top-level ObjectRef args for the task's flight time so their
+        # refcount can't hit zero between submit and the worker's borrow.
+        pinned = [a.id().binary() for a in list(args) + list(kwargs.values())
+                  if isinstance(a, ObjectRef)]
+        for oid in pinned:
+            self.refs.incr(oid)
+        # Pin lineage for the returns (dropped when this owner's local refs
+        # to them reach zero — see _on_ref_zero).
+        with self._lineage_lock:
+            for oid in return_ids:
+                self._lineage[oid.binary()] = spec
         self._pool.submit(self._lease_and_push, spec, return_ids,
-                          options.max_retries or 0)
+                          options.max_retries or 0, pinned)
         return [ObjectRef(oid, owner_address=self.node_address)
                 for oid in return_ids]
 
     def _lease_and_push(self, spec: pb.TaskSpec, return_ids: List[ObjectID],
-                        retries: int):
+                        retries: int, pinned: Optional[List[bytes]] = None):
         try:
             attempt = 0
             while True:
@@ -317,6 +495,9 @@ class ClusterRuntime(CoreRuntime):
             self._store_error(
                 exceptions.RayTaskError.from_exception(e, spec.name),
                 return_ids)
+        finally:
+            for oid in pinned or ():
+                self.refs.decr(oid)
 
     def _lease_and_push_once(self, spec: pb.TaskSpec,
                              return_ids: List[ObjectID]):
@@ -365,6 +546,8 @@ class ClusterRuntime(CoreRuntime):
 
     def _apply_push_result(self, result: pb.PushTaskResult,
                            return_ids: List[ObjectID], name: str):
+        if return_ids:
+            self._task_done.add(return_ids[0].task_id().binary())
         if not result.ok:
             err = pickle.loads(result.error) if result.error else \
                 exceptions.RayTaskError(name, "task failed")
@@ -374,10 +557,14 @@ class ClusterRuntime(CoreRuntime):
             if i < len(result.in_store) and result.in_store[i]:
                 continue  # large result: fetched on demand via the directory
             self.memory.put(oid, loads(result.inline_results[i]))
+        with self._ready_cond:
+            self._ready_cond.notify_all()
 
     def _store_error(self, err, return_ids):
         for oid in return_ids:
             self.memory.put(oid, err)
+        with self._ready_cond:
+            self._ready_cond.notify_all()
 
     def cancel(self, ref, force, recursive):
         logger.warning("cancel() is best-effort in the cluster runtime")
@@ -407,27 +594,41 @@ class ClusterRuntime(CoreRuntime):
 
     def _resolve_actor(self, actor_id: ActorID,
                        timeout_s: float = 60.0) -> pb.ActorInfo:
+        """Resolve an actor's worker address. Pubsub-driven: after one
+        initial GetActor (cold cache / missed events), waiters block on the
+        ACTOR-channel condition instead of polling the GCS."""
         key = actor_id.binary()
-        with self._actor_lock:
-            info = self._actor_cache.get(key)
-        if info is not None and info.state == "ALIVE":
-            return info
         deadline = time.monotonic() + timeout_s
+        checked_gcs = False
         while True:
-            reply = self.gcs.GetActor(pb.GetActorRequest(actor_id=key))
-            if reply.found:
-                info = reply.info
-                if info.state == "ALIVE":
-                    with self._actor_lock:
-                        self._actor_cache[key] = info
-                    return info
-                if info.state == "DEAD":
-                    raise exceptions.ActorDiedError(
-                        actor_id, info.death_cause or "actor is dead")
+            with self._actor_lock:
+                info = self._actor_cache.get(key)
+                dead = self._actor_dead.get(key)
+            if info is not None and info.state == "ALIVE":
+                return info
+            if dead is not None:
+                raise exceptions.ActorDiedError(actor_id, dead)
+            if not checked_gcs:
+                checked_gcs = True
+                reply = self.gcs.GetActor(pb.GetActorRequest(actor_id=key))
+                if reply.found:
+                    if reply.info.state == "ALIVE":
+                        with self._actor_lock:
+                            self._actor_cache[key] = reply.info
+                        return reply.info
+                    if reply.info.state == "DEAD":
+                        raise exceptions.ActorDiedError(
+                            actor_id,
+                            reply.info.death_cause or "actor is dead")
+                continue
             if time.monotonic() > deadline:
                 raise exceptions.GetTimeoutError(
                     f"Actor {actor_id.hex()} not ALIVE within {timeout_s}s")
-            time.sleep(0.02)
+            with self._ready_cond:
+                self._ready_cond.wait(timeout=1.0)
+            # Safety: periodically refresh from the GCS in case an ACTOR
+            # event was published before our subscription attached.
+            checked_gcs = False
 
     def submit_actor_task(self, actor_id, method_name, args, kwargs, options):
         task_id = TaskID.for_actor_task(actor_id)
@@ -580,4 +781,14 @@ class ClusterRuntime(CoreRuntime):
         if self._shutdown:
             return
         self._shutdown = True
+        try:
+            self.refs.shutdown()  # release all held refcounts at the GCS
+        except Exception:  # noqa: BLE001
+            pass
+        stream = getattr(self, "_sub_stream", None)
+        if stream is not None:
+            try:
+                stream.cancel()
+            except Exception:  # noqa: BLE001
+                pass
         self._pool.shutdown(wait=False)

@@ -333,6 +333,11 @@ class LocalRuntime(CoreRuntime):
         self._cancelled: set = set()
         self._lock = threading.Lock()
         self._shutdown = False
+        # Local reference counts: live ObjectRef instances per object. When a
+        # count returns to zero the stored value is evicted (single-process
+        # analog of the distributed refcount GC).
+        self._refcounts: Dict[ObjectID, int] = {}
+        self._ref_lock = threading.Lock()
         self._dispatcher = threading.Thread(target=self._dispatch_loop,
                                             name="dispatcher", daemon=True)
         self._dispatcher.start()
@@ -429,6 +434,23 @@ class LocalRuntime(CoreRuntime):
 
     def free(self, refs):
         self.store.delete([r.id() for r in refs])
+
+    # ------------------------------------------------------------- references
+    def add_local_reference(self, ref: ObjectRef) -> None:
+        with self._ref_lock:
+            self._refcounts[ref.id()] = self._refcounts.get(ref.id(), 0) + 1
+
+    def remove_local_reference(self, object_id: ObjectID) -> None:
+        if self._shutdown:
+            return
+        with self._ref_lock:
+            n = self._refcounts.get(object_id, 0) - 1
+            if n <= 0:
+                self._refcounts.pop(object_id, None)
+            else:
+                self._refcounts[object_id] = n
+        if n == 0:
+            self.store.delete([object_id])
 
     # ---------------------------------------------------------------- tasks
     def submit_task(self, function, function_name, args, kwargs, options):

@@ -18,13 +18,22 @@ from ray_tpu._private.node_manager.server import NodeManager
 
 class Cluster:
     def __init__(self, initialize_head: bool = True,
-                 head_node_args: Optional[Dict] = None):
-        self.gcs = GcsServer(port=0)
+                 head_node_args: Optional[Dict] = None,
+                 gcs_persist_path: Optional[str] = None):
+        self.gcs_persist_path = gcs_persist_path
+        self.gcs = GcsServer(port=0, persist_path=gcs_persist_path)
         self.address = f"127.0.0.1:{self.gcs.port}"
         self.nodes: List[NodeManager] = []
         self.head_node: Optional[NodeManager] = None
         if initialize_head:
             self.head_node = self.add_node(**(head_node_args or {}))
+
+    def restart_gcs(self) -> None:
+        """Kill and restart the GCS on the same port (fault-tolerance tests:
+        reference ``python/ray/tests/test_gcs_fault_tolerance.py``)."""
+        port = self.gcs.port
+        self.gcs.shutdown()
+        self.gcs = GcsServer(port=port, persist_path=self.gcs_persist_path)
 
     def add_node(self, num_cpus: float = 4, num_tpus: float = 0,
                  resources: Optional[Dict[str, float]] = None,

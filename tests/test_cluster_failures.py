@@ -156,3 +156,30 @@ def test_placement_group_infeasible(fresh_cluster):
     info = _make_pg(c.address, b"pg3" + b"\x00" * 13, "STRICT_PACK",
                     [{"CPU": 100.0}])
     assert info.state == "INFEASIBLE"
+
+
+@ray_tpu.remote
+def _chaos_add(x):
+    return x + 1
+
+
+def test_rpc_chaos_injection(fresh_cluster, monkeypatch):
+    """Deterministic RPC fault injection on the lease + push hot path
+    (reference: rpc_chaos.cc:29, RAY_testing_rpc_failure): the first lease
+    request and the first task push fail; the submitter's failover/retry
+    machinery must still complete the task."""
+    c = fresh_cluster
+    monkeypatch.setenv(
+        "RAY_TPU_TESTING_RPC_FAILURE",
+        "NodeService.RequestWorkerLease=1,WorkerService.PushTask=1",
+    )
+    rpc.reset_chaos()
+    try:
+        ray_tpu.init(address=c.address)
+        assert ray_tpu.get(_chaos_add.remote(41), timeout=60) == 42
+        # And a follow-up burst with no chaos budget left runs clean.
+        assert ray_tpu.get([_chaos_add.remote(i) for i in range(4)],
+                           timeout=60) == [1, 2, 3, 4]
+    finally:
+        monkeypatch.delenv("RAY_TPU_TESTING_RPC_FAILURE", raising=False)
+        rpc.reset_chaos()

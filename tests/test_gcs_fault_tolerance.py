@@ -1,0 +1,95 @@
+"""GCS restart under a live cluster (reference:
+python/ray/tests/test_gcs_fault_tolerance.py): durable state survives via the
+snapshot store, nodes re-register through the heartbeat ok=false path, pubsub
+subscribers reconnect, and both existing actors and new tasks keep working.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+
+@pytest.fixture
+def persistent_cluster(tmp_path):
+    c = Cluster(head_node_args={"num_cpus": 4},
+                gcs_persist_path=str(tmp_path / "gcs_state.bin"))
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@ray_tpu.remote
+class Stateful:
+    def __init__(self):
+        self.n = 0
+
+    def inc(self):
+        self.n += 1
+        return self.n
+
+
+@ray_tpu.remote
+def _double(x):
+    return 2 * x
+
+
+def _wait_alive_nodes(address: str, want: int, timeout_s: float = 15.0):
+    gcs = rpc.get_stub("GcsService", address)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            alive = [n for n in gcs.GetNodes(pb.GetNodesRequest()).nodes
+                     if n.alive]
+            if len(alive) >= want:
+                return True
+        except Exception:  # noqa: BLE001
+            pass
+        time.sleep(0.1)
+    return False
+
+
+def test_gcs_restart_preserves_cluster(persistent_cluster):
+    c = persistent_cluster
+    ray_tpu.init(address=c.address)
+
+    # Durable state before the crash: KV, a named actor with state.
+    gcs = rpc.get_stub("GcsService", c.address)
+    gcs.KvPut(pb.KvRequest(ns="test", key="k", value=b"v", overwrite=True))
+    a = Stateful.options(name="survivor", lifetime="detached").remote()
+    assert ray_tpu.get(a.inc.remote(), timeout=60) == 1
+    assert ray_tpu.get(ray_tpu.put(123)) == 123
+
+    c.restart_gcs()
+
+    # Nodes re-register via HeartbeatReply.ok=false.
+    assert _wait_alive_nodes(c.address, 1), "node did not re-register"
+
+    # KV survived.
+    reply = gcs.KvGet(pb.KvRequest(ns="test", key="k"))
+    assert reply.found and reply.value == b"v"
+
+    # Named-actor lookup survived and the live instance kept its state.
+    b = ray_tpu.get_actor("survivor")
+    assert ray_tpu.get(b.inc.remote(), timeout=60) == 2
+
+    # New tasks schedule normally on the re-registered node.
+    assert ray_tpu.get(_double.remote(21), timeout=60) == 42
+
+
+def test_gcs_restart_mid_actor_calls(persistent_cluster):
+    c = persistent_cluster
+    ray_tpu.init(address=c.address)
+    a = Stateful.remote()
+    assert ray_tpu.get(a.inc.remote(), timeout=60) == 1
+
+    c.restart_gcs()
+    assert _wait_alive_nodes(c.address, 1)
+
+    # Actor address resolution goes through the (restarted) GCS; cached
+    # addresses keep working and fresh resolutions succeed after re-register.
+    assert ray_tpu.get(a.inc.remote(), timeout=60) == 2
