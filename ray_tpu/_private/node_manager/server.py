@@ -241,6 +241,7 @@ class NodeManager:
 
     def _reap_idle_workers(self):
         now = time.monotonic()
+        reaped = []
         with self._pool_lock:
             keep = []
             for wid in self._idle:
@@ -250,9 +251,16 @@ class NodeManager:
                 if now - w.idle_since > IDLE_WORKER_TTL_S:
                     w.proc.terminate()
                     self._workers.pop(wid, None)
+                    reaped.append(wid)
                 else:
                     keep.append(wid)
             self._idle = keep
+        for wid in reaped:
+            try:
+                self.gcs.ReapHolder(
+                    pb.ReapHolderRequest(holder_id=wid), timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _check_dead_workers(self):
         """Detect crashed actor workers and hand the restart decision to the
@@ -266,6 +274,13 @@ class NodeManager:
                 if w.worker_id in self._idle:
                     self._idle.remove(w.worker_id)
         for w in dead:
+            # A dead worker's refcounts would pin objects forever: reap its
+            # holder at the GCS (reference: refs tied to owner liveness).
+            try:
+                self.gcs.ReapHolder(
+                    pb.ReapHolderRequest(holder_id=w.worker_id), timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
             for actor_id, (wid, demand) in list(self._actor_demands.items()):
                 if wid != w.worker_id:
                     continue

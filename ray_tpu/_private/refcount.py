@@ -7,21 +7,34 @@ process keeps exact local counts of live ``ObjectRef`` instances and flushes
 *deltas* to the GCS in the background. The GCS sums counts across holders and,
 when an object's total drops to zero, frees every stored copy and clears the
 directory entry (the owner also drops its pinned lineage — see
-``ClusterRuntime``). Borrowing falls out naturally: deserializing a ref in a
-worker registers a +1 from that holder; the submitting process pins task-arg
-refs for the duration of the task so the count can never dip to zero between
-submit and the worker's borrow registration.
+``ClusterRuntime``).
+
+Zero-dip safety is ordering-based, not time-based: the submitting process pins
+every ref contained in a task's payload until the push RPC returns, and the
+executing worker *synchronously* flushes its borrow (+1) before running user
+code — so the GCS observes the worker's +1 strictly before the submitter's
+pin release. The GCS's short free-grace timer remains only as defense in
+depth for refs handed off outside the task-arg path (e.g. refs embedded in
+``put()`` values read by a process that holds no other count).
+
+Holder liveness (reference ties refs to owner liveness): every flush carries
+the holder's node id; worker holders are reaped by the GCS on node death and
+by the node manager on worker-process death (``ReapHolder``). Driver holders
+(which survive node failover) are reaped by a flush-ping TTL — the counter
+sends an empty flush at least every ``PING_PERIOD_S`` while it holds counts.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
-from typing import Callable, Dict, Optional
+import time
+from typing import Callable, Dict, Optional, Set
 
 logger = logging.getLogger(__name__)
 
 FLUSH_PERIOD_S = 0.1
+PING_PERIOD_S = 2.0
 
 
 class ReferenceCounter:
@@ -33,13 +46,20 @@ class ReferenceCounter:
     """
 
     def __init__(self, gcs_stub, holder_id: str,
-                 on_local_zero: Optional[Callable[[bytes], None]] = None):
+                 on_local_zero: Optional[Callable[[bytes], None]] = None,
+                 node_id: str = "", is_driver: bool = True):
         self._gcs = gcs_stub
         self._holder = holder_id
+        self._node_id = node_id
+        self._is_driver = is_driver
         self._on_local_zero = on_local_zero
         self._counts: Dict[bytes, int] = {}
         self._pending: Dict[bytes, int] = {}
+        # Transient +1/-1 pairs that failed to reach the GCS (ADVICE r2 #4):
+        # without re-emission their stored copies would leak forever.
+        self._transient_retry: Set[bytes] = set()
         self._lock = threading.Lock()
+        self._last_flush = time.monotonic()
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._flush_loop, daemon=True, name="refcount-flush")
@@ -71,21 +91,33 @@ class ReferenceCounter:
         with self._lock:
             return self._counts.get(oid, 0)
 
-    def flush(self) -> None:
+    def flush(self, force_ping: bool = False) -> bool:
+        """Push pending deltas to the GCS. Returns True on success (or when
+        there was nothing to send and no ping was due)."""
         with self._lock:
             deltas = {k: v for k, v in self._pending.items() if v != 0}
             # A net-zero pending entry whose local count is also zero means
             # the object was created AND fully dropped within one flush
             # window; the GCS never saw it, so stored copies would leak.
             # Emit an explicit +1/-1 pair to drive the GCS free path.
-            transient = [k for k, v in self._pending.items()
-                         if v == 0 and self._counts.get(k, 0) == 0]
+            transient = set(
+                k for k, v in self._pending.items()
+                if v == 0 and self._counts.get(k, 0) == 0)
+            transient |= self._transient_retry
+            transient -= set(deltas)
+            self._transient_retry = set()
             self._pending.clear()
-        if not deltas and not transient:
-            return
+            holding = bool(self._counts)
+        ping_due = holding and (
+            force_ping
+            or time.monotonic() - self._last_flush >= PING_PERIOD_S)
+        if not deltas and not transient and not ping_due:
+            return True
         from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
-        req = pb.UpdateRefCountsRequest(holder_id=self._holder)
+        req = pb.UpdateRefCountsRequest(
+            holder_id=self._holder, node_id=self._node_id,
+            is_driver=self._is_driver)
         for oid, delta in deltas.items():
             req.deltas.append(pb.RefCountDelta(object_id=oid, delta=delta))
         for oid in transient:
@@ -93,10 +125,14 @@ class ReferenceCounter:
             req.deltas.append(pb.RefCountDelta(object_id=oid, delta=-1))
         try:
             self._gcs.UpdateRefCounts(req, timeout=5)
+            self._last_flush = time.monotonic()
+            return True
         except Exception:  # noqa: BLE001 — GCS down: re-queue for next flush
             with self._lock:
                 for oid, delta in deltas.items():
                     self._pending[oid] = self._pending.get(oid, 0) + delta
+                self._transient_retry |= transient
+            return False
 
     def _flush_loop(self) -> None:
         while not self._stop.wait(FLUSH_PERIOD_S):

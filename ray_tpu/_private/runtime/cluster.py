@@ -50,6 +50,35 @@ def loads(data) -> Any:
     return cloudpickle.loads(data)
 
 
+def dumps_payload(value: Any) -> Tuple[bytes, List[bytes]]:
+    """Serialize a task payload, returning (wire bytes, contained ref ids).
+
+    Uses the framework Serializer so ObjectRefs nested anywhere inside
+    args/kwargs are collected — the submitter pins each contained ref for
+    the task's flight time (reference: task-arg pinning in
+    reference_count.h; round-2 advisor finding #1: top-level-only pinning
+    let containerized refs hit zero mid-flight).
+    """
+    from ray_tpu._private.serialization import Serializer
+
+    s = Serializer().serialize(value)
+    return s.to_bytes(), list(s.contained_refs)
+
+
+def loads_payload(data) -> Tuple[Any, int]:
+    """Deserialize a task payload. Returns (value, n_contained_refs).
+
+    Deserializing registers a borrow (+1) for every contained ref via the
+    ObjectRef constructor; executors must flush those borrows to the GCS
+    *before* running user code so the submitter's pin release (-1, sent
+    after the push returns) can never be observed first.
+    """
+    from ray_tpu._private.serialization import SerializedObject, Serializer
+
+    s = SerializedObject.parse(data)
+    return Serializer().deserialize(s), len(s.contained_refs)
+
+
 def put_bytes_to_node(node_stub, oid_binary: bytes, data: bytes,
                       owner: str) -> None:
     """Store serialized bytes on a node: large payloads go through a
@@ -83,7 +112,8 @@ def read_object_reply(reply) -> Any:
 class ClusterRuntime(CoreRuntime):
     def __init__(self, gcs_address: str, node_address: str,
                  namespace: str = "default", is_worker: bool = False,
-                 worker_id: Optional[str] = None):
+                 worker_id: Optional[str] = None,
+                 node_id: Optional[str] = None):
         self.gcs_address = gcs_address
         self.node_address = node_address
         self.namespace = namespace
@@ -97,6 +127,7 @@ class ClusterRuntime(CoreRuntime):
                                         thread_name_prefix="submit")
         self._actor_cache: Dict[bytes, pb.ActorInfo] = {}
         self._actor_dead: Dict[bytes, str] = {}
+        self._actor_create_pins: Dict[bytes, List[bytes]] = {}
         self._actor_seq: Dict[bytes, int] = {}
         self._actor_session: Dict[bytes, int] = {}
         self._actor_lock = threading.Lock()
@@ -112,13 +143,19 @@ class ClusterRuntime(CoreRuntime):
         from ray_tpu._private.refcount import ReferenceCounter
 
         self.refs = ReferenceCounter(self.gcs, self.worker_id,
-                                     on_local_zero=self._on_ref_zero)
+                                     on_local_zero=self._on_ref_zero,
+                                     node_id=node_id or "",
+                                     is_driver=not is_worker)
         self._lineage: Dict[bytes, pb.TaskSpec] = {}
         self._lineage_lock = threading.Lock()
         self._reconstructing: Dict[bytes, threading.Event] = {}
         # Tasks whose first execution finished (success or error): a fetch
         # miss on their returns means "produced then lost", not "pending".
+        # Pruned alongside lineage: when the last lineage entry for a task's
+        # returns is dropped, the done-marker goes too (weak #7 r2: these
+        # grew without bound in long-lived drivers).
         self._task_done: set = set()
+        self._task_lineage_count: Dict[bytes, int] = {}
         # GCS pubsub drives actor-address resolution and object-readiness
         # wakeups (no sleep-polling on those paths — reference:
         # pubsub/publisher.h:297). The condition is notified on every
@@ -193,15 +230,20 @@ class ClusterRuntime(CoreRuntime):
             info.ParseFromString(data)
         except Exception:  # noqa: BLE001
             return
+        if info.state in ("ALIVE", "DEAD"):
+            self._release_create_pins(bytes(info.actor_id))
         with self._actor_lock:
             if info.state == "ALIVE":
                 self._actor_cache[bytes(info.actor_id)] = info
             else:
                 self._actor_cache.pop(bytes(info.actor_id), None)
                 if info.state == "DEAD":
-                    # Remember terminal states so waiters fail fast.
+                    # Remember terminal states so waiters fail fast
+                    # (bounded: long-lived drivers churn many actors).
                     self._actor_dead[bytes(info.actor_id)] = \
                         info.death_cause or "actor is dead"
+                    while len(self._actor_dead) > 4096:
+                        self._actor_dead.pop(next(iter(self._actor_dead)))
         with self._ready_cond:
             self._ready_cond.notify_all()
 
@@ -220,7 +262,15 @@ class ClusterRuntime(CoreRuntime):
 
         self.memory.delete([ObjectID(oid)])
         with self._lineage_lock:
-            self._lineage.pop(oid, None)
+            if self._lineage.pop(oid, None) is not None:
+                task_key = ObjectID(oid).task_id().binary()
+                n = self._task_lineage_count.get(task_key, 0) - 1
+                if n <= 0:
+                    self._task_lineage_count.pop(task_key, None)
+                    self._task_done.discard(task_key)
+                    self._reconstructing.pop(task_key, None)
+                else:
+                    self._task_lineage_count[task_key] = n
 
     # ---------------------------------------------------------------- objects
     def put(self, value: Any, owner_ref: Optional[ObjectRef] = None) -> ObjectRef:
@@ -244,9 +294,12 @@ class ClusterRuntime(CoreRuntime):
             self._put_index += 1
             return self._put_index
 
-    def _fetch_object(self, ref: ObjectRef) -> Tuple[bool, Any]:
-        """Try all known locations once. Returns (found, value)."""
+    def _fetch_object(self, ref: ObjectRef) -> Tuple[bool, Any, bool]:
+        """Try all known locations once. Returns (found, value, freed) —
+        ``freed`` means the GCS refcount hit zero and the object is gone for
+        good (borrowers surface ObjectLostError instead of spinning)."""
         oid = ref.id()
+        freed = False
         try:
             reply = self.node.GetObject(
                 pb.GetObjectRequest(object_id=oid.binary()))
@@ -257,13 +310,14 @@ class ClusterRuntime(CoreRuntime):
             value = read_object_reply(reply)
             if value is not None or not reply.shm_name:
                 self.memory.put(oid, value)
-                return True, value
+                return True, value, freed
         candidates = []
         if ref.owner_address() and ref.owner_address() != self.node_address:
             candidates.append(ref.owner_address())
         try:
             locs = self.gcs.GetObjectLocations(
                 pb.GetObjectLocationsRequest(object_id=oid.binary()))
+            freed = locs.freed
             nodes = {n.node_id: n.address
                      for n in self.gcs.GetNodes(pb.GetNodesRequest()).nodes
                      if n.alive}
@@ -293,10 +347,10 @@ class ClusterRuntime(CoreRuntime):
                                           bytes(buf), self.worker_id)
                     except Exception:  # noqa: BLE001
                         pass
-                    return True, value
+                    return True, value, freed
             except Exception:  # noqa: BLE001
                 continue
-        return False, None
+        return False, None, freed
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -319,12 +373,24 @@ class ClusterRuntime(CoreRuntime):
                 return self.memory.get_if_ready(oid)
             except KeyError:
                 pass
-            found, value = self._fetch_object(ref)
+            found, value, freed = self._fetch_object(ref)
             if found:
                 return value
             if rebuilds < 3 and self._maybe_reconstruct(ref):
                 rebuilds += 1
                 continue
+            if freed:
+                # The GCS freed this object (all holders dropped, or its
+                # owner was reaped) and this process can't rebuild it: a
+                # typed terminal error, not a timeout (reference:
+                # ObjectNotFound/OwnerDied semantics, common/status.h).
+                with self._lineage_lock:
+                    has_lineage = oid.binary() in self._lineage
+                if not has_lineage:
+                    raise exceptions.ObjectLostError(
+                        f"Object {oid.hex()} was freed cluster-wide (its "
+                        f"reference count reached zero or its owner died) "
+                        f"and cannot be reconstructed by this process.")
             if deadline is not None and time.monotonic() >= deadline:
                 raise exceptions.GetTimeoutError(
                     f"Timed out getting object {oid.hex()}")
@@ -372,7 +438,7 @@ class ClusterRuntime(CoreRuntime):
             # Recursively ensure this task's own ObjectRef args exist.
             if depth < 10:
                 try:
-                    _, args, kwargs = loads(spec.payload)
+                    (_, args, kwargs), _ = loads_payload(spec.payload)
                     for a in list(args) + list(kwargs.values()):
                         if isinstance(a, ObjectRef) and \
                                 not self._fetch_object(a)[0]:
@@ -447,10 +513,11 @@ class ClusterRuntime(CoreRuntime):
         task_id = TaskID.for_normal_task(self.job_id)
         nreturns = max(options.num_returns, 1)
         return_ids = [ObjectID.from_task(task_id, i) for i in range(nreturns)]
+        payload, contained = dumps_payload((function, args, kwargs))
         spec = pb.TaskSpec(
             task_id=task_id.binary(),
             name=function_name,
-            payload=dumps((function, args, kwargs)),
+            payload=payload,
             return_ids=[oid.binary() for oid in return_ids],
             max_retries=options.max_retries or 0,
         )
@@ -458,10 +525,10 @@ class ClusterRuntime(CoreRuntime):
             spec.runtime_env = pickle.dumps(options.runtime_env)
         for k, v in options.task_resources().items():
             spec.resources[k] = v
-        # Pin top-level ObjectRef args for the task's flight time so their
-        # refcount can't hit zero between submit and the worker's borrow.
-        pinned = [a.id().binary() for a in list(args) + list(kwargs.values())
-                  if isinstance(a, ObjectRef)]
+        # Pin every contained ObjectRef (top-level AND nested in containers)
+        # for the task's flight time so its refcount can't hit zero between
+        # submit and the worker's borrow flush.
+        pinned = contained
         for oid in pinned:
             self.refs.incr(oid)
         # Pin lineage for the returns (dropped when this owner's local refs
@@ -469,6 +536,8 @@ class ClusterRuntime(CoreRuntime):
         with self._lineage_lock:
             for oid in return_ids:
                 self._lineage[oid.binary()] = spec
+            self._task_lineage_count[task_id.binary()] = \
+                self._task_lineage_count.get(task_id.binary(), 0) + nreturns
         self._pool.submit(self._lease_and_push, spec, return_ids,
                           options.max_retries or 0, pinned)
         return [ObjectRef(oid, owner_address=self.node_address)
@@ -573,11 +642,21 @@ class ClusterRuntime(CoreRuntime):
     def create_actor(self, cls, args, kwargs, options) -> ActorID:
         actor_id = ActorID.of(self.job_id)
         demand = dict(options.task_resources())
+        payload, contained = dumps_payload((cls, args, kwargs, options))
         spec = pickle.dumps({
             "resources": demand,
             "runtime_env": options.runtime_env or {},
-            "payload": dumps((cls, args, kwargs, options)),
+            "payload": payload,
         })
+        # Constructor args are pinned until the actor reaches a settled
+        # state (ALIVE after the constructor's borrow flush, or DEAD):
+        # placement can take minutes, during which the caller may drop its
+        # only refs (same flight-time rule as submit_task).
+        if contained:
+            for oid in contained:
+                self.refs.incr(oid)
+            with self._actor_lock:
+                self._actor_create_pins[actor_id.binary()] = list(contained)
         info = pb.ActorInfo(
             actor_id=actor_id.binary(),
             name=options.name or "",
@@ -591,6 +670,12 @@ class ClusterRuntime(CoreRuntime):
         if not reply.ok:
             raise ValueError(reply.error)
         return actor_id
+
+    def _release_create_pins(self, actor_key: bytes) -> None:
+        with self._actor_lock:
+            pins = self._actor_create_pins.pop(actor_key, None)
+        for oid in pins or ():
+            self.refs.decr(oid)
 
     def _resolve_actor(self, actor_id: ActorID,
                        timeout_s: float = 60.0) -> pb.ActorInfo:
@@ -612,6 +697,10 @@ class ClusterRuntime(CoreRuntime):
                 checked_gcs = True
                 reply = self.gcs.GetActor(pb.GetActorRequest(actor_id=key))
                 if reply.found:
+                    if reply.info.state in ("ALIVE", "DEAD"):
+                        # Settled: release ctor-arg pins even if the ACTOR
+                        # pubsub event was missed.
+                        self._release_create_pins(key)
                     if reply.info.state == "ALIVE":
                         with self._actor_lock:
                             self._actor_cache[key] = reply.info
@@ -642,18 +731,23 @@ class ClusterRuntime(CoreRuntime):
             session = self._actor_session.get(actor_id.binary(), 0)
             seq = self._actor_seq.get(actor_id.binary(), 0)
             self._actor_seq[actor_id.binary()] = seq + 1
+        payload, contained = dumps_payload((None, args, kwargs))
         spec = pb.TaskSpec(
             task_id=task_id.binary(),
             name=method_name,
             method_name=method_name,
-            payload=dumps((None, args, kwargs)),
+            payload=payload,
             return_ids=[oid.binary() for oid in return_ids],
             actor_id=actor_id.binary(),
             sequence_no=seq,
             caller_address=f"{self.worker_id}:{session}".encode(),
         )
+        # Same flight-time pinning as submit_task: actor resolution can take
+        # tens of seconds, during which the caller may drop its handles.
+        for oid in contained:
+            self.refs.incr(oid)
         self._pool.submit(self._push_actor_task, actor_id, spec, return_ids,
-                          options.max_task_retries)
+                          options.max_task_retries, contained)
         return [ObjectRef(oid, owner_address=self.node_address)
                 for oid in return_ids]
 
@@ -665,32 +759,37 @@ class ClusterRuntime(CoreRuntime):
             self._actor_seq[actor_id.binary()] = 0
 
     def _push_actor_task(self, actor_id: ActorID, spec: pb.TaskSpec,
-                         return_ids: List[ObjectID], retries: int):
+                         return_ids: List[ObjectID], retries: int,
+                         pinned: Optional[List[bytes]] = None):
         attempt = 0
-        while True:
-            try:
-                info = self._resolve_actor(actor_id)
-                stub = rpc.get_stub("WorkerService", info.address)
-                result = stub.PushTask(pb.PushTaskRequest(spec=spec),
-                                       timeout=PUSH_TIMEOUT_S)
-                self._apply_push_result(result, return_ids, spec.name)
-                return
-            except exceptions.ActorDiedError as e:
-                self._store_error(e, return_ids)
-                return
-            except BaseException as e:  # noqa: BLE001
-                self._invalidate_actor(actor_id)
-                # Actor tasks are NOT retried by default (the push may have
-                # executed) — reference: max_task_retries=0 semantics.
-                if attempt < retries:
-                    attempt += 1
-                    time.sleep(0.1)
-                    continue
-                self._store_error(
-                    exceptions.ActorDiedError(actor_id,
-                                              f"actor task failed: {e}"),
-                    return_ids)
-                return
+        try:
+            while True:
+                try:
+                    info = self._resolve_actor(actor_id)
+                    stub = rpc.get_stub("WorkerService", info.address)
+                    result = stub.PushTask(pb.PushTaskRequest(spec=spec),
+                                           timeout=PUSH_TIMEOUT_S)
+                    self._apply_push_result(result, return_ids, spec.name)
+                    return
+                except exceptions.ActorDiedError as e:
+                    self._store_error(e, return_ids)
+                    return
+                except BaseException as e:  # noqa: BLE001
+                    self._invalidate_actor(actor_id)
+                    # Actor tasks are NOT retried by default (the push may
+                    # have executed) — reference: max_task_retries=0.
+                    if attempt < retries:
+                        attempt += 1
+                        time.sleep(0.1)
+                        continue
+                    self._store_error(
+                        exceptions.ActorDiedError(actor_id,
+                                                  f"actor task failed: {e}"),
+                        return_ids)
+                    return
+        finally:
+            for oid in pinned or ():
+                self.refs.decr(oid)
 
     def kill_actor(self, actor_id, no_restart):
         reply = self.gcs.GetActor(
@@ -723,7 +822,7 @@ class ClusterRuntime(CoreRuntime):
                 f"Failed to look up actor {name!r} in namespace {ns!r}")
         info = reply.info
         outer = pickle.loads(info.spec)
-        cls, _args, _kwargs, options = loads(outer["payload"])
+        (cls, _args, _kwargs, options), _ = loads_payload(outer["payload"])
         return ActorID(bytes(info.actor_id)), cls, options
 
     def list_named_actors(self, all_namespaces: bool):

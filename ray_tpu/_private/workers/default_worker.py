@@ -37,6 +37,7 @@ from ray_tpu._private.runtime.cluster import (
     INLINE_RESULT_MAX,
     dumps,
     loads,
+    loads_payload,
     put_bytes_to_node,
 )
 from ray_tpu.protobuf import ray_tpu_pb2 as pb
@@ -76,7 +77,8 @@ class WorkerServer:
         self.worker_id = worker_id
         self.node_id = node_id
         self.runtime = ClusterRuntime(gcs_address, node_address,
-                                      is_worker=True, worker_id=worker_id)
+                                      is_worker=True, worker_id=worker_id,
+                                      node_id=node_id)
         worker_mod._global_worker = worker_mod.Worker(self.runtime, "worker")
         self._actors: Dict[bytes, _ActorRunner] = {}
         self._task_lock = threading.Lock()  # one normal task at a time
@@ -156,7 +158,13 @@ class WorkerServer:
                         os.environ[k] = str(v)
                     if renv.get("working_dir"):
                         os.chdir(renv["working_dir"])
-                fn, args, kwargs = loads(spec.payload)
+                (fn, args, kwargs), n_borrows = loads_payload(spec.payload)
+                if n_borrows:
+                    # Flush the borrow (+1) registrations synchronously so
+                    # the GCS observes them before the submitter's pin
+                    # release (sent only after this push returns) — the
+                    # ordering that makes the zero-dip race impossible.
+                    self.runtime.refs.flush()
                 args, kwargs = self._resolve_args(args, kwargs)
                 result = fn(*args, **kwargs)
                 if hasattr(result, "__next__"):  # generator tasks
@@ -178,7 +186,9 @@ class WorkerServer:
                 ActorID(bytes(spec.actor_id)), "actor died")
             return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
         try:
-            _, args, kwargs = loads(spec.payload)
+            (_, args, kwargs), n_borrows = loads_payload(spec.payload)
+            if n_borrows:
+                self.runtime.refs.flush()  # borrow-before-pin-release order
             args, kwargs = self._resolve_args(args, kwargs)
             method = getattr(runner.instance, spec.method_name)
             result = method(*args, **kwargs)
@@ -197,7 +207,10 @@ class WorkerServer:
             for k, v in request.env.items():
                 os.environ[k] = v
             outer = pickle.loads(info.spec)
-            cls, args, kwargs, options = loads(outer["payload"])
+            (cls, args, kwargs, options), n_borrows = \
+                loads_payload(outer["payload"])
+            if n_borrows:
+                self.runtime.refs.flush()  # borrow-before-pin-release order
             instance = cls(*args, **kwargs)
             self._actors[bytes(info.actor_id)] = _ActorRunner(instance)
             return pb.CreateActorReply(ok=True)

@@ -100,3 +100,153 @@ def test_lineage_reconstruction_cpu(fresh_cluster):
     # store, lost ones re-executed via lineage.
     vals = ray_tpu.get(refs, timeout=120)
     assert all(v.sum() == 300_000 * 7 for v in vals)
+
+
+# ---------------------------------------------------------------- round 3:
+# holder liveness, exact pinning, and typed lost-object errors.
+
+@ray_tpu.remote
+class _RefHolder:
+    def __init__(self):
+        self.held = None
+
+    def hold(self, ref_list):
+        self.held = ref_list  # keeps the borrow alive in this process
+        return True
+
+    def pid(self):
+        import os
+        return os.getpid()
+
+
+def test_dead_worker_holder_reaped(fresh_cluster):
+    """kill -9 a worker holding the only remaining refs -> objects freed
+    (reference ties refs to owner liveness, reference_count.h:66)."""
+    import os
+    import signal
+
+    c = fresh_cluster
+    ray_tpu.init(address=c.address)
+    holder = _RefHolder.remote()
+    ref = ray_tpu.put(np.ones(300_000, np.uint8))
+    oid = ref.id().binary()
+    assert ray_tpu.get(holder.hold.remote([ref])) is True
+    pid = ray_tpu.get(holder.pid.remote())
+    del ref
+    gc.collect()
+    time.sleep(1.5)  # driver's decrement flushed; actor's borrow pins it
+    assert _directory_locations(c.address, oid), \
+        "actor borrow should keep the object alive"
+    os.kill(pid, signal.SIGKILL)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and \
+            _directory_locations(c.address, oid):
+        time.sleep(0.2)
+    assert not _directory_locations(c.address, oid), \
+        "dead worker's refcounts were not reaped"
+
+
+def test_borrower_of_freed_object_gets_object_lost_error(fresh_cluster):
+    c = fresh_cluster
+    ray_tpu.init(address=c.address)
+    ref = ray_tpu.put(np.ones(300_000, np.uint8))
+    oid = ref.id().binary()
+    binary, owner = ref.binary(), ref.owner_address()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            not _directory_locations(c.address, oid):
+        time.sleep(0.05)
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and \
+            _directory_locations(c.address, oid):
+        time.sleep(0.1)
+    # A late borrower (e.g. deserialized a stale ref) fails fast and typed.
+    from ray_tpu._private.object_ref import ObjectRef
+
+    stale = ObjectRef.from_binary(binary, owner)
+    t0 = time.monotonic()
+    with pytest.raises(ray_tpu.exceptions.ObjectLostError):
+        ray_tpu.get(stale, timeout=30)
+    assert time.monotonic() - t0 < 10, "ObjectLostError should be fast"
+
+
+@ray_tpu.remote
+def _sum_nested(lst):
+    return int(ray_tpu.get(lst[0]).sum())
+
+
+def test_nested_ref_pinned_across_submit(fresh_cluster):
+    """Refs nested in containers are pinned for the task's flight time
+    (round-2 advisor #1: top-level-only pinning freed them mid-flight)."""
+    c = fresh_cluster
+    ray_tpu.init(address=c.address)
+    ref = ray_tpu.put(np.ones(300_000, np.uint8))
+    out = _sum_nested.remote([ref])
+    del ref  # only the in-flight task payload references it now
+    gc.collect()
+    assert ray_tpu.get(out, timeout=60) == 300_000
+
+
+def test_nested_ref_pinned_across_actor_submit(fresh_cluster):
+    c = fresh_cluster
+    ray_tpu.init(address=c.address)
+    holder = _RefHolder.remote()
+    ref = ray_tpu.put(np.full(300_000, 3, np.uint8))
+    oid = ref.id().binary()
+    ok = holder.hold.remote([ref])
+    del ref
+    gc.collect()
+    assert ray_tpu.get(ok, timeout=60) is True
+    time.sleep(1.5)  # flush windows: actor's borrow must now pin it
+    assert _directory_locations(c.address, oid)
+
+
+def test_stale_driver_holder_reaped(fresh_cluster, monkeypatch):
+    """A crashed driver (no clean shutdown flush) stops pinging; its counts
+    are reaped after the TTL instead of pinning objects forever."""
+    from ray_tpu._private.gcs import server as gcs_server_mod
+    from ray_tpu._private.refcount import ReferenceCounter
+
+    monkeypatch.setattr(gcs_server_mod, "DRIVER_HOLDER_TTL_S", 1.5)
+    c = fresh_cluster
+    ray_tpu.init(address=c.address)
+    ref = ray_tpu.put(np.ones(300_000, np.uint8))
+    oid = ref.id().binary()
+    # Simulated second driver: registers a count, then "crashes" (flush
+    # thread stopped without the clean shutdown decrement).
+    gcs = rpc.get_stub("GcsService", c.address)
+    crashed = ReferenceCounter(gcs, "crashed-driver", is_driver=True)
+    crashed.incr(oid)
+    assert crashed.flush()
+    crashed._stop.set()  # no more pings — looks crashed to the GCS
+    del ref
+    gc.collect()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and \
+            _directory_locations(c.address, oid):
+        time.sleep(0.2)
+    assert not _directory_locations(c.address, oid), \
+        "stale driver holder not reaped"
+
+
+@ray_tpu.remote
+class _CtorConsumer:
+    def __init__(self, lst):
+        self.total = int(ray_tpu.get(lst[0]).sum())
+
+    def total_(self):
+        return self.total
+
+
+def test_ctor_args_pinned_until_actor_settles(fresh_cluster):
+    """Actor constructor args (incl. nested refs) are pinned until the actor
+    reaches ALIVE/DEAD — placement can outlive the caller's last ref."""
+    c = fresh_cluster
+    ray_tpu.init(address=c.address)
+    ref = ray_tpu.put(np.full(300_000, 2, np.uint8))
+    a = _CtorConsumer.remote([ref])
+    del ref
+    gc.collect()
+    assert ray_tpu.get(a.total_.remote(), timeout=60) == 600_000
