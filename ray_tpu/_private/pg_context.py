@@ -1,0 +1,26 @@
+"""Per-task placement-group capture context.
+
+Reference: ``placement_group_capture_child_tasks`` semantics — a task running
+inside a capturing placement group schedules its children into the same
+group by default. The executing worker sets this context around user code;
+submit paths read it when no explicit placement option is given.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+_local = threading.local()
+
+
+def set(group_id: bytes, bundle_index: int, capture: bool) -> None:  # noqa: A001
+    _local.ctx = (group_id, bundle_index, capture)
+
+
+def clear() -> None:
+    _local.ctx = None
+
+
+def get() -> Optional[Tuple[bytes, int, bool]]:
+    return getattr(_local, "ctx", None)

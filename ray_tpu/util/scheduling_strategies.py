@@ -1,0 +1,41 @@
+"""Scheduling strategies for tasks and actors.
+
+Re-design of the reference strategy objects (reference:
+``python/ray/util/scheduling_strategies.py``): plain dataclasses consumed by
+the submit paths, which translate them into TaskSpec scheduling fields. The
+node-side policies they select live in ``_private/scheduler/policies.py``
+(hybrid/spread/affinity — reference ``raylet/scheduling/policy/``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class PlacementGroupSchedulingStrategy:
+    """Schedule onto a reserved placement-group bundle.
+
+    Reference: ``scheduling_strategies.py`` PlacementGroupSchedulingStrategy.
+    The task/actor charges the group's 2PC-reserved bundle resources instead
+    of free node capacity, so gang placement survives contention.
+    """
+
+    placement_group: Any
+    placement_group_bundle_index: int = -1
+    placement_group_capture_child_tasks: bool = False
+
+
+@dataclasses.dataclass
+class NodeAffinitySchedulingStrategy:
+    """Pin to one node. ``soft=True`` falls back to the default policy when
+    the node is gone/full (reference: NodeAffinitySchedulingStrategy)."""
+
+    node_id: str
+    soft: bool = False
+
+
+# String strategies "DEFAULT" (hybrid pack-then-spread) and "SPREAD"
+# (min-utilization) are accepted anywhere a strategy object is.
+SchedulingStrategyT = Optional[Any]
