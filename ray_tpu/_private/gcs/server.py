@@ -57,8 +57,9 @@ class GcsServer:
         self._actor_names: Dict[Tuple[str, str], bytes] = {}
         # pubsub
         self._subscribers: Dict[str, List[queue.Queue]] = defaultdict(list)
-        # placement groups
+        # placement groups (+ ids with an in-flight _place_group run)
         self._pgroups: Dict[bytes, pb.PlacementGroupInfo] = {}
+        self._placing: Set[bytes] = set()
         # object directory
         self._locations: Dict[bytes, Set[str]] = defaultdict(set)
         self._object_sizes: Dict[bytes, int] = {}
@@ -413,6 +414,27 @@ class GcsServer:
                        if nid == node_id and not is_driver]
         if holders:
             self._reap_holders(holders)
+        # Reschedule placement bundles that lived on the dead node
+        # (reference: GcsPlacementGroupManager::OnNodeDead,
+        # gcs_placement_group_manager.cc:585 — groups go RESCHEDULING and
+        # their lost bundles are re-placed; surviving bundles keep their
+        # reservations).
+        to_replace: List[pb.PlacementGroupInfo] = []
+        with self._lock:
+            for info in self._pgroups.values():
+                if info.state in ("REMOVED", "INFEASIBLE"):
+                    continue
+                hit = [b for b in info.bundles if b.node_id == node_id]
+                if not hit:
+                    continue
+                for b in hit:
+                    b.node_id = ""
+                info.state = "RESCHEDULING"
+                to_replace.append(info)
+        for info in to_replace:
+            self._mark_dirty()
+            self._publish("PLACEMENT_GROUP", info.SerializeToString())
+            self._submit_place(info)
         with self._lock:
             affected = [a for a in self._actors.values()
                         if a.node_id == node_id and a.state == "ALIVE"]
@@ -428,32 +450,69 @@ class GcsServer:
                 self.UpdateActor(pb.UpdateActorRequest(info=info), None)
 
     def _restart_actor(self, info: pb.ActorInfo):
-        """Reference: GcsActorManager RestartActor (gcs_actor_manager.cc:1372)."""
-        node_id = self._schedule_actor(info)
-        if node_id is None:
-            info.state = "DEAD"
-            info.death_cause = "no feasible node for restart"
-            self.UpdateActor(pb.UpdateActorRequest(info=info), None)
-            return
-        stub = self._node_stub(node_id)
-        try:
-            reply = stub.CreateActorOnNode(
-                pb.CreateActorOnNodeRequest(info=info), timeout=60)
-            if reply.ok:
-                info.state = "ALIVE"
-                info.node_id = node_id
-                info.address = reply.worker_address
-            else:
-                info.state = "DEAD"
-                info.death_cause = reply.error
-        except Exception as e:  # noqa: BLE001
-            info.state = "DEAD"
-            info.death_cause = f"restart failed: {e}"
+        """Reference: GcsActorManager RestartActor (gcs_actor_manager.cc:1372).
+
+        PG-targeted actors retry while their bundle is momentarily full
+        (``pg-wait``) — the reference queues actor creation on the bundle;
+        everything else fails fast to DEAD.
+        """
+        deadline = time.monotonic() + 60.0
+        last_err = "no feasible node for restart"
+        while not self._stop.is_set():
+            candidates = self._schedule_actor(info)
+            retriable = False
+            for node_id in candidates:
+                stub = self._node_stub(node_id)
+                if stub is None:
+                    continue
+                try:
+                    reply = stub.CreateActorOnNode(
+                        pb.CreateActorOnNodeRequest(info=info), timeout=60)
+                except Exception as e:  # noqa: BLE001
+                    last_err = f"restart failed: {e}"
+                    continue
+                if reply.ok:
+                    info.state = "ALIVE"
+                    info.node_id = node_id
+                    info.address = reply.worker_address
+                    self.UpdateActor(pb.UpdateActorRequest(info=info), None)
+                    return
+                last_err = reply.error
+                if "pg-wait" in (reply.error or ""):
+                    retriable = True
+            if not retriable or time.monotonic() > deadline:
+                break
+            time.sleep(0.2)
+        info.state = "DEAD"
+        info.death_cause = last_err
         self.UpdateActor(pb.UpdateActorRequest(info=info), None)
 
-    def _schedule_actor(self, info: pb.ActorInfo) -> Optional[str]:
-        """Pick a live node with available resources (GcsActorScheduler)."""
+    def _schedule_actor(self, info: pb.ActorInfo) -> List[str]:
+        """Candidate nodes, best first (GcsActorScheduler). A PG-targeted
+        actor's candidates are its bundle's node (or every bundle node for
+        bundle_index=-1), found after the group finishes placing."""
         spec = pickle.loads(info.spec)
+        pg = spec.get("pg")
+        if pg is not None:
+            group_id, idx = pg
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and not self._stop.is_set():
+                with self._lock:
+                    ginfo = self._pgroups.get(group_id)
+                    if ginfo is None:
+                        return []
+                    state = ginfo.state
+                    if state == "CREATED":
+                        if idx >= 0:
+                            return [b.node_id for b in ginfo.bundles
+                                    if b.index == idx and b.node_id]
+                        # De-dup, preserving bundle order.
+                        return list(dict.fromkeys(
+                            b.node_id for b in ginfo.bundles if b.node_id))
+                    if state in ("REMOVED", "INFEASIBLE"):
+                        return []
+                time.sleep(0.05)
+            return []
         demand: Dict[str, float] = spec.get("resources", {})
         with self._lock:
             candidates = [
@@ -463,10 +522,10 @@ class GcsServer:
                     for k, v in demand.items())
             ]
         if not candidates:
-            return None
+            return []
         best = max(candidates,
                    key=lambda n: sum(n.available.values()))
-        return best.node_id
+        return [best.node_id]
 
     # ------------------------------------------------------------- pubsub
     def Publish(self, request, context):
@@ -501,8 +560,33 @@ class GcsServer:
         with self._lock:
             self._pgroups[request.group_id] = info
         self._mark_dirty()
-        self._work_pool.submit(self._place_group, info)
+        self._submit_place(info)
         return pb.Empty()
+
+    def _submit_place(self, info: pb.PlacementGroupInfo):
+        """At most one _place_group run per group: concurrent runs (create +
+        node-death resubmits) would double-prepare the same pending bundles."""
+        gid = bytes(info.group_id)
+        with self._lock:
+            if gid in self._placing:
+                return
+            self._placing.add(gid)
+
+        def run():
+            try:
+                self._place_group(info)
+            finally:
+                resubmit = False
+                with self._lock:
+                    self._placing.discard(gid)
+                    # A node death during the run may have cleared more
+                    # bundles after our last look; pick them up.
+                    resubmit = (info.state not in ("REMOVED", "INFEASIBLE")
+                                and any(not b.node_id for b in info.bundles))
+                if resubmit:
+                    self._submit_place(info)
+
+        self._work_pool.submit(run)
 
     def _place_group(self, info: pb.PlacementGroupInfo):
         """2PC bundle placement (reference: GcsPlacementGroupScheduler
@@ -512,22 +596,29 @@ class GcsServer:
         deadline = time.monotonic() + 30.0
         while time.monotonic() < deadline and not self._stop.is_set():
             with self._lock:
+                if info.state == "REMOVED":
+                    return
                 nodes = [n for n in self._nodes.values() if n.alive]
+                pending = [b for b in info.bundles if not b.node_id]
+                occupied = [b.node_id for b in info.bundles if b.node_id]
+            if not pending:
+                break  # nothing lost (partial re-place already done)
             # Permanently infeasible (by total, not available, resources):
             # fail fast rather than burning the retry window.
             from ray_tpu._private.scheduler.policies import feasible_anywhere
 
             if nodes and not all(
                     feasible_anywhere(nodes, dict(b.resources))
-                    for b in info.bundles):
+                    for b in pending):
                 break
-            assignment = place_bundles(info, nodes)
+            assignment = place_bundles(info, nodes, pending=pending,
+                                       occupied=occupied)
             if assignment is None:
                 time.sleep(0.2)  # retry loop (gcs_placement_group_manager.cc:405)
                 continue
             # Phase 1: prepare on every involved node.
             by_node: Dict[str, List[pb.Bundle]] = defaultdict(list)
-            for bundle, node_id in zip(info.bundles, assignment):
+            for bundle, node_id in zip(pending, assignment):
                 b = pb.Bundle(index=bundle.index, node_id=node_id)
                 for k, v in bundle.resources.items():
                     b.resources[k] = v
@@ -557,20 +648,52 @@ class GcsServer:
                             pass
                 time.sleep(0.2)
                 continue
-            # Phase 2: commit.
+            # Phase 2: commit. A node lost between prepare and commit keeps
+            # its bundles pending; they are retried next iteration.
+            committed: set = set()
             for node_id, bundles in by_node.items():
                 stub = self._node_stub(node_id)
-                stub.CommitBundle(pb.CommitBundleRequest(
-                    group_id=info.group_id, bundles=bundles))
+                try:
+                    if stub is None:
+                        raise ConnectionError(f"node {node_id[:8]} gone")
+                    stub.CommitBundle(pb.CommitBundleRequest(
+                        group_id=info.group_id, bundles=bundles))
+                    committed.add(node_id)
+                except Exception:  # noqa: BLE001
+                    pass
+            rollback = False
             with self._lock:
-                for bundle, node_id in zip(info.bundles, assignment):
-                    bundle.node_id = node_id
-                info.state = "CREATED"
+                if info.state == "REMOVED":
+                    # remove_placement_group raced the commit: roll the
+                    # fresh reservations back instead of resurrecting.
+                    rollback = True
+                else:
+                    for bundle, node_id in zip(pending, assignment):
+                        if node_id in committed:
+                            bundle.node_id = node_id
+                    if all(b.node_id for b in info.bundles):
+                        info.state = "CREATED"
+            if rollback:
+                for node_id in committed:
+                    stub = self._node_stub(node_id)
+                    if stub:
+                        try:
+                            stub.CancelBundle(pb.CancelBundleRequest(
+                                group_id=info.group_id))
+                        except Exception:  # noqa: BLE001
+                            pass
+                return
+            if len(committed) < len(by_node):
+                time.sleep(0.2)
+                continue
             self._mark_dirty()
             self._publish("PLACEMENT_GROUP", info.SerializeToString())
             return
         with self._lock:
-            info.state = "INFEASIBLE"
+            if info.state == "REMOVED":
+                return
+            done = all(b.node_id for b in info.bundles)
+            info.state = "CREATED" if done else "INFEASIBLE"
         self._mark_dirty()
         self._publish("PLACEMENT_GROUP", info.SerializeToString())
 

@@ -86,9 +86,14 @@ class NodeManager:
         self._idle: List[str] = []
         self._pool_lock = threading.RLock()
 
-        # placement bundles: group -> reserved resources
+        # placement bundles (reference: placement_group_resource_manager.h).
+        # Prepare holds the group's node-total demand; commit converts it to
+        # per-bundle availability that PG-targeted leases charge against.
         self._prepared: Dict[bytes, Dict[str, float]] = {}
-        self._committed: Dict[bytes, Dict[str, float]] = {}
+        self._pg_avail: Dict[bytes, Dict[int, Dict[str, float]]] = {}
+        self._pg_totals: Dict[bytes, Dict[int, Dict[str, float]]] = {}
+        # holder (lease or actor id) -> (group_id, bundle_index) it charged
+        self._pg_holders: Dict[bytes, Tuple[bytes, int]] = {}
         # outstanding leases / actor resource holds
         self._leases: Dict[bytes, Tuple[str, Dict[str, float]]] = {}
         self._actor_demands: Dict[bytes, Tuple[str, Dict[str, float]]] = {}
@@ -159,6 +164,66 @@ class NodeManager:
                     self.available.get(k, 0.0) + v, self.total.get(k, 0.0))
             if holder is not None:
                 self._tpu_free.extend(self._tpu_held.pop(holder, []))
+
+    def _acquire_from_bundle(self, group_id: bytes, bundle_index: int,
+                             demand: Dict[str, float],
+                             holder: bytes) -> Tuple[bool, str]:
+        """Charge ``demand`` against a committed bundle's reservation instead
+        of free node capacity (reference:
+        ``placement_group_resource_manager.h`` — bundles own CPU_group_...
+        resource instances; here they own per-bundle availability maps).
+
+        Chip slots were debited from ``available`` at prepare time but left
+        in ``_tpu_free``; a PG lease claims its physical slots here.
+        """
+        with self._res_lock:
+            bundles = self._pg_avail.get(group_id)
+            if bundles is None:
+                return False, "pg-unknown"
+            indices = [bundle_index] if bundle_index >= 0 else sorted(bundles)
+            for i in indices:
+                avail = bundles.get(i)
+                if avail is None:
+                    continue
+                if all(avail.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items()):
+                    for k, v in demand.items():
+                        avail[k] = avail.get(k, 0.0) - v
+                    n_chips = int(demand.get("TPU", 0))
+                    if n_chips >= 1 and n_chips == demand.get("TPU"):
+                        self._tpu_held[holder] = \
+                            [self._tpu_free.pop() for _ in range(n_chips)]
+                    self._pg_holders[holder] = (group_id, i)
+                    return True, ""
+            totals = self._pg_totals.get(group_id, {})
+            fits_ever = any(
+                all(t.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+                for i, t in totals.items()
+                if bundle_index < 0 or i == bundle_index)
+            return False, ("pg-wait" if fits_ever else "infeasible")
+
+    def _release_pg_holder(self, holder: bytes,
+                           demand: Dict[str, float]) -> bool:
+        """Return a PG lease/actor charge to its bundle. False if ``holder``
+        never charged a bundle (caller falls back to node release). If the
+        group was removed while the holder ran, its share was the only part
+        of the reservation not yet returned to the node — credit it now."""
+        with self._res_lock:
+            key = self._pg_holders.pop(holder, None)
+            if key is None:
+                return False
+            self._tpu_free.extend(self._tpu_held.pop(holder, []))
+            group_id, idx = key
+            bundles = self._pg_avail.get(group_id)
+            if bundles is None or idx not in bundles:
+                for k, v in demand.items():
+                    self.available[k] = min(
+                        self.available.get(k, 0.0) + v, self.total.get(k, 0.0))
+                return True
+            avail = bundles[idx]
+            for k, v in demand.items():
+                avail[k] = avail.get(k, 0.0) + v
+            return True
 
     def _heartbeat_loop(self):
         seq = 0
@@ -285,7 +350,8 @@ class NodeManager:
                 if wid != w.worker_id:
                     continue
                 del self._actor_demands[actor_id]
-                self._release(demand, holder=actor_id)
+                if not self._release_pg_holder(actor_id, demand):
+                    self._release(demand, holder=actor_id)
                 try:
                     reply = self.gcs.GetActor(
                         pb.GetActorRequest(actor_id=actor_id), timeout=5)
@@ -319,6 +385,48 @@ class NodeManager:
         spec = request.spec
         demand = dict(spec.resources)
         lease_id = uuid.uuid4().bytes
+        if spec.placement_group_id:
+            # PG-targeted: charge the bundle reservation; never spill back —
+            # the bundle lives here or nowhere (bundle_scheduling_policy.h).
+            ok, err = self._acquire_from_bundle(
+                bytes(spec.placement_group_id), spec.pg_bundle_index,
+                demand, lease_id)
+            if not ok:
+                return pb.LeaseReply(granted=False, error=err)
+            worker = self._pop_worker()
+            if worker is None:
+                self._release_pg_holder(lease_id, demand)
+                return pb.LeaseReply(granted=False,
+                                     error="worker start timeout")
+            worker.leased_for = lease_id
+            with self._pool_lock:
+                if worker.worker_id in self._idle:
+                    self._idle.remove(worker.worker_id)
+            self._leases[lease_id] = (worker.worker_id, demand)
+            return pb.LeaseReply(granted=True,
+                                 worker_address=worker.address,
+                                 worker_id=worker.worker_id,
+                                 tpu_chips=self._chips_for(lease_id))
+        if spec.strategy == "SPREAD":
+            # Min-utilization placement (reference: spread_scheduling_policy):
+            # hand off when a clearly-less-loaded node exists; the margin
+            # damps spillback ping-pong between nodes with stale views.
+            others = [n for n in self._cluster_view()
+                      if n.node_id != self.node_id]
+            best = policies.pick_node_spread(others, demand)
+            if best is not None:
+                me = pb.NodeInfo(node_id=self.node_id, alive=True)
+                with self._res_lock:
+                    for k, v in self.total.items():
+                        me.resources[k] = v
+                    for k, v in self.available.items():
+                        me.available[k] = v
+                best_node = next(n for n in others if n.node_id == best)
+                if policies._utilization(best_node) + 0.05 < \
+                        policies._utilization(me):
+                    return pb.LeaseReply(granted=False,
+                                         spillback_node_id=best,
+                                         spillback_address=best_node.address)
         if self._try_acquire(demand, holder=lease_id):
             worker = self._pop_worker()
             if worker is None:
@@ -335,9 +443,19 @@ class NodeManager:
                                  worker_address=worker.address,
                                  worker_id=worker.worker_id,
                                  tpu_chips=self._chips_for(lease_id))
+        if spec.affinity_node_id and not spec.affinity_soft:
+            # Hard node affinity (NodeAffinitySchedulingStrategy): never
+            # spill; the task waits for local resources, or fails if this
+            # node can never hold the demand.
+            if not all(self.total.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items()):
+                return pb.LeaseReply(granted=False, error="infeasible")
+            return pb.LeaseReply(granted=False)
         # Spillback: pick another node from the cluster view.
         nodes = [n for n in self._cluster_view() if n.node_id != self.node_id]
-        target = policies.pick_node_hybrid(nodes, demand)
+        picker = (policies.pick_node_spread if spec.strategy == "SPREAD"
+                  else policies.pick_node_hybrid)
+        target = picker(nodes, demand)
         if target is None:
             if not policies.feasible_anywhere(self._cluster_view(), demand):
                 return pb.LeaseReply(granted=False, error="infeasible")
@@ -361,7 +479,8 @@ class NodeManager:
             # Release exactly this lease's resources and chip slots. (Chips
             # held by live actors are keyed by actor_id and must NOT be
             # reclaimed here — see resource_instance_set.h semantics.)
-            self._release(demand, holder=lease_id)
+            if not self._release_pg_holder(lease_id, demand):
+                self._release(demand, holder=lease_id)
         with self._pool_lock:
             w = self._workers.get(request.worker_id)
             if w and w.proc.poll() is None and not w.is_actor_worker:
@@ -377,12 +496,20 @@ class NodeManager:
         info = request.info
         spec = pickle.loads(info.spec)
         demand = dict(spec.get("resources", {}))
-        if not self._try_acquire(demand, holder=bytes(info.actor_id)):
+        pg = spec.get("pg")
+        if pg is not None:
+            ok, err = self._acquire_from_bundle(
+                pg[0], pg[1], demand, bytes(info.actor_id))
+            if not ok:
+                return pb.CreateActorOnNodeReply(
+                    ok=False, error=f"insufficient resources ({err})")
+        elif not self._try_acquire(demand, holder=bytes(info.actor_id)):
             return pb.CreateActorOnNodeReply(
                 ok=False, error="insufficient resources")
         worker = self._pop_worker()
         if worker is None:
-            self._release(demand, holder=bytes(info.actor_id))
+            if not self._release_pg_holder(bytes(info.actor_id), demand):
+                self._release(demand, holder=bytes(info.actor_id))
             return pb.CreateActorOnNodeReply(ok=False,
                                              error="worker start timeout")
         worker.is_actor_worker = True
@@ -404,10 +531,14 @@ class NodeManager:
             reply = stub.CreateActor(pb.CreateActorRequest(info=info, env=env),
                                      timeout=60)
         except Exception as e:  # noqa: BLE001
-            self._release(demand, holder=bytes(info.actor_id))
+            self._actor_demands.pop(info.actor_id, None)
+            if not self._release_pg_holder(bytes(info.actor_id), demand):
+                self._release(demand, holder=bytes(info.actor_id))
             return pb.CreateActorOnNodeReply(ok=False, error=str(e))
         if not reply.ok:
-            self._release(demand, holder=bytes(info.actor_id))
+            self._actor_demands.pop(info.actor_id, None)
+            if not self._release_pg_holder(bytes(info.actor_id), demand):
+                self._release(demand, holder=bytes(info.actor_id))
             return pb.CreateActorOnNodeReply(ok=False, error=reply.error)
         return pb.CreateActorOnNodeReply(ok=True,
                                          worker_address=worker.address)
@@ -425,16 +556,33 @@ class NodeManager:
 
     def CommitBundle(self, request, context):
         demand = self._prepared.pop(request.group_id, None)
-        if demand is not None:
-            self._committed[request.group_id] = demand
+        if demand is None:
+            return pb.Empty()  # already cancelled or duplicate commit
+        with self._res_lock:
+            avail = self._pg_avail.setdefault(request.group_id, {})
+            totals = self._pg_totals.setdefault(request.group_id, {})
+            for b in request.bundles:
+                avail[b.index] = dict(b.resources)
+                totals[b.index] = dict(b.resources)
         return pb.Empty()
 
     def CancelBundle(self, request, context):
         demand = self._prepared.pop(request.group_id, None)
-        if demand is None:
-            demand = self._committed.pop(request.group_id, None)
         if demand is not None:
             self._release(demand)
+            return pb.Empty()
+        with self._res_lock:
+            avail = self._pg_avail.pop(request.group_id, None)
+            self._pg_totals.pop(request.group_id, None)
+        if avail is not None:
+            # Return only the unconsumed share; outstanding PG leases return
+            # their charges straight to the node when they finish
+            # (_release_pg_holder group-gone branch).
+            freed: Dict[str, float] = defaultdict(float)
+            for res in avail.values():
+                for k, v in res.items():
+                    freed[k] += v
+            self._release(dict(freed))
         return pb.Empty()
 
     # ------------------------------------------------------------ objects

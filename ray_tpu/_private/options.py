@@ -85,3 +85,72 @@ class RemoteOptions:
 def options_from_decorator_kwargs(kwargs: Dict[str, Any], is_actor: bool) -> RemoteOptions:
     opts = RemoteOptions(_is_actor=is_actor)
     return opts.merged_with(kwargs)
+
+
+@dataclasses.dataclass
+class PlacementFields:
+    """Resolved scheduling-strategy fields, 1:1 with the TaskSpec proto
+    (reference: TaskSpecification scheduling_strategy,
+    ``common/task/task_spec.h`` + ``scheduling_strategies.py``)."""
+
+    placement_group_id: bytes = b""
+    bundle_index: int = -1
+    capture_child_tasks: bool = False
+    affinity_node_id: str = ""
+    affinity_soft: bool = False
+    strategy: str = ""  # "" | "DEFAULT" | "SPREAD"
+
+
+def resolve_placement(options: RemoteOptions) -> PlacementFields:
+    """Collapse ``scheduling_strategy`` / ``placement_group=`` options (and,
+    absent both, the worker's capture context) into TaskSpec fields.
+
+    Matches reference precedence: an explicit strategy object wins, then the
+    legacy ``placement_group=`` option, then
+    ``placement_group_capture_child_tasks`` inherited from the running task.
+    """
+    out = PlacementFields()
+    strat = options.scheduling_strategy
+    pg = options.placement_group
+    idx = options.placement_group_bundle_index
+    capture = options.placement_group_capture_child_tasks
+    if strat is not None:
+        if isinstance(strat, str):
+            if strat not in ("DEFAULT", "SPREAD"):
+                raise ValueError(
+                    f"Unknown scheduling strategy {strat!r}; expected "
+                    "'DEFAULT', 'SPREAD', or a strategy object")
+            out.strategy = strat
+        elif hasattr(strat, "placement_group"):
+            pg = strat.placement_group
+            idx = strat.placement_group_bundle_index
+            capture = strat.placement_group_capture_child_tasks
+        elif hasattr(strat, "node_id"):
+            out.affinity_node_id = strat.node_id
+            out.affinity_soft = bool(strat.soft)
+            return out
+        else:
+            raise ValueError(f"Unknown scheduling strategy {strat!r}")
+    if pg is not None:
+        group_id = pg.id if hasattr(pg, "id") else pg
+        if idx >= len(getattr(pg, "bundle_specs", [])) and \
+                getattr(pg, "bundle_specs", None):
+            raise ValueError(
+                f"placement_group_bundle_index {idx} out of range for a "
+                f"group with {len(pg.bundle_specs)} bundles")
+        out.placement_group_id = group_id
+        out.bundle_index = idx
+        out.capture_child_tasks = bool(capture)
+        return out
+    if not out.strategy:
+        # Inherit the capturing group of the currently-executing task.
+        from ray_tpu._private import pg_context
+
+        ctx = pg_context.get()
+        if ctx is not None:
+            gid, _bidx, cap = ctx
+            if cap:
+                out.placement_group_id = gid
+                out.bundle_index = -1
+                out.capture_child_tasks = True
+    return out

@@ -109,6 +109,14 @@ def read_object_reply(reply) -> Any:
     return loads(reply.data)
 
 
+class _HexId(str):
+    """Node/worker ids travel as hex strings in this runtime; ``.hex()``
+    (the ID-object protocol runtime_context expects) is identity."""
+
+    def hex(self) -> str:  # type: ignore[override]
+        return str(self)
+
+
 class ClusterRuntime(CoreRuntime):
     def __init__(self, gcs_address: str, node_address: str,
                  namespace: str = "default", is_worker: bool = False,
@@ -119,6 +127,7 @@ class ClusterRuntime(CoreRuntime):
         self.namespace = namespace
         self.is_worker = is_worker
         self.worker_id = worker_id or uuid.uuid4().hex
+        self.node_id = _HexId(node_id or "")
         self.job_id = JobID.from_int(1)
         self.gcs = rpc.get_stub("GcsService", gcs_address)
         self.node = rpc.get_stub("NodeService", node_address)
@@ -173,7 +182,8 @@ class ClusterRuntime(CoreRuntime):
         if not nodes:
             raise ConnectionError(f"no alive nodes in cluster at {address}")
         local = sorted(nodes, key=lambda n: n.node_id)[0]
-        return cls(address, local.address, namespace=namespace)
+        return cls(address, local.address, namespace=namespace,
+                   node_id=local.node_id)
 
     def _refresh_local_node(self) -> bool:
         """Fail over to another alive node when the local raylet is gone
@@ -196,6 +206,7 @@ class ClusterRuntime(CoreRuntime):
                        self.node_address, pick.address)
         self.node_address = pick.address
         self.node = rpc.get_stub("NodeService", pick.address)
+        self.node_id = _HexId(pick.node_id)
         return True
 
     # ------------------------------------------------------------- pubsub
@@ -525,6 +536,18 @@ class ClusterRuntime(CoreRuntime):
             spec.runtime_env = pickle.dumps(options.runtime_env)
         for k, v in options.task_resources().items():
             spec.resources[k] = v
+        from ray_tpu._private.options import resolve_placement
+
+        pf = resolve_placement(options)
+        if pf.placement_group_id:
+            spec.placement_group_id = pf.placement_group_id
+            spec.pg_bundle_index = pf.bundle_index
+            spec.pg_capture_child_tasks = pf.capture_child_tasks
+        if pf.affinity_node_id:
+            spec.affinity_node_id = pf.affinity_node_id
+            spec.affinity_soft = pf.affinity_soft
+        if pf.strategy:
+            spec.strategy = pf.strategy
         # Pin every contained ObjectRef (top-level AND nested in containers)
         # for the task's flight time so its refcount can't hit zero between
         # submit and the worker's borrow flush.
@@ -568,15 +591,91 @@ class ClusterRuntime(CoreRuntime):
             for oid in pinned or ():
                 self.refs.decr(oid)
 
+    def _node_address(self, node_id: str) -> Optional[str]:
+        return self._node_addresses().get(node_id)
+
+    def _node_addresses(self) -> Dict[str, str]:
+        return {n.node_id: n.address
+                for n in self.gcs.GetNodes(pb.GetNodesRequest()).nodes
+                if n.alive}
+
+    def _pg_lease_targets(self, spec: pb.TaskSpec) -> List[Any]:
+        """Node stubs hosting the target bundle(s), waiting for placement
+        (reference: tasks targeting a PG queue until the group is CREATED,
+        gcs_placement_group_manager.h WaitPlacementGroupReady)."""
+        gid = bytes(spec.placement_group_id)
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            reply = self.gcs.GetPlacementGroup(
+                pb.GetPlacementGroupRequest(group_id=gid))
+            if not reply.found:
+                raise exceptions.RayTpuError(
+                    f"Task {spec.name} targets placement group "
+                    f"{gid.hex()[:12]} which does not exist")
+            info = reply.info
+            if info.state == "REMOVED":
+                raise exceptions.RayTpuError(
+                    f"Task {spec.name} targets removed placement group "
+                    f"{gid.hex()[:12]}")
+            if info.state == "INFEASIBLE":
+                raise exceptions.RayTpuError(
+                    f"Placement group {gid.hex()[:12]} is infeasible; "
+                    f"task {spec.name} can never be placed")
+            if info.state == "CREATED":
+                if spec.pg_bundle_index >= 0:
+                    node_ids = [b.node_id for b in info.bundles
+                                if b.index == spec.pg_bundle_index
+                                and b.node_id]
+                else:
+                    node_ids = list(dict.fromkeys(
+                        b.node_id for b in info.bundles if b.node_id))
+                addrs = self._node_addresses()
+                stubs = [rpc.get_stub("NodeService", addrs[nid])
+                         for nid in node_ids if nid in addrs]
+                if stubs:
+                    return stubs
+            time.sleep(0.05)
+        raise exceptions.RayTpuError(
+            f"Timed out waiting for placement group {gid.hex()[:12]} "
+            f"to be placed (task {spec.name})")
+
+    def _affinity_target(self, spec: pb.TaskSpec):
+        addr = self._node_address(spec.affinity_node_id)
+        if addr is not None:
+            return rpc.get_stub("NodeService", addr)
+        if spec.affinity_soft:
+            return self.node
+        raise exceptions.RayTpuError(
+            f"Task {spec.name} has hard node affinity to "
+            f"{spec.affinity_node_id[:8]} which is not alive")
+
     def _lease_and_push_once(self, spec: pb.TaskSpec,
                              return_ids: List[ObjectID]):
-        target = self.node
+        pg_targets: List[Any] = []
+        if spec.placement_group_id:
+            pg_targets = self._pg_lease_targets(spec)
+            target = pg_targets[0]
+        elif spec.affinity_node_id:
+            target = self._affinity_target(spec)
+        else:
+            target = self.node
         deadline = time.monotonic() + 300.0
         backoff = 0.01
         while True:
             try:
                 reply = target.RequestWorkerLease(pb.LeaseRequest(spec=spec))
             except Exception:  # noqa: BLE001 — lease target died; re-route
+                if spec.placement_group_id:
+                    # Bundle node died: GCS reschedules the bundle; wait for
+                    # the new assignment and retry there.
+                    time.sleep(0.1)
+                    pg_targets = self._pg_lease_targets(spec)
+                    target = pg_targets[0]
+                    continue
+                if spec.affinity_node_id and not spec.affinity_soft:
+                    raise exceptions.RayTpuError(
+                        f"Node {spec.affinity_node_id[:8]} died while task "
+                        f"{spec.name} was pinned to it")
                 if not self._refresh_local_node():
                     raise exceptions.RayTpuError("no alive nodes in cluster")
                 target = self.node
@@ -584,9 +683,22 @@ class ClusterRuntime(CoreRuntime):
             if reply.granted:
                 break
             if reply.error == "infeasible":
+                where = (f"placement group bundle" if spec.placement_group_id
+                         else "cluster node")
                 raise exceptions.RayTpuError(
                     f"Task {spec.name} demands {dict(spec.resources)} which "
-                    f"no cluster node can ever satisfy.")
+                    f"no {where} can ever satisfy.")
+            if reply.error == "pg-unknown":
+                # The bundle was rescheduled off this node; re-resolve.
+                time.sleep(0.05)
+                pg_targets = self._pg_lease_targets(spec)
+                target = pg_targets[0]
+                continue
+            if reply.error == "pg-wait" and len(pg_targets) > 1:
+                # Any-bundle task: rotate across the group's nodes before
+                # backing off.
+                pg_targets = pg_targets[1:] + pg_targets[:1]
+                target = pg_targets[0]
             if reply.spillback_address:
                 target = rpc.get_stub("NodeService", reply.spillback_address)
                 continue
@@ -643,10 +755,18 @@ class ClusterRuntime(CoreRuntime):
         actor_id = ActorID.of(self.job_id)
         demand = dict(options.task_resources())
         payload, contained = dumps_payload((cls, args, kwargs, options))
+        from ray_tpu._private.options import resolve_placement
+
+        pf = resolve_placement(options)
         spec = pickle.dumps({
             "resources": demand,
             "runtime_env": options.runtime_env or {},
             "payload": payload,
+            # PG-targeted actors are scheduled onto their bundle's node and
+            # charge the bundle reservation (gcs_actor_scheduler.cc + PG).
+            "pg": ((pf.placement_group_id, pf.bundle_index)
+                   if pf.placement_group_id else None),
+            "pg_capture": pf.capture_child_tasks,
         })
         # Constructor args are pinned until the actor reaches a settled
         # state (ALIVE after the constructor's borrow flush, or DEAD):
@@ -875,6 +995,26 @@ class ClusterRuntime(CoreRuntime):
             for k, v in n.available.items():
                 totals[k] = totals.get(k, 0.0) + v
         return totals
+
+    # ------------------------------------------------------ placement groups
+    def current_placement_group_id(self) -> Optional[bytes]:
+        from ray_tpu._private import pg_context
+
+        ctx = pg_context.get()
+        return ctx[0] if ctx else None
+
+    def create_placement_group(self, req: pb.CreatePlacementGroupRequest):
+        self.gcs.CreatePlacementGroup(req)
+
+    def remove_placement_group(self, group_id: bytes):
+        self.gcs.RemovePlacementGroup(
+            pb.RemovePlacementGroupRequest(group_id=group_id))
+
+    def get_placement_group(self, group_id: bytes) \
+            -> Optional[pb.PlacementGroupInfo]:
+        reply = self.gcs.GetPlacementGroup(
+            pb.GetPlacementGroupRequest(group_id=group_id))
+        return reply.info if reply.found else None
 
     def shutdown(self):
         if self._shutdown:

@@ -51,14 +51,17 @@ def current_task_context() -> Optional["_TaskCtx"]:
 
 
 class _TaskCtx:
-    __slots__ = ("task_id", "actor_id", "attempt", "name", "resources")
+    __slots__ = ("task_id", "actor_id", "attempt", "name", "resources",
+                 "ledger")
 
-    def __init__(self, task_id, actor_id=None, attempt=0, name="", resources=None):
+    def __init__(self, task_id, actor_id=None, attempt=0, name="",
+                 resources=None, ledger=None):
         self.task_id = task_id
         self.actor_id = actor_id
         self.attempt = attempt
         self.name = name
         self.resources = resources or {}
+        self.ledger = ledger  # bundle ledger for PG tasks; None = main
 
 
 def _resolve_retry(exc: BaseException, retry_exceptions, retries_left: int) -> bool:
@@ -145,6 +148,12 @@ class _LocalActor:
 
     # -- thread bodies ----------------------------------------------------
     def _run(self):
+        if getattr(self, "pg_ctx", None) is not None:
+            # Capturing PG: the actor thread inherits the group, so the
+            # constructor and every (ordered-mode) method schedule children
+            # into it (placement_group_capture_child_tasks).
+            from ray_tpu._private import pg_context
+            pg_context.set(*self.pg_ctx)
         try:
             self.instance = self.cls(*self.init_args, **self.init_kwargs)
         except BaseException as e:  # noqa: BLE001
@@ -298,14 +307,51 @@ class _LocalActor:
         self.runtime._actor_died(self.actor_id, None)
 
 
-class _PendingTask:
-    __slots__ = ("fn", "demand", "return_ids", "warned")
+class _AnyBundleLedger:
+    """Per-task view over a group's bundle ledgers for bundle_index=-1: the
+    acquire picks whichever bundle fits and the release returns to it."""
 
-    def __init__(self, fn, demand, return_ids):
+    def __init__(self, ledgers: Dict[Any, "_ResourceLedger"]):
+        self._ledgers = [l for i, l in sorted(ledgers.items())]
+        self._charged: Optional[_ResourceLedger] = None
+        self.total: Dict[str, float] = {}
+        for led in self._ledgers:
+            for k, v in led.total.items():
+                self.total[k] = max(self.total.get(k, 0.0), v)
+
+    def feasible(self, demand: Dict[str, float]) -> bool:
+        return any(all(led.total.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items()) for led in self._ledgers)
+
+    def try_acquire(self, demand: Dict[str, float]) -> bool:
+        if self._charged is not None:
+            # Re-acquisition after a blocked-get release sticks to the
+            # bundle this task originally charged.
+            return self._charged.try_acquire(demand)
+        for led in self._ledgers:
+            if led.try_acquire(demand):
+                self._charged = led
+                return True
+        return False
+
+    def release(self, demand: Dict[str, float]) -> None:
+        if self._charged is not None:
+            self._charged.release(demand)
+
+    @property
+    def cv(self):
+        return (self._charged or self._ledgers[0]).cv
+
+
+class _PendingTask:  # admission unit; ``ledger=None`` charges the main ledger
+    __slots__ = ("fn", "demand", "return_ids", "warned", "ledger")
+
+    def __init__(self, fn, demand, return_ids, ledger=None):
         self.fn = fn
         self.demand = demand
         self.return_ids = return_ids
         self.warned = False
+        self.ledger = ledger
 
 
 class LocalRuntime(CoreRuntime):
@@ -325,6 +371,12 @@ class LocalRuntime(CoreRuntime):
             total["TPU"] = float(num_tpus)
         total.update(resources or {})
         self.ledger = _ResourceLedger(total)
+        # Placement groups, single-node edition: a group reserves its summed
+        # resources from the main ledger at creation; PG-targeted tasks then
+        # charge per-bundle ledgers (bundle_index=-1 charges a group-level
+        # ledger — a local-mode simplification of "any bundle").
+        self._pgroups: Dict[bytes, Any] = {}
+        self._pg_ledgers: Dict[bytes, Dict[Any, _ResourceLedger]] = {}
         self._dispatch_queue: "queue.Queue[Optional[_PendingTask]]" = queue.Queue()
         self._pending: List[_PendingTask] = []
         self._actors: Dict[ActorID, _LocalActor] = {}
@@ -360,23 +412,25 @@ class LocalRuntime(CoreRuntime):
                 self._pending.append(item)
             still_pending = []
             for t in self._pending:
-                if not self.ledger.feasible(t.demand):
+                led = t.ledger if t.ledger is not None else self.ledger
+                if not led.feasible(t.demand):
                     if not t.warned:
                         t.warned = True
                         logger.warning(
                             "Task demands %s which exceeds total cluster resources"
                             " %s; it will hang until resources are added (parity"
                             " with reference infeasible tasks).",
-                            t.demand, self.ledger.total)
+                            t.demand, led.total)
                     still_pending.append(t)
-                elif self.ledger.try_acquire(t.demand):
+                elif led.try_acquire(t.demand):
                     self.pool.submit(t.fn)
                 else:
                     still_pending.append(t)
             self._pending = still_pending
 
-    def _enqueue(self, fn, demand, return_ids):
-        self._dispatch_queue.put(_PendingTask(fn, demand, return_ids))
+    def _enqueue(self, fn, demand, return_ids, ledger=None):
+        self._dispatch_queue.put(
+            _PendingTask(fn, demand, return_ids, ledger=ledger))
 
     # ---------------------------------------------------------------- objects
     def put(self, value: Any, owner_ref: Optional[ObjectRef] = None) -> ObjectRef:
@@ -396,12 +450,18 @@ class LocalRuntime(CoreRuntime):
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         ctx = current_task_context()
         release = {}
+        led = self.ledger
         if ctx is not None and ctx.resources:
             # A task blocked in get() returns its CPU so dependents can run
-            # (reference: raylet releases CPU of blocked workers).
+            # (reference: raylet releases CPU of blocked workers). PG tasks
+            # return it to their bundle ledger so same-bundle children can
+            # be admitted (the canonical tree-of-tasks-in-a-PG pattern).
             release = {k: v for k, v in ctx.resources.items() if k == "CPU"}
+            if ctx.ledger is not None:
+                led = ctx.ledger
         if release:
-            self.ledger.release(release)
+            led.release(release)
+            self._dispatch_queue.put(False)
         try:
             deadline = None if timeout is None else time.monotonic() + timeout
             out = []
@@ -417,12 +477,13 @@ class LocalRuntime(CoreRuntime):
             return out
         finally:
             if release:
-                self._reacquire(release)
+                self._reacquire(release, led)
 
-    def _reacquire(self, demand):
-        while not self.ledger.try_acquire(demand):
-            with self.ledger.cv:
-                self.ledger.cv.wait(timeout=0.05)
+    def _reacquire(self, demand, ledger=None):
+        led = ledger if ledger is not None else self.ledger
+        while not led.try_acquire(demand):
+            with led.cv:
+                led.cv.wait(timeout=0.05)
 
     def wait(self, refs, num_returns, timeout, fetch_local):
         ids = [r.id() for r in refs]
@@ -463,13 +524,39 @@ class LocalRuntime(CoreRuntime):
 
             retries = GLOBAL_CONFIG.task_max_retries
         demand = options.task_resources()
+        from ray_tpu._private.options import resolve_placement
+
+        pf = resolve_placement(options)
+        pg_ctx = ((pf.placement_group_id, pf.bundle_index,
+                   pf.capture_child_tasks)
+                  if pf.placement_group_id else None)
 
         def on_ready(rargs, rkwargs):
-            self._enqueue(
-                lambda: self._run_task(function, function_name, rargs, rkwargs,
-                                       return_ids, task_id, retries, options,
-                                       demand),
-                demand, return_ids)
+            def run(ledger=None):
+                self._run_task(function, function_name, rargs, rkwargs,
+                               return_ids, task_id, retries, options,
+                               demand, ledger=ledger, pg_ctx=pg_ctx)
+
+            if pf.placement_group_id:
+                # Resolve the bundle ledger off-thread: the group may still
+                # be placing (reference: tasks queue on a pending group).
+                def admit():
+                    try:
+                        ledger = self._pg_bundle_ledger(
+                            pf.placement_group_id, pf.bundle_index)
+                    except BaseException as e:  # noqa: BLE001
+                        self._store_error(
+                            e if isinstance(e, exceptions.RayTpuError)
+                            else exceptions.RayTaskError.from_exception(
+                                e, function_name),
+                            return_ids)
+                        return
+                    self._enqueue(lambda: run(ledger), demand, return_ids,
+                                  ledger=ledger)
+
+                self.pool.submit(admit)
+            else:
+                self._enqueue(run, demand, return_ids)
 
         self._schedule_when_ready(args, kwargs, on_ready, return_ids)
         return [ObjectRef(oid, owner_address="local") for oid in return_ids]
@@ -520,15 +607,20 @@ class LocalRuntime(CoreRuntime):
             self.store.on_ready(d.id(), on_dep)
 
     def _run_task(self, function, function_name, args, kwargs, return_ids,
-                  task_id, retries_left, options, demand, attempt=0):
+                  task_id, retries_left, options, demand, attempt=0,
+                  ledger=None, pg_ctx=None):
         retried = False
         try:
             if task_id in self._cancelled:
                 self._cancelled.discard(task_id)
                 self._store_error(exceptions.TaskCancelledError(task_id), return_ids)
                 return
-            token = _context.set(_TaskCtx(task_id, attempt=attempt,
-                                          name=function_name, resources=demand))
+            token = _context.set(_TaskCtx(
+                task_id, attempt=attempt, name=function_name,
+                resources=demand, ledger=ledger))
+            if pg_ctx is not None:
+                from ray_tpu._private import pg_context
+                pg_context.set(*pg_ctx)
             try:
                 result = function(*args, **kwargs)
                 if inspect.isgenerator(result):
@@ -542,17 +634,20 @@ class LocalRuntime(CoreRuntime):
                     self.pool.submit(self._run_task, function, function_name,
                                      args, kwargs, return_ids, task_id,
                                      retries_left - 1, options, demand,
-                                     attempt + 1)
+                                     attempt + 1, ledger, pg_ctx)
                 else:
                     self._store_error(
                         exceptions.RayTaskError.from_exception(
                             e, function_name, task_id),
                         return_ids)
             finally:
+                if pg_ctx is not None:
+                    from ray_tpu._private import pg_context
+                    pg_context.clear()
                 _context.reset(token)
         finally:
             if not retried:
-                self.ledger.release(demand)
+                (ledger if ledger is not None else self.ledger).release(demand)
                 # Wake the dispatcher so freed resources admit pending tasks.
                 self._dispatch_queue.put(False)
 
@@ -596,6 +691,12 @@ class LocalRuntime(CoreRuntime):
         name = options.name
         ns = options.namespace or "default"
         actor = _LocalActor(self, actor_id, cls, args, kwargs, options)
+        from ray_tpu._private.options import resolve_placement
+
+        pf = resolve_placement(options)
+        actor.pg_ctx = ((pf.placement_group_id, pf.bundle_index,
+                         pf.capture_child_tasks)
+                        if pf.placement_group_id else None)
         with self._lock:
             if name:
                 key = (ns, name)
@@ -703,6 +804,113 @@ class LocalRuntime(CoreRuntime):
 
     def available_resources(self):
         return self.ledger.snapshot()
+
+    # ------------------------------------------------------ placement groups
+    def create_placement_group(self, req):
+        """Single-node placement: reserve the group's summed resources from
+        the main ledger (async-waiting while busy), then carve per-bundle
+        ledgers PG-targeted tasks charge (cluster analog: 2PC + per-bundle
+        availability in the node manager)."""
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        info = pb.PlacementGroupInfo(
+            group_id=req.group_id, name=req.name, strategy=req.strategy,
+            bundles=list(req.bundles), state="PENDING")
+        with self._lock:
+            self._pgroups[req.group_id] = info
+        total: Dict[str, float] = {}
+        for b in req.bundles:
+            for k, v in b.resources.items():
+                total[k] = total.get(k, 0.0) + v
+        infeasible = (
+            not self.ledger.feasible(total)
+            or (req.strategy == "STRICT_SPREAD" and len(req.bundles) > 1))
+        if infeasible:
+            info.state = "INFEASIBLE"
+            return
+
+        def place():
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline and not self._shutdown:
+                if self.ledger.try_acquire(total):
+                    ledgers: Dict[Any, _ResourceLedger] = {
+                        b.index: _ResourceLedger(dict(b.resources))
+                        for b in info.bundles}
+                    with self._lock:
+                        if info.state == "REMOVED":
+                            self.ledger.release(total)
+                            return
+                        self._pg_ledgers[bytes(req.group_id)] = ledgers
+                        for b in info.bundles:
+                            b.node_id = self.node_id.hex()
+                        info.state = "CREATED"
+                    return
+                time.sleep(0.02)
+            if info.state == "PENDING":
+                info.state = "INFEASIBLE"
+
+        self.pool.submit(place)
+
+    def remove_placement_group(self, group_id: bytes):
+        with self._lock:
+            info = self._pgroups.get(group_id)
+            if info is None or info.state == "REMOVED":
+                return
+            was_created = info.state == "CREATED"
+            info.state = "REMOVED"
+            ledgers = self._pg_ledgers.pop(group_id, None)
+        if was_created and ledgers is not None:
+            # Return the unconsumed share; charges held by still-running
+            # tasks drain into the orphaned bundle ledgers (accepted local-
+            # mode simplification — the cluster runtime credits the node).
+            freed: Dict[str, float] = {}
+            for led in ledgers.values():
+                for k, v in led.snapshot().items():
+                    freed[k] = freed.get(k, 0.0) + v
+            self.ledger.release(freed)
+            self._dispatch_queue.put(False)
+
+    def get_placement_group(self, group_id: bytes):
+        with self._lock:
+            return self._pgroups.get(group_id)
+
+    def current_placement_group_id(self):
+        from ray_tpu._private import pg_context
+
+        ctx = pg_context.get()
+        return ctx[0] if ctx else None
+
+    def _pg_bundle_ledger(self, group_id: bytes, bundle_index: int) \
+            -> _ResourceLedger:
+        """Ledger a PG-targeted task charges; blocks while the group places."""
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline and not self._shutdown:
+            with self._lock:
+                info = self._pgroups.get(group_id)
+                state = info.state if info is not None else None
+                ledgers = self._pg_ledgers.get(group_id)
+            if info is None:
+                raise exceptions.RayTpuError(
+                    f"placement group {group_id.hex()[:12]} does not exist")
+            if state == "REMOVED":
+                raise exceptions.RayTpuError(
+                    f"placement group {group_id.hex()[:12]} was removed")
+            if state == "INFEASIBLE":
+                raise exceptions.RayTpuError(
+                    f"placement group {group_id.hex()[:12]} is infeasible")
+            if state == "CREATED" and ledgers is not None:
+                if bundle_index < 0:
+                    return _AnyBundleLedger(ledgers)
+                led = ledgers.get(bundle_index)
+                if led is None:
+                    raise exceptions.RayTpuError(
+                        f"bundle index {bundle_index} does not exist in "
+                        f"placement group {group_id.hex()[:12]}")
+                return led
+            time.sleep(0.01)
+        raise exceptions.RayTpuError(
+            f"timed out waiting for placement group "
+            f"{group_id.hex()[:12]} to be placed")
 
     def shutdown(self):
         if self._shutdown:

@@ -86,19 +86,39 @@ def feasible_anywhere(nodes: Sequence[pb.NodeInfo], demand: Dict[str, float]) ->
 # ---------------------------------------------------------------- bundles
 
 def place_bundles(
-    info: pb.PlacementGroupInfo, nodes: Sequence[pb.NodeInfo]
+    info: pb.PlacementGroupInfo, nodes: Sequence[pb.NodeInfo],
+    pending: Optional[Sequence] = None,
+    occupied: Sequence[str] = (),
 ) -> Optional[List[str]]:
-    """Assign each bundle a node id per strategy; None if infeasible now.
+    """Assign each pending bundle a node id per strategy; None if infeasible
+    now.
 
     PACK/STRICT_PACK prefer one node — and among multi-node fallbacks, nodes
     sharing one ``tpu-slice`` label (ICI-connected) are preferred over
     arbitrary nodes (TPU-topology-aware packing).
+
+    ``pending``/``occupied`` support partial re-placement after a node death
+    (reference: gcs_placement_group_manager.cc:585): only ``pending`` bundles
+    are assigned; ``occupied`` lists nodes hosting the group's surviving
+    bundles — STRICT_SPREAD avoids them, STRICT_PACK requires them.
     """
-    bundles = list(info.bundles)
+    bundles = list(pending) if pending is not None else list(info.bundles)
     strategy = info.strategy or "PACK"
     alive = [n for n in nodes if n.alive]
     if not alive:
         return None
+    if occupied:
+        if strategy == "STRICT_PACK":
+            # Survivors fix the node: everything re-placed must join them.
+            home = occupied[0]
+            node = next((n for n in alive if n.node_id == home), None)
+            if node is None or not _all_fit(bundles, [dict(node.available)]):
+                return None
+            return [home] * len(bundles)
+        if strategy == "STRICT_SPREAD":
+            alive = [n for n in alive if n.node_id not in set(occupied)]
+            if not alive:
+                return None
 
     def bundle_demand(b) -> Dict[str, float]:
         return dict(b.resources)

@@ -28,6 +28,7 @@ import traceback
 from typing import Any, Dict, List, Optional
 
 from ray_tpu import exceptions
+from ray_tpu._private import pg_context
 from ray_tpu._private import rpc
 from ray_tpu._private import worker as worker_mod
 from ray_tpu._private.ids import ActorID
@@ -53,6 +54,7 @@ class _ActorRunner:
         self.cond = threading.Condition()
         self.next_seq: Dict[bytes, int] = {}
         self.dead = False
+        self.pg_ctx: Optional[tuple] = None  # (group_id, bundle_idx, capture)
 
     def wait_turn(self, caller: bytes, seq: int) -> bool:
         deadline = time.monotonic() + 120.0
@@ -166,7 +168,17 @@ class WorkerServer:
                     # ordering that makes the zero-dip race impossible.
                     self.runtime.refs.flush()
                 args, kwargs = self._resolve_args(args, kwargs)
-                result = fn(*args, **kwargs)
+                if spec.placement_group_id:
+                    # Children of a capturing task inherit its group
+                    # (placement_group_capture_child_tasks semantics).
+                    pg_context.set(bytes(spec.placement_group_id),
+                                   spec.pg_bundle_index,
+                                   spec.pg_capture_child_tasks)
+                try:
+                    result = fn(*args, **kwargs)
+                finally:
+                    if spec.placement_group_id:
+                        pg_context.clear()
                 if hasattr(result, "__next__"):  # generator tasks
                     result = tuple(result) if len(spec.return_ids) > 1 \
                         else list(result)
@@ -191,7 +203,13 @@ class WorkerServer:
                 self.runtime.refs.flush()  # borrow-before-pin-release order
             args, kwargs = self._resolve_args(args, kwargs)
             method = getattr(runner.instance, spec.method_name)
-            result = method(*args, **kwargs)
+            if runner.pg_ctx is not None:
+                pg_context.set(*runner.pg_ctx)
+            try:
+                result = method(*args, **kwargs)
+            finally:
+                if runner.pg_ctx is not None:
+                    pg_context.clear()
             return self._package_results(result, spec.return_ids)
         except exceptions.AsyncioActorExit:
             self._terminate_actor(spec.actor_id, "exit_actor() called")
@@ -211,8 +229,20 @@ class WorkerServer:
                 loads_payload(outer["payload"])
             if n_borrows:
                 self.runtime.refs.flush()  # borrow-before-pin-release order
-            instance = cls(*args, **kwargs)
-            self._actors[bytes(info.actor_id)] = _ActorRunner(instance)
+            pg_ctx = None
+            if outer.get("pg") is not None:
+                gid, idx = outer["pg"]
+                pg_ctx = (gid, idx, bool(outer.get("pg_capture")))
+            if pg_ctx is not None:
+                pg_context.set(*pg_ctx)
+            try:
+                instance = cls(*args, **kwargs)
+            finally:
+                if pg_ctx is not None:
+                    pg_context.clear()
+            runner = _ActorRunner(instance)
+            runner.pg_ctx = pg_ctx
+            self._actors[bytes(info.actor_id)] = runner
             return pb.CreateActorReply(ok=True)
         except BaseException as e:  # noqa: BLE001
             return pb.CreateActorReply(

@@ -42,12 +42,11 @@ class PlacementGroup:
         from ray_tpu._private import worker as worker_mod
 
         core = worker_mod.global_worker().core
-        reply = core.gcs.GetPlacementGroup(
-            pb.GetPlacementGroupRequest(group_id=self.id))
-        if not reply.found:
+        info = core.get_placement_group(self.id)
+        if info is None:
             raise ValueError(
                 f"placement group {self.id.hex()[:12]} does not exist")
-        return reply.info
+        return info
 
     def wait(self, timeout_seconds: float = 30.0) -> bool:
         """Block until every bundle is reserved (state CREATED).
@@ -135,8 +134,10 @@ def placement_group_table(pg: Optional[PlacementGroup] = None) -> Dict:
 
     core = worker_mod.global_worker().core
     if pg is not None:
-        info = core.gcs.GetPlacementGroup(
-            pb.GetPlacementGroupRequest(group_id=pg.id)).info
+        info = core.get_placement_group(pg.id)
+        if info is None:
+            raise ValueError(
+                f"placement group {pg.id.hex()[:12]} does not exist")
         return _info_to_dict(info)
     raise NotImplementedError("pass a PlacementGroup handle")
 

@@ -1,0 +1,280 @@
+"""Placement group tests (reference: python/ray/tests/test_placement_group*.py).
+
+Covers the public API end-to-end against a multi-node in-process cluster:
+strategy semantics (PACK/SPREAD/STRICT_*), bundle-charged scheduling for
+tasks and actors, capture of child tasks, removal releasing reservations,
+TPU-slice-aware PACK, and the local (single-process) runtime's PG support.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+    get_current_placement_group,
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+@pytest.fixture(scope="module")
+def pg_cluster():
+    c = Cluster(head_node_args={"num_cpus": 2})
+    c.add_node(num_cpus=4, labels={"tpu-slice": "slice-a"})
+    c.add_node(num_cpus=4, labels={"tpu-slice": "slice-a"})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@ray_tpu.remote
+def my_node():
+    return ray_tpu.get_runtime_context().get_node_id()
+
+
+@ray_tpu.remote
+def sleeper(t):
+    time.sleep(t)
+    return ray_tpu.get_runtime_context().get_node_id()
+
+
+def test_create_wait_and_table(pg_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    try:
+        assert pg.wait(30)
+        table = placement_group_table(pg)
+        assert table["state"] == "CREATED"
+        assert table["strategy"] == "PACK"
+        assert set(table["bundles"]) == {0, 1}
+        assert all(table["bundles_to_node_id"].values())
+    finally:
+        remove_placement_group(pg)
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="strategy"):
+        placement_group([{"CPU": 1}], strategy="DIAGONAL")
+    with pytest.raises(ValueError, match="at least one"):
+        placement_group([])
+    with pytest.raises(ValueError, match="non-empty"):
+        placement_group([{}])
+
+
+def test_task_targets_its_bundle(pg_cluster):
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="STRICT_SPREAD")
+    try:
+        assert pg.wait(30)
+        nodes = pg.bundle_node_ids()
+        assert len(set(nodes)) == 2  # strict spread: distinct nodes
+        got0 = ray_tpu.get(my_node.options(
+            placement_group=pg, placement_group_bundle_index=0).remote(),
+            timeout=60)
+        got1 = ray_tpu.get(my_node.options(
+            placement_group=pg, placement_group_bundle_index=1).remote(),
+            timeout=60)
+        assert got0 == nodes[0]
+        assert got1 == nodes[1]
+    finally:
+        remove_placement_group(pg)
+
+
+def test_scheduling_strategy_object(pg_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    try:
+        assert pg.wait(30)
+        got = ray_tpu.get(my_node.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg,
+                placement_group_bundle_index=0)).remote(), timeout=60)
+        assert got == pg.bundle_node_ids()[0]
+    finally:
+        remove_placement_group(pg)
+
+
+def test_ready_schedules_through_bundle(pg_cluster):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    try:
+        assert ray_tpu.get(pg.ready(), timeout=60) is True
+    finally:
+        remove_placement_group(pg)
+
+
+def test_bundle_resources_constrain_concurrency(pg_cluster):
+    # One 1-CPU bundle: two 1-CPU tasks confined to it must serialize even
+    # though the cluster has plenty of free CPU elsewhere.
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    try:
+        assert pg.wait(30)
+        start = time.monotonic()
+        refs = [sleeper.options(num_cpus=1, placement_group=pg).remote(0.5)
+                for _ in range(2)]
+        ray_tpu.get(refs, timeout=60)
+        assert time.monotonic() - start >= 0.95
+    finally:
+        remove_placement_group(pg)
+
+
+def test_strict_pack_lands_on_one_node(pg_cluster):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}, {"CPU": 1}],
+                         strategy="STRICT_PACK")
+    try:
+        assert pg.wait(30)
+        assert len(set(pg.bundle_node_ids())) == 1
+    finally:
+        remove_placement_group(pg)
+
+
+def test_pack_spans_one_ici_slice(pg_cluster):
+    # 3+3 CPUs fit no single node (max 4), so PACK spills across nodes —
+    # and must prefer the two nodes sharing the ``tpu-slice`` label (one
+    # ICI domain) over mixing in the unlabeled head node.
+    slice_nodes = {n.node_id for n in pg_cluster.nodes
+                   if getattr(n, "labels", {}).get("tpu-slice") == "slice-a"}
+    pg = placement_group([{"CPU": 3}, {"CPU": 3}], strategy="PACK")
+    try:
+        assert pg.wait(30)
+        assert set(pg.bundle_node_ids()) <= slice_nodes
+    finally:
+        remove_placement_group(pg)
+
+
+def test_actor_in_placement_group(pg_cluster):
+    @ray_tpu.remote(num_cpus=1)
+    class Where:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    try:
+        assert pg.wait(30)
+        a = Where.options(placement_group=pg,
+                          placement_group_bundle_index=0).remote()
+        assert ray_tpu.get(a.node.remote(), timeout=60) == \
+            pg.bundle_node_ids()[0]
+        ray_tpu.kill(a)
+    finally:
+        remove_placement_group(pg)
+
+
+def test_capture_child_tasks(pg_cluster):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+
+    @ray_tpu.remote(num_cpus=1)
+    def parent():
+        current = get_current_placement_group()
+        child = my_node.options(num_cpus=1).remote()
+        return (current.id if current else None,
+                ray_tpu.get(child, timeout=60))
+
+    try:
+        assert pg.wait(30)
+        seen_id, child_node = ray_tpu.get(parent.options(
+            scheduling_strategy=PlacementGroupSchedulingStrategy(
+                placement_group=pg, placement_group_bundle_index=0,
+                placement_group_capture_child_tasks=True)).remote(),
+            timeout=60)
+        assert seen_id == pg.id
+        assert child_node == pg.bundle_node_ids()[0]
+    finally:
+        remove_placement_group(pg)
+
+
+def test_remove_releases_reservation(pg_cluster):
+    # Reserve almost everything, remove, then a demanding task must run.
+    pg = placement_group([{"CPU": 3}, {"CPU": 3}], strategy="SPREAD")
+    assert pg.wait(30)
+    remove_placement_group(pg)
+    got = ray_tpu.get(sleeper.options(num_cpus=4).remote(0.01), timeout=60)
+    assert got
+
+
+def test_infeasible_group(pg_cluster):
+    pg = placement_group([{"CPU": 100}], strategy="PACK")
+    try:
+        assert pg.wait(10) is False
+        assert placement_group_table(pg)["state"] == "INFEASIBLE"
+        with pytest.raises(Exception, match="infeasible|satisfy"):
+            ray_tpu.get(my_node.options(placement_group=pg).remote(),
+                        timeout=60)
+    finally:
+        remove_placement_group(pg)
+
+
+def test_node_affinity_strategy(pg_cluster):
+    target = pg_cluster.nodes[-1].node_id
+    got = ray_tpu.get(my_node.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=target, soft=False)).remote(), timeout=60)
+    assert got == target
+
+
+def test_node_affinity_dead_node_raises(pg_cluster):
+    with pytest.raises(Exception, match="not alive"):
+        ray_tpu.get(my_node.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id="deadbeef", soft=False)).remote(), timeout=60)
+
+
+def test_spread_strategy_string(pg_cluster):
+    nodes = ray_tpu.get([my_node.options(
+        scheduling_strategy="SPREAD", num_cpus=1).remote()
+        for _ in range(4)], timeout=60)
+    assert len(set(nodes)) >= 2
+
+
+# ---------------------------------------------------------------- local mode
+
+def test_local_mode_placement_group(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+    assert placement_group_table(pg)["state"] == "CREATED"
+
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return 42
+
+    assert ray_tpu.get(f.options(
+        placement_group=pg, placement_group_bundle_index=0).remote(),
+        timeout=30) == 42
+    # Serialized within one 1-CPU bundle:
+    @ray_tpu.remote(num_cpus=1)
+    def nap():
+        time.sleep(0.3)
+        return 1
+
+    start = time.monotonic()
+    ray_tpu.get([nap.options(placement_group=pg,
+                             placement_group_bundle_index=0).remote()
+                 for _ in range(2)], timeout=30)
+    assert time.monotonic() - start >= 0.55
+    remove_placement_group(pg)
+    assert placement_group_table(pg)["state"] == "REMOVED"
+
+
+def test_local_mode_infeasible(ray_start_regular):
+    pg = placement_group([{"CPU": 1000}])
+    assert pg.wait(5) is False
+
+
+def test_local_mode_capture(ray_start_regular):
+    pg = placement_group([{"CPU": 2}])
+    assert pg.wait(10)
+
+    @ray_tpu.remote(num_cpus=1)
+    def parent():
+        cur = get_current_placement_group()
+        return cur.id if cur else None
+
+    got = ray_tpu.get(parent.options(
+        scheduling_strategy=PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0,
+            placement_group_capture_child_tasks=True)).remote(), timeout=30)
+    assert got == pg.id
+    remove_placement_group(pg)
