@@ -1,0 +1,192 @@
+"""Core-runtime microbenchmarks.
+
+Reference: ``python/ray/_private/ray_perf.py:93-241`` +
+``release/microbenchmark/run_microbenchmark.py`` — the accountability
+instrument for core throughput properties (single in-flight task per worker,
+lease path, GCS-central directory, channel hops). Runs against a real
+in-process cluster (GCS + node manager + OS worker processes), prints one
+JSON line per metric, and writes ``BENCH_CORE_r{N}.json``.
+
+Usage: python bench_core.py [--round N] [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def timed(fn, *args):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    return time.perf_counter() - t0, out
+
+
+def bench_tasks_per_s(ray_tpu, n):
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return 0
+
+    ray_tpu.get(nop.remote(), timeout=60)  # warm a worker
+    dt, _ = timed(lambda: ray_tpu.get([nop.remote() for _ in range(n)],
+                                      timeout=300))
+    return n / dt
+
+
+def bench_task_roundtrip_us(ray_tpu, n):
+    @ray_tpu.remote(num_cpus=0)
+    def nop():
+        return 0
+
+    ray_tpu.get(nop.remote(), timeout=60)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ray_tpu.get(nop.remote(), timeout=60)
+    return (time.perf_counter() - t0) / n * 1e6
+
+
+def _actor(ray_tpu):
+    @ray_tpu.remote(num_cpus=0)
+    class A:
+        def m(self, x=0):
+            return x
+
+    return A.remote()
+
+
+def bench_actor_calls_sync_per_s(ray_tpu, n):
+    a = _actor(ray_tpu)
+    ray_tpu.get(a.m.remote(), timeout=60)
+    dt, _ = timed(lambda: [ray_tpu.get(a.m.remote(), timeout=60)
+                           for _ in range(n)])
+    return n / dt
+
+
+def bench_actor_calls_async_per_s(ray_tpu, n):
+    a = _actor(ray_tpu)
+    ray_tpu.get(a.m.remote(), timeout=60)
+    dt, _ = timed(lambda: ray_tpu.get([a.m.remote(i) for i in range(n)],
+                                      timeout=300))
+    return n / dt
+
+
+def bench_put_small_per_s(ray_tpu, n):
+    payload = b"x" * 1024
+    dt, _ = timed(lambda: [ray_tpu.put(payload) for _ in range(n)])
+    return n / dt
+
+
+def bench_put_get_large_gbps(ray_tpu, n_mb=64, chunk_mb=16):
+    arr = np.random.default_rng(0).integers(
+        0, 255, size=chunk_mb << 20, dtype=np.uint8)
+    refs = []
+    reps = max(1, n_mb // chunk_mb)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        refs.append(ray_tpu.put(arr))
+    put_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs = ray_tpu.get(refs, timeout=300)
+    get_dt = time.perf_counter() - t0
+    assert all(o.nbytes == arr.nbytes for o in outs)
+    total_gb = reps * arr.nbytes / 1e9
+    return total_gb / put_dt, total_gb / get_dt
+
+
+def bench_wait_fanin_s(ray_tpu, n):
+    @ray_tpu.remote(num_cpus=0)
+    def val(i):
+        return i
+
+    refs = [val.remote(i) for i in range(n)]
+    t0 = time.perf_counter()
+    ready, not_ready = ray_tpu.wait(refs, num_returns=n, timeout=300)
+    dt = time.perf_counter() - t0
+    assert len(ready) == n
+    return dt
+
+
+def bench_dag_hop(ray_tpu, n):
+    """Compiled-DAG hop latency vs the equivalent actor-call round-trip."""
+    from ray_tpu.dag import InputNode
+
+    @ray_tpu.remote(num_cpus=0)
+    class Ident:
+        def f(self, x):
+            return x
+
+    rpc_actor = Ident.remote()
+    dag_actor = Ident.remote()
+    with InputNode() as x:
+        dag = dag_actor.f.bind(x)
+    compiled = dag.experimental_compile()
+    try:
+        ray_tpu.get(compiled.execute(0), timeout=60)
+        ray_tpu.get(rpc_actor.f.remote(0), timeout=60)
+        t0 = time.perf_counter()
+        for i in range(n):
+            ray_tpu.get(compiled.execute(i), timeout=60)
+        dag_us = (time.perf_counter() - t0) / n * 1e6
+        t0 = time.perf_counter()
+        for i in range(n):
+            ray_tpu.get(rpc_actor.f.remote(i), timeout=60)
+        rpc_us = (time.perf_counter() - t0) / n * 1e6
+        return dag_us, rpc_us
+    finally:
+        compiled.teardown()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--round", type=int, default=3)
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(head_node_args={"num_cpus": 4})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+
+    scale = 0.2 if args.quick else 1.0
+    n_tasks = int(500 * scale)
+    n_calls = int(500 * scale)
+    n_wait = int(1000 * scale)
+
+    metrics = {}
+    metrics["tasks_per_s"] = round(bench_tasks_per_s(ray_tpu, n_tasks), 1)
+    metrics["task_roundtrip_us"] = round(
+        bench_task_roundtrip_us(ray_tpu, max(50, n_tasks // 5)), 1)
+    metrics["actor_calls_sync_per_s"] = round(
+        bench_actor_calls_sync_per_s(ray_tpu, n_calls), 1)
+    metrics["actor_calls_async_per_s"] = round(
+        bench_actor_calls_async_per_s(ray_tpu, n_calls), 1)
+    metrics["put_1kb_per_s"] = round(
+        bench_put_small_per_s(ray_tpu, int(2000 * scale)), 1)
+    put_gbps, get_gbps = bench_put_get_large_gbps(
+        ray_tpu, n_mb=int(64 * scale) or 16)
+    metrics["put_large_gb_per_s"] = round(put_gbps, 3)
+    metrics["get_large_gb_per_s"] = round(get_gbps, 3)
+    metrics["wait_1k_fanin_s"] = round(bench_wait_fanin_s(ray_tpu, n_wait), 3)
+    dag_us, rpc_us = bench_dag_hop(ray_tpu, max(100, int(200 * scale)))
+    metrics["compiled_dag_hop_us"] = round(dag_us, 1)
+    metrics["actor_call_roundtrip_us"] = round(rpc_us, 1)
+    metrics["dag_vs_rpc_speedup"] = round(rpc_us / dag_us, 2)
+
+    ray_tpu.shutdown()
+    c.shutdown()
+
+    for k, v in metrics.items():
+        print(json.dumps({"metric": k, "value": v}))
+    out = f"BENCH_CORE_r{args.round:02d}.json"
+    with open(out, "w") as f:
+        json.dump({"metrics": metrics, "ts": time.time()}, f, indent=1)
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,221 @@
+// Mutable shared-memory channel for compiled DAGs.
+//
+// Reference analog: the mutable-object channel of accelerated DAGs
+// (src/ray/core_worker/experimental_mutable_object_manager.h and
+// python/ray/experimental/channel/shared_memory_channel.py:151): one
+// fixed-capacity buffer a writer mutates in place and N readers consume,
+// synchronized without RPCs so a DAG hop costs microseconds, not a
+// lease/submit round-trip.
+//
+// Protocol (single writer, up to MAX_READERS readers, seqlock-style):
+//   * `version` is even when the buffer is stable, odd while the writer
+//     mutates it. Stable versions advance 0 -> 2 -> 4 ...
+//   * a reader waits for an even version newer than the one it last
+//     consumed, copies the payload, re-checks the version (seqlock
+//     validate), then stores the version in its ack slot.
+//   * the writer waits until every ack slot equals the current version
+//     before mutating, so a payload is never overwritten while a reader
+//     still owes a read. This is the in-place analog of the reference's
+//     WriteAcquire/ReadRelease cycle.
+//   * close() publishes a sentinel size; readers observe it and return
+//     CHAN_CLOSED forever after.
+//
+// Waits spin briefly then back off to nanosleep, releasing the GIL the
+// whole time (callers come through ctypes).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMaxReaders = 16;
+constexpr uint32_t kMagic = 0x52544348;  // "RTCH"
+
+struct alignas(64) Header {
+  uint32_t magic;
+  uint32_t n_readers;
+  uint64_t capacity;
+  std::atomic<uint64_t> version;
+  std::atomic<uint64_t> size;
+  std::atomic<uint64_t> closed;  // set once; never clobbers a pending value
+  alignas(64) std::atomic<uint64_t> acks[kMaxReaders];
+};
+
+struct Handle {
+  Header* hdr;
+  char* data;
+  size_t map_bytes;
+  int reader_idx;          // -1 for the writer
+  uint64_t last_seen;      // reader: last consumed version
+  char name[256];
+};
+
+double now_s() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+// Spin ~4k iterations, then sleep in escalating steps capped at 20us —
+// the cap bounds wake latency (a hop's critical path is one wake) while
+// still yielding the core to the peer process on small machines. Returns
+// false on timeout (timeout_s < 0 means wait forever).
+template <typename Pred>
+bool wait_until(Pred pred, double timeout_s) {
+  for (int i = 0; i < 4000; ++i) {
+    if (pred()) return true;
+  }
+  double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
+  long ns = 1000;
+  while (true) {
+    if (pred()) return true;
+    if (deadline > 0 && now_s() > deadline) return pred();
+    struct timespec ts{0, ns};
+    nanosleep(&ts, nullptr);
+    if (ns < 20000) ns *= 2;
+  }
+}
+
+Handle* map_channel(const char* name, uint64_t capacity, bool create,
+                    uint32_t n_readers, int reader_idx) {
+  size_t bytes = sizeof(Header) + capacity;
+  int flags = create ? (O_CREAT | O_EXCL | O_RDWR) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  if (create && ftruncate(fd, (off_t)bytes) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  if (!create) {
+    // Capacity comes from the header for attachers.
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(Header)) {
+      close(fd);
+      return nullptr;
+    }
+    bytes = st.st_size;
+  }
+  void* mem = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* hdr = static_cast<Header*>(mem);
+  if (create) {
+    std::memset(mem, 0, sizeof(Header));
+    hdr->capacity = capacity;
+    hdr->n_readers = n_readers;
+    hdr->magic = kMagic;  // last: attachers poll for it
+  } else if (hdr->magic != kMagic) {
+    munmap(mem, bytes);
+    return nullptr;
+  }
+  Handle* h = new Handle();
+  h->hdr = hdr;
+  h->data = static_cast<char*>(mem) + sizeof(Header);
+  h->map_bytes = bytes;
+  h->reader_idx = reader_idx;
+  h->last_seen = 0;
+  std::snprintf(h->name, sizeof(h->name), "%s", name);
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Writer-side create. Returns NULL on failure.
+void* chan_create(const char* name, uint64_t capacity, uint32_t n_readers) {
+  if (n_readers == 0 || n_readers > kMaxReaders) return nullptr;
+  return map_channel(name, capacity, /*create=*/true, n_readers, -1);
+}
+
+// Attach an existing channel; reader_idx in [0, n_readers) for readers,
+// -1 to attach as (take over) the writer.
+void* chan_attach(const char* name, int reader_idx) {
+  return map_channel(name, 0, /*create=*/false, 0, reader_idx);
+}
+
+uint64_t chan_capacity(void* handle) {
+  return static_cast<Handle*>(handle)->hdr->capacity;
+}
+
+// 0 ok, -1 timeout, -2 payload too large, -3 channel closed.
+int chan_write(void* handle, const char* buf, uint64_t len, double timeout_s) {
+  Handle* h = static_cast<Handle*>(handle);
+  Header* hdr = h->hdr;
+  if (len > hdr->capacity) return -2;
+  if (hdr->closed.load(std::memory_order_acquire)) return -3;
+  uint64_t v = hdr->version.load(std::memory_order_relaxed);
+  uint32_t n = hdr->n_readers;
+  auto all_acked = [&] {
+    for (uint32_t i = 0; i < n; ++i) {
+      if (hdr->acks[i].load(std::memory_order_acquire) != v) return false;
+    }
+    return true;
+  };
+  if (!wait_until(all_acked, timeout_s)) return -1;
+  hdr->version.store(v + 1, std::memory_order_release);  // odd: mutating
+  std::memcpy(h->data, buf, len);
+  hdr->size.store(len, std::memory_order_release);
+  hdr->version.store(v + 2, std::memory_order_release);  // even: stable
+  return 0;
+}
+
+// >=0: payload size copied into out, -1 timeout, -3 closed, -4 out_cap too
+// small (payload left unconsumed).
+int64_t chan_read(void* handle, char* out, uint64_t out_cap, double timeout_s) {
+  Handle* h = static_cast<Handle*>(handle);
+  Header* hdr = h->hdr;
+  auto fresh = [&] {
+    uint64_t v = hdr->version.load(std::memory_order_acquire);
+    return (v % 2 == 0 && v != h->last_seen) ||
+           hdr->closed.load(std::memory_order_acquire);
+  };
+  if (!wait_until(fresh, timeout_s)) return -1;
+  while (true) {
+    uint64_t v = hdr->version.load(std::memory_order_acquire);
+    if (v % 2 != 0) continue;  // writer mid-mutation; stable soon
+    if (v == h->last_seen) {
+      // No unconsumed value; closed means no more will ever arrive.
+      if (hdr->closed.load(std::memory_order_acquire)) return -3;
+      continue;
+    }
+    uint64_t len = hdr->size.load(std::memory_order_acquire);
+    if (len > out_cap) return -4;
+    std::memcpy(out, h->data, len);
+    // Seqlock validate: a torn copy shows as a version change.
+    if (hdr->version.load(std::memory_order_acquire) == v) {
+      h->last_seen = v;
+      if (h->reader_idx >= 0) {
+        hdr->acks[h->reader_idx].store(v, std::memory_order_release);
+      }
+      return (int64_t)len;
+    }
+  }
+}
+
+// Publish the closed flag. A value written before close is still readable;
+// reads past it return -3.
+void chan_close(void* handle) {
+  static_cast<Handle*>(handle)->hdr->closed.store(
+      1, std::memory_order_release);
+}
+
+void chan_detach(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  munmap(h->hdr, h->map_bytes);
+  delete h;
+}
+
+void chan_unlink(const char* name) { shm_unlink(name); }
+
+}  // extern "C"

@@ -586,7 +586,11 @@ class GcsServer:
                 if resubmit:
                     self._submit_place(info)
 
-        self._work_pool.submit(run)
+        # Dedicated thread, NOT the bounded work pool: PG-targeted actor
+        # creations occupy pool slots waiting for CREATED — a placement run
+        # queued behind them would deadlock the pool.
+        threading.Thread(target=run, daemon=True,
+                         name=f"pg-place-{gid.hex()[:8]}").start()
 
     def _place_group(self, info: pb.PlacementGroupInfo):
         """2PC bundle placement (reference: GcsPlacementGroupScheduler
@@ -673,15 +677,19 @@ class GcsServer:
                             bundle.node_id = node_id
                     if all(b.node_id for b in info.bundles):
                         info.state = "CREATED"
+            # Nodes whose commit failed still hold a prepared reservation;
+            # cancel it or their capacity leaks (prepare debits available).
+            uncommitted = ([] if rollback
+                           else [n for n in by_node if n not in committed])
+            for node_id in (list(by_node) if rollback else uncommitted):
+                stub = self._node_stub(node_id)
+                if stub:
+                    try:
+                        stub.CancelBundle(pb.CancelBundleRequest(
+                            group_id=info.group_id))
+                    except Exception:  # noqa: BLE001
+                        pass
             if rollback:
-                for node_id in committed:
-                    stub = self._node_stub(node_id)
-                    if stub:
-                        try:
-                            stub.CancelBundle(pb.CancelBundleRequest(
-                                group_id=info.group_id))
-                        except Exception:  # noqa: BLE001
-                            pass
                 return
             if len(committed) < len(by_node):
                 time.sleep(0.2)

@@ -291,14 +291,43 @@ class NodeManager:
             self._workers[worker_id] = worker
         return worker
 
-    def _pop_worker(self, timeout_s: float = 30.0) -> Optional[_Worker]:
-        """Reference: WorkerPool::PopWorker (worker_pool.cc:1355)."""
-        with self._pool_lock:
-            while self._idle:
-                wid = self._idle.pop()
-                w = self._workers.get(wid)
-                if w and w.proc.poll() is None:
-                    return w
+    def _pop_worker(self, timeout_s: float = 30.0,
+                    for_actor: bool = False) -> Optional[_Worker]:
+        """Reference: WorkerPool::PopWorker (worker_pool.cc:1355).
+
+        Task-worker spawn is capped (reference: maximum_startup_concurrency):
+        a burst of zero-CPU leases must not fork-bomb the host — beyond the
+        cap the lease waits briefly for a worker to free and otherwise
+        retries from the client with backoff. Dedicated actor workers count
+        against a separate, much larger cap (actors legitimately number in
+        the dozens; their admission is governed by resources, not the pool).
+        """
+        if for_actor:
+            cap = int(os.environ.get("RAY_TPU_MAX_ACTOR_WORKERS", 128))
+        else:
+            cap = int(os.environ.get(
+                "RAY_TPU_MAX_WORKERS",
+                max(4, int(self.total.get("CPU", 4)) * 2)))
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._pool_lock:
+                while self._idle:
+                    wid = self._idle.pop()
+                    w = self._workers.get(wid)
+                    if w and w.proc.poll() is None:
+                        return w
+                if for_actor:
+                    used = sum(1 for w in self._workers.values()
+                               if w.is_actor_worker)
+                else:
+                    used = sum(1 for w in self._workers.values()
+                               if not w.is_actor_worker)
+                can_spawn = used < cap
+            if can_spawn:
+                break
+            if time.monotonic() + 29.0 > deadline:  # wait ≤1s at the cap
+                return None
+            time.sleep(0.005)
         worker = self._spawn_worker()
         if worker.ready.wait(timeout_s):
             return worker
@@ -506,7 +535,7 @@ class NodeManager:
         elif not self._try_acquire(demand, holder=bytes(info.actor_id)):
             return pb.CreateActorOnNodeReply(
                 ok=False, error="insufficient resources")
-        worker = self._pop_worker()
+        worker = self._pop_worker(for_actor=True)
         if worker is None:
             if not self._release_pg_holder(bytes(info.actor_id), demand):
                 self._release(demand, holder=bytes(info.actor_id))
@@ -549,6 +578,11 @@ class NodeManager:
         for b in request.bundles:
             for k, v in b.resources.items():
                 total_demand[k] += v
+        # A re-prepare for the same group supersedes the previous attempt;
+        # release the stale reservation or it leaks (each prepare debits).
+        stale = self._prepared.pop(request.group_id, None)
+        if stale is not None:
+            self._release(stale)
         if self._try_acquire(dict(total_demand)):
             self._prepared[request.group_id] = dict(total_demand)
             return pb.PrepareBundleReply(success=True)

@@ -224,8 +224,14 @@ class _LocalActor:
         token = _context.set(_TaskCtx(task_id, self.actor_id,
                                       name=f"{self.cls.__name__}.{method_name}"))
         try:
-            method = getattr(self.instance, method_name)
-            result = method(*args, **kwargs)
+            if method_name == "__ray_dag_loop__":
+                # Compiled-DAG pinned loop (see experimental/channel.py).
+                from ray_tpu.experimental.channel import run_dag_loop
+
+                result = run_dag_loop(self.instance, *args)
+            else:
+                method = getattr(self.instance, method_name)
+                result = method(*args, **kwargs)
             if inspect.isgenerator(result):
                 self.runtime._store_generator(result, return_ids, task_id)
             else:
@@ -318,6 +324,10 @@ class _AnyBundleLedger:
         for led in self._ledgers:
             for k, v in led.total.items():
                 self.total[k] = max(self.total.get(k, 0.0), v)
+
+    @property
+    def dead(self) -> bool:
+        return any(getattr(l, "dead", False) for l in self._ledgers)
 
     def feasible(self, demand: Dict[str, float]) -> bool:
         return any(all(led.total.get(k, 0.0) + 1e-9 >= v
@@ -413,6 +423,14 @@ class LocalRuntime(CoreRuntime):
             still_pending = []
             for t in self._pending:
                 led = t.ledger if t.ledger is not None else self.ledger
+                if t.ledger is not None and getattr(led, "dead", False):
+                    # The task's placement group was removed while it was
+                    # queued (cluster analog: pg-unknown lease rejection).
+                    self._store_error(
+                        exceptions.RayTpuError(
+                            "placement group was removed before the task "
+                            "could be scheduled"), t.return_ids)
+                    continue
                 if not led.feasible(t.demand):
                     if not t.warned:
                         t.warned = True
@@ -863,8 +881,11 @@ class LocalRuntime(CoreRuntime):
             # Return the unconsumed share; charges held by still-running
             # tasks drain into the orphaned bundle ledgers (accepted local-
             # mode simplification — the cluster runtime credits the node).
+            # ``dead`` stops the dispatcher from admitting queued PG tasks
+            # out of the orphaned ledgers (that capacity was just freed).
             freed: Dict[str, float] = {}
             for led in ledgers.values():
+                led.dead = True
                 for k, v in led.snapshot().items():
                     freed[k] = freed.get(k, 0.0) + v
             self.ledger.release(freed)

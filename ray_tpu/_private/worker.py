@@ -143,6 +143,12 @@ def put(value: Any, *, _owner=None) -> ObjectRef:
 
 def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *,
         timeout: Optional[float] = None):
+    from ray_tpu.dag import CompiledDAGRef
+
+    if isinstance(refs, CompiledDAGRef):
+        # Compiled-DAG results live in channels, not the object store
+        # (reference: ray.get on a CompiledDAGRef).
+        return refs.get(timeout=timeout)
     is_single = isinstance(refs, ObjectRef)
     if is_single:
         refs = [refs]

@@ -202,6 +202,14 @@ class WorkerServer:
             if n_borrows:
                 self.runtime.refs.flush()  # borrow-before-pin-release order
             args, kwargs = self._resolve_args(args, kwargs)
+            if spec.method_name == "__ray_dag_loop__":
+                # Compiled-DAG pinned loop: the actor executes its channel
+                # schedule until teardown (reference: aDAG ExecutableTask
+                # loop); this call occupies the actor by design.
+                from ray_tpu.experimental.channel import run_dag_loop
+
+                result = run_dag_loop(runner.instance, *args)
+                return self._package_results(result, spec.return_ids)
             method = getattr(runner.instance, spec.method_name)
             if runner.pg_ctx is not None:
                 pg_context.set(*runner.pg_ctx)

@@ -52,8 +52,9 @@ class DAGNode:
             id(n): v for n, v in zip(_collect_input_nodes(self), input_args)}
         return self._execute_cached(cache)
 
-    def experimental_compile(self) -> "CompiledDAG":
-        return CompiledDAG(self)
+    def experimental_compile(self, _buffer_size_bytes: Optional[int] = None) \
+            -> "CompiledDAG":
+        return CompiledDAG(self, buffer_size_bytes=_buffer_size_bytes)
 
 
 class InputNode(DAGNode):
@@ -158,23 +159,204 @@ def _collect_input_nodes(root: DAGNode) -> List[InputNode]:
     return seen
 
 
+class _UnsupportedDAG(Exception):
+    """Graph shape the channel compiler can't pin; interpreted fallback."""
+
+
+class CompiledDAGRef:
+    """Result handle of one compiled execution (reference:
+    ``CompiledDAGRef``): ``get()`` blocks on the DAG's output channel."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+
+    def get(self, timeout: Optional[float] = None):
+        return self._dag._get_result(self._seq, timeout)
+
+
 class CompiledDAG:
     """Reusable executable of a static DAG (reference:
-    ``dag/compiled_dag_node.py:767``). Actors are created once at compile
-    time; each ``execute`` only submits the per-call method chain."""
+    ``dag/compiled_dag_node.py:767``).
 
-    def __init__(self, root: DAGNode):
+    Compilation pins the DAG onto mutable shared-memory channels
+    (``experimental/channel.py``): every actor runs a ``__ray_dag_loop__``
+    schedule reading inputs and writing outputs in place, so a per-step hop
+    costs a channel write/read (microseconds) instead of a lease + RPC +
+    pickle round-trip. ``execute`` writes the input channel and returns a
+    ``CompiledDAGRef``; results stream out in submission order.
+
+    Graphs that don't fit the channel model (plain function nodes, no
+    InputNode) fall back to interpreted per-call submission.
+    """
+
+    def __init__(self, root: DAGNode, buffer_size_bytes: Optional[int] = None):
+        from ray_tpu.experimental import channel as chan
+
         self._root = root
+        self._chan = chan
+        self._capacity = buffer_size_bytes or chan.DEFAULT_CAPACITY
         # Materialize all ClassNodes now (actor startup off the hot path).
         warm: Dict[int, Any] = {}
         for node in _walk(root):
             if isinstance(node, ClassNode):
                 node._ensure_actor(warm)
+        self._warm = warm
+        self._lock = threading.RLock()
+        self._next_seq = 0
+        self._read_count = 0
+        self._partial: List[Any] = []
+        self._results: Dict[int, Any] = {}
+        self._torn_down = False
+        try:
+            self._build_channels()
+            self._channel_mode = True
+        except _UnsupportedDAG:
+            self._channel_mode = False
 
-    def execute(self, *input_args) -> Any:
-        return self._root.execute(*input_args)
+    # ------------------------------------------------------------- compile
+    def _topo_nodes(self) -> List[DAGNode]:
+        order: List[DAGNode] = []
+        seen = set()
+
+        def visit(node: DAGNode):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            deps = [a for a in list(node._bound_args)
+                    + list(node._bound_kwargs.values())
+                    if isinstance(a, DAGNode)]
+            for d in deps:
+                visit(d)
+            order.append(node)
+
+        visit(self._root)
+        return order
+
+    def _build_channels(self):
+        chan = self._chan
+        topo = self._topo_nodes()
+        inputs = [n for n in topo if isinstance(n, InputNode)]
+        outputs = (list(self._root._bound_args)
+                   if isinstance(self._root, MultiOutputNode)
+                   else [self._root])
+        compute = [n for n in topo
+                   if not isinstance(n, (InputNode, MultiOutputNode,
+                                         ClassNode))]
+        if len(inputs) != 1 or not compute:
+            raise _UnsupportedDAG("channel mode needs one InputNode")
+        if not all(isinstance(n, ActorMethodNode) for n in compute):
+            raise _UnsupportedDAG("channel mode pins actor methods only")
+        if not all(isinstance(o, ActorMethodNode) for o in outputs):
+            raise _UnsupportedDAG("outputs must be actor methods")
+
+        # Count consumer edges per producer (driver counts for outputs).
+        n_edges: Dict[int, int] = {}
+        for n in compute:
+            for a in list(n._bound_args) + list(n._bound_kwargs.values()):
+                if isinstance(a, DAGNode):
+                    n_edges[id(a)] = n_edges.get(id(a), 0) + 1
+        for o in outputs:
+            n_edges[id(o)] = n_edges.get(id(o), 0) + 1
+
+        self._channels: List[Any] = []
+        out_chan: Dict[int, Any] = {}
+        for n in [inputs[0]] + compute:
+            if id(n) not in n_edges:
+                raise _UnsupportedDAG(f"dangling node {n}")
+            c = chan.Channel(capacity=self._capacity,
+                             n_readers=n_edges[id(n)])
+            out_chan[id(n)] = c
+            self._channels.append(c)
+
+        next_idx: Dict[int, int] = {}
+
+        def reader_for(producer: DAGNode):
+            i = next_idx.get(id(producer), 0)
+            next_idx[id(producer)] = i + 1
+            return out_chan[id(producer)].reader(i)
+
+        # Per-actor executable schedule in topological order (reference:
+        # ExecutableTask lists, compiled_dag_node.py:161).
+        def handle_of(node: ActorMethodNode):
+            target = node._target
+            if isinstance(target, ClassNode):
+                target = target._ensure_actor(self._warm)
+            return target
+
+        per_actor: Dict[bytes, List[tuple]] = {}
+        actor_handles: Dict[bytes, Any] = {}
+        for n in compute:
+            h = handle_of(n)
+            key = h._actor_id.binary()
+            arg_slots = [reader_for(a) if isinstance(a, DAGNode) else a
+                         for a in n._bound_args]
+            kwarg_slots = {k: (reader_for(v) if isinstance(v, DAGNode)
+                               else v)
+                           for k, v in n._bound_kwargs.items()}
+            per_actor.setdefault(key, []).append(
+                (n._method_name, arg_slots, kwarg_slots, out_chan[id(n)]))
+            actor_handles[key] = h
+
+        # Driver endpoints (readers assigned after actor edges).
+        self._input_channel = out_chan[id(inputs[0])]
+        self._output_readers = [reader_for(o) for o in outputs]
+        self._multi_output = isinstance(self._root, MultiOutputNode)
+
+        from ray_tpu.actor import ActorMethod
+
+        self._loop_refs = [
+            ActorMethod(actor_handles[key], "__ray_dag_loop__").remote(ops)
+            for key, ops in per_actor.items()]
+
+    # ------------------------------------------------------------- execute
+    def execute(self, *input_args):
+        if self._torn_down:
+            raise RuntimeError("CompiledDAG was torn down")
+        if not self._channel_mode:
+            return self._root.execute(*input_args)
+        value = input_args[0] if len(input_args) == 1 else input_args
+        with self._lock:
+            # Write under the lock: the channel is single-writer, and the
+            # seq must match the write order.
+            self._input_channel.write(value)
+            seq = self._next_seq
+            self._next_seq += 1
+        return CompiledDAGRef(self, seq)
+
+    def _get_result(self, seq: int, timeout: Optional[float]):
+        chan = self._chan
+        with self._lock:
+            while seq >= self._read_count:
+                # Resume partially-read ticks: a timeout mid-tick must not
+                # discard values already consumed from earlier readers or
+                # every later result would pair mismatched executions.
+                while len(self._partial) < len(self._output_readers):
+                    r = self._output_readers[len(self._partial)]
+                    self._partial.append(r.read(timeout=timeout))
+                vals, self._partial = self._partial, []
+                self._results[self._read_count] = (
+                    vals if self._multi_output else vals[0])
+                self._read_count += 1
+            out = self._results.pop(seq)
+        for v in (out if isinstance(out, list) else [out]):
+            if isinstance(v, chan._StageError):
+                raise v.exc
+        return out
 
     def teardown(self):
+        if self._torn_down:
+            return
+        self._torn_down = True
+        if self._channel_mode:
+            self._input_channel.close()
+            for ref in self._loop_refs:
+                try:
+                    ray_tpu.get(ref, timeout=10)
+                except Exception:  # noqa: BLE001
+                    pass
+            for c in self._channels:
+                c.destroy()
         for node in _walk(self._root):
             if isinstance(node, ClassNode) and node._handle is not None:
                 try:
@@ -201,6 +383,6 @@ def _walk(root: DAGNode):
 
 
 __all__ = [
-    "ActorMethodNode", "ClassNode", "CompiledDAG", "DAGNode", "FunctionNode",
-    "InputNode", "MultiOutputNode",
+    "ActorMethodNode", "ClassNode", "CompiledDAG", "CompiledDAGRef",
+    "DAGNode", "FunctionNode", "InputNode", "MultiOutputNode",
 ]

@@ -1,0 +1,10 @@
+"""Experimental APIs (reference: ``python/ray/experimental``): mutable
+shared-memory channels backing compiled DAGs."""
+
+from ray_tpu.experimental.channel import (  # noqa: F401
+    Channel,
+    ChannelClosed,
+    ChannelTimeout,
+)
+
+__all__ = ["Channel", "ChannelClosed", "ChannelTimeout"]

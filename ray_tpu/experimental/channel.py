@@ -1,0 +1,349 @@
+"""Mutable shared-memory channels for compiled DAGs.
+
+Reference: ``python/ray/experimental/channel/shared_memory_channel.py:151``
+and ``src/ray/core_worker/experimental_mutable_object_manager.h`` — a
+fixed-capacity buffer one writer mutates in place and N readers consume,
+synchronized by a version/ack protocol instead of RPCs, so a compiled-DAG
+hop costs microseconds rather than a lease/submit round-trip.
+
+The hot path lives in ``native/shm_channel.cpp`` (seqlock writer/reader over
+POSIX shm, waits release the GIL). A pure-python mmap fallback implements
+the identical byte layout, so native and fallback processes interoperate.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import pickle
+import struct
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private.native_build import native_lib_path
+
+DEFAULT_CAPACITY = 1 << 20  # 1 MiB payloads by default
+_MAGIC = 0x52544348
+# Byte layout (mirrors native Header): magic u32, n_readers u32,
+# capacity u64, version u64, size u64, closed u64; acks (16 * u64) at
+# offset 64; payload at offset 192.
+_VER_OFF = 16
+_SIZE_OFF = 24
+_CLOSED_OFF = 32
+_ACKS_OFF = 64
+_DATA_OFF = 192
+
+
+class ChannelClosed(Exception):
+    """The writer closed the channel; no further values will arrive."""
+
+
+class ChannelTimeout(Exception):
+    pass
+
+
+_lib = None
+_lib_tried = False
+
+
+def _native():
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        path = native_lib_path("shm_channel")
+        if path:
+            lib = ctypes.CDLL(path)
+            lib.chan_create.restype = ctypes.c_void_p
+            lib.chan_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                        ctypes.c_uint32]
+            lib.chan_attach.restype = ctypes.c_void_p
+            lib.chan_attach.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.chan_capacity.restype = ctypes.c_uint64
+            lib.chan_capacity.argtypes = [ctypes.c_void_p]
+            lib.chan_write.restype = ctypes.c_int
+            lib.chan_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_uint64, ctypes.c_double]
+            lib.chan_read.restype = ctypes.c_int64
+            lib.chan_read.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64, ctypes.c_double]
+            lib.chan_close.argtypes = [ctypes.c_void_p]
+            lib.chan_detach.argtypes = [ctypes.c_void_p]
+            lib.chan_unlink.argtypes = [ctypes.c_char_p]
+            _lib = lib
+    return _lib
+
+
+class Channel:
+    """One writer, ``n_readers`` readers, single in-flight mutable value.
+
+    ``write`` blocks until every reader consumed the previous value (the
+    in-place analog of WriteAcquire); ``read`` blocks for the next value.
+    Pickling a Channel yields an attach-spec: unpickling in another process
+    attaches to the same buffer (reference: channels travel inside actor
+    task args at compile time).
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, n_readers: int = 1,
+                 name: Optional[str] = None, _create: bool = True,
+                 _reader_idx: int = -1):
+        if _create and not 1 <= n_readers <= 16:
+            raise ValueError(
+                f"Channel supports 1..16 readers, got {n_readers} (the "
+                f"header reserves 16 ack slots)")
+        self.name = name or f"/rtch-{uuid.uuid4().hex[:24]}"
+        self.capacity = capacity
+        self.n_readers = n_readers
+        self.reader_idx = _reader_idx
+        self._creator = _create
+        self._closed_seen = False
+        self._h = None
+        self._mm = None
+        self._last_seen = 0
+        lib = _native()
+        if lib is not None:
+            if _create:
+                self._h = lib.chan_create(self.name.encode(), capacity,
+                                          n_readers)
+                if not self._h:
+                    raise OSError(f"chan_create failed for {self.name}")
+            else:
+                deadline = time.monotonic() + 10.0
+                while True:
+                    self._h = lib.chan_attach(self.name.encode(), _reader_idx)
+                    if self._h:
+                        break
+                    if time.monotonic() > deadline:
+                        raise OSError(f"chan_attach failed for {self.name}")
+                    time.sleep(0.001)
+                self.capacity = lib.chan_capacity(self._h)
+            self._buf = ctypes.create_string_buffer(self.capacity)
+        else:
+            self._open_fallback(_create)
+
+    # ------------------------------------------------------------- fallback
+    def _open_fallback(self, create: bool):
+        path = f"/dev/shm{self.name}"
+        total = _DATA_OFF + self.capacity
+        if create:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            os.ftruncate(fd, total)
+            self._mm = mmap.mmap(fd, total)
+            os.close(fd)
+            struct.pack_into("<IIQQQ", self._mm, 0, _MAGIC, self.n_readers,
+                             self.capacity, 0, 0)
+        else:
+            deadline = time.monotonic() + 10.0
+            while not os.path.exists(path):
+                if time.monotonic() > deadline:
+                    raise OSError(f"channel {self.name} does not exist")
+                time.sleep(0.001)
+            fd = os.open(path, os.O_RDWR)
+            total = os.fstat(fd).st_size
+            self._mm = mmap.mmap(fd, total)
+            os.close(fd)
+            magic, self.n_readers, self.capacity, _, _ = struct.unpack_from(
+                "<IIQQQ", self._mm, 0)
+            if magic != _MAGIC:
+                raise OSError(f"{self.name} is not a channel")
+
+    def _fb_version(self) -> int:
+        return struct.unpack_from("<Q", self._mm, _VER_OFF)[0]
+
+    def _fb_size(self) -> int:
+        return struct.unpack_from("<Q", self._mm, _SIZE_OFF)[0]
+
+    def _fb_closed(self) -> bool:
+        return struct.unpack_from("<Q", self._mm, _CLOSED_OFF)[0] != 0
+
+    # --------------------------------------------------------------- pickle
+    def __reduce__(self):
+        return (_attach, (self.name, self.capacity, self.n_readers,
+                          self.reader_idx))
+
+    def reader(self, idx: int) -> "Channel":
+        """Attach-spec for reader ``idx`` (what you pass to another process)."""
+        return _attach(self.name, self.capacity, self.n_readers, idx)
+
+    # ------------------------------------------------------------------ ops
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        data = pickle.dumps(value, protocol=5)
+        if len(data) > self.capacity:
+            raise ValueError(
+                f"serialized value ({len(data)} B) exceeds channel capacity "
+                f"({self.capacity} B); create the Channel with a larger "
+                f"capacity")
+        t = -1.0 if timeout is None else float(timeout)
+        if self._h is not None:
+            rc = _native().chan_write(self._h, data, len(data), t)
+            if rc == 0:
+                return
+            if rc == -1:
+                raise ChannelTimeout(f"write timed out on {self.name}")
+            if rc == -3:
+                raise ChannelClosed(self.name)
+            raise OSError(f"chan_write rc={rc}")
+        self._fb_write(data, timeout)
+
+    def _fb_write(self, data: bytes, timeout: Optional[float]):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        v = self._fb_version()
+        while True:
+            acks = struct.unpack_from(f"<{self.n_readers}Q", self._mm,
+                                      _ACKS_OFF)
+            if all(a == v for a in acks):
+                break
+            if self._fb_closed():
+                raise ChannelClosed(self.name)
+            if deadline and time.monotonic() > deadline:
+                raise ChannelTimeout(f"write timed out on {self.name}")
+            time.sleep(0.0001)
+        struct.pack_into("<Q", self._mm, _VER_OFF, v + 1)
+        self._mm[_DATA_OFF:_DATA_OFF + len(data)] = data
+        struct.pack_into("<Q", self._mm, _SIZE_OFF, len(data))
+        struct.pack_into("<Q", self._mm, _VER_OFF, v + 2)
+
+    def read(self, timeout: Optional[float] = None) -> Any:
+        if self._closed_seen:
+            raise ChannelClosed(self.name)
+        t = -1.0 if timeout is None else float(timeout)
+        if self._h is not None:
+            n = _native().chan_read(self._h, self._buf, self.capacity, t)
+            if n >= 0:
+                return pickle.loads(self._buf.raw[:n])
+            if n == -1:
+                raise ChannelTimeout(f"read timed out on {self.name}")
+            if n == -3:
+                self._closed_seen = True
+                raise ChannelClosed(self.name)
+            raise OSError(f"chan_read rc={n}")
+        return self._fb_read(timeout)
+
+    def _fb_read(self, timeout: Optional[float]) -> Any:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            v = self._fb_version()
+            if v % 2 == 0 and v != self._last_seen:
+                size = self._fb_size()
+                data = bytes(self._mm[_DATA_OFF:_DATA_OFF + size])
+                if self._fb_version() == v:  # seqlock validate
+                    self._last_seen = v
+                    if self.reader_idx >= 0:
+                        struct.pack_into("<Q", self._mm,
+                                         _ACKS_OFF + 8 * self.reader_idx, v)
+                    return pickle.loads(data)
+                continue
+            if self._fb_closed():
+                # Pending value (if any) was consumed above; no more coming.
+                self._closed_seen = True
+                raise ChannelClosed(self.name)
+            if deadline and time.monotonic() > deadline:
+                raise ChannelTimeout(f"read timed out on {self.name}")
+            time.sleep(0.0001)
+
+    def close(self) -> None:
+        """Writer-side: publish the closed sentinel to all readers."""
+        if self._h is not None:
+            _native().chan_close(self._h)
+            return
+        if self._mm is not None:
+            struct.pack_into("<Q", self._mm, _CLOSED_OFF, 1)
+
+    def destroy(self) -> None:
+        """Detach and unlink the backing segment (creator-side teardown)."""
+        lib = _native()
+        if self._h is not None and lib is not None:
+            lib.chan_detach(self._h)
+            self._h = None
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+        try:
+            if _native() is not None:
+                _native().chan_unlink(self.name.encode())
+            else:
+                os.unlink(f"/dev/shm{self.name}")
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __del__(self):  # detach only; unlink is explicit via destroy()
+        try:
+            lib = _native()
+            if self._h is not None and lib is not None:
+                lib.chan_detach(self._h)
+            elif self._mm is not None:
+                self._mm.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _attach(name: str, capacity: int, n_readers: int, reader_idx: int) \
+        -> Channel:
+    return Channel(capacity=capacity, n_readers=n_readers, name=name,
+                   _create=False, _reader_idx=reader_idx)
+
+
+# --------------------------------------------------------------- DAG loop
+
+def run_dag_loop(instance: Any, ops: List[tuple]) -> int:
+    """Pinned executor loop for one compiled-DAG actor.
+
+    ``ops`` is this actor's executable schedule in topological order
+    (reference: one ExecutableTask list per actor,
+    ``compiled_dag_node.py:161``): each op is ``(method_name, arg_slots,
+    kwarg_slots, out_channel)`` where slots mix Channel readers (DAG edges)
+    with captured constants. Each tick runs every op once: read inputs,
+    invoke, write the result. Exits — closing every output so teardown
+    ripples downstream — when any input channel closes.
+
+    Returns the number of completed ticks.
+    """
+    ticks = 0
+    closed = False
+    try:
+        while not closed:
+            for method_name, arg_slots, kwarg_slots, out in ops:
+                try:
+                    args = [s.read() if isinstance(s, Channel) else s
+                            for s in arg_slots]
+                    kwargs = {k: (s.read() if isinstance(s, Channel) else s)
+                              for k, s in kwarg_slots.items()}
+                except ChannelClosed:
+                    closed = True
+                    break
+                upstream_err = next(
+                    (a for a in args if isinstance(a, _StageError)),
+                    next((v for v in kwargs.values()
+                          if isinstance(v, _StageError)), None))
+                if upstream_err is not None:
+                    result = upstream_err  # propagate, don't invoke
+                else:
+                    try:
+                        result = getattr(instance, method_name)(
+                            *args, **kwargs)
+                    except BaseException as e:  # noqa: BLE001
+                        # Errors ride the channel to the driver (reference:
+                        # compiled DAGs surface stage errors at the ref).
+                        result = _StageError(e)
+                out.write(result)
+            else:
+                ticks += 1
+    finally:
+        for _, _, _, out in ops:
+            out.close()
+    return ticks
+
+
+class _StageError:
+    """Pickled carrier of a stage exception through channels."""
+
+    def __init__(self, exc: BaseException):
+        try:
+            self.exc = exc
+            pickle.dumps(exc)
+        except Exception:  # noqa: BLE001
+            self.exc = RuntimeError(repr(exc))
+
+
+__all__ = ["Channel", "ChannelClosed", "ChannelTimeout", "run_dag_loop"]

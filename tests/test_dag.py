@@ -76,3 +76,81 @@ def test_bound_actor_handle_method():
     with InputNode() as x:
         dag = actor.forward.bind(x)
     assert ray_tpu.get(dag.execute(1)) == 4
+
+
+# ------------------------------------------------------- compiled channels
+
+def test_compiled_dag_uses_channels():
+    with InputNode() as x:
+        dag = Stage.bind(1).forward.bind(x)
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled._channel_mode
+        refs = [compiled.execute(i) for i in range(3)]
+        assert [r.get(timeout=30) for r in refs] == [1, 2, 3]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_multi_stage_pipeline():
+    with InputNode() as x:
+        dag = Stage.bind(1000).forward.bind(Stage.bind(100).forward.bind(x))
+    compiled = dag.experimental_compile()
+    try:
+        out = [ray_tpu.get(compiled.execute(i), timeout=30) for i in range(5)]
+        assert out == [1100 + i for i in range(5)]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_multi_output_fanout():
+    with InputNode() as x:
+        s1 = Stage.bind(1).forward.bind(x)   # both consume the same input
+        s2 = Stage.bind(2).forward.bind(x)
+        dag = MultiOutputNode([s1, s2])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled._channel_mode
+        assert compiled.execute(10).get(timeout=30) == [11, 12]
+        assert compiled.execute(20).get(timeout=30) == [21, 22]
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_stage_error_propagates():
+    @ray_tpu.remote
+    class Boom:
+        def forward(self, x):
+            if x == 2:
+                raise ValueError("x was two")
+            return x
+
+    with InputNode() as x:
+        dag = Stage.bind(0).forward.bind(Boom.bind().forward.bind(x))
+    compiled = dag.experimental_compile()
+    try:
+        assert ray_tpu.get(compiled.execute(1), timeout=30) == 1
+        with pytest.raises(ValueError, match="x was two"):
+            ray_tpu.get(compiled.execute(2), timeout=30)
+        # The pipeline survives an error tick.
+        assert ray_tpu.get(compiled.execute(3), timeout=30) == 3
+    finally:
+        compiled.teardown()
+
+
+def test_compiled_dag_teardown_then_execute_raises():
+    with InputNode() as x:
+        dag = Stage.bind(5).forward.bind(x)
+    compiled = dag.experimental_compile()
+    assert ray_tpu.get(compiled.execute(1), timeout=30) == 6
+    compiled.teardown()
+    with pytest.raises(RuntimeError, match="torn down"):
+        compiled.execute(2)
+
+
+def test_task_dag_falls_back_to_interpreted():
+    with InputNode() as x:
+        dag = times.bind(plus.bind(x, 1), 10)
+    compiled = dag.experimental_compile()
+    assert not compiled._channel_mode
+    assert ray_tpu.get(compiled.execute(4)) == 50
