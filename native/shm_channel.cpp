@@ -17,8 +17,8 @@
 //     before mutating, so a payload is never overwritten while a reader
 //     still owes a read. This is the in-place analog of the reference's
 //     WriteAcquire/ReadRelease cycle.
-//   * close() publishes a sentinel size; readers observe it and return
-//     CHAN_CLOSED forever after.
+//   * close() publishes a sticky closed flag; readers drain any pending
+//     value first, then observe it; blocked writers abort with it.
 //
 // Waits spin briefly then back off to nanosleep, releasing the GIL the
 // whole time (callers come through ctypes).
@@ -157,12 +157,14 @@ int chan_write(void* handle, const char* buf, uint64_t len, double timeout_s) {
   uint64_t v = hdr->version.load(std::memory_order_relaxed);
   uint32_t n = hdr->n_readers;
   auto all_acked = [&] {
+    if (hdr->closed.load(std::memory_order_acquire)) return true;  // abort
     for (uint32_t i = 0; i < n; ++i) {
       if (hdr->acks[i].load(std::memory_order_acquire) != v) return false;
     }
     return true;
   };
   if (!wait_until(all_acked, timeout_s)) return -1;
+  if (hdr->closed.load(std::memory_order_acquire)) return -3;
   hdr->version.store(v + 1, std::memory_order_release);  // odd: mutating
   std::memcpy(h->data, buf, len);
   hdr->size.store(len, std::memory_order_release);

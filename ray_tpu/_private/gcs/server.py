@@ -679,9 +679,10 @@ class GcsServer:
                         info.state = "CREATED"
             # Nodes whose commit failed still hold a prepared reservation;
             # cancel it or their capacity leaks (prepare debits available).
-            uncommitted = ([] if rollback
-                           else [n for n in by_node if n not in committed])
-            for node_id in (list(by_node) if rollback else uncommitted):
+            # On rollback every node (committed included) is cancelled.
+            cancel_targets = (list(by_node) if rollback else
+                              [n for n in by_node if n not in committed])
+            for node_id in cancel_targets:
                 stub = self._node_stub(node_id)
                 if stub:
                     try:

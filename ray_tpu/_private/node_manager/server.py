@@ -85,6 +85,8 @@ class NodeManager:
         self._workers: Dict[str, _Worker] = {}
         self._idle: List[str] = []
         self._pool_lock = threading.RLock()
+        self._spawning_task = 0   # in-flight spawns counted against the caps
+        self._spawning_actor = 0
 
         # placement bundles (reference: placement_group_resource_manager.h).
         # Prepare holds the group's node-total demand; commit converts it to
@@ -308,7 +310,8 @@ class NodeManager:
             cap = int(os.environ.get(
                 "RAY_TPU_MAX_WORKERS",
                 max(4, int(self.total.get("CPU", 4)) * 2)))
-        deadline = time.monotonic() + timeout_s
+        start = time.monotonic()
+        reserved = False
         while True:
             with self._pool_lock:
                 while self._idle:
@@ -319,16 +322,35 @@ class NodeManager:
                 if for_actor:
                     used = sum(1 for w in self._workers.values()
                                if w.is_actor_worker)
+                    used += self._spawning_actor
                 else:
                     used = sum(1 for w in self._workers.values()
                                if not w.is_actor_worker)
-                can_spawn = used < cap
-            if can_spawn:
+                    used += self._spawning_task
+                if used < cap:
+                    # Reserve the slot under the lock — concurrent lease
+                    # RPCs must not all pass the check before any spawn
+                    # registers (that is the fork-bomb the cap prevents).
+                    if for_actor:
+                        self._spawning_actor += 1
+                    else:
+                        self._spawning_task += 1
+                    reserved = True
+            if reserved:
                 break
-            if time.monotonic() + 29.0 > deadline:  # wait ≤1s at the cap
+            if time.monotonic() - start > 1.0:  # wait ≤1s at the cap
                 return None
             time.sleep(0.005)
-        worker = self._spawn_worker()
+        try:
+            worker = self._spawn_worker()
+            if for_actor:
+                worker.is_actor_worker = True
+        finally:
+            with self._pool_lock:
+                if for_actor:
+                    self._spawning_actor -= 1
+                else:
+                    self._spawning_task -= 1
         if worker.ready.wait(timeout_s):
             return worker
         return None

@@ -47,14 +47,22 @@ logger = logging.getLogger(__name__)
 
 
 class _ActorRunner:
-    """Per-caller sequence ordering + single-slot execution for one actor."""
+    """Per-caller sequence ordering + single-slot execution for one actor.
 
-    def __init__(self, instance: Any):
+    ``max_concurrency > 1`` switches to threaded-actor semantics
+    (reference: threaded actors, ``core_worker.cc`` BoundedExecutor):
+    calls run concurrently on RPC threads gated by a semaphore, and
+    per-caller ordering is deliberately NOT enforced.
+    """
+
+    def __init__(self, instance: Any, max_concurrency: int = 1):
         self.instance = instance
         self.cond = threading.Condition()
         self.next_seq: Dict[bytes, int] = {}
         self.dead = False
         self.pg_ctx: Optional[tuple] = None  # (group_id, bundle_idx, capture)
+        self.max_concurrency = max(1, int(max_concurrency))
+        self.sem = threading.Semaphore(self.max_concurrency)
 
     def wait_turn(self, caller: bytes, seq: int) -> bool:
         deadline = time.monotonic() + 120.0
@@ -193,10 +201,19 @@ class WorkerServer:
                 ActorID(bytes(spec.actor_id)), "actor not hosted here")
             return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
         caller = bytes(spec.caller_address)
-        if not runner.wait_turn(caller, spec.sequence_no):
-            err = exceptions.ActorDiedError(
-                ActorID(bytes(spec.actor_id)), "actor died")
-            return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
+        ordered = runner.max_concurrency <= 1
+        if ordered:
+            if not runner.wait_turn(caller, spec.sequence_no):
+                err = exceptions.ActorDiedError(
+                    ActorID(bytes(spec.actor_id)), "actor died")
+                return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
+        else:
+            runner.sem.acquire()
+            if runner.dead:
+                runner.sem.release()
+                err = exceptions.ActorDiedError(
+                    ActorID(bytes(spec.actor_id)), "actor died")
+                return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
         try:
             (_, args, kwargs), n_borrows = loads_payload(spec.payload)
             if n_borrows:
@@ -225,7 +242,10 @@ class WorkerServer:
         except BaseException as e:  # noqa: BLE001
             return self._error_result(e, f"{spec.method_name}")
         finally:
-            runner.complete(caller, spec.sequence_no)
+            if ordered:
+                runner.complete(caller, spec.sequence_no)
+            else:
+                runner.sem.release()
 
     def CreateActor(self, request, context):
         info = request.info
@@ -248,7 +268,9 @@ class WorkerServer:
             finally:
                 if pg_ctx is not None:
                     pg_context.clear()
-            runner = _ActorRunner(instance)
+            runner = _ActorRunner(
+                instance,
+                max_concurrency=getattr(options, "max_concurrency", 1))
             runner.pg_ctx = pg_ctx
             self._actors[bytes(info.actor_id)] = runner
             return pb.CreateActorReply(ok=True)

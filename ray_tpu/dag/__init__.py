@@ -302,6 +302,22 @@ class CompiledDAG:
         self._input_channel = out_chan[id(inputs[0])]
         self._output_readers = [reader_for(o) for o in outputs]
         self._multi_output = isinstance(self._root, MultiOutputNode)
+        # Bound in-flight executions to the pipeline's holding capacity so
+        # an over-eager submit blocks HERE (lock-free) instead of inside the
+        # input-channel write while holding the driver lock — which would
+        # deadlock, since draining results also needs that lock (reference:
+        # max in-flight executions, compiled_dag_node.py). Capacity along a
+        # path of d actors is d+1 channel slots + d in-execution slots; the
+        # shallowest input→output path is the bottleneck.
+        depth: Dict[int, int] = {id(inputs[0]): 0}
+        for n in compute:  # topo order: producers already have depths
+            dag_args = [a for a in list(n._bound_args)
+                        + list(n._bound_kwargs.values())
+                        if isinstance(a, DAGNode)]
+            n_depth = 1 + min(depth.get(id(a), 0) for a in dag_args)
+            depth[id(n)] = n_depth
+        min_depth = min(depth.get(id(o), 1) for o in outputs)
+        self._inflight_sem = threading.Semaphore(2 * min_depth + 1)
 
         from ray_tpu.actor import ActorMethod
 
@@ -316,17 +332,34 @@ class CompiledDAG:
         if not self._channel_mode:
             return self._root.execute(*input_args)
         value = input_args[0] if len(input_args) == 1 else input_args
-        with self._lock:
-            # Write under the lock: the channel is single-writer, and the
-            # seq must match the write order.
-            self._input_channel.write(value)
-            seq = self._next_seq
-            self._next_seq += 1
+        # Block lock-free while the pipeline is full; a single-threaded
+        # caller that never drains would wait forever, so surface the
+        # misuse after a bounded wait (reference raises when max buffered
+        # results is exceeded).
+        if not self._inflight_sem.acquire(timeout=60.0):
+            raise RuntimeError(
+                "compiled DAG pipeline is full and no result was consumed "
+                "for 60s; call get() on earlier CompiledDAGRefs to drain")
+        try:
+            with self._lock:
+                # Write under the lock: the channel is single-writer, and
+                # the seq must match the write order. The semaphore
+                # guarantees a free slot, so this write cannot block.
+                self._input_channel.write(value)
+                seq = self._next_seq
+                self._next_seq += 1
+        except BaseException:
+            self._inflight_sem.release()
+            raise
         return CompiledDAGRef(self, seq)
 
     def _get_result(self, seq: int, timeout: Optional[float]):
         chan = self._chan
         with self._lock:
+            if seq < self._read_count and seq not in self._results:
+                raise ValueError(
+                    f"CompiledDAGRef (execution #{seq}) was already "
+                    f"consumed; get() may only be called once per ref")
             while seq >= self._read_count:
                 # Resume partially-read ticks: a timeout mid-tick must not
                 # discard values already consumed from earlier readers or
@@ -338,6 +371,7 @@ class CompiledDAG:
                 self._results[self._read_count] = (
                     vals if self._multi_output else vals[0])
                 self._read_count += 1
+                self._inflight_sem.release()
             out = self._results.pop(seq)
         for v in (out if isinstance(out, list) else [out]):
             if isinstance(v, chan._StageError):
@@ -349,7 +383,11 @@ class CompiledDAG:
             return
         self._torn_down = True
         if self._channel_mode:
-            self._input_channel.close()
+            # Close EVERY channel, not just the input: an actor blocked
+            # writing an unread output would never observe an input-only
+            # close and would spin forever in the pinned loop.
+            for c in self._channels:
+                c.close()
             for ref in self._loop_refs:
                 try:
                     ray_tpu.get(ref, timeout=10)

@@ -326,7 +326,13 @@ def run_dag_loop(instance: Any, ops: List[tuple]) -> int:
                         # Errors ride the channel to the driver (reference:
                         # compiled DAGs surface stage errors at the ref).
                         result = _StageError(e)
-                out.write(result)
+                try:
+                    out.write(result)
+                except ChannelClosed:
+                    # Teardown closed our output (possibly mid-blocked
+                    # write): exit the loop instead of wedging the actor.
+                    closed = True
+                    break
             else:
                 ticks += 1
     finally:

@@ -11,6 +11,14 @@ Condensed re-design of SURVEY.md §3.5's architecture:
 * ``DeploymentHandle`` (``handle.py:625``): routes each call with
   power-of-two-choices on per-replica in-flight counts
   (``replica_scheduler/pow_2_scheduler.py:813``'s local approximation).
+* Autoscaling (``_private/autoscaling_policy.py``): replicas count ongoing
+  requests; the controller scales toward ``total_ongoing / target`` within
+  ``[min_replicas, max_replicas]``, applying upscale/downscale delays.
+* Push-based routing (``_private/long_poll.py:204``): the controller
+  publishes a route-change event over the GCS pubsub whenever a
+  deployment's replica set changes; handles refresh on the event instead of
+  polling on a TTL, and a call that lands on a dead replica refreshes and
+  retries immediately.
 * HTTP ingress: an aiohttp proxy thread mapping ``POST /<deployment>`` to
   handle calls (``proxy.py:752``).
 """
@@ -18,14 +26,77 @@ Condensed re-design of SURVEY.md §3.5's architecture:
 from __future__ import annotations
 
 import json
+import math
 import random
 import threading
 import time
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 
 CONTROLLER_NAME = "__serve_controller__"
+ROUTES_CHANNEL = "SERVE_ROUTES"
+
+# In-process route-event bus for the single-process (local) runtime, where
+# controller and handles share the interpreter; cluster mode rides the GCS
+# pubsub instead.
+_LOCAL_BUS: List[Callable[[str], None]] = []
+
+
+def _core():
+    from ray_tpu._private import worker as worker_mod
+
+    return worker_mod.global_worker().core
+
+
+def _publish_route_event(name: str) -> None:
+    core = _core()
+    if hasattr(core, "gcs"):
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        try:
+            core.gcs.Publish(pb.PublishRequest(
+                channel=ROUTES_CHANNEL, data=name.encode()))
+            return
+        except Exception:  # noqa: BLE001
+            pass
+    for cb in list(_LOCAL_BUS):
+        try:
+            cb(name)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _subscribe_route_events(cb: Callable[[str], None]) -> None:
+    core = _core()
+    if hasattr(core, "gcs"):
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        def loop():
+            sub_id = f"serve-{uuid.uuid4().hex[:12]}"
+            while True:
+                try:
+                    stream = core.gcs.Subscribe(pb.SubscribeRequest(
+                        channels=[ROUTES_CHANNEL], subscriber_id=sub_id))
+                    for msg in stream:
+                        cb(msg.data.decode())
+                except Exception:  # noqa: BLE001
+                    time.sleep(0.5)
+
+        threading.Thread(target=loop, daemon=True,
+                         name="serve-routes-sub").start()
+    else:
+        _LOCAL_BUS.append(cb)
+
+
+DEFAULT_AUTOSCALING = {
+    "min_replicas": 1,
+    "max_replicas": 4,
+    "target_ongoing_requests": 2.0,
+    "upscale_delay_s": 0.3,
+    "downscale_delay_s": 2.0,
+}
 
 
 class Replica:
@@ -37,36 +108,100 @@ class Replica:
             self.instance = cls_or_fn
         else:
             self.instance = cls_or_fn(*init_args, **(init_kwargs or {}))
+        self._ongoing = 0
+        self._total = 0
+        self._m_lock = threading.Lock()
 
     def handle_request(self, method: str, args, kwargs):
-        if self.is_function:
-            return self.instance(*args, **kwargs)
-        target = getattr(self.instance, method or "__call__")
-        return target(*args, **kwargs)
+        with self._m_lock:
+            self._ongoing += 1
+            self._total += 1
+        try:
+            if self.is_function:
+                return self.instance(*args, **kwargs)
+            target = getattr(self.instance, method or "__call__")
+            return target(*args, **kwargs)
+        finally:
+            with self._m_lock:
+                self._ongoing -= 1
+
+    def metrics(self):
+        """Ongoing-request count the autoscaler averages (reference:
+        replica metrics pushed to the controller, autoscaling_policy.py)."""
+        with self._m_lock:
+            return {"ongoing": self._ongoing, "total": self._total}
 
     def health(self):
         return True
 
 
 class ServeController:
-    """Reconciles deployment specs → replica actors."""
+    """Reconciles deployment specs → replica actors and autoscales them."""
 
     def __init__(self):
         self.deployments: Dict[str, Dict[str, Any]] = {}
         self.replicas: Dict[str, List[Any]] = {}
+        self._route_version: Dict[str, int] = {}
+        # autoscaler intent: name -> (desired, first_seen_monotonic)
+        self._scale_intent: Dict[str, Any] = {}
         self._stop = False
         threading.Thread(target=self._reconcile_loop, daemon=True).start()
 
     def deploy(self, name: str, cls_or_fn, init_args, init_kwargs,
                num_replicas: int, is_function: bool,
-               max_concurrency: int) -> bool:
+               max_concurrency: int,
+               autoscaling_config: Optional[Dict[str, Any]] = None) -> bool:
+        cfg = None
+        if autoscaling_config is not None or num_replicas == "auto":
+            cfg = dict(DEFAULT_AUTOSCALING)
+            cfg.update(autoscaling_config or {})
+            num_replicas = cfg["min_replicas"]
         self.deployments[name] = {
             "cls": cls_or_fn, "args": init_args, "kwargs": init_kwargs,
             "num_replicas": num_replicas, "is_function": is_function,
-            "max_concurrency": max_concurrency,
+            "max_concurrency": max_concurrency, "autoscaling": cfg,
         }
         self._reconcile_once(name)
         return True
+
+    def _autoscale_once(self, name: str):
+        """Reference: autoscaling_policy.py — desired =
+        ceil(total_ongoing / target), clamped to [min, max], applied after
+        the respective upscale/downscale delay holds steadily."""
+        spec = self.deployments.get(name)
+        if spec is None or spec["autoscaling"] is None:
+            return
+        cfg = spec["autoscaling"]
+        replicas = self.replicas.get(name, [])
+        if not replicas:
+            return
+        ongoing = 0
+        for r in replicas:
+            try:
+                m = ray_tpu.get(r.metrics.remote(), timeout=2)
+                ongoing += m["ongoing"]
+            except Exception:  # noqa: BLE001
+                pass
+        desired = math.ceil(ongoing / max(cfg["target_ongoing_requests"],
+                                          1e-9))
+        desired = max(cfg["min_replicas"],
+                      min(cfg["max_replicas"], desired))
+        current = spec["num_replicas"]
+        if desired == current:
+            self._scale_intent.pop(name, None)
+            return
+        now = time.monotonic()
+        intent = self._scale_intent.get(name)
+        if intent is None or intent[0] != desired:
+            self._scale_intent[name] = (desired, now)
+            return
+        delay = (cfg["upscale_delay_s"] if desired > current
+                 else cfg["downscale_delay_s"])
+        if now - intent[1] < delay:
+            return
+        spec["num_replicas"] = desired
+        self._scale_intent.pop(name, None)
+        self._reconcile_once(name)
 
     def delete(self, name: str) -> bool:
         self.deployments.pop(name, None)
@@ -79,6 +214,11 @@ class ServeController:
 
     def get_replicas(self, name: str):
         return list(self.replicas.get(name, []))
+
+    def get_routes(self, name: str):
+        """(version, replicas) — versioned routing table (long-poll analog)."""
+        return self._route_version.get(name, 0), \
+            list(self.replicas.get(name, []))
 
     def list_deployments(self):
         return {name: {"num_replicas": spec["num_replicas"]}
@@ -94,7 +234,7 @@ class ServeController:
         live = []
         for r in current:
             try:
-                ray_tpu.get(r.health.remote(), timeout=5)
+                ray_tpu.get(r.health.remote(), timeout=2)
                 live.append(r)
             except Exception:  # noqa: BLE001
                 pass
@@ -110,13 +250,21 @@ class ServeController:
                 ray_tpu.kill(victim)
             except Exception:  # noqa: BLE001
                 pass
+        changed = [id(r) for r in current] != \
+            [id(r) for r in self.replicas.get(name, [])]
         self.replicas[name] = current
+        if changed:
+            # Push the new routing table to every handle (reference:
+            # LongPollHost notify, long_poll.py:204).
+            self._route_version[name] = self._route_version.get(name, 0) + 1
+            _publish_route_event(name)
 
     def _reconcile_loop(self):
         while not self._stop:
-            time.sleep(1.0)
+            time.sleep(0.5)
             for name in list(self.deployments):
                 try:
+                    self._autoscale_once(name)
                     self._reconcile_once(name)
                 except Exception:  # noqa: BLE001
                     pass
@@ -130,45 +278,133 @@ class ServeController:
 class DeploymentResponse:
     """Future-like response (reference: ``DeploymentResponse``)."""
 
-    def __init__(self, ref):
+    def __init__(self, ref, handle: Optional["DeploymentHandle"] = None,
+                 call: Optional[tuple] = None, replica: Any = None):
         self._ref = ref
+        self._handle = handle
+        self._call = call
+        self._replica = replica
 
     def result(self, timeout_s: Optional[float] = 60.0):
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        ref, replica = self._ref, self._replica
+        attempts = 0
+        while True:
+            try:
+                return ray_tpu.get(ref, timeout=timeout_s)
+            except ray_tpu.exceptions.ActorDiedError:
+                # The chosen replica died mid-flight: evict it from the
+                # handle's table (the controller may not have pruned it
+                # yet) and retry on a live replica (reference: router
+                # retries on ActorDiedError with an updated replica set).
+                if self._handle is None or self._call is None or \
+                        attempts >= 5:
+                    raise
+                attempts += 1
+                self._handle._evict(replica)
+                args, kwargs = self._call
+                retry = self._handle.remote(*args, **kwargs)
+                ref, replica = retry._ref, retry._replica
 
     @property
     def ref(self):
         return self._ref
 
 
+class _RouterState:
+    """Routing table + subscription shared by a handle and its clones."""
+
+    def __init__(self):
+        self.replicas: List[Any] = []
+        self.dirty = True
+        self.inflight: Dict[int, int] = {}
+        self.lock = threading.Lock()
+        self.subscribed = False
+
+
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, method_name: Optional[str] = None):
+    """Routes calls to replicas. The routing table is *pushed*: a subscriber
+    registered on first use refreshes it when the controller publishes a
+    route-change event (reference: long-poll updates, ``long_poll.py:204``)
+    — no per-call TTL polling. A call that raced a replica death refreshes
+    immediately and retries on a live replica."""
+
+    def __init__(self, deployment_name: str, method_name: Optional[str] = None,
+                 _router: Optional["_RouterState"] = None):
         self._name = deployment_name
         self._method = method_name
-        self._replicas: List[Any] = []
-        self._replicas_ts = 0.0
-        self._inflight: Dict[int, int] = {}
-        self._lock = threading.Lock()
+        # Router state (replica table, in-flight counts, subscription) is
+        # SHARED across options()/method clones: one subscription per
+        # logical handle, not per call.
+        self._router = _router or _RouterState()
 
     def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self._name, method_name)
+        return DeploymentHandle(self._name, method_name,
+                                _router=self._router)
+
+    @property
+    def _replicas(self):
+        return self._router.replicas
+
+    @property
+    def _lock(self):
+        return self._router.lock
+
+    @property
+    def _inflight(self):
+        return self._router.inflight
 
     def __getattr__(self, name):
         if name.startswith("_"):
             raise AttributeError(name)
         return _HandleMethod(self, name)
 
-    def _refresh(self):
-        now = time.monotonic()
-        if now - self._replicas_ts > 2.0 or not self._replicas:
-            controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            self._replicas = ray_tpu.get(
-                controller.get_replicas.remote(self._name), timeout=30)
-            self._replicas_ts = now
+    def _ensure_subscribed(self):
+        st = self._router
+        if st.subscribed:
+            return
+        st.subscribed = True
+
+        def on_event(name: str):
+            if name == self._name:
+                with st.lock:
+                    st.dirty = True
+
+        try:
+            _subscribe_route_events(on_event)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _refresh(self, force: bool = False):
+        self._ensure_subscribed()
+        st = self._router
+        if not force and not st.dirty and st.replicas:
+            return
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        _, replicas = ray_tpu.get(
+            controller.get_routes.remote(self._name), timeout=30)
+        with st.lock:
+            st.replicas = replicas
+            st.dirty = False
+            st.inflight = {}
+
+    def _evict(self, replica) -> None:
+        """Drop a replica observed dead; refreshed tables re-add the live
+        set (reference: router removes failed replicas eagerly)."""
+        st = self._router
+        with st.lock:
+            st.replicas = [r for r in st.replicas if r is not replica]
+            st.inflight = {}
+            st.dirty = not st.replicas
 
     def _choose(self):
         """Power-of-two-choices over in-flight counts."""
         self._refresh()
+        if not self._replicas:
+            # A fresh deployment may still be starting replicas.
+            deadline = time.monotonic() + 10.0
+            while not self._replicas and time.monotonic() < deadline:
+                time.sleep(0.05)
+                self._refresh(force=True)
         if not self._replicas:
             raise RuntimeError(f"deployment {self._name!r} has no replicas")
         with self._lock:
@@ -194,7 +430,8 @@ class DeploymentHandle:
         except Exception:  # noqa: BLE001
             with self._lock:
                 self._inflight[idx] = max(self._inflight.get(idx, 1) - 1, 0)
-        return DeploymentResponse(ref)
+        return DeploymentResponse(ref, handle=self, call=(args, kwargs),
+                                  replica=replica)
 
 
 class _HandleMethod:
@@ -216,34 +453,47 @@ class Application:
 class Deployment:
     def __init__(self, cls_or_fn, name: str, num_replicas: int = 1,
                  max_ongoing_requests: int = 100,
-                 ray_actor_options: Optional[Dict] = None):
+                 ray_actor_options: Optional[Dict] = None,
+                 autoscaling_config: Optional[Dict[str, Any]] = None):
         self._cls_or_fn = cls_or_fn
         self.name = name
         self.num_replicas = num_replicas
         self.max_ongoing_requests = max_ongoing_requests
         self.ray_actor_options = ray_actor_options or {}
+        self.autoscaling_config = autoscaling_config
 
-    def options(self, *, num_replicas: Optional[int] = None,
+    def options(self, *, num_replicas: Optional[Any] = None,
                 name: Optional[str] = None,
                 max_ongoing_requests: Optional[int] = None,
+                autoscaling_config: Optional[Dict[str, Any]] = None,
                 **_) -> "Deployment":
         return Deployment(
             self._cls_or_fn, name or self.name,
             num_replicas or self.num_replicas,
             max_ongoing_requests or self.max_ongoing_requests,
-            self.ray_actor_options)
+            self.ray_actor_options,
+            autoscaling_config if autoscaling_config is not None
+            else self.autoscaling_config)
 
     def bind(self, *args, **kwargs) -> Application:
         return Application(self, args, kwargs)
 
 
-def deployment(_cls=None, *, name: Optional[str] = None, num_replicas: int = 1,
-               max_ongoing_requests: int = 100, **kwargs):
-    """``@serve.deployment`` decorator (class or function)."""
+def deployment(_cls=None, *, name: Optional[str] = None,
+               num_replicas: Any = 1, max_ongoing_requests: int = 100,
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               **kwargs):
+    """``@serve.deployment`` decorator (class or function).
+
+    ``num_replicas="auto"`` or an ``autoscaling_config`` dict (min_replicas,
+    max_replicas, target_ongoing_requests, upscale/downscale_delay_s)
+    enables autoscaling (reference: serve autoscaling_policy.py).
+    """
 
     def decorate(cls_or_fn):
         return Deployment(cls_or_fn, name or cls_or_fn.__name__,
-                          num_replicas, max_ongoing_requests)
+                          num_replicas, max_ongoing_requests,
+                          autoscaling_config=autoscaling_config)
 
     if _cls is not None:
         return decorate(_cls)
@@ -271,7 +521,8 @@ def run(app: Application, *, name: str = "default",
     is_function = not inspect.isclass(dep._cls_or_fn)
     ray_tpu.get(controller.deploy.remote(
         dep.name, dep._cls_or_fn, app.args, app.kwargs, dep.num_replicas,
-        is_function, dep.max_ongoing_requests), timeout=120)
+        is_function, dep.max_ongoing_requests, dep.autoscaling_config),
+        timeout=120)
     return DeploymentHandle(dep.name)
 
 

@@ -110,7 +110,6 @@ def test_replica_recovery():
     ok = False
     while time.monotonic() < deadline:
         try:
-            handle._replicas_ts = 0  # force refresh
             if handle.remote(2).result(timeout_s=10) == 2:
                 ok = True
                 break
@@ -118,6 +117,87 @@ def test_replica_recovery():
             time.sleep(0.5)
     assert ok
     serve.delete("Fragile")
+
+
+def test_autoscaling_up_and_down():
+    """Load ramp scales replicas toward total_ongoing/target, then idleness
+    scales back to min (reference: serve autoscaling_policy.py)."""
+
+    @serve.deployment(autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 1.0,
+        "upscale_delay_s": 0.2, "downscale_delay_s": 0.5,
+    })
+    class Slow:
+        def __call__(self, x):
+            time.sleep(0.4)
+            return x
+
+    handle = serve.run(Slow.bind())
+    controller = ray_tpu.get_actor("__serve_controller__")
+
+    def replica_count():
+        return len(ray_tpu.get(
+            controller.get_replicas.remote("Slow"), timeout=10))
+
+    assert replica_count() == 1
+    # Sustained concurrent load: keep ~6 requests in flight.
+    stop = time.monotonic() + 8
+    pending = []
+    grew = False
+    while time.monotonic() < stop:
+        while len(pending) < 6:
+            pending.append(handle.remote(1))
+        done, pending = pending[:2], pending[2:]
+        for d in done:
+            try:
+                d.result(timeout_s=30)
+            except Exception:
+                pass
+        if replica_count() >= 2:
+            grew = True
+            break
+    for d in pending:
+        try:
+            d.result(timeout_s=30)
+        except Exception:
+            pass
+    assert grew, "autoscaler never scaled up under sustained load"
+    # Idle: scales back down to min_replicas.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline and replica_count() > 1:
+        time.sleep(0.3)
+    assert replica_count() == 1, "autoscaler never scaled back down"
+    serve.delete("Slow")
+
+
+def test_routing_table_pushed_on_change():
+    """Handles learn about replica-set changes via the pubsub event, not a
+    poll TTL: after a scale-up the handle's table refreshes promptly."""
+
+    @serve.deployment(num_replicas=1)
+    class Echo:
+        def __call__(self, x):
+            return x
+
+    handle = serve.run(Echo.bind())
+    assert handle.remote(1).result() == 1
+    assert len(handle._replicas) == 1
+    controller = ray_tpu.get_actor("__serve_controller__")
+    #
+
+    ray_tpu.get(controller.deploy.remote(
+        "Echo", Echo._cls_or_fn, (), {}, 3, False, 100, None), timeout=30)
+    deadline = time.monotonic() + 10
+    seen = 0
+    while time.monotonic() < deadline:
+        handle.remote(2).result(timeout_s=10)
+        seen = len(handle._replicas)
+        if seen == 3:
+            break
+        time.sleep(0.1)
+    assert seen == 3, f"handle saw {seen} replicas; push event not applied"
+    serve.delete("Echo")
 
 
 def test_http_proxy():
