@@ -12,7 +12,7 @@ Public API parity target: ``ray.*`` (reference: ``python/ray/__init__.py``).
 """
 
 from ray_tpu import exceptions
-from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu._private.worker import (
     available_resources,
     cancel,
@@ -36,6 +36,7 @@ __version__ = "0.1.0"
 
 __all__ = [
     "ObjectRef",
+    "ObjectRefGenerator",
     "available_resources",
     "cancel",
     "cluster_resources",

@@ -11,6 +11,12 @@ import dataclasses
 from typing import Any, Dict, Optional
 
 
+def is_streaming(num_returns: Any) -> bool:
+    """True when ``num_returns`` requests a streaming generator task
+    (``"streaming"``, or the reference's ``"dynamic"`` alias)."""
+    return num_returns in ("streaming", "dynamic")
+
+
 @dataclasses.dataclass
 class RemoteOptions:
     # Resources. ``num_tpus`` is first-class: a task/actor holding N tpu chips
@@ -22,8 +28,9 @@ class RemoteOptions:
     memory: Optional[float] = None
     resources: Optional[Dict[str, float]] = None
 
-    # Task behavior.
-    num_returns: int = 1
+    # Task behavior. num_returns: int, or "streaming"/"dynamic" for
+    # generator tasks whose yields become an ObjectRefGenerator.
+    num_returns: Any = 1
     max_retries: Optional[int] = None
     retry_exceptions: Any = False  # False | True | list of exception types
     name: Optional[str] = None

@@ -33,6 +33,7 @@ from ray_tpu._private import rpc
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu._private.memory_store import MemoryStore
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.options import is_streaming
 from ray_tpu._private.runtime.interface import CoreRuntime
 from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
@@ -87,7 +88,9 @@ def put_bytes_to_node(node_stub, oid_binary: bytes, data: bytes,
     from ray_tpu._private.shm import ShmClient
 
     if len(data) > INLINE_RESULT_MAX and ShmClient.available():
-        seg = f"/rtpu.{oid_binary.hex()[:48]}"
+        # Full oid hex: truncating would collide every object of one task
+        # (they differ only in the trailing 4-byte index).
+        seg = f"/rtpu.{oid_binary.hex()}"
         if ShmClient.create_segment(seg, data):
             node_stub.PutObject(pb.PutObjectRequest(
                 object_id=oid_binary, shm_name=seg, size=len(data),
@@ -165,6 +168,12 @@ class ClusterRuntime(CoreRuntime):
         # grew without bound in long-lived drivers).
         self._task_done: set = set()
         self._task_lineage_count: Dict[bytes, int] = {}
+        # task id -> raw promoted-payload bytes, retained while lineage
+        # lives so reconstruction can re-put the payload if the node holding
+        # its only store copy died — memory cost matches the inline-payload
+        # spec the lineage used to pin, so this is not a regression. (The
+        # payload's object id itself lives on the lineage spec.)
+        self._lineage_payload_bytes: Dict[bytes, bytes] = {}
         # GCS pubsub drives actor-address resolution and object-readiness
         # wakeups (no sleep-polling on those paths — reference:
         # pubsub/publisher.h:297). The condition is notified on every
@@ -272,16 +281,24 @@ class ClusterRuntime(CoreRuntime):
         from ray_tpu._private.ids import ObjectID
 
         self.memory.delete([ObjectID(oid)])
+        payload_oid = None
         with self._lineage_lock:
-            if self._lineage.pop(oid, None) is not None:
+            spec = self._lineage.pop(oid, None)
+            if spec is not None:
                 task_key = ObjectID(oid).task_id().binary()
                 n = self._task_lineage_count.get(task_key, 0) - 1
                 if n <= 0:
                     self._task_lineage_count.pop(task_key, None)
                     self._task_done.discard(task_key)
                     self._reconstructing.pop(task_key, None)
+                    self._lineage_payload_bytes.pop(task_key, None)
+                    payload_oid = bytes(spec.payload_ref) or None
                 else:
                     self._task_lineage_count[task_key] = n
+        if payload_oid is not None:
+            # Lineage gone: the promoted payload can go too. Decremented
+            # outside _lineage_lock — the zero callback re-enters here.
+            self.refs.decr(payload_oid)
 
     # ---------------------------------------------------------------- objects
     def put(self, value: Any, owner_ref: Optional[ObjectRef] = None) -> ObjectRef:
@@ -444,12 +461,35 @@ class ClusterRuntime(CoreRuntime):
             ev.wait(300)
             return True
         try:
+            # Task completion can be observed before the worker's location
+            # update lands in the GCS directory; re-probe briefly before
+            # paying for a re-execution (spurious-"lost" window).
+            for _ in range(3):
+                if self._fetch_object(ref)[0]:
+                    return True
+                time.sleep(0.05)
             logger.warning("all copies of %s lost; re-executing task %s (%s)",
                            ref.id().hex()[:12], task_key.hex()[:12], spec.name)
+            # A promoted payload's only store copy may have died with its
+            # node: re-put from the lineage-retained bytes so the executor's
+            # fetch can't dead-end (the inline-payload path never had this
+            # failure mode).
+            raw_payload = spec.payload
+            if spec.payload_ref:
+                raw_payload = self._lineage_payload_bytes.get(task_key, b"")
+                if raw_payload and not self._is_ready(
+                        ObjectRef(ObjectID(bytes(spec.payload_ref)),
+                                  skip_ref_count=True)):
+                    try:
+                        put_bytes_to_node(self.node, bytes(spec.payload_ref),
+                                          raw_payload, self.worker_id)
+                    except Exception:  # noqa: BLE001
+                        logger.exception("payload re-put failed for task %s",
+                                         task_key.hex()[:12])
             # Recursively ensure this task's own ObjectRef args exist.
             if depth < 10:
                 try:
-                    (_, args, kwargs), _ = loads_payload(spec.payload)
+                    (_, args, kwargs), _ = loads_payload(raw_payload)
                     for a in list(args) + list(kwargs.values()):
                         if isinstance(a, ObjectRef) and \
                                 not self._fetch_object(a)[0]:
@@ -522,16 +562,18 @@ class ClusterRuntime(CoreRuntime):
     # ---------------------------------------------------------------- tasks
     def submit_task(self, function, function_name, args, kwargs, options):
         task_id = TaskID.for_normal_task(self.job_id)
-        nreturns = max(options.num_returns, 1)
+        streaming = is_streaming(options.num_returns)
+        nreturns = 1 if streaming else max(options.num_returns, 1)
         return_ids = [ObjectID.from_task(task_id, i) for i in range(nreturns)]
         payload, contained = dumps_payload((function, args, kwargs))
         spec = pb.TaskSpec(
             task_id=task_id.binary(),
             name=function_name,
-            payload=payload,
             return_ids=[oid.binary() for oid in return_ids],
             max_retries=options.max_retries or 0,
+            returns_stream=streaming,
         )
+        payload_oid = self._maybe_promote_payload(task_id, payload, spec)
         if options.runtime_env:
             spec.runtime_env = pickle.dumps(options.runtime_env)
         for k, v in options.task_resources().items():
@@ -550,21 +592,152 @@ class ClusterRuntime(CoreRuntime):
             spec.strategy = pf.strategy
         # Pin every contained ObjectRef (top-level AND nested in containers)
         # for the task's flight time so its refcount can't hit zero between
-        # submit and the worker's borrow flush.
-        pinned = contained
+        # submit and the worker's borrow flush. A promoted payload gets the
+        # same flight pin on top of its lineage pin below.
+        pinned = list(contained)
+        if payload_oid is not None:
+            pinned.append(payload_oid)
+            self.refs.incr(payload_oid)  # lineage pin (see _on_ref_zero)
         for oid in pinned:
             self.refs.incr(oid)
         # Pin lineage for the returns (dropped when this owner's local refs
-        # to them reach zero — see _on_ref_zero).
+        # to them reach zero — see _on_ref_zero). A promoted payload stays
+        # pinned as long as the lineage lives so reconstruction can re-ship
+        # nothing (lineage pinning, task_manager.h:274).
         with self._lineage_lock:
             for oid in return_ids:
                 self._lineage[oid.binary()] = spec
             self._task_lineage_count[task_id.binary()] = \
                 self._task_lineage_count.get(task_id.binary(), 0) + nreturns
+            if payload_oid is not None:
+                self._lineage_payload_bytes[task_id.binary()] = payload
         self._pool.submit(self._lease_and_push, spec, return_ids,
                           options.max_retries or 0, pinned)
         return [ObjectRef(oid, owner_address=self.node_address)
                 for oid in return_ids]
+
+    PAYLOAD_PROMOTE_BYTES = 100 * 1024  # reference: >100KB args to plasma
+    PAYLOAD_INDEX = (1 << 30) - 1       # object index reserved for payloads
+
+    def _maybe_promote_payload(self, task_id: TaskID, payload: bytes,
+                               spec: pb.TaskSpec) -> Optional[bytes]:
+        """Large task payloads go to the object store and travel by ref
+        (reference C29, ``core_worker.cc:1527``): retries, spillback, and
+        reconstruction then re-ship an object id, not megabytes. Returns
+        the payload's object id (pinned by the caller) or None when the
+        payload rode inline."""
+        if len(payload) <= self.PAYLOAD_PROMOTE_BYTES:
+            spec.payload = payload
+            return None
+        oid = ObjectID.from_task(task_id, self.PAYLOAD_INDEX)
+        try:
+            put_bytes_to_node(self.node, oid.binary(), payload,
+                              self.worker_id)
+        except Exception:  # noqa: BLE001
+            if not self._refresh_local_node():
+                spec.payload = payload
+                return None
+            put_bytes_to_node(self.node, oid.binary(), payload,
+                              self.worker_id)
+        spec.payload_ref = oid.binary()
+        return oid.binary()
+
+    def fetch_object_bytes(self, oid_binary: bytes,
+                           timeout: float = 120.0) -> Optional[bytes]:
+        """Raw serialized bytes of a store object (payload-ref fetch path):
+        local node first, then any directory location via chunked pull."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                reply = self.node.GetObject(
+                    pb.GetObjectRequest(object_id=oid_binary))
+                if reply.found:
+                    if reply.shm_name:
+                        from ray_tpu._private.shm import ShmClient
+
+                        data = ShmClient.read_segment(reply.shm_name,
+                                                      reply.size)
+                        if data is not None:
+                            return data
+                    else:
+                        return reply.data
+            except Exception:  # noqa: BLE001
+                self._refresh_local_node()
+            try:
+                locs = self.gcs.GetObjectLocations(
+                    pb.GetObjectLocationsRequest(object_id=oid_binary))
+                if locs.freed:
+                    return None  # freed cluster-wide: no point polling on
+                nodes = self._node_addresses()
+                for nid in locs.node_ids:
+                    addr = nodes.get(nid)
+                    if not addr:
+                        continue
+                    stub = rpc.get_stub("NodeService", addr)
+                    buf = bytearray()
+                    found = False
+                    for chunk in stub.PullObject(
+                            pb.PullObjectRequest(object_id=oid_binary)):
+                        if not chunk.found:
+                            break
+                        found = True
+                        buf.extend(chunk.data)
+                        if chunk.eof:
+                            break
+                    if found:
+                        return bytes(buf)
+            except Exception:  # noqa: BLE001
+                pass
+            time.sleep(0.05)
+        return None
+
+    def release_stream_tail(self, length_ref: ObjectRef,
+                            from_index: int) -> None:
+        """Free the unconsumed items of an abandoned ObjectRefGenerator.
+
+        No holder ever registered the tail items (the consumer stopped
+        iterating before reaching them), so without this they stay pinned
+        in the store for the job's lifetime. Waits for the stream length,
+        then emits a transient +1/-1 refcount pair per tail id — the
+        existing GCS free path reclaims stored copies and directory
+        entries cluster-wide (reference: ObjectRefStream deletion,
+        ``task_manager.h:104``).
+        """
+        task_id = length_ref.task_id()
+
+        def _reap():
+            from ray_tpu._private.object_ref import STREAM_INDEX_BASE
+
+            try:
+                # Wait as long as the producer runs: the length ref always
+                # resolves eventually (a value, or a stored error when the
+                # task/worker dies), and bailing early would leak exactly
+                # the tail this reaper exists to reclaim.
+                while not self._shutdown:
+                    ready, _ = self.wait([length_ref], num_returns=1,
+                                         timeout=60.0, fetch_local=True)
+                    if ready:
+                        break
+                else:
+                    return
+                n = int(self.get([length_ref], timeout=30)[0])
+            except Exception:  # noqa: BLE001
+                # Stream errored: the count never materialized, but items
+                # stored before the failure still exist. Their ids are
+                # contiguous, so probe until the first gap.
+                n = None
+            i = from_index
+            while n is None or i < n:
+                oid_obj = ObjectID.from_task(task_id, STREAM_INDEX_BASE + i)
+                if n is None and not self._is_ready(
+                        ObjectRef(oid_obj, skip_ref_count=True)):
+                    break
+                self.refs.incr(oid_obj.binary())
+                self.refs.decr(oid_obj.binary())
+                i += 1
+
+        threading.Thread(target=_reap, daemon=True,
+                         name="stream-reaper").start()
 
     def _lease_and_push(self, spec: pb.TaskSpec, return_ids: List[ObjectID],
                         retries: int, pinned: Optional[List[bytes]] = None):
@@ -841,7 +1014,8 @@ class ClusterRuntime(CoreRuntime):
 
     def submit_actor_task(self, actor_id, method_name, args, kwargs, options):
         task_id = TaskID.for_actor_task(actor_id)
-        nreturns = max(options.num_returns, 1)
+        streaming = is_streaming(options.num_returns)
+        nreturns = 1 if streaming else max(options.num_returns, 1)
         return_ids = [ObjectID.from_task(task_id, i) for i in range(nreturns)]
         # Sequence numbers are scoped to a caller *session*; the session
         # rotates whenever the cached actor address is invalidated, so a
@@ -856,18 +1030,24 @@ class ClusterRuntime(CoreRuntime):
             task_id=task_id.binary(),
             name=method_name,
             method_name=method_name,
-            payload=payload,
             return_ids=[oid.binary() for oid in return_ids],
             actor_id=actor_id.binary(),
             sequence_no=seq,
             caller_address=f"{self.worker_id}:{session}".encode(),
+            returns_stream=streaming,
         )
+        payload_oid = self._maybe_promote_payload(task_id, payload, spec)
         # Same flight-time pinning as submit_task: actor resolution can take
-        # tens of seconds, during which the caller may drop its handles.
-        for oid in contained:
+        # tens of seconds, during which the caller may drop its handles. A
+        # promoted payload is pinned the same way (released after the push —
+        # actor tasks are not lineage-reconstructed).
+        pinned = list(contained)
+        if payload_oid is not None:
+            pinned.append(payload_oid)
+        for oid in pinned:
             self.refs.incr(oid)
         self._pool.submit(self._push_actor_task, actor_id, spec, return_ids,
-                          options.max_task_retries, contained)
+                          options.max_task_retries, pinned)
         return [ObjectRef(oid, owner_address=self.node_address)
                 for oid in return_ids]
 

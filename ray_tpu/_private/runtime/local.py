@@ -37,6 +37,7 @@ from ray_tpu import exceptions
 from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
 from ray_tpu._private.memory_store import MemoryStore
 from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private import options as opt_mod
 from ray_tpu._private.options import RemoteOptions
 from ray_tpu._private.runtime.interface import CoreRuntime
 
@@ -220,7 +221,7 @@ class _LocalActor:
 
     # -- execution --------------------------------------------------------
     def _execute(self, method_name: str, args, kwargs, return_ids: List[ObjectID],
-                 task_id: TaskID):
+                 task_id: TaskID, streaming: bool = False):
         token = _context.set(_TaskCtx(task_id, self.actor_id,
                                       name=f"{self.cls.__name__}.{method_name}"))
         try:
@@ -233,7 +234,12 @@ class _LocalActor:
                 method = getattr(self.instance, method_name)
                 result = method(*args, **kwargs)
             if inspect.isgenerator(result):
-                self.runtime._store_generator(result, return_ids, task_id)
+                self.runtime._store_generator(result, return_ids, task_id,
+                                              streaming=streaming)
+            elif streaming:
+                raise TypeError(
+                    f"num_returns='streaming' requires a generator method, "
+                    f"but {method_name!r} returned {type(result).__name__}")
             else:
                 self.runtime._store_results(result, return_ids)
         except exceptions.AsyncioActorExit:
@@ -246,7 +252,8 @@ class _LocalActor:
         finally:
             _context.reset(token)
 
-    async def _execute_async(self, method_name, args, kwargs, return_ids, task_id):
+    async def _execute_async(self, method_name, args, kwargs, return_ids,
+                             task_id, streaming: bool = False):
         # ContextVar set inside an asyncio task is task-local, so concurrent
         # coroutines keep distinct task contexts.
         token = _context.set(_TaskCtx(task_id, self.actor_id,
@@ -256,7 +263,26 @@ class _LocalActor:
             result = method(*args, **kwargs)
             if inspect.iscoroutine(result):
                 result = await result
-            self.runtime._store_results(result, return_ids)
+            if inspect.isasyncgen(result):
+                if streaming:
+                    from ray_tpu._private.object_ref import \
+                        drain_stream_async
+
+                    n = await drain_stream_async(result, task_id,
+                                                 self.runtime.store.put)
+                    self.runtime._store_results(n, return_ids)
+                else:
+                    self.runtime._store_results(
+                        [item async for item in result], return_ids)
+            elif inspect.isgenerator(result):
+                self.runtime._store_generator(result, return_ids, task_id,
+                                              streaming=streaming)
+            elif streaming:
+                raise TypeError(
+                    f"num_returns='streaming' requires a generator method, "
+                    f"but {method_name!r} returned {type(result).__name__}")
+            else:
+                self.runtime._store_results(result, return_ids)
         except exceptions.AsyncioActorExit:
             self.runtime._store_results(None, return_ids)
             self.terminate()
@@ -268,7 +294,8 @@ class _LocalActor:
             _context.reset(token)
 
     # -- lifecycle --------------------------------------------------------
-    def submit(self, method_name, args, kwargs, return_ids, task_id):
+    def submit(self, method_name, args, kwargs, return_ids, task_id,
+               streaming: bool = False):
         with self._lock:
             if self.dead:
                 err = exceptions.ActorDiedError(
@@ -281,7 +308,8 @@ class _LocalActor:
                 raise exceptions.PendingCallsLimitExceeded(
                     f"Actor {self.actor_id.hex()} has "
                     f">={self.options.max_pending_calls} pending calls")
-            self._inbox.put((method_name, args, kwargs, return_ids, task_id))
+            self._inbox.put((method_name, args, kwargs, return_ids, task_id,
+                             streaming))
 
     def _die(self, cause: Optional[BaseException]):
         with self._lock:
@@ -297,7 +325,7 @@ class _LocalActor:
                 break
             if item is None:
                 continue
-            _, _, _, return_ids, _ = item
+            return_ids = item[3]
             self.runtime._store_error(
                 exceptions.ActorDiedError(self.actor_id, f"Actor died: {cause}"),
                 return_ids)
@@ -535,6 +563,8 @@ class LocalRuntime(CoreRuntime):
     def submit_task(self, function, function_name, args, kwargs, options):
         task_id = TaskID.for_normal_task(self.job_id)
         nreturns = options.num_returns
+        if opt_mod.is_streaming(nreturns):
+            nreturns = 1
         return_ids = [ObjectID.from_task(task_id, i) for i in range(max(nreturns, 1))]
         retries = options.max_retries
         if retries is None:
@@ -642,7 +672,14 @@ class LocalRuntime(CoreRuntime):
             try:
                 result = function(*args, **kwargs)
                 if inspect.isgenerator(result):
-                    self._store_generator(result, return_ids, task_id)
+                    self._store_generator(
+                        result, return_ids, task_id,
+                        streaming=opt_mod.is_streaming(options.num_returns))
+                elif opt_mod.is_streaming(options.num_returns):
+                    raise TypeError(
+                        f"num_returns='streaming' requires a generator "
+                        f"function, but {function_name!r} returned "
+                        f"{type(result).__name__}")
                 else:
                     self._store_results(result, return_ids)
             except BaseException as e:  # noqa: BLE001
@@ -684,11 +721,56 @@ class LocalRuntime(CoreRuntime):
         for oid, v in zip(return_ids, result):
             self.store.put(oid, v)
 
-    def _store_generator(self, gen, return_ids: List[ObjectID], task_id):
-        # num_returns="streaming" is modeled as eager drain in local mode.
+    def _store_generator(self, gen, return_ids: List[ObjectID], task_id,
+                         streaming: bool = False):
+        if streaming:
+            # Each yield becomes its own store object at the deterministic
+            # stream id the caller's ObjectRefGenerator polls; the declared
+            # return carries the count (ObjectRefStream semantics).
+            from ray_tpu._private.object_ref import drain_stream
+
+            self._store_results(
+                drain_stream(gen, task_id, self.store.put), return_ids)
+            return
         values = list(gen)
         self._store_results(tuple(values) if len(return_ids) > 1 else values,
                             return_ids)
+
+    def release_stream_tail(self, length_ref: ObjectRef,
+                            from_index: int) -> None:
+        """Delete unconsumed stream items of an abandoned
+        ObjectRefGenerator (see ClusterRuntime.release_stream_tail)."""
+        task_id = length_ref.task_id()
+
+        def _reap():
+            from ray_tpu._private.object_ref import STREAM_INDEX_BASE
+
+            try:
+                # Outlast the producer (see ClusterRuntime counterpart).
+                while not self._shutdown:
+                    ready, _ = self.wait([length_ref], num_returns=1,
+                                         timeout=60.0, fetch_local=True)
+                    if ready:
+                        break
+                else:
+                    return
+                n = int(self.get([length_ref], timeout=30)[0])
+            except Exception:  # noqa: BLE001
+                # Errored stream: free the contiguous prefix of stored
+                # items (see ClusterRuntime.release_stream_tail).
+                i = from_index
+                while True:
+                    oid = ObjectID.from_task(task_id, STREAM_INDEX_BASE + i)
+                    if not self.store.contains(oid):
+                        return
+                    self.store.delete([oid])
+                    i += 1
+            self.store.delete([
+                ObjectID.from_task(task_id, STREAM_INDEX_BASE + i)
+                for i in range(from_index, n)])
+
+        threading.Thread(target=_reap, daemon=True,
+                         name="stream-reaper").start()
 
     def _store_error(self, err, return_ids: List[ObjectID]):
         for oid in return_ids:
@@ -750,7 +832,8 @@ class LocalRuntime(CoreRuntime):
     def submit_actor_task(self, actor_id, method_name, args, kwargs, options):
         actor = self._actors.get(actor_id)
         task_id = TaskID.for_actor_task(actor_id)
-        nreturns = max(options.num_returns, 1)
+        streaming = opt_mod.is_streaming(options.num_returns)
+        nreturns = 1 if streaming else max(options.num_returns, 1)
         return_ids = [ObjectID.from_task(task_id, i) for i in range(nreturns)]
         if actor is None:
             self._store_error(
@@ -760,7 +843,8 @@ class LocalRuntime(CoreRuntime):
             self._schedule_when_ready(
                 args, kwargs,
                 lambda rargs, rkwargs: actor.submit(method_name, rargs, rkwargs,
-                                                    return_ids, task_id),
+                                                    return_ids, task_id,
+                                                    streaming),
                 return_ids)
         return [ObjectRef(oid, owner_address="local") for oid in return_ids]
 

@@ -31,7 +31,7 @@ from ray_tpu import exceptions
 from ray_tpu._private import pg_context
 from ray_tpu._private import rpc
 from ray_tpu._private import worker as worker_mod
-from ray_tpu._private.ids import ActorID
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.runtime.cluster import (
     ClusterRuntime,
@@ -100,6 +100,38 @@ class WorkerServer:
             worker_id=worker_id, address=self.address, pid=os.getpid()))
 
     # ------------------------------------------------------------- helpers
+    def _payload_bytes(self, spec) -> bytes:
+        """Inline payload, or fetch a promoted one from the object store
+        (reference: plasma-promoted task args, core_worker.cc:1527)."""
+        if spec.payload_ref:
+            raw = self.runtime.fetch_object_bytes(bytes(spec.payload_ref))
+            if raw is None:
+                raise exceptions.RayTpuError(
+                    f"task payload object "
+                    f"{bytes(spec.payload_ref).hex()[:16]} was lost")
+            return raw
+        return spec.payload
+
+    def _stream_generator(self, gen, spec) -> int:
+        """Drain a streaming-generator task via the shared protocol helper
+        (reference: ObjectRefStream, task_manager.h:104). Each yielded value
+        becomes its own store object, visible to the caller's
+        ObjectRefGenerator before the task finishes; returns the item count,
+        which rides the declared return."""
+        import inspect
+
+        from ray_tpu._private.object_ref import drain_stream
+
+        if not (inspect.isgenerator(gen) or hasattr(gen, "__next__")):
+            raise TypeError(
+                f"num_returns='streaming' requires a generator "
+                f"{'method' if spec.actor_id else 'function'}, but "
+                f"{spec.name!r} returned {type(gen).__name__}")
+        return drain_stream(
+            gen, TaskID(bytes(spec.task_id)),
+            lambda oid, item: put_bytes_to_node(
+                self.node, oid.binary(), dumps(item), self.worker_id))
+
     def _resolve_args(self, args, kwargs):
         """Top-level ObjectRef resolution (nested refs pass through)."""
         refs = [a for a in args if isinstance(a, ObjectRef)]
@@ -168,7 +200,8 @@ class WorkerServer:
                         os.environ[k] = str(v)
                     if renv.get("working_dir"):
                         os.chdir(renv["working_dir"])
-                (fn, args, kwargs), n_borrows = loads_payload(spec.payload)
+                (fn, args, kwargs), n_borrows = \
+                    loads_payload(self._payload_bytes(spec))
                 if n_borrows:
                     # Flush the borrow (+1) registrations synchronously so
                     # the GCS observes them before the submitter's pin
@@ -187,7 +220,9 @@ class WorkerServer:
                 finally:
                     if spec.placement_group_id:
                         pg_context.clear()
-                if hasattr(result, "__next__"):  # generator tasks
+                if spec.returns_stream:
+                    result = self._stream_generator(result, spec)
+                elif hasattr(result, "__next__"):  # legacy generator tasks
                     result = tuple(result) if len(spec.return_ids) > 1 \
                         else list(result)
                 return self._package_results(result, spec.return_ids)
@@ -215,7 +250,8 @@ class WorkerServer:
                     ActorID(bytes(spec.actor_id)), "actor died")
                 return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
         try:
-            (_, args, kwargs), n_borrows = loads_payload(spec.payload)
+            (_, args, kwargs), n_borrows = \
+                loads_payload(self._payload_bytes(spec))
             if n_borrows:
                 self.runtime.refs.flush()  # borrow-before-pin-release order
             args, kwargs = self._resolve_args(args, kwargs)
@@ -235,6 +271,8 @@ class WorkerServer:
             finally:
                 if runner.pg_ctx is not None:
                     pg_context.clear()
+            if spec.returns_stream:
+                result = self._stream_generator(result, spec)
             return self._package_results(result, spec.return_ids)
         except exceptions.AsyncioActorExit:
             self._terminate_actor(spec.actor_id, "exit_actor() called")

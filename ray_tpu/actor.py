@@ -15,7 +15,7 @@ from typing import Any, Dict, Optional
 
 from ray_tpu._private import worker as _worker
 from ray_tpu._private.ids import ActorID
-from ray_tpu._private.options import RemoteOptions
+from ray_tpu._private.options import RemoteOptions, is_streaming
 
 
 def method(**method_options):
@@ -55,7 +55,14 @@ class ActorMethod:
              if k in ("num_returns",)})
         refs = _worker.global_worker().core.submit_actor_task(
             self._handle._actor_id, self._method_name, args, kwargs, opts)
-        num_returns = self._method_options.get("num_returns", 1)
+        # Same source of truth as submit_actor_task: the merged options
+        # (a class-level num_returns="streaming" must stream too).
+        num_returns = opts.num_returns
+        if is_streaming(num_returns):
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0],
+                                      owner_address=refs[0].owner_address())
         if num_returns == 1:
             return refs[0]
         return refs

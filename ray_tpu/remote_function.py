@@ -12,7 +12,8 @@ import functools
 from typing import Any, Dict
 
 from ray_tpu._private import worker as _worker
-from ray_tpu._private.options import RemoteOptions, options_from_decorator_kwargs
+from ray_tpu._private.options import (RemoteOptions, is_streaming,
+                                      options_from_decorator_kwargs)
 
 
 class RemoteFunction:
@@ -44,6 +45,13 @@ class RemoteFunction:
     def _remote(self, args, kwargs, options: RemoteOptions):
         refs = _worker.global_worker().core.submit_task(
             self._function, self._function_name, args, kwargs, options)
+        if is_streaming(options.num_returns):
+            # Generator task: refs[0] carries the final item count; items
+            # stream out at deterministic ids (reference: ObjectRefStream).
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(refs[0],
+                                      owner_address=refs[0].owner_address())
         if options.num_returns == 1:
             return refs[0]
         return refs
