@@ -42,6 +42,9 @@ struct Store {
   std::mutex mu;
   std::unordered_map<std::string, Entry> index;  // object id (hex) -> entry
   std::list<std::string> lru;                    // front = most recent
+  // Objects mid-write (capacity reserved, segment not yet sealed). Kept out
+  // of `index` so readers can never map a partially written segment.
+  std::unordered_map<std::string, uint64_t> pending;
 };
 
 std::string SegmentName(const Store* s, const std::string& oid) {
@@ -107,13 +110,21 @@ int shm_store_put(void* handle, const char* oid, const void* data,
       snprintf(name_out, name_cap, "%s", e.name.c_str());
       return 0;
     }
-    if (!EvictLocked(s, size)) return -2;
     name = SegmentName(s, oid);
+    if (s->pending.count(oid)) {
+      // Another thread is writing the same immutable object; report its
+      // name — readers stay safe because lookups miss until it seals.
+      snprintf(name_out, name_cap, "%s", name.c_str());
+      return 0;
+    }
+    if (!EvictLocked(s, size)) return -2;
     s->used += size;  // reserve before the copy so parallel puts respect cap
-    s->lru.push_front(oid);
-    Entry e{name, size, s->lru.begin()};
-    s->index.emplace(oid, e);
+    s->pending.emplace(oid, size);
   }
+  // Create + fill OUTSIDE the index: a concurrent Get must never hand a
+  // reader the name of a segment that isn't fully written yet (mapping
+  // past a short file's end SIGBUSes the reader). Plasma's Create/Seal
+  // boundary, collapsed to "insert into the index only once sealed".
   int fd = shm_open(name.c_str(), O_CREAT | O_RDWR | O_EXCL, 0600);
   if (fd < 0 && errno == EEXIST) {
     shm_unlink(name.c_str());  // stale segment from a crashed predecessor
@@ -129,11 +140,17 @@ int shm_store_put(void* handle, const char* oid, const void* data,
     }
   }
   if (fd >= 0) close(fd);
-  if (!ok) {
+  {
     std::lock_guard<std::mutex> g(s->mu);
-    auto it = s->index.find(oid);
-    if (it != s->index.end()) DropLocked(s, it);
-    return -1;
+    s->pending.erase(oid);
+    if (!ok) {
+      s->used -= size;
+      shm_unlink(name.c_str());
+      return -1;
+    }
+    s->lru.push_front(oid);
+    Entry e{name, size, s->lru.begin()};
+    s->index.emplace(oid, e);
   }
   snprintf(name_out, name_cap, "%s", name.c_str());
   return 0;
@@ -170,6 +187,16 @@ int shm_store_get(void* handle, const char* oid, char* name_out,
   return 0;
 }
 
+// Object id of the least-recently-used entry (spill victim selection).
+// Returns 0 and fills oid_out, or -1 when the store is empty.
+int shm_store_coldest(void* handle, char* oid_out, uint64_t oid_cap) {
+  auto* s = static_cast<Store*>(handle);
+  std::lock_guard<std::mutex> g(s->mu);
+  if (s->lru.empty()) return -1;
+  snprintf(oid_out, oid_cap, "%s", s->lru.back().c_str());
+  return 0;
+}
+
 int shm_store_contains(void* handle, const char* oid) {
   auto* s = static_cast<Store*>(handle);
   std::lock_guard<std::mutex> g(s->mu);
@@ -202,6 +229,14 @@ uint64_t shm_store_count(void* handle) {
 void* shm_client_map(const char* name, uint64_t size) {
   int fd = shm_open(name, O_RDONLY, 0);
   if (fd < 0) return nullptr;
+  // Mapping past a short file SIGBUSes on access; a not-fully-written
+  // segment (e.g. a concurrent creator between create and seal) must read
+  // as "not available yet", not crash the reader.
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (uint64_t)st.st_size < size) {
+    close(fd);
+    return nullptr;
+  }
   void* p = mmap(nullptr, size, PROT_READ, MAP_SHARED, fd, 0);
   close(fd);
   return p == MAP_FAILED ? nullptr : p;

@@ -17,6 +17,7 @@ import os
 import pickle
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 import uuid
@@ -44,6 +45,7 @@ class _Worker:
         self.leased_for: Optional[bytes] = None  # lease id
         self.is_actor_worker = False
         self.idle_since = time.monotonic()
+        self.busy_since = 0.0  # set when leased (memory-monitor kill order)
 
 
 class NodeManager:
@@ -71,12 +73,22 @@ class NodeManager:
         self._objects: Dict[bytes, bytes] = {}
         self._obj_lock = threading.RLock()
         self._shm = None
+        # Spilling (reference: LocalObjectManager, local_object_manager.h:41):
+        # instead of LRU-*dropping* under memory pressure, cold objects move
+        # to disk and restore on access. The C++ store therefore gets an
+        # unbounded capacity; the configured budget is enforced here by
+        # spilling down from the high watermark to the low one.
+        self._store_capacity = int(os.environ.get(
+            "RAY_TPU_OBJECT_STORE_BYTES", 4 << 30))
+        self._spill_dir = os.path.join(
+            tempfile.gettempdir(), f"ray_tpu_spill_{self.node_id[:12]}")
+        self._spilled: Dict[str, Tuple[str, int]] = {}  # oid -> (path, size)
+        self._spill_lock = threading.Lock()
+        self._spill_event = threading.Event()
         try:
             from ray_tpu._private.shm import ShmStore
 
-            self._shm = ShmStore(
-                capacity_bytes=int(os.environ.get(
-                    "RAY_TPU_OBJECT_STORE_BYTES", 4 << 30)))
+            self._shm = ShmStore(capacity_bytes=1 << 62)
         except Exception as e:  # noqa: BLE001
             logger.warning("native shm store unavailable (%s); "
                            "using in-memory store", e)
@@ -124,6 +136,19 @@ class NodeManager:
         # Prestart workers so first leases don't pay process-spawn latency
         # (reference: worker pool prestart, worker_pool.h:216).
         threading.Thread(target=self._prestart_workers, daemon=True).start()
+        # Memory monitor (reference: memory_monitor.h:52): sheds the newest
+        # leased task worker under host memory pressure so the OS OOM killer
+        # never picks a victim at random. Kill cause surfaces through the
+        # normal worker-crash retry path.
+        self._mem_threshold = float(os.environ.get(
+            "RAY_TPU_MEMORY_USAGE_THRESHOLD", 0.95))
+        self._mem_usage_file = os.environ.get("RAY_TPU_MEMORY_USAGE_FILE", "")
+        self.oom_kills = 0
+        threading.Thread(target=self._memory_monitor_loop, daemon=True,
+                         name="nm-memmon").start()
+        if self._shm is not None:
+            threading.Thread(target=self._spill_loop, daemon=True,
+                             name="nm-spill").start()
 
     def _prestart_workers(self):
         n = min(int(self.total.get("CPU", 1)), 4)
@@ -450,6 +475,7 @@ class NodeManager:
                 return pb.LeaseReply(granted=False,
                                      error="worker start timeout")
             worker.leased_for = lease_id
+            worker.busy_since = time.monotonic()
             with self._pool_lock:
                 if worker.worker_id in self._idle:
                     self._idle.remove(worker.worker_id)
@@ -485,6 +511,7 @@ class NodeManager:
                 return pb.LeaseReply(granted=False,
                                      error="worker start timeout")
             worker.leased_for = lease_id
+            worker.busy_since = time.monotonic()
             with self._pool_lock:
                 if worker.worker_id in self._idle:
                     self._idle.remove(worker.worker_id)
@@ -641,6 +668,144 @@ class NodeManager:
             self._release(dict(freed))
         return pb.Empty()
 
+    # ----------------------------------------------------------- spilling
+    SPILL_HIGH = 0.9  # spill starts above this fraction of the budget
+    SPILL_LOW = 0.7   # ... and runs down to this fraction
+
+    def _maybe_spill(self):
+        """Signal the spill thread when the store exceeds its budget
+        (reference: LocalObjectManager::SpillObjectsOfSize,
+        local_object_manager.h:41 — spilling happens on background IO, so
+        the put/get handler threads never stall on the disk drain)."""
+        if self._shm is None:
+            return
+        used, _ = self._shm.stats()
+        if used > self._store_capacity * self.SPILL_HIGH:
+            self._spill_event.set()
+
+    def _spill_loop(self):
+        while not self._stop.is_set():
+            if not self._spill_event.wait(0.25):
+                continue
+            self._spill_event.clear()
+            self._drain_to_low_water()
+
+    def _drain_to_low_water(self):
+        """Spill LRU-cold objects until usage falls to the low watermark.
+        The lock is taken per victim so concurrent restores/pulls interleave
+        with the drain instead of blocking for its whole duration."""
+        target = self._store_capacity * self.SPILL_LOW
+        try:
+            os.makedirs(self._spill_dir, exist_ok=True)
+        except OSError:
+            return
+        while not self._stop.is_set():
+            used, _ = self._shm.stats()
+            if used <= target:
+                break
+            with self._spill_lock:
+                oid = self._shm.coldest()
+                if oid is None:
+                    break
+                data = self._shm.read(oid)
+                if data is None:
+                    self._shm.delete(oid)
+                    continue
+                path = os.path.join(self._spill_dir, oid)
+                tmp = f"{path}.tmp.{os.getpid()}"
+                try:
+                    with open(tmp, "wb") as f:
+                        f.write(data)
+                    os.replace(tmp, path)
+                except OSError:
+                    logger.exception("spill write failed; stopping spill")
+                    break
+                self._spilled[oid] = (path, len(data))
+                self._shm.delete(oid)
+
+    def _restore_spilled(self, oid_hex: str) -> Optional[bytes]:
+        """Bring a spilled object back (reference:
+        ObjectManager restore-from-external-storage). Returns the bytes, or
+        None if this object was never spilled here."""
+        with self._spill_lock:
+            meta = self._spilled.get(oid_hex)
+            if meta is None:
+                return None
+            path, _ = meta
+            try:
+                with open(path, "rb") as f:
+                    data = f.read()
+            except OSError:
+                self._spilled.pop(oid_hex, None)
+                return None
+            if self._shm is not None and \
+                    self._shm.put(oid_hex, data) is not None:
+                self._spilled.pop(oid_hex, None)
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        self._maybe_spill()  # the restore itself may breach the high water
+        return data
+
+    # ------------------------------------------------------ memory monitor
+    def _memory_usage_fraction(self) -> float:
+        if self._mem_usage_file:
+            try:
+                with open(self._mem_usage_file) as f:
+                    return float(f.read().strip() or 0.0)
+            except (OSError, ValueError):
+                return 0.0
+        try:  # cgroup v2 limit, when one is set
+            with open("/sys/fs/cgroup/memory.current") as f:
+                cur = int(f.read())
+            with open("/sys/fs/cgroup/memory.max") as f:
+                mx = f.read().strip()
+            if mx != "max":
+                return cur / max(int(mx), 1)
+        except (OSError, ValueError):
+            pass
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, v = line.split(":", 1)
+                    info[k] = int(v.strip().split()[0])
+            return 1.0 - info["MemAvailable"] / max(info["MemTotal"], 1)
+        except (OSError, ValueError, KeyError):
+            return 0.0
+
+    def _memory_monitor_loop(self):
+        while not self._stop.wait(0.25):
+            if self._memory_usage_fraction() < self._mem_threshold:
+                continue
+            if self._shed_memory():
+                # Give the freed memory time to show up before re-checking.
+                self._stop.wait(1.0)
+
+    def _shed_memory(self) -> bool:
+        """Kill the newest leased non-actor worker (newest-first mirrors the
+        reference policy of shedding retriable work before long-running
+        work; the node doesn't see TaskSpecs, so retriability itself is
+        decided by the owner's retry budget on the crash-retry path)."""
+        with self._pool_lock:
+            busy = [w for w in self._workers.values()
+                    if w.leased_for is not None and not w.is_actor_worker
+                    and w.proc.poll() is None]
+            if not busy:
+                return False
+            victim = max(busy, key=lambda w: w.busy_since)
+        logger.warning(
+            "memory usage above threshold %.2f: killing newest task worker "
+            "%s (reference memory_monitor policy)",
+            self._mem_threshold, victim.worker_id)
+        try:
+            victim.proc.kill()
+        except Exception:  # noqa: BLE001
+            return False
+        self.oom_kills += 1
+        return True
+
     # ------------------------------------------------------------ objects
     def PutObject(self, request, context):
         size = request.size or len(request.data)
@@ -660,11 +825,23 @@ class NodeManager:
                 added=True, size=size))
         except Exception:  # noqa: BLE001
             pass
+        self._maybe_spill()
         return pb.Empty()
 
     def GetObject(self, request, context):
+        oid_hex = request.object_id.hex()
         if self._shm is not None:
-            meta = self._shm.get(request.object_id.hex())
+            meta = self._shm.get(oid_hex)
+            if meta is None and oid_hex in self._spilled:
+                if request.metadata_only:
+                    # Report presence without paying the restore.
+                    size = self._spilled.get(oid_hex, (None, 0))[1]
+                    return pb.GetObjectReply(found=True, size=size)
+                data = self._restore_spilled(oid_hex)
+                if data is not None:
+                    meta = self._shm.get(oid_hex)
+                    if meta is None:  # restore couldn't re-seat it in shm
+                        return pb.GetObjectReply(found=True, data=data)
             if meta is not None:
                 name, size = meta
                 if request.metadata_only:
@@ -683,6 +860,16 @@ class NodeManager:
             data = self._shm.read(object_id.hex())
             if data is not None:
                 return data
+            # Spilled: serve straight from disk without churning the store
+            # (remote pulls don't need the object resident locally).
+            with self._spill_lock:
+                meta = self._spilled.get(object_id.hex())
+                if meta is not None:
+                    try:
+                        with open(meta[0], "rb") as f:
+                            return f.read()
+                    except OSError:
+                        pass
         with self._obj_lock:
             return self._objects.get(object_id)
 
@@ -708,6 +895,13 @@ class NodeManager:
         for oid in request.object_ids:
             if self._shm is not None:
                 self._shm.delete(oid.hex())
+            with self._spill_lock:
+                meta = self._spilled.pop(oid.hex(), None)
+            if meta is not None:
+                try:
+                    os.unlink(meta[0])
+                except OSError:
+                    pass
             try:
                 self.gcs.UpdateObjectLocation(pb.ObjectLocationUpdate(
                     object_id=oid, node_id=self.node_id, added=False))
@@ -743,6 +937,9 @@ class NodeManager:
                 self._shm.close()
             except Exception:  # noqa: BLE001
                 pass
+        import shutil
+
+        shutil.rmtree(self._spill_dir, ignore_errors=True)
 
 
 class _DummyProc:

@@ -46,6 +46,9 @@ def _load() -> Optional[ctypes.CDLL]:
                                   ctypes.POINTER(ctypes.c_uint64)]
     lib.shm_store_contains.restype = ctypes.c_int
     lib.shm_store_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.shm_store_coldest.restype = ctypes.c_int
+    lib.shm_store_coldest.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                      ctypes.c_uint64]
     lib.shm_store_delete.restype = ctypes.c_int
     lib.shm_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.shm_store_used.restype = ctypes.c_uint64
@@ -121,6 +124,13 @@ class ShmStore:
 
     def contains(self, oid_hex: str) -> bool:
         return bool(self._lib.shm_store_contains(self._h, oid_hex.encode()))
+
+    def coldest(self) -> Optional[str]:
+        """Least-recently-used object id (spill victim), or None if empty."""
+        buf = ctypes.create_string_buffer(_NAME_CAP)
+        if self._lib.shm_store_coldest(self._h, buf, _NAME_CAP) != 0:
+            return None
+        return buf.value.decode()
 
     def delete(self, oid_hex: str) -> bool:
         return self._lib.shm_store_delete(self._h, oid_hex.encode()) == 0

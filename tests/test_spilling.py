@@ -1,0 +1,104 @@
+"""Object spilling + memory monitor.
+
+Reference: disk spilling with restore-on-access
+(``src/ray/raylet/local_object_manager.h:41``) and the host memory monitor
+that sheds retriable work before the OS OOM killer fires
+(``src/ray/common/memory_monitor.h:52``).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+MB = 1024 * 1024
+
+
+@pytest.fixture
+def small_store_cluster(monkeypatch):
+    # 4MB object-store budget so a handful of ~1MB objects force spilling.
+    monkeypatch.setenv("RAY_TPU_OBJECT_STORE_BYTES", str(4 * MB))
+    c = Cluster(head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_spill_and_restore_roundtrip(small_store_cluster):
+    """Filling the store past its budget must spill to disk, keep usage
+    under budget, and still serve every object back on get."""
+    node = small_store_cluster.head_node
+    if node._shm is None:
+        pytest.skip("native shm store unavailable")
+    refs = [ray_tpu.put(np.full(100_000, i, dtype=np.float64))  # ~800KB each
+            for i in range(12)]
+    # The drain runs on the node's background spill thread.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        used, _ = node._shm.stats()
+        if node._spilled and used <= 4 * MB:
+            break
+        time.sleep(0.05)
+    assert node._spilled, "expected cold objects to spill"
+    used, _ = node._shm.stats()
+    assert used <= 4 * MB, f"store over budget after spill: {used}"
+    for i, r in enumerate(refs):
+        v = ray_tpu.get(r, timeout=60)
+        assert int(v[0]) == i and v.shape == (100_000,)
+
+
+def test_spill_task_outputs(small_store_cluster):
+    """Task returns written worker-side (zero-copy register path) spill and
+    restore the same way driver puts do."""
+
+    @ray_tpu.remote
+    def make(i):
+        return np.full(130_000, i, dtype=np.float64)  # ~1MB
+
+    refs = [make.remote(i) for i in range(10)]
+    vals = [ray_tpu.get(r, timeout=120) for r in refs]
+    assert [int(v[0]) for v in vals] == list(range(10))
+
+
+def test_memory_monitor_kills_newest_task_worker(tmp_path, monkeypatch):
+    """Above the usage threshold the node kills the newest leased task
+    worker; the owner's crash-retry path finishes the task."""
+    usage = tmp_path / "usage"
+    usage.write_text("0.0")
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_FILE", str(usage))
+    monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0.9")
+    c = Cluster(head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote(max_retries=2)
+        def slow(marker_dir):
+            import os
+            import time as t
+
+            mk = os.path.join(marker_dir, "attempt")
+            if not os.path.exists(mk):
+                open(mk, "w").close()
+                t.sleep(30)  # first attempt: hang until the monitor kills us
+            return "done"
+
+        ref = slow.remote(str(tmp_path))
+        deadline = time.monotonic() + 30
+        while not (tmp_path / "attempt").exists() and \
+                time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert (tmp_path / "attempt").exists(), "task never started"
+        usage.write_text("0.99")
+        node = c.head_node
+        deadline = time.monotonic() + 20
+        while node.oom_kills == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert node.oom_kills >= 1, "memory monitor never killed a worker"
+        usage.write_text("0.0")  # pressure relieved; let the retry finish
+        assert ray_tpu.get(ref, timeout=90) == "done"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
