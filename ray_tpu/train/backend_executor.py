@@ -52,7 +52,41 @@ class TrainWorker:
         return True
 
     def node_ip(self) -> str:
-        return "127.0.0.1"
+        """Routable address of this worker's host — the coordinator must be
+        reachable from every other host, so loopback is only the fallback."""
+        import socket
+
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect(("8.8.8.8", 80))  # no packet sent; routing only
+                return s.getsockname()[0]
+        except OSError:
+            return "127.0.0.1"
+
+    def reserve_port(self) -> int:
+        """Free port on this worker's host for the coordinator service."""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+
+    def init_jax_distributed(self, coordinator: str, num_processes: int):
+        """Join the jax.distributed group (reference analog: MASTER_ADDR +
+        ``dist.init_process_group``, ``train/torch/config.py:153``). Worker
+        0 hosts the coordinator service; every process must call in before
+        any jax computation runs in it."""
+        import jax
+
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=self.rank)
+        except RuntimeError as e:
+            if "already" not in str(e).lower():
+                raise
+        return jax.process_index()
 
     def run(self, fn: Callable, config: Optional[Dict[str, Any]],
             restore_checkpoint_path: Optional[str]):
@@ -133,6 +167,26 @@ class JaxBackend:
         # CUDA_VISIBLE_DEVICES across colocated workers).
         env = {"RAY_TPU_TRAIN_WORLD_SIZE": str(scaling.num_workers)}
         worker_group.execute("setup", env)
+        if scaling.jax_distributed and scaling.num_workers > 1:
+            w0 = worker_group.workers[0]
+            host = ray_tpu.get(w0.node_ip.remote())
+            port = ray_tpu.get(w0.reserve_port.remote())
+            coordinator = f"{host}:{port}"
+            try:
+                # Published for observability and late joiners (elastic
+                # restarts re-read it) — the KV is the MASTER_ADDR channel.
+                from ray_tpu._private import worker as _worker_mod
+                from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+                _worker_mod.global_worker().core.gcs.KvPut(pb.KvRequest(
+                    ns="train", key=f"coordinator/{id(worker_group)}",
+                    value=coordinator.encode(), overwrite=True))
+            except Exception:  # noqa: BLE001 — local mode has no GCS
+                pass
+            ranks = worker_group.execute(
+                "init_jax_distributed", coordinator, scaling.num_workers)
+            logger.info("jax.distributed group formed: coordinator=%s "
+                        "ranks=%s", coordinator, ranks)
 
     def on_shutdown(self, worker_group: WorkerGroup):
         pass

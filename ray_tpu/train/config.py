@@ -32,6 +32,11 @@ class ScalingConfig:
     topology: Optional[str] = None       # e.g. "v5e-8": slice type ask
     mesh: Optional[MeshConfig] = None    # parallelism layout over all chips
     placement_strategy: str = "PACK"
+    # Form a real multi-process jax.distributed group across the worker
+    # actors (worker 0 hosts the coordinator service; the address is also
+    # published to the GCS KV). Off by default: single-host workers sharing
+    # one jax client don't need it.
+    jax_distributed: bool = False
 
     def worker_resources(self) -> Dict[str, float]:
         res = dict(self.resources_per_worker or {})

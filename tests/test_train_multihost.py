@@ -1,0 +1,65 @@
+"""Multi-process jax.distributed training bootstrap.
+
+Reference: MASTER_ADDR + ``dist.init_process_group`` bootstrap in
+``python/ray/train/torch/config.py:153`` — here worker 0 hosts the
+jax.distributed coordinator service, the address rides the GCS KV, and the
+worker actors (real separate processes in cluster mode) form one global
+device mesh.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.train import JaxTrainer, ScalingConfig, session
+
+
+@pytest.fixture
+def train_cluster():
+    c = Cluster(head_node_args={"num_cpus": 4})
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _loop():
+    # Runs inside each worker process AFTER the backend called
+    # jax.distributed.initialize there; jax sees the union of both
+    # processes' devices (each has 8 virtual CPUs from the test env).
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.process_count() == 2, jax.process_count()
+    global_devices = jax.device_count()
+    local_devices = jax.local_device_count()
+    assert global_devices == 2 * local_devices
+
+    # One SPMD computation over the global mesh: every process contributes
+    # its local shard; the psum must see the global device count.
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    import numpy as np
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    x = jnp.ones((local_devices,))
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, PartitionSpec("dp")), x,
+        (global_devices,))
+    total = float(jax.jit(jnp.sum)(arr))
+    assert total == global_devices, total
+
+    session.report({"procs": jax.process_count(),
+                    "devices": global_devices, "total": total})
+    return total
+
+
+def test_two_process_jax_distributed(train_cluster):
+    trainer = JaxTrainer(
+        _loop,
+        scaling_config=ScalingConfig(num_workers=2, cpus_per_worker=1,
+                                     jax_distributed=True),
+    )
+    result = trainer.fit()
+    m = result.metrics
+    assert m["procs"] == 2
+    assert m["devices"] == m["total"] == 16  # 2 processes x 8 virtual CPUs
