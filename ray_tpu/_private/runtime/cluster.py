@@ -19,6 +19,7 @@ C25-C30) collapsed to its essential protocol, python-side:
 from __future__ import annotations
 
 import logging
+import os
 import pickle
 import threading
 import time
@@ -575,7 +576,8 @@ class ClusterRuntime(CoreRuntime):
         )
         payload_oid = self._maybe_promote_payload(task_id, payload, spec)
         if options.runtime_env:
-            spec.runtime_env = pickle.dumps(options.runtime_env)
+            spec.runtime_env = pickle.dumps(
+                self._prepare_runtime_env(options.runtime_env))
         for k, v in options.task_resources().items():
             spec.resources[k] = v
         from ray_tpu._private.options import resolve_placement
@@ -690,6 +692,38 @@ class ClusterRuntime(CoreRuntime):
                 pass
             time.sleep(0.05)
         return None
+
+    def _prepare_runtime_env(self, renv: dict) -> dict:
+        """Driver-side runtime_env prep: local working_dir/py_modules
+        directories become content-addressed KV packages any node can
+        materialize (reference: runtime_env/packaging.py upload path).
+        Cached per identity so repeated submissions don't re-hash."""
+        if not renv:
+            return renv
+        if not hasattr(self, "_renv_cache"):
+            self._renv_cache = {}
+        from ray_tpu._private import runtime_env as renv_mod
+        from ray_tpu._private.runtime_env import packaging as pkg_mod
+
+        # Key on directory fingerprints, not just paths: editing the
+        # working_dir between submissions must produce a fresh package.
+        prints = []
+        for d in [renv.get("working_dir"), *(renv.get("py_modules") or [])]:
+            if isinstance(d, str) and not pkg_mod.is_uri(d) and \
+                    os.path.isdir(d):
+                try:
+                    prints.append(pkg_mod.dir_fingerprint(d))
+                except OSError:
+                    pass
+        key = pickle.dumps(
+            (sorted(renv.items(), key=lambda kv: kv[0]), prints))
+        cached = self._renv_cache.get(key)
+        if cached is None:
+            cached = renv_mod.prepare(renv, self.gcs)
+            self._renv_cache[key] = cached
+            while len(self._renv_cache) > 256:
+                self._renv_cache.pop(next(iter(self._renv_cache)))
+        return cached
 
     def release_stream_tail(self, length_ref: ObjectRef,
                             from_index: int) -> None:
@@ -933,7 +967,8 @@ class ClusterRuntime(CoreRuntime):
         pf = resolve_placement(options)
         spec = pickle.dumps({
             "resources": demand,
-            "runtime_env": options.runtime_env or {},
+            "runtime_env": self._prepare_runtime_env(
+                options.runtime_env or {}),
             "payload": payload,
             # PG-targeted actors are scheduled onto their bundle's node and
             # charge the bundle reservation (gcs_actor_scheduler.cc + PG).

@@ -1,0 +1,130 @@
+"""Runtime environments: per-task/actor working_dir, py_modules, env_vars, pip.
+
+Reference: ``python/ray/_private/runtime_env/`` — the reference ships a
+per-node agent that materializes environments before worker launch. The
+TPU-native redesign is agentless: the driver packages local directories
+into content-addressed zips stored in the GCS KV (``packaging.py``), and
+the executing worker materializes them on first use (download + extract to
+a per-node cache, venv build for pip specs) inside the worker process.
+Pure-Python pip deps activate via ``sys.path`` rather than an interpreter
+re-exec, which keeps workers reusable across environments.
+
+Public surface:
+
+* :func:`prepare` — driver-side: replace local paths in a runtime_env dict
+  with uploaded ``pkg://`` URIs (reference:
+  ``runtime_env/packaging.py`` upload path).
+* :func:`apply` — worker-side: materialize and activate a prepared
+  runtime_env in this process (reference:
+  ``runtime_env/agent/runtime_env_agent.py:167`` CreateRuntimeEnv).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Any, Dict
+
+from ray_tpu._private.runtime_env import packaging, pip_env
+
+logger = logging.getLogger(__name__)
+
+
+def prepare(renv: Dict[str, Any], kv_stub) -> Dict[str, Any]:
+    """Upload local directories referenced by ``renv`` and return a copy
+    whose ``working_dir``/``py_modules`` entries are ``pkg://`` URIs any
+    node can materialize. Non-directory entries pass through untouched."""
+    out = dict(renv)
+    wd = out.get("working_dir")
+    if wd and not packaging.is_uri(wd) and os.path.isdir(wd):
+        out["working_dir"] = packaging.upload_directory(wd, kv_stub)
+    mods = out.get("py_modules")
+    if mods:
+        # A py_modules entry is itself the importable module/package, so it
+        # nests under its own name in the zip (reference py_modules
+        # semantics: ``import <basename>`` works on the worker).
+        out["py_modules"] = [
+            packaging.upload_directory(
+                m, kv_stub,
+                prefix=os.path.basename(os.path.normpath(m)))
+            if not packaging.is_uri(m) and os.path.isdir(m) else m
+            for m in mods
+        ]
+    return out
+
+
+def _purge_shadowed_modules(path: str) -> None:
+    """Drop cached top-level modules that ``path`` provides, so the
+    version this env ships wins over one a previous task already imported
+    in this reused worker process."""
+    try:
+        entries = os.listdir(path)
+    except OSError:
+        return
+    names = set()
+    for e in entries:
+        if e.endswith(".py") and e != "__init__.py":
+            names.add(e[:-3])
+        elif os.path.isdir(os.path.join(path, e)) and \
+                os.path.exists(os.path.join(path, e, "__init__.py")):
+            names.add(e)
+    for name in names:
+        for mod in [m for m in list(sys.modules)
+                    if m == name or m.startswith(name + ".")]:
+            sys.modules.pop(mod, None)
+
+
+def apply(renv: Dict[str, Any], kv_stub):
+    """Activate a prepared runtime_env in the current process: set env
+    vars, chdir into the working_dir, put py_modules and the pip env's
+    site-packages on ``sys.path``. Returns a zero-arg restore callable
+    that undoes the process-level mutations (cwd, sys.path, env vars) —
+    task workers call it after the task so a reused worker doesn't leak
+    one task's environment into the next (the reference instead dedicates
+    workers per env; actors here keep their env for life and skip
+    restore)."""
+    saved_env: Dict[str, Any] = {}
+    added_paths: list = []
+    old_cwd = os.getcwd()
+
+    def _add_path(p: str) -> None:
+        if p not in sys.path:
+            sys.path.insert(0, p)
+            added_paths.append(p)
+        _purge_shadowed_modules(p)
+
+    for k, v in (renv.get("env_vars") or {}).items():
+        saved_env[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    wd = renv.get("working_dir")
+    if wd:
+        if packaging.is_uri(wd):
+            wd = packaging.ensure_local(wd, kv_stub)
+        os.chdir(wd)
+        _add_path(wd)
+    for mod in renv.get("py_modules") or []:
+        path = packaging.ensure_local(mod, kv_stub) \
+            if packaging.is_uri(mod) else mod
+        _add_path(path)
+    pip_specs = renv.get("pip")
+    if pip_specs:
+        _add_path(pip_env.ensure_pip_env(list(pip_specs)))
+
+    def restore() -> None:
+        try:
+            os.chdir(old_cwd)
+        except OSError:
+            pass
+        for p in added_paths:
+            try:
+                sys.path.remove(p)
+            except ValueError:
+                pass
+        for k, old in saved_env.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+
+    return restore

@@ -1,0 +1,153 @@
+"""Content-addressed packaging of directories for runtime environments.
+
+Reference: ``python/ray/_private/runtime_env/packaging.py`` — local
+directories become deterministic zips addressed by content hash
+(``pkg://<sha256>``), stored in the GCS KV (the reference's internal KV
+plays the same role), extracted once per node into a cache directory, and
+garbage-collected by an LRU cap on the cache.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import logging
+import os
+import shutil
+import tempfile
+import threading
+import zipfile
+from typing import Iterator, Tuple
+
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+logger = logging.getLogger(__name__)
+
+PKG_PREFIX = "pkg://"
+KV_NS = "runtime_env"
+# Keep the N most recently used packages per node; older ones are deleted
+# (reference: URI reference counting + deletion; an LRU cap is the
+# agentless equivalent).
+CACHE_CAP = 20
+_EXCLUDE_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+_cache_lock = threading.Lock()
+
+
+def is_uri(s: str) -> bool:
+    return isinstance(s, str) and s.startswith(PKG_PREFIX)
+
+
+def cache_dir() -> str:
+    return os.environ.get(
+        "RAY_TPU_RUNTIME_ENV_CACHE",
+        os.path.join(tempfile.gettempdir(), "ray_tpu_runtime_env_cache"))
+
+
+def _iter_files(root: str) -> Iterator[Tuple[str, str]]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d not in _EXCLUDE_DIRS)
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            yield full, os.path.relpath(full, root)
+
+
+def dir_fingerprint(path: str) -> str:
+    """Cheap change detector over a directory (relpath + mtime + size per
+    file) — used to key the driver's prepared-env cache so edits to a
+    working_dir between submissions produce a fresh package instead of a
+    stale cache hit. Content hashing happens in :func:`package_directory`;
+    this only has to be sensitive, not collision-proof."""
+    h = hashlib.sha256()
+    for full, rel in _iter_files(path):
+        st = os.stat(full)
+        h.update(f"{rel}\0{st.st_mtime_ns}\0{st.st_size}\0".encode())
+    return h.hexdigest()[:16]
+
+
+def package_directory(path: str, prefix: str = "") -> Tuple[str, bytes]:
+    """Zip ``path`` deterministically. Returns ``(uri, zip_bytes)`` where
+    the URI is the sha256 of the content — identical trees share one
+    package regardless of where or when they were zipped. ``prefix`` nests
+    the tree under one top-level directory (py_modules semantics: the
+    packaged directory itself stays importable)."""
+    h = hashlib.sha256()
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for full, rel in _iter_files(path):
+            arcname = os.path.join(prefix, rel) if prefix else rel
+            with open(full, "rb") as f:
+                data = f.read()
+            h.update(arcname.encode())
+            h.update(b"\0")
+            h.update(data)
+            info = zipfile.ZipInfo(arcname, date_time=(1980, 1, 1, 0, 0, 0))
+            info.external_attr = (os.stat(full).st_mode & 0xFFFF) << 16
+            zf.writestr(info, data)
+    return PKG_PREFIX + h.hexdigest(), buf.getvalue()
+
+
+def upload_directory(path: str, kv_stub, prefix: str = "") -> str:
+    """Package ``path`` and store it in the GCS KV (idempotent: the key is
+    the content hash). Returns the ``pkg://`` URI."""
+    uri, data = package_directory(path, prefix=prefix)
+    kv_stub.KvPut(pb.KvRequest(ns=KV_NS, key=uri, value=data,
+                               overwrite=True))
+    return uri
+
+
+def ensure_local(uri: str, kv_stub) -> str:
+    """Materialize ``uri`` into this node's cache (download + extract on
+    first use) and return the extracted directory path."""
+    assert is_uri(uri), uri
+    dest = os.path.join(cache_dir(), uri[len(PKG_PREFIX):])
+    with _cache_lock:
+        if os.path.isdir(dest):
+            os.utime(dest)  # LRU touch
+            return dest
+        reply = kv_stub.KvGet(pb.KvRequest(ns=KV_NS, key=uri))
+        if not reply.found:
+            raise FileNotFoundError(
+                f"runtime_env package {uri} not found in the cluster KV")
+        tmp = f"{dest}.tmp.{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        with zipfile.ZipFile(io.BytesIO(reply.value)) as zf:
+            zf.extractall(tmp)
+        try:
+            os.replace(tmp, dest)
+        except OSError:
+            # Another *process* won the materialization race (the lock
+            # above is per-process only); its extraction is equivalent —
+            # content-addressed — so losing is success.
+            shutil.rmtree(tmp, ignore_errors=True)
+            if not os.path.isdir(dest):
+                raise
+        _gc_cache_locked()
+    return dest
+
+
+def _gc_cache_locked() -> None:
+    root = cache_dir()
+    try:
+        entries = [os.path.join(root, e) for e in os.listdir(root)
+                   if not e.endswith(".tmp")]
+    except OSError:
+        return
+    entries = [e for e in entries if os.path.isdir(e)]
+    if len(entries) <= CACHE_CAP:
+        return
+    entries.sort(key=lambda e: os.path.getmtime(e))
+    for victim in entries[:len(entries) - CACHE_CAP]:
+        logger.info("runtime_env cache GC: removing %s", victim)
+        shutil.rmtree(victim, ignore_errors=True)
+
+
+def delete_uri(uri: str, kv_stub) -> None:
+    """Drop a package from the cluster KV and the local cache."""
+    try:
+        kv_stub.KvDel(pb.KvRequest(ns=KV_NS, key=uri))
+    except Exception:  # noqa: BLE001
+        pass
+    with _cache_lock:
+        shutil.rmtree(os.path.join(cache_dir(), uri[len(PKG_PREFIX):]),
+                      ignore_errors=True)

@@ -1,0 +1,107 @@
+"""Per-spec-hash virtual environments for ``runtime_env={"pip": [...]}``.
+
+Reference: ``python/ray/_private/runtime_env/pip.py`` — one venv per
+distinct spec list, built once per node and shared by every worker using
+that env. Differences for the agentless TPU runtime:
+
+* installs run with ``--no-index --no-build-isolation`` so resolution
+  never touches the network — specs must be local paths/wheels or already
+  satisfied, which is the only sound behavior in air-gapped TPU pods;
+* the venv is created with ``--system-site-packages`` so the baked-in
+  scientific stack (jax et al.) stays importable;
+* activation is ``sys.path`` insertion of the env's site-packages by the
+  worker (pure-Python deps), not an interpreter re-exec — workers stay
+  reusable across environments.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import List
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+
+
+def base_dir() -> str:
+    return os.environ.get(
+        "RAY_TPU_PIP_ENV_DIR",
+        os.path.join(tempfile.gettempdir(), "ray_tpu_pip_envs"))
+
+
+def env_hash(specs: List[str]) -> str:
+    h = hashlib.sha256()
+    for s in sorted(specs):
+        h.update(s.encode())
+        h.update(b"\0")
+    return h.hexdigest()[:16]
+
+
+def ensure_pip_env(specs: List[str]) -> str:
+    """Build (once per node) the venv for ``specs`` and return its
+    site-packages directory. Builds happen in a private tmp dir that is
+    atomically renamed into place, so concurrent worker *processes* (the
+    module lock only covers threads) race safely: the loser discards its
+    build and adopts the winner's."""
+    env_dir = os.path.join(base_dir(), env_hash(specs))
+    marker = os.path.join(env_dir, ".ready")
+    with _lock:
+        if os.path.exists(marker):
+            return _site_packages(env_dir)
+        import shutil
+        import time
+        import venv
+
+        tmp = f"{env_dir}.tmp.{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        venv.EnvBuilder(with_pip=True,
+                        system_site_packages=True).create(tmp)
+        py = os.path.join(tmp, "bin", "python")
+        cmd = [py, "-m", "pip", "install", "--quiet", "--no-index",
+               "--no-build-isolation", *specs]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=600)
+        if proc.returncode != 0:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise RuntimeError(
+                f"pip env build failed for {specs}: {proc.stderr[-2000:]}")
+        with open(os.path.join(tmp, ".ready"), "w") as f:
+            f.write("\n".join(specs))
+        try:
+            os.rename(tmp, env_dir)
+        except OSError:
+            # Another process won; wait for its marker then use that env.
+            shutil.rmtree(tmp, ignore_errors=True)
+            deadline = time.monotonic() + 600
+            while not os.path.exists(marker):
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"pip env {env_dir} exists but never became ready")
+                time.sleep(0.2)
+    return _site_packages(env_dir)
+
+
+def _site_packages(env_dir: str) -> str:
+    matches = glob.glob(os.path.join(env_dir, "lib", "python*",
+                                     "site-packages"))
+    if not matches:
+        raise RuntimeError(f"no site-packages under {env_dir}")
+    return matches[0]
+
+
+def delete_env(specs: List[str]) -> None:
+    import shutil
+
+    with _lock:
+        shutil.rmtree(os.path.join(base_dir(), env_hash(specs)),
+                      ignore_errors=True)
+
+
+__all__ = ["ensure_pip_env", "delete_env", "env_hash", "base_dir"]

@@ -190,16 +190,16 @@ class WorkerServer:
 
     def _push_normal_task(self, spec) -> pb.PushTaskResult:
         with self._task_lock:
+            renv_restore = None
             try:
                 if spec.tpu_chips:
                     os.environ["TPU_VISIBLE_CHIPS"] = ",".join(
                         map(str, spec.tpu_chips))
                 if spec.runtime_env:
-                    renv = pickle.loads(spec.runtime_env)
-                    for k, v in renv.get("env_vars", {}).items():
-                        os.environ[k] = str(v)
-                    if renv.get("working_dir"):
-                        os.chdir(renv["working_dir"])
+                    from ray_tpu._private import runtime_env as renv_mod
+
+                    renv_restore = renv_mod.apply(
+                        pickle.loads(spec.runtime_env), self.runtime.gcs)
                 (fn, args, kwargs), n_borrows = \
                     loads_payload(self._payload_bytes(spec))
                 if n_borrows:
@@ -228,6 +228,10 @@ class WorkerServer:
                 return self._package_results(result, spec.return_ids)
             except BaseException as e:  # noqa: BLE001
                 return self._error_result(e, spec.name)
+            finally:
+                if renv_restore is not None:
+                    # Reused worker: don't leak this task's cwd/env/path.
+                    renv_restore()
 
     def _push_actor_task(self, spec) -> pb.PushTaskResult:
         runner = self._actors.get(spec.actor_id)
@@ -291,6 +295,10 @@ class WorkerServer:
             for k, v in request.env.items():
                 os.environ[k] = v
             outer = pickle.loads(info.spec)
+            if outer.get("runtime_env"):
+                from ray_tpu._private import runtime_env as renv_mod
+
+                renv_mod.apply(outer["runtime_env"], self.runtime.gcs)
             (cls, args, kwargs, options), n_borrows = \
                 loads_payload(outer["payload"])
             if n_borrows:

@@ -1,0 +1,115 @@
+"""Runtime environments: packaging, shipping, activation, pip venvs.
+
+Reference: ``python/ray/_private/runtime_env/`` (packaging.py content-
+addressed URIs, pip.py per-spec venvs, the agent's CreateRuntimeEnv flow).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.runtime_env import packaging
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def renv_cluster():
+    c = Cluster(head_node_args={"num_cpus": 4})
+    c.add_node(num_cpus=2)
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_package_directory_content_addressed(tmp_path):
+    (tmp_path / "a.py").write_text("x = 1\n")
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    (sub / "b.txt").write_text("hello")
+    uri1, data1 = packaging.package_directory(str(tmp_path))
+    uri2, data2 = packaging.package_directory(str(tmp_path))
+    assert uri1 == uri2 and uri1.startswith("pkg://")
+    assert data1 == data2
+    (tmp_path / "a.py").write_text("x = 2\n")
+    uri3, _ = packaging.package_directory(str(tmp_path))
+    assert uri3 != uri1  # content change -> new address
+
+
+def test_cache_gc_keeps_lru_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_RUNTIME_ENV_CACHE", str(tmp_path))
+    monkeypatch.setattr(packaging, "CACHE_CAP", 3)
+    import os
+    import time
+
+    for i in range(6):
+        d = tmp_path / f"{i:064d}"
+        d.mkdir()
+        os.utime(d, (time.time() + i, time.time() + i))
+    with packaging._cache_lock:
+        packaging._gc_cache_locked()
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert len(left) == 3
+    assert left == [f"{i:064d}" for i in (3, 4, 5)]  # newest survive
+
+
+def test_working_dir_ships_to_workers(renv_cluster, tmp_path):
+    (tmp_path / "data.txt").write_text("shipped-content")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(tmp_path)})
+    def read():
+        with open("data.txt") as f:
+            return f.read()
+
+    assert ray_tpu.get(read.remote(), timeout=60) == "shipped-content"
+
+
+def test_py_modules_importable_in_workers(renv_cluster, tmp_path):
+    mod = tmp_path / "shipmod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("VALUE = 42\n")
+    (mod / "extra.py").write_text("def f():\n    return 'extra'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    def use():
+        import shipmod
+        from shipmod import extra
+
+        return shipmod.VALUE, extra.f()
+
+    assert tuple(ray_tpu.get(use.remote(), timeout=60)) == (42, "extra")
+
+
+def test_py_modules_on_actor(renv_cluster, tmp_path):
+    mod = tmp_path / "actmod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("WHO = 'actor-env'\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod)]})
+    class A:
+        def who(self):
+            import actmod
+
+            return actmod.WHO
+
+    a = A.remote()
+    assert ray_tpu.get(a.who.remote(), timeout=60) == "actor-env"
+
+
+def test_pip_env_installs_local_package(renv_cluster, tmp_path):
+    """pip specs build a per-hash venv (offline: --no-index, so only local
+    paths resolve) whose site-packages the worker activates."""
+    pkg = tmp_path / "pkgsrc"
+    (pkg / "localpkg").mkdir(parents=True)
+    (pkg / "localpkg" / "__init__.py").write_text("MAGIC = 'pip-ok'\n")
+    (pkg / "setup.py").write_text(
+        "from setuptools import setup, find_packages\n"
+        "setup(name='localpkg', version='0.1', packages=find_packages())\n")
+
+    @ray_tpu.remote(runtime_env={"pip": [str(pkg)]})
+    def use():
+        import localpkg
+
+        return localpkg.MAGIC
+
+    assert ray_tpu.get(use.remote(), timeout=180) == "pip-ok"
