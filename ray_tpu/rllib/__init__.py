@@ -1,10 +1,27 @@
 """ray_tpu.rllib: reinforcement learning (reference: ``rllib/``)."""
 
-from ray_tpu.rllib.core import PPOLearner, PPOModule, SampleBatch, compute_gae
-from ray_tpu.rllib.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rllib.core import (
+    DQNLearner,
+    DQNModule,
+    PPOLearner,
+    PPOModule,
+    ReplayBuffer,
+    SampleBatch,
+    Transition,
+    compute_gae,
+)
+from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.env_runner import (
+    EnvRunnerGroup,
+    SingleAgentEnvRunner,
+    TransitionEnvRunner,
+)
+from ray_tpu.rllib.learner_group import LearnerGroup
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
 __all__ = [
-    "EnvRunnerGroup", "PPO", "PPOConfig", "PPOLearner", "PPOModule",
-    "SampleBatch", "SingleAgentEnvRunner", "compute_gae",
+    "DQN", "DQNConfig", "DQNLearner", "DQNModule", "EnvRunnerGroup",
+    "LearnerGroup", "PPO", "PPOConfig", "PPOLearner", "PPOModule",
+    "ReplayBuffer", "SampleBatch", "SingleAgentEnvRunner", "Transition",
+    "TransitionEnvRunner", "compute_gae",
 ]

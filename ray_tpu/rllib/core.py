@@ -164,3 +164,138 @@ class PPOLearner:
 
     def set_weights(self, weights):
         self.params = jax.tree.map(jnp.asarray, weights)
+
+
+class DQNModule:
+    """Q-network module for discrete action spaces (reference:
+    ``rllib/algorithms/dqn`` default RLModule)."""
+
+    def __init__(self, obs_dim: int, num_actions: int, hidden=(64, 64)):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Dict[str, Any]:
+        return {"q": mlp_init(key, (self.obs_dim, *self.hidden,
+                                    self.num_actions), scale=0.01)}
+
+    @staticmethod
+    def q_values(params, obs):
+        return mlp_apply(params["q"], obs)
+
+
+class Transition(NamedTuple):
+    obs: np.ndarray
+    actions: np.ndarray
+    rewards: np.ndarray
+    next_obs: np.ndarray
+    dones: np.ndarray
+
+
+class ReplayBuffer:
+    """Uniform ring-buffer replay (reference:
+    ``rllib/utils/replay_buffers/replay_buffer.py``)."""
+
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity,), np.int64)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.dones = np.zeros((capacity,), np.float32)
+        self.idx = 0
+        self.size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, batch: Transition) -> None:
+        n = len(batch.obs)
+        ix = (self.idx + np.arange(n)) % self.capacity
+        self.obs[ix] = batch.obs
+        self.actions[ix] = batch.actions
+        self.rewards[ix] = batch.rewards
+        self.next_obs[ix] = batch.next_obs
+        self.dones[ix] = batch.dones
+        self.idx = int((self.idx + n) % self.capacity)
+        self.size = int(min(self.size + n, self.capacity))
+
+    def sample(self, batch_size: int) -> Transition:
+        ix = self._rng.integers(0, self.size, size=batch_size)
+        return Transition(self.obs[ix], self.actions[ix], self.rewards[ix],
+                          self.next_obs[ix], self.dones[ix])
+
+
+class DQNLearner:
+    """Jitted double-DQN learner (reference:
+    ``rllib/algorithms/dqn/torch/dqn_torch_learner.py`` loss). The
+    gradient computation and application are split so a LearnerGroup can
+    allreduce gradients between them (multi-learner data parallelism)."""
+
+    def __init__(self, module: DQNModule, lr: float = 5e-4,
+                 gamma: float = 0.99, target_update_freq: int = 200,
+                 seed: int = 0):
+        self.module = module
+        self.optimizer = optax.adam(lr)
+        self.gamma = gamma
+        self.target_update_freq = target_update_freq
+        self.params = module.init(jax.random.PRNGKey(seed))
+        self.target_params = jax.tree.map(jnp.asarray, self.params)
+        self.opt_state = self.optimizer.init(self.params)
+        self.steps = 0
+        module_q, gamma_c = module.q_values, gamma
+
+        def loss_fn(params, target_params, b):
+            q = module_q(params, b["obs"])
+            q_taken = jnp.take_along_axis(q, b["actions"][:, None],
+                                          axis=1)[:, 0]
+            # Double DQN: online net picks the action, target net scores it.
+            next_a = jnp.argmax(module_q(params, b["next_obs"]), axis=-1)
+            next_q = jnp.take_along_axis(
+                module_q(target_params, b["next_obs"]), next_a[:, None],
+                axis=1)[:, 0]
+            y = b["rewards"] + gamma_c * (1.0 - b["dones"]) * \
+                jax.lax.stop_gradient(next_q)
+            td = q_taken - y
+            loss = jnp.mean(optax.huber_loss(td))
+            return loss, {"td_error_mean": jnp.mean(jnp.abs(td))}
+
+        self._grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply_fn = jax.jit(apply_fn)
+
+    @staticmethod
+    def _to_batch(t: Transition) -> Dict[str, Any]:
+        return {"obs": jnp.asarray(t.obs),
+                "actions": jnp.asarray(t.actions),
+                "rewards": jnp.asarray(t.rewards),
+                "next_obs": jnp.asarray(t.next_obs),
+                "dones": jnp.asarray(t.dones)}
+
+    def compute_gradients(self, t: Transition):
+        (loss, metrics), grads = self._grad_fn(
+            self.params, self.target_params, self._to_batch(t))
+        metrics = {k: float(v) for k, v in metrics.items()}
+        metrics["loss"] = float(loss)
+        return grads, metrics
+
+    def apply_gradients(self, grads) -> None:
+        self.params, self.opt_state = self._apply_fn(
+            self.params, self.opt_state, grads)
+        self.steps += 1
+        if self.steps % self.target_update_freq == 0:
+            self.target_params = jax.tree.map(jnp.asarray, self.params)
+
+    def update_from_batch(self, t: Transition) -> Dict[str, float]:
+        grads, metrics = self.compute_gradients(t)
+        self.apply_gradients(grads)
+        return metrics
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)

@@ -1,0 +1,185 @@
+"""DQN algorithm (reference: ``rllib/algorithms/dqn/dqn.py``).
+
+The SURVEY §3.6 loop, value-based variant: epsilon-greedy env runners feed
+a uniform replay buffer; a :class:`~ray_tpu.rllib.learner_group.LearnerGroup`
+of one or more learner actors performs double-DQN updates (gradients
+allreduced across learners); weights broadcast back to the runners each
+iteration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core import ReplayBuffer
+from ray_tpu.rllib.env_runner import TransitionEnvRunner
+from ray_tpu.rllib.learner_group import LearnerGroup
+
+
+@dataclasses.dataclass
+class DQNConfig:
+    env: Optional[str] = None
+    env_creator: Optional[Callable] = None
+    num_env_runners: int = 2
+    num_envs_per_env_runner: int = 2
+    rollout_fragment_length: int = 32
+    lr: float = 5e-4
+    gamma: float = 0.99
+    buffer_size: int = 50_000
+    train_batch_size: int = 64
+    num_updates_per_iteration: int = 16
+    learning_starts: int = 500
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_decay_iterations: int = 30
+    target_update_freq: int = 100
+    num_learners: int = 1
+    hidden_sizes: tuple = (64, 64)
+    seed: int = 0
+
+    # -- fluent builder (reference AlgorithmConfig style) ------------------
+    def environment(self, env: Optional[str] = None, *,
+                    env_creator: Optional[Callable] = None) -> "DQNConfig":
+        self.env = env
+        self.env_creator = env_creator
+        return self
+
+    def env_runners(self, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "DQNConfig":
+        for k, v in dict(num_env_runners=num_env_runners,
+                         num_envs_per_env_runner=num_envs_per_env_runner,
+                         rollout_fragment_length=rollout_fragment_length
+                         ).items():
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def training(self, **kwargs) -> "DQNConfig":
+        known = {f.name for f in dataclasses.fields(self)}
+        bad = set(kwargs) - known
+        if bad:
+            raise ValueError(f"Unknown DQN training options: {sorted(bad)}")
+        for k, v in kwargs.items():
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def learners(self, num_learners: Optional[int] = None) -> "DQNConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+def _resolve_env(config) -> Callable:
+    if config.env_creator is not None:
+        return config.env_creator
+    if config.env is None:
+        raise ValueError("DQNConfig needs .environment(env=...) or "
+                         "env_creator")
+    import gymnasium as gym
+
+    name = config.env
+    return lambda: gym.make(name)
+
+
+class DQN:
+    def __init__(self, config: DQNConfig):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.config = config
+        creator = _resolve_env(config)
+        probe = creator()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        module_spec = {"obs_dim": obs_dim, "num_actions": num_actions,
+                       "hidden": tuple(config.hidden_sizes)}
+        cfg = config
+
+        def builder():
+            from ray_tpu.rllib.core import DQNLearner, DQNModule
+
+            return DQNLearner(DQNModule(**module_spec), lr=cfg.lr,
+                              gamma=cfg.gamma,
+                              target_update_freq=cfg.target_update_freq,
+                              seed=cfg.seed)
+
+        self.learner_group = LearnerGroup(builder,
+                                          num_learners=config.num_learners)
+        runner_cls = ray_tpu.remote(TransitionEnvRunner)
+        self.runners = [
+            runner_cls.remote(creator, module_spec,
+                              config.num_envs_per_env_runner, seed)
+            for seed in range(config.num_env_runners)
+        ]
+        self.buffer = ReplayBuffer(config.buffer_size, obs_dim,
+                                   seed=config.seed)
+        self.iteration = 0
+        self._returns: List[float] = []
+
+    def _epsilon(self) -> float:
+        c = self.config
+        frac = min(self.iteration / max(c.epsilon_decay_iterations, 1), 1.0)
+        return c.epsilon_initial + frac * (c.epsilon_final
+                                           - c.epsilon_initial)
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration: sample -> replay -> N learner updates -> sync."""
+        c = self.config
+        t0 = time.monotonic()
+        eps = self._epsilon()
+        weights = self.learner_group.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners],
+                    timeout=120)
+        ray_tpu.get([r.set_epsilon.remote(eps) for r in self.runners],
+                    timeout=120)
+        sampled = ray_tpu.get(
+            [r.sample.remote(c.rollout_fragment_length)
+             for r in self.runners], timeout=300)
+        episode_returns: List[float] = []
+        for transitions, finished in sampled:
+            self.buffer.add(transitions)
+            episode_returns.extend(finished)
+        self._returns.extend(episode_returns)
+        self._returns = self._returns[-100:]
+        metrics: Dict[str, float] = {}
+        if self.buffer.size >= max(c.learning_starts, c.train_batch_size):
+            for _ in range(c.num_updates_per_iteration):
+                batch = self.buffer.sample(c.train_batch_size)
+                metrics = self.learner_group.update(batch)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "epsilon": eps,
+            "buffer_size": self.buffer.size,
+            "episode_return_mean": (float(np.mean(self._returns))
+                                    if self._returns else float("nan")),
+            "episodes_this_iter": len(episode_returns),
+            "time_this_iter_s": time.monotonic() - t0,
+            **metrics,
+        }
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        for a in self.learner_group.learners:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass

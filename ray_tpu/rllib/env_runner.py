@@ -125,3 +125,82 @@ class EnvRunnerGroup:
                 alive.append(self._make(self._seed))
         self.runners = alive
         return batches, episode_returns
+
+
+class TransitionEnvRunner:
+    """Epsilon-greedy transition collector for value-based algorithms
+    (reference: the DQN rollout path of ``single_agent_env_runner.py`` —
+    transitions, not GAE trajectories)."""
+
+    def __init__(self, env_creator: Callable, module_spec: Dict[str, Any],
+                 num_envs: int = 1, seed: int = 0):
+        import gymnasium as gym
+        import jax
+
+        from ray_tpu.rllib.core import DQNModule
+
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda i=i: env_creator() for i in range(num_envs)])
+        self.num_envs = num_envs
+        self.module = DQNModule(**module_spec)
+        self.params = None
+        self.epsilon = 1.0
+        self.rng = np.random.default_rng(seed)
+        self._jax = jax
+        self._q = jax.jit(self.module.q_values)
+        self.obs, _ = self.envs.reset(seed=seed)
+        self._episode_returns = np.zeros(num_envs, dtype=np.float64)
+        self._finished_returns: List[float] = []
+        # Envs that finished last step: with gymnasium's next-step
+        # autoreset, their next step() is the reset (action ignored) and
+        # must not be recorded as a transition.
+        self._resetting = np.zeros(num_envs, dtype=bool)
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+
+        self.params = self._jax.tree.map(jnp.asarray, weights)
+        return True
+
+    def set_epsilon(self, epsilon: float):
+        self.epsilon = float(epsilon)
+        return True
+
+    def sample(self, num_steps: int):
+        from ray_tpu.rllib.core import Transition
+
+        T, N = num_steps, self.num_envs
+        rows = {k: [] for k in
+                ("obs", "actions", "rewards", "next_obs", "dones")}
+        for _ in range(T):
+            q = np.asarray(self._q(self.params, self.obs.astype(np.float32)))
+            greedy = q.argmax(axis=-1)
+            explore = self.rng.random(N) < self.epsilon
+            random_a = self.rng.integers(0, q.shape[-1], size=N)
+            actions = np.where(explore, random_a, greedy)
+            nxt, rewards, terms, truncs, _ = self.envs.step(actions)
+            # Next-step autoreset: rows where the env was resetting this
+            # step are not transitions (action ignored, reward 0) — skip.
+            valid = ~self._resetting
+            rows["obs"].append(self.obs[valid].astype(np.float32))
+            rows["actions"].append(actions[valid])
+            rows["rewards"].append(rewards[valid].astype(np.float32))
+            rows["next_obs"].append(nxt[valid].astype(np.float32))
+            # Bootstrapping cuts only at true terminations; time-limit
+            # truncations keep their value (partial-episode bootstrap, and
+            # `nxt` at the done step is the episode's true final obs).
+            rows["dones"].append(terms[valid].astype(np.float32))
+            dones = np.logical_or(terms, truncs)
+            self._episode_returns[valid] += rewards[valid]
+            for i in np.nonzero(dones & valid)[0]:
+                self._finished_returns.append(self._episode_returns[i])
+                self._episode_returns[i] = 0.0
+            self._resetting = dones
+            self.obs = nxt
+        finished, self._finished_returns = self._finished_returns, []
+        return Transition(*[np.concatenate(rows[k]) for k in
+                            ("obs", "actions", "rewards", "next_obs",
+                             "dones")]), finished
+
+    def ping(self):
+        return True

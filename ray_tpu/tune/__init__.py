@@ -4,6 +4,7 @@ from ray_tpu.tune.schedulers import (
     AsyncHyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
+    PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
     choice,
@@ -18,13 +19,14 @@ from ray_tpu.tune.tuner import (
     TrialResult,
     TuneConfig,
     Tuner,
+    get_checkpoint,
     report,
     run,
 )
 
 __all__ = [
     "AsyncHyperBandScheduler", "FIFOScheduler", "MedianStoppingRule",
-    "ResultGrid", "TrialResult", "TuneConfig", "Tuner", "choice",
-    "grid_search", "loguniform", "randint", "report", "run", "sample_from",
-    "uniform",
+    "PopulationBasedTraining", "ResultGrid", "TrialResult", "TuneConfig",
+    "Tuner", "choice", "get_checkpoint", "grid_search", "loguniform",
+    "randint", "report", "run", "sample_from", "uniform",
 ]

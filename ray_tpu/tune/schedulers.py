@@ -81,3 +81,101 @@ class MedianStoppingRule:
             else min(self.histories[trial_id])
         ok = mine >= median if self.mode == "max" else mine <= median
         return CONTINUE if ok else STOP
+
+
+EXPLOIT = "EXPLOIT"
+
+
+class PopulationBasedTraining:
+    """PBT (reference: ``python/ray/tune/schedulers/pbt.py``): at every
+    ``perturbation_interval`` reported steps, a trial in the bottom
+    quantile clones the checkpoint + config of a random top-quantile peer
+    and perturbs the mutated hyperparameters (exploit + explore). The
+    controller performs the fork; this class decides who forks from whom
+    and how configs mutate."""
+
+    requires_checkpoints = True
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 2,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        assert mode in ("max", "min")
+        assert 0.0 < quantile_fraction <= 0.5
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = dict(hyperparam_mutations or {})
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        import numpy as _np
+
+        self._rng = _np.random.default_rng(seed)
+        self._latest: Dict[str, float] = {}   # trial -> last metric value
+        self._configs: Dict[str, Dict] = {}   # trial -> current config
+        self.exploit_count = 0
+
+    def on_trial_config(self, trial_id: str, config: Dict) -> None:
+        self._configs[trial_id] = dict(config)
+
+    def _quantiles(self):
+        ordered = sorted(self._latest,
+                         key=lambda t: self._latest[t],
+                         reverse=(self.mode == "max"))
+        k = max(1, int(len(ordered) * self.quantile))
+        return ordered[:k], ordered[-k:]
+
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        self._latest[trial_id] = value
+        if step % self.interval != 0 or len(self._latest) < 2:
+            return CONTINUE
+        top, bottom = self._quantiles()
+        if trial_id in bottom and trial_id not in top:
+            return EXPLOIT
+        return CONTINUE
+
+    def exploit(self, trial_id: str):
+        """Pick a donor from the top quantile and build the perturbed
+        config. Returns ``(donor_trial_id, new_config)``. Pure: the
+        controller may still decline the fork (no donor checkpoint yet) —
+        bookkeeping moves in :meth:`commit_exploit` once it commits."""
+        top, _ = self._quantiles()
+        donors = [t for t in top if t != trial_id]
+        if not donors:
+            return None, None
+        donor = donors[int(self._rng.integers(0, len(donors)))]
+        new_config = self._explore(dict(self._configs.get(donor, {})))
+        return donor, new_config
+
+    def commit_exploit(self, trial_id: str, new_config: Dict) -> None:
+        """The controller actually forked ``trial_id`` onto ``new_config``."""
+        self._configs[trial_id] = dict(new_config)
+        self.exploit_count += 1
+
+    def _explore(self, config: Dict) -> Dict:
+        for key, domain in self.mutations.items():
+            if callable(domain):
+                resampled = domain()
+            elif isinstance(domain, (list, tuple)):
+                resampled = domain[int(self._rng.integers(0, len(domain)))]
+            else:
+                resampled = None
+            cur = config.get(key)
+            if resampled is not None and (
+                    cur is None or self._rng.random() < self.resample_p):
+                config[key] = resampled
+            elif isinstance(cur, (int, float)) and \
+                    not isinstance(cur, bool):
+                factor = 1.2 if self._rng.random() < 0.5 else 0.8
+                if isinstance(cur, int):
+                    # Round, and keep positive ints from ratcheting to 0
+                    # (int(1*0.8) would freeze a batch-size at 0 forever).
+                    new = int(round(cur * factor))
+                    config[key] = max(new, 1) if cur >= 1 else new
+                else:
+                    config[key] = cur * factor
+            elif resampled is not None:
+                config[key] = resampled
+        return config

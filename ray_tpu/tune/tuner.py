@@ -26,11 +26,20 @@ _report_queue_var = threading.local()
 
 def report(metrics: Dict[str, Any], checkpoint=None) -> None:
     """Report intermediate metrics from inside a trainable
-    (reference: ``ray.tune.report`` / ``session.report``)."""
+    (reference: ``ray.tune.report`` / ``session.report``). ``checkpoint``
+    is any picklable trial state; PBT forks trials from the donor's last
+    reported checkpoint."""
     q = getattr(_report_queue_var, "queue", None)
     if q is None:
         raise RuntimeError("tune.report() called outside a trial")
     q.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+
+
+def get_checkpoint():
+    """Checkpoint the trial was started from (None for a fresh start;
+    reference: ``ray.tune.get_checkpoint``). PBT-forked trials resume from
+    their donor's state through this."""
+    return getattr(_report_queue_var, "checkpoint", None)
 
 
 class _TrialActor:
@@ -42,8 +51,9 @@ class _TrialActor:
         self._error: Optional[str] = None
         self._final: Any = None
 
-    def run(self, fn: Callable, config: Dict[str, Any]):
+    def run(self, fn: Callable, config: Dict[str, Any], checkpoint=None):
         _report_queue_var.queue = self._q
+        _report_queue_var.checkpoint = checkpoint
         try:
             self._final = fn(config)
             if isinstance(self._final, dict):
@@ -142,17 +152,26 @@ class Tuner:
                    for i, cfg in enumerate(configs)]
         running: Dict[str, Dict[str, Any]] = {}
         results: List[TrialResult] = []
+        # Last reported checkpoint per trial — PBT forks bottom-quantile
+        # trials from a top-quantile donor's entry (pbt.py exploit step).
+        checkpoints: Dict[str, Any] = {}
+        is_pbt = getattr(scheduler, "requires_checkpoints", False)
+
+        def launch(trial_id, cfg, checkpoint=None, st=None):
+            actor = trial_cls.options(max_concurrency=2).remote()
+            run_ref = actor.run.remote(self.trainable, cfg, checkpoint)
+            if is_pbt:
+                scheduler.on_trial_config(trial_id, cfg)
+            if st is None:
+                st = {"history": [], "steps": 0, "stopped": False}
+            st.update(actor=actor, config=cfg, run_ref=run_ref)
+            running[trial_id] = st
 
         while pending or running:
             # Launch up to the concurrency limit.
             while pending and len(running) < limit:
                 trial_id, cfg = pending.pop(0)
-                actor = trial_cls.options(max_concurrency=2).remote()
-                run_ref = actor.run.remote(self.trainable, cfg)
-                running[trial_id] = {
-                    "actor": actor, "config": cfg, "run_ref": run_ref,
-                    "history": [], "steps": 0, "stopped": False,
-                }
+                launch(trial_id, cfg)
             # Poll every running trial.
             for trial_id, st in list(running.items()):
                 try:
@@ -165,15 +184,31 @@ class Tuner:
                     del running[trial_id]
                     continue
                 stop = False
+                exploit = False
                 for r in poll["reports"]:
                     st["steps"] += 1
                     st["history"].append(r["metrics"])
+                    if r.get("checkpoint") is not None:
+                        checkpoints[trial_id] = r["checkpoint"]
                     if tc.metric and tc.metric in r["metrics"]:
                         verdict = scheduler.on_result(
                             trial_id, st["steps"],
                             float(r["metrics"][tc.metric]))
                         if verdict == sched_mod.STOP:
                             stop = True
+                        elif verdict == getattr(sched_mod, "EXPLOIT", None):
+                            exploit = True
+                if exploit and not poll["finished"]:
+                    donor, new_cfg = scheduler.exploit(trial_id)
+                    if donor is not None and donor in checkpoints:
+                        # Exploit+explore: replace this trial's actor with
+                        # a clone of the donor's checkpoint under the
+                        # perturbed config; history/steps continue.
+                        ray_tpu.kill(st["actor"])
+                        launch(trial_id, new_cfg,
+                               checkpoint=checkpoints[donor], st=st)
+                        scheduler.commit_exploit(trial_id, new_cfg)
+                        continue
                 if stop and not poll["finished"]:
                     ray_tpu.kill(st["actor"])
                     results.append(TrialResult(
