@@ -157,21 +157,34 @@ def main():
     n_calls = int(500 * scale)
     n_wait = int(1000 * scale)
 
+    import sys as _sys
+
+    def _stage(name):
+        print(f"[bench stage] {name}", file=_sys.stderr, flush=True)
+
     metrics = {}
+    _stage("tasks_per_s")
     metrics["tasks_per_s"] = round(bench_tasks_per_s(ray_tpu, n_tasks), 1)
+    _stage("task_roundtrip_us")
     metrics["task_roundtrip_us"] = round(
         bench_task_roundtrip_us(ray_tpu, max(50, n_tasks // 5)), 1)
+    _stage("actor_calls_sync")
     metrics["actor_calls_sync_per_s"] = round(
         bench_actor_calls_sync_per_s(ray_tpu, n_calls), 1)
+    _stage("actor_calls_async")
     metrics["actor_calls_async_per_s"] = round(
         bench_actor_calls_async_per_s(ray_tpu, n_calls), 1)
+    _stage("put_1kb")
     metrics["put_1kb_per_s"] = round(
         bench_put_small_per_s(ray_tpu, int(2000 * scale)), 1)
+    _stage("put_get_large")
     put_gbps, get_gbps = bench_put_get_large_gbps(
         ray_tpu, n_mb=int(64 * scale) or 16)
     metrics["put_large_gb_per_s"] = round(put_gbps, 3)
     metrics["get_large_gb_per_s"] = round(get_gbps, 3)
+    _stage("wait_fanin")
     metrics["wait_1k_fanin_s"] = round(bench_wait_fanin_s(ray_tpu, n_wait), 3)
+    _stage("dag_hop")
     dag_us, rpc_us = bench_dag_hop(ray_tpu, max(100, int(200 * scale)))
     metrics["compiled_dag_hop_us"] = round(dag_us, 1)
     metrics["actor_call_roundtrip_us"] = round(rpc_us, 1)

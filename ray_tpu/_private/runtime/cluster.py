@@ -143,10 +143,17 @@ class ClusterRuntime(CoreRuntime):
         self._actor_create_pins: Dict[bytes, List[bytes]] = {}
         self._actor_seq: Dict[bytes, int] = {}
         self._actor_session: Dict[bytes, int] = {}
+        self._actor_window: Dict[bytes, dict] = {}
         self._actor_lock = threading.Lock()
         self._put_index = 0
         self._put_lock = threading.Lock()
         self._shutdown = False
+        # Short-TTL cache of granted worker leases keyed by resource shape
+        # (see _lease_signature): same-shaped tasks pipeline onto a held
+        # lease instead of paying lease/return per task.
+        self._lease_cache: Dict[Any, List[dict]] = {}
+        self._lease_cache_lock = threading.Lock()
+        self._lease_reaper_started = False
         # Ownership: this process owns the objects its tasks/puts create.
         # Local ObjectRef lifetimes feed the distributed refcount (GCS sums
         # per-holder counts; zero => cluster-wide free). Lineage (the creating
@@ -227,16 +234,24 @@ class ClusterRuntime(CoreRuntime):
         resubscribe path of the reference's GCS client).
         """
         sub_id = f"rt-{self.worker_id[:12]}"
+        # Drivers also stream worker logs (reference: log_to_driver);
+        # workers must not, or their re-printing would loop forever.
+        channels = ["ACTOR", "OBJECT_LOC"]
+        if not self.is_worker and \
+                os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+            channels.append("LOG")
         while not self._shutdown:
             try:
                 stream = self.gcs.Subscribe(pb.SubscribeRequest(
-                    channels=["ACTOR", "OBJECT_LOC"], subscriber_id=sub_id))
+                    channels=channels, subscriber_id=sub_id))
                 self._sub_stream = stream
                 for msg in stream:
                     if self._shutdown:
                         return
                     if msg.channel == "ACTOR":
                         self._on_actor_event(msg.data)
+                    elif msg.channel == "LOG":
+                        self._on_log_event(msg.data)
                     else:
                         with self._ready_cond:
                             self._ready_cond.notify_all()
@@ -244,6 +259,25 @@ class ClusterRuntime(CoreRuntime):
                 if self._shutdown:
                     return
                 time.sleep(0.2)
+
+    def _on_log_event(self, data: bytes) -> None:
+        """Print a worker's mirrored output with its identity prefix
+        (reference: ``log_to_driver`` formatting in worker.py)."""
+        import sys
+
+        try:
+            rec = pickle.loads(data)
+            # Scope to this driver's namespace (the analog of the
+            # reference's per-job log routing; drivers sharing a namespace
+            # share worker logs).
+            if rec.get("ns", "default") != self.namespace:
+                return
+            out = sys.stderr if rec.get("stream") == "stderr" else sys.stdout
+            for line in rec.get("lines", ()):
+                print(f"({rec.get('name', '?')} pid={rec.get('pid', '?')}) "
+                      f"{line}", file=out, flush=True)
+        except Exception:  # noqa: BLE001
+            pass
 
     def _on_actor_event(self, data: bytes):
         try:
@@ -464,9 +498,11 @@ class ClusterRuntime(CoreRuntime):
         try:
             # Task completion can be observed before the worker's location
             # update lands in the GCS directory; re-probe briefly before
-            # paying for a re-execution (spurious-"lost" window).
+            # paying for a re-execution (spurious-"lost" window). The
+            # in-process store counts too: inline results land there.
             for _ in range(3):
-                if self._fetch_object(ref)[0]:
+                if self.memory.contains(ref.id()) or \
+                        self._fetch_object(ref)[0]:
                     return True
                 time.sleep(0.05)
             logger.warning("all copies of %s lost; re-executing task %s (%s)",
@@ -773,6 +809,74 @@ class ClusterRuntime(CoreRuntime):
         threading.Thread(target=_reap, daemon=True,
                          name="stream-reaper").start()
 
+    # ------------------------------------------------------ lease caching
+    # Reference: normal task submitters keep granted worker leases for a
+    # short idle window and pipeline same-shaped tasks onto them
+    # (``normal_task_submitter.cc:88-145``) — skipping the per-task
+    # lease/return round-trip is the single biggest tasks/s lever.
+    LEASE_CACHE_TTL_S = 0.2
+
+    def _lease_signature(self, spec: pb.TaskSpec):
+        """Cache key, or None when the task isn't lease-reusable (PG- or
+        affinity-targeted leases are placement-specific)."""
+        if spec.placement_group_id or spec.affinity_node_id:
+            return None
+        return (tuple(sorted(spec.resources.items())),
+                bytes(spec.runtime_env))
+
+    def _take_cached_lease(self, sig) -> Optional[dict]:
+        with self._lease_cache_lock:
+            lst = self._lease_cache.get(sig)
+            if lst:
+                return lst.pop()
+        return None
+
+    def _cache_lease(self, sig, lease: dict) -> bool:
+        lease["ts"] = time.monotonic()
+        with self._lease_cache_lock:
+            if self._shutdown:
+                return False
+            self._lease_cache.setdefault(sig, []).append(lease)
+            self._lease_reaper_started or self._start_lease_reaper()
+            return True
+
+    def _start_lease_reaper(self) -> bool:
+        self._lease_reaper_started = True
+        threading.Thread(target=self._lease_reaper_loop, daemon=True,
+                         name="lease-reaper").start()
+        return True
+
+    def _lease_reaper_loop(self):
+        while not self._shutdown:
+            time.sleep(0.05)
+            now = time.monotonic()
+            expired = []
+            with self._lease_cache_lock:
+                for sig, lst in list(self._lease_cache.items()):
+                    keep = [l for l in lst
+                            if now - l["ts"] <= self.LEASE_CACHE_TTL_S]
+                    expired.extend(l for l in lst if l not in keep)
+                    if keep:
+                        self._lease_cache[sig] = keep
+                    else:
+                        self._lease_cache.pop(sig, None)
+            for lease in expired:
+                self._return_lease(lease)
+
+    def _return_lease(self, lease: dict) -> None:
+        try:
+            lease["node"].ReturnWorker(pb.ReturnWorkerRequest(
+                worker_id=lease["worker_id"]))
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _drain_lease_cache(self) -> None:
+        with self._lease_cache_lock:
+            leases = [l for lst in self._lease_cache.values() for l in lst]
+            self._lease_cache.clear()
+        for lease in leases:
+            self._return_lease(lease)
+
     def _lease_and_push(self, spec: pb.TaskSpec, return_ids: List[ObjectID],
                         retries: int, pinned: Optional[List[bytes]] = None):
         try:
@@ -858,6 +962,25 @@ class ClusterRuntime(CoreRuntime):
 
     def _lease_and_push_once(self, spec: pb.TaskSpec,
                              return_ids: List[ObjectID]):
+        sig = self._lease_signature(spec)
+        if sig is not None:
+            lease = self._take_cached_lease(sig)
+            if lease is not None:
+                del spec.tpu_chips[:]
+                spec.tpu_chips.extend(lease["tpu_chips"])
+                stub = rpc.get_stub("WorkerService", lease["worker_address"])
+                try:
+                    result = stub.PushTask(pb.PushTaskRequest(spec=spec),
+                                           timeout=PUSH_TIMEOUT_S)
+                except Exception:  # noqa: BLE001
+                    # Stale cached lease (worker died idle): drop it and
+                    # fall through to a fresh lease — the task never ran.
+                    self._return_lease(lease)
+                else:
+                    if not self._cache_lease(sig, lease):
+                        self._return_lease(lease)
+                    self._apply_push_result(result, return_ids, spec.name)
+                    return
         pg_targets: List[Any] = []
         if spec.placement_group_id:
             pg_targets = self._pg_lease_targets(spec)
@@ -918,33 +1041,40 @@ class ClusterRuntime(CoreRuntime):
         if reply.tpu_chips:
             del spec.tpu_chips[:]
             spec.tpu_chips.extend(reply.tpu_chips)
+        lease = {"node": target, "worker_id": reply.worker_id,
+                 "worker_address": reply.worker_address,
+                 "tpu_chips": list(reply.tpu_chips)}
         try:
             result = worker_stub.PushTask(
                 pb.PushTaskRequest(spec=spec), timeout=PUSH_TIMEOUT_S)
         except Exception as e:  # noqa: BLE001
+            self._return_lease(lease)
             raise exceptions.WorkerCrashedError(
                 f"Worker executing {spec.name} died: {e}") from None
-        finally:
-            try:
-                target.ReturnWorker(pb.ReturnWorkerRequest(
-                    worker_id=reply.worker_id))
-            except Exception:  # noqa: BLE001
-                pass
+        # Keep the lease for the reuse window instead of returning it
+        # (returned by the reaper after LEASE_CACHE_TTL_S idle).
+        if sig is None or not self._cache_lease(sig, lease):
+            self._return_lease(lease)
         self._apply_push_result(result, return_ids, spec.name)
 
     def _apply_push_result(self, result: pb.PushTaskResult,
                            return_ids: List[ObjectID], name: str):
-        if return_ids:
-            self._task_done.add(return_ids[0].task_id().binary())
+        # Values are stored BEFORE the done-marker: a concurrent get that
+        # observed "done" with the value still missing would conclude
+        # "produced then lost" and re-execute the task spuriously.
         if not result.ok:
             err = pickle.loads(result.error) if result.error else \
                 exceptions.RayTaskError(name, "task failed")
             self._store_error(err, return_ids)
+            if return_ids:
+                self._task_done.add(return_ids[0].task_id().binary())
             return
         for i, oid in enumerate(return_ids):
             if i < len(result.in_store) and result.in_store[i]:
                 continue  # large result: fetched on demand via the directory
             self.memory.put(oid, loads(result.inline_results[i]))
+        if return_ids:
+            self._task_done.add(return_ids[0].task_id().binary())
         with self._ready_cond:
             self._ready_cond.notify_all()
 
@@ -1092,11 +1222,46 @@ class ClusterRuntime(CoreRuntime):
             self._actor_session[actor_id.binary()] = \
                 self._actor_session.get(actor_id.binary(), 0) + 1
             self._actor_seq[actor_id.binary()] = 0
+            st = self._actor_window.get(actor_id.binary())
+        if st is not None:
+            # New session restarts sequence numbers at 0; reopen the
+            # send window so the restarted actor's pushes aren't gated on
+            # the dead session's completion counter.
+            with st["cond"]:
+                st["done"] = 0
+                st["cond"].notify_all()
+
+    # Max concurrent pushes per actor. Must stay well under the worker's
+    # gRPC server pool: each ordered push occupies a server thread while it
+    # waits for its sequence turn, and a full pool with the next-needed
+    # sequence still unadmitted is a deadlock (reference analog: the actor
+    # scheduling queue admits out-of-order arrivals without holding a
+    # thread; this runtime's unary RPCs can't, so the submitter bounds the
+    # in-flight window instead).
+    ACTOR_SEND_WINDOW = 16
+
+    def _actor_window_state(self, aid: bytes) -> dict:
+        with self._actor_lock:
+            st = self._actor_window.get(aid)
+            if st is None:
+                st = self._actor_window[aid] = {
+                    "cond": threading.Condition(), "done": 0}
+            return st
 
     def _push_actor_task(self, actor_id: ActorID, spec: pb.TaskSpec,
                          return_ids: List[ObjectID], retries: int,
                          pinned: Optional[List[bytes]] = None):
         attempt = 0
+        st = self._actor_window_state(actor_id.binary())
+        seq = spec.sequence_no
+        # Deadline: a session rotation resets the completion counter, so a
+        # stale-session push could otherwise wait forever — after the
+        # deadline it proceeds and fails fast server-side instead.
+        gate_deadline = time.monotonic() + 120.0
+        with st["cond"]:
+            while seq >= st["done"] + self.ACTOR_SEND_WINDOW and \
+                    not self._shutdown and time.monotonic() < gate_deadline:
+                st["cond"].wait(1.0)
         try:
             while True:
                 try:
@@ -1123,6 +1288,9 @@ class ClusterRuntime(CoreRuntime):
                         return_ids)
                     return
         finally:
+            with st["cond"]:
+                st["done"] = max(st["done"], seq + 1)
+                st["cond"].notify_all()
             for oid in pinned or ():
                 self.refs.decr(oid)
 
@@ -1235,6 +1403,7 @@ class ClusterRuntime(CoreRuntime):
         if self._shutdown:
             return
         self._shutdown = True
+        self._drain_lease_cache()
         try:
             self.refs.shutdown()  # release all held refcounts at the GCS
         except Exception:  # noqa: BLE001

@@ -46,6 +46,80 @@ from ray_tpu.protobuf import ray_tpu_pb2 as pb
 logger = logging.getLogger(__name__)
 
 
+class _LogTee:
+    """Mirror a worker's stdout/stderr to the driver (reference: the log
+    monitor tailing worker log files + ``log_to_driver`` printing with a
+    ``(pid=...)`` prefix, ``_private/log_monitor.py``). Lines buffer
+    briefly and ship over the GCS LOG pubsub channel; drivers subscribe
+    and re-print them."""
+
+    FLUSH_PERIOD_S = 0.1
+
+    def __init__(self, orig, stream_name: str, publisher):
+        self._orig = orig
+        self._stream = stream_name
+        self._publisher = publisher
+        self._partial = ""
+
+    def write(self, s):
+        self._orig.write(s)
+        self._partial += s
+        *lines, self._partial = self._partial.split("\n")
+        for line in lines:
+            if line:
+                self._publisher.add(self._stream, line)
+        return len(s)
+
+    def flush(self):
+        self._orig.flush()
+
+    def fileno(self):
+        return self._orig.fileno()
+
+    def isatty(self):
+        return False
+
+
+class _LogPublisher:
+    def __init__(self, gcs, worker_id: str, namespace: str = "default"):
+        self._gcs = gcs
+        self._worker_id = worker_id
+        self._namespace = namespace
+        self._pid = os.getpid()
+        self._buf: List[tuple] = []
+        self._lock = threading.Lock()
+        threading.Thread(target=self._flush_loop, daemon=True,
+                         name="log-pub").start()
+
+    def add(self, stream: str, line: str) -> None:
+        with self._lock:
+            self._buf.append((stream, line))
+            if len(self._buf) > 1000:  # chatty task: drop oldest
+                del self._buf[:500]
+
+    def _flush_loop(self):
+        while True:
+            time.sleep(_LogTee.FLUSH_PERIOD_S)
+            with self._lock:
+                buf, self._buf = self._buf, []
+            if not buf:
+                continue
+            by_stream: Dict[str, List[str]] = {}
+            for stream, line in buf:
+                by_stream.setdefault(stream, []).append(line)
+            for stream, lines in by_stream.items():
+                try:
+                    self._gcs.Publish(pb.PublishRequest(
+                        channel="LOG",
+                        data=pickle.dumps({"name": self._worker_id[:8],
+                                           "pid": self._pid,
+                                           "ns": self._namespace,
+                                           "stream": stream,
+                                           "lines": lines})))
+                except Exception:  # noqa: BLE001 — logs are best-effort
+                    pass
+
+
 class _ActorRunner:
     """Per-caller sequence ordering + single-slot execution for one actor.
 
@@ -93,9 +167,23 @@ class WorkerServer:
         self._actors: Dict[bytes, _ActorRunner] = {}
         self._task_lock = threading.Lock()  # one normal task at a time
         self._exit = threading.Event()
-        self._server, self.port = rpc.serve("WorkerService", self)
+        # Pool must exceed any single submitter's concurrency: ordered
+        # actor pushes BLOCK a server thread until their sequence number's
+        # turn, so a pool smaller than the in-flight push count can starve
+        # the very push holding the next sequence number (deadlock until
+        # the ordering-gap timeout). Paired with the submitter-side
+        # per-actor send window (cluster.py ACTOR_SEND_WINDOW).
+        self._server, self.port = rpc.serve("WorkerService", self,
+                                            max_workers=128)
         self.address = f"127.0.0.1:{self.port}"
         self.node = rpc.get_stub("NodeService", node_address)
+        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+            import sys
+
+            pub = _LogPublisher(self.runtime.gcs, worker_id,
+                                namespace=self.runtime.namespace)
+            sys.stdout = _LogTee(sys.stdout, "stdout", pub)
+            sys.stderr = _LogTee(sys.stderr, "stderr", pub)
         self.node.AnnounceWorker(pb.AnnounceWorkerRequest(
             worker_id=worker_id, address=self.address, pid=os.getpid()))
 

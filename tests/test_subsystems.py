@@ -137,3 +137,71 @@ def test_cli_status(cluster, capsys):
     main(["status", "--address", cluster.address])
     out = capsys.readouterr().out
     assert "nodes alive" in out
+
+
+# -------------------------------------------------- log streaming to driver
+
+def test_worker_logs_stream_to_driver():
+    """Worker prints arrive at the driver with an identity prefix
+    (reference: log_to_driver + log monitor)."""
+    import io
+    import sys
+    import time
+
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote
+        def chatty():
+            print("log-stream-marker-xyz")
+            return 1
+
+        buf = io.StringIO()
+        real = sys.stdout
+
+        class Tee:
+            def write(self, s):
+                buf.write(s)
+                return real.write(s)
+
+            def flush(self):
+                real.flush()
+
+        sys.stdout = Tee()
+        try:
+            assert ray_tpu.get(chatty.remote(), timeout=60) == 1
+            deadline = time.monotonic() + 10
+            while "pid=" not in buf.getvalue() and \
+                    time.monotonic() < deadline:
+                time.sleep(0.1)
+        finally:
+            sys.stdout = real
+        out = buf.getvalue()
+        assert "log-stream-marker-xyz" in out
+        prefixed = [l for l in out.splitlines()
+                    if "pid=" in l and "log-stream-marker-xyz" in l]
+        assert prefixed, out
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_usage_stats_records_and_respects_optout(monkeypatch):
+    from ray_tpu._private import usage
+
+    monkeypatch.setattr(usage, "_library_usages", set())
+    monkeypatch.setattr(usage, "_extra_tags", {})
+    usage.record_library_usage("testlib")
+    usage.record_extra_usage_tag("k", "v")
+    s = usage.usage_summary()
+    assert "testlib" in s["libraries"] and s["extra_tags"]["k"] == "v"
+
+    monkeypatch.setenv("RAY_TPU_USAGE_STATS_ENABLED", "0")
+    usage.record_library_usage("hidden")
+    assert "hidden" not in usage.usage_summary()["libraries"]
+    assert not usage.usage_stats_enabled()
