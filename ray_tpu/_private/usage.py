@@ -1,0 +1,89 @@
+"""Usage stats: opt-in feature/library usage accounting.
+
+Reference: ``python/ray/_private/usage/usage_lib.py`` — the reference
+collects cluster metadata + library-usage tags and reports them to a
+telemetry endpoint unless disabled. The TPU-native build runs in
+air-gapped pods, so there is NO network reporter: records aggregate in
+the GCS KV (cluster mode) and a local JSON file, surfaced through
+:func:`usage_summary` and the dashboard. Enabled by default like the
+reference; ``RAY_TPU_USAGE_STATS_ENABLED=0`` disables all recording.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Dict
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_library_usages: set = set()
+_extra_tags: Dict[str, str] = {}
+
+
+def usage_stats_enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") != "0"
+
+
+def record_library_usage(name: str) -> None:
+    """Mark a library (data/train/tune/serve/rllib/...) as used this
+    session (reference: ``record_library_usage``)."""
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        if name in _library_usages:
+            return
+        _library_usages.add(name)
+    _persist()
+
+
+def record_extra_usage_tag(key: str, value: str) -> None:
+    if not usage_stats_enabled():
+        return
+    with _lock:
+        _extra_tags[key] = str(value)
+    _persist()
+
+
+def usage_summary() -> Dict[str, Any]:
+    with _lock:
+        return {
+            "enabled": usage_stats_enabled(),
+            "libraries": sorted(_library_usages),
+            "extra_tags": dict(_extra_tags),
+            "pid": os.getpid(),
+        }
+
+
+def _usage_path() -> str:
+    return os.path.join(tempfile.gettempdir(),
+                        f"ray_tpu_usage_{os.getpid()}.json")
+
+
+def _persist() -> None:
+    """Best-effort local record + cluster KV record (the air-gapped stand-
+    in for the reference's telemetry upload)."""
+    summary = usage_summary()
+    summary["ts"] = time.time()
+    try:
+        with open(_usage_path(), "w") as f:
+            json.dump(summary, f)
+    except OSError:
+        pass
+    try:
+        from ray_tpu._private import worker as worker_mod
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        w = worker_mod.global_worker_or_none()
+        gcs = getattr(getattr(w, "core", None), "gcs", None)
+        if gcs is not None:
+            gcs.KvPut(pb.KvRequest(
+                ns="usage", key=f"worker/{os.getpid()}",
+                value=json.dumps(summary).encode(), overwrite=True))
+    except Exception:  # noqa: BLE001
+        pass

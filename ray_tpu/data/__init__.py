@@ -1,6 +1,7 @@
 """ray_tpu.data: distributed data processing (reference: ``python/ray/data``)."""
 
 from ray_tpu.data.dataset import (
+    ActorPoolStrategy,
     DataIterator,
     Dataset,
     from_arrow,
@@ -14,6 +15,11 @@ from ray_tpu.data.dataset import (
 )
 
 __all__ = [
-    "DataIterator", "Dataset", "from_arrow", "from_items", "from_numpy",
+    "ActorPoolStrategy", "DataIterator", "Dataset", "from_arrow", "from_items", "from_numpy",
     "from_pandas", "range", "read_csv", "read_json", "read_parquet",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("data")
+del _rlu

@@ -27,7 +27,60 @@ import ray_tpu
 
 Batch = Union[Dict[str, np.ndarray], "pa.Table", "pandas.DataFrame"]
 
-MAX_IN_FLIGHT = 16  # streaming window (backpressure bound)
+MAX_IN_FLIGHT = 16  # streaming window hard cap (backpressure bound)
+_STATS_ACTOR = "_rtpu_data_stats"
+
+
+_stats_handle = None
+_stats_handle_core = None
+
+
+def _record_stats(stats_key, op: str, rows_in: int, rows_out: int,
+                  seconds: float) -> None:
+    """Fire-and-forget per-block stats to the session stats actor
+    (reference: ``_StatsActor``, ``data/_internal/stats.py``). The handle
+    is cached per runtime — a per-block name lookup would add a GCS
+    round-trip to the very latency being measured, and a handle cached
+    across init/shutdown cycles would silently drop records."""
+    global _stats_handle, _stats_handle_core
+    if not stats_key:
+        return
+    try:
+        from ray_tpu._private import worker as _worker_mod
+
+        core = _worker_mod.global_worker().core
+        if _stats_handle is None or _stats_handle_core is not core:
+            _stats_handle = ray_tpu.get_actor(_STATS_ACTOR)
+            _stats_handle_core = core
+        _stats_handle.record.remote(stats_key, op, rows_in, rows_out,
+                                    seconds)
+    except Exception:  # noqa: BLE001 — stats are best-effort
+        _stats_handle = None
+
+
+class _StatsActor:
+    """Session-wide collector of per-operator execution stats. Bounded:
+    only the most recent executions are retained (long-lived sessions
+    re-executing datasets every epoch would otherwise grow it forever)."""
+
+    MAX_KEYS = 256
+
+    def __init__(self):
+        self.data: Dict[str, Dict[str, list]] = {}
+
+    def record(self, key, op, rows_in, rows_out, seconds):
+        if key not in self.data:
+            while len(self.data) >= self.MAX_KEYS:
+                self.data.pop(next(iter(self.data)))
+        entry = self.data.setdefault(key, {}).setdefault(
+            op, [0, 0, 0.0, 0])  # rows_in, rows_out, seconds, blocks
+        entry[0] += rows_in
+        entry[1] += rows_out
+        entry[2] += seconds
+        entry[3] += 1
+
+    def get(self, key):
+        return self.data.get(key, {})
 
 
 # ----------------------------------------------------------------- block ops
@@ -68,26 +121,76 @@ def _table_from_batch(batch) -> pa.Table:
 
 # remote per-block kernels (module-level so they pickle by reference)
 @ray_tpu.remote
-def _map_block(table: pa.Table, fn) -> pa.Table:
-    return _table_from_rows([fn(r) for r in _rows_of(table)])
+def _map_block(table: pa.Table, fn, stats_key=None) -> pa.Table:
+    import time as _time
+
+    t0 = _time.perf_counter()
+    out = _table_from_rows([fn(r) for r in _rows_of(table)])
+    _record_stats(stats_key, "map", len(table), len(out),
+                  _time.perf_counter() - t0)
+    return out
 
 
 @ray_tpu.remote
-def _map_batches_block(table: pa.Table, fn, fmt: str) -> pa.Table:
-    return _table_from_batch(fn(_batch_of(table, fmt)))
+def _map_batches_block(table: pa.Table, fn, fmt: str,
+                       stats_key=None) -> pa.Table:
+    import time as _time
+
+    t0 = _time.perf_counter()
+    out = _table_from_batch(fn(_batch_of(table, fmt)))
+    _record_stats(stats_key, "map_batches", len(table), len(out),
+                  _time.perf_counter() - t0)
+    return out
 
 
 @ray_tpu.remote
-def _filter_block(table: pa.Table, fn) -> pa.Table:
-    return _table_from_rows([r for r in _rows_of(table) if fn(r)])
+def _filter_block(table: pa.Table, fn, stats_key=None) -> pa.Table:
+    import time as _time
+
+    t0 = _time.perf_counter()
+    out = _table_from_rows([r for r in _rows_of(table) if fn(r)])
+    _record_stats(stats_key, "filter", len(table), len(out),
+                  _time.perf_counter() - t0)
+    return out
 
 
 @ray_tpu.remote
-def _flat_map_block(table: pa.Table, fn) -> pa.Table:
+def _flat_map_block(table: pa.Table, fn, stats_key=None) -> pa.Table:
+    import time as _time
+
+    t0 = _time.perf_counter()
     out: List[Any] = []
     for r in _rows_of(table):
         out.extend(fn(r))
-    return _table_from_rows(out)
+    out = _table_from_rows(out)
+    _record_stats(stats_key, "flat_map", len(table), len(out),
+                  _time.perf_counter() - t0)
+    return out
+
+
+class _MapWorker:
+    """Actor hosting a stateful map_batches callable (reference:
+    ``ActorPoolMapOperator`` — a class UDF is constructed ONCE per pool
+    actor and reused for every batch, amortizing model loads)."""
+
+    def __init__(self, fn_or_cls, ctor_args, ctor_kwargs):
+        if isinstance(fn_or_cls, type):
+            self.fn = fn_or_cls(*ctor_args, **(ctor_kwargs or {}))
+        else:
+            self.fn = fn_or_cls
+
+    def map_batch(self, table: pa.Table, fmt: str,
+                  stats_key=None) -> pa.Table:
+        import time as _time
+
+        t0 = _time.perf_counter()
+        out = _table_from_batch(self.fn(_batch_of(table, fmt)))
+        _record_stats(stats_key, "map_batches(actors)", len(table),
+                      len(out), _time.perf_counter() - t0)
+        return out
+
+    def ping(self):
+        return True
 
 
 @ray_tpu.remote
@@ -107,12 +210,21 @@ def _read_file_block(path: str, fmt: str) -> pa.Table:
     raise ValueError(fmt)
 
 
+class ActorPoolStrategy:
+    """Fixed-size actor pool for stateful map_batches (reference:
+    ``ray.data.ActorPoolStrategy`` — min/max autoscaling pool, fixed here)."""
+
+    def __init__(self, size: int = 2):
+        self.size = max(int(size), 1)
+
+
 class Dataset:
     """Lazy plan: a list of block-producing thunks + pending transforms."""
 
     def __init__(self, block_refs: List[Any], plan: Optional[List] = None):
         self._block_refs = block_refs  # ObjectRefs of pa.Table
         self._plan = plan or []       # [(op, payload), ...] pending stages
+        self._last_stats_key: Optional[str] = None
 
     # -------------------------------------------------------------- plan ops
     def _with(self, op: str, payload) -> "Dataset":
@@ -121,9 +233,24 @@ class Dataset:
     def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
         return self._with("map", fn)
 
-    def map_batches(self, fn: Callable[[Batch], Batch], *,
+    def map_batches(self, fn: Union[Callable[[Batch], Batch], type], *,
                     batch_format: str = "numpy",
-                    batch_size: Optional[int] = None) -> "Dataset":
+                    batch_size: Optional[int] = None,
+                    compute: Optional["ActorPoolStrategy"] = None,
+                    concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None
+                    ) -> "Dataset":
+        """Per-block batch transform. A class UDF (or an explicit
+        ``compute=ActorPoolStrategy(...)`` / ``concurrency=N``) runs on a
+        pool of actors that construct the UDF once and reuse it per batch
+        (reference: ``ActorPoolMapOperator``)."""
+        if isinstance(fn, type) or compute is not None or                 concurrency is not None:
+            pool = compute or ActorPoolStrategy(concurrency or 2)
+            return self._with("map_batches_actors",
+                              (fn, batch_format, pool.size,
+                               fn_constructor_args,
+                               fn_constructor_kwargs or {}))
         return self._with("map_batches", (fn, batch_format))
 
     def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
@@ -152,21 +279,60 @@ class Dataset:
             else:
                 stages.append((op, payload))
 
+        import uuid as _uuid
+
+        stats_key = _uuid.uuid4().hex[:12]
+        self._last_stats_key = stats_key
+        try:  # session stats actor, shared across datasets
+            ray_tpu.remote(_StatsActor).options(
+                name=_STATS_ACTOR, get_if_exists=True,
+                lifetime="detached").remote()
+        except Exception:  # noqa: BLE001
+            stats_key = None
+
+        # Actor pools for stateful map_batches stages, one per stage;
+        # torn down once every produced block is ready.
+        pools: Dict[int, List[Any]] = {}
+
+        def pool_for(stage_idx, payload):
+            actors = pools.get(stage_idx)
+            if actors is None:
+                fn, _, size, ctor_args, ctor_kwargs = payload
+                cls = ray_tpu.remote(_MapWorker)
+                actors = [cls.remote(fn, ctor_args, ctor_kwargs)
+                          for _ in builtins.range(size)]
+                pools[stage_idx] = actors
+            return actors
+
+        rr = itertools.count()
+
         def apply_stages(ref):
-            for op, payload in stages:
+            for i, (op, payload) in enumerate(stages):
                 if op == "map":
-                    ref = _map_block.remote(ref, payload)
+                    ref = _map_block.remote(ref, payload, stats_key)
                 elif op == "map_batches":
                     fn, fmt = payload
-                    ref = _map_batches_block.remote(ref, fn, fmt)
+                    ref = _map_batches_block.remote(ref, fn, fmt, stats_key)
+                elif op == "map_batches_actors":
+                    actors = pool_for(i, payload)
+                    actor = actors[next(rr) % len(actors)]
+                    ref = actor.map_batch.remote(ref, payload[1], stats_key)
                 elif op == "filter":
-                    ref = _filter_block.remote(ref, payload)
+                    ref = _filter_block.remote(ref, payload, stats_key)
                 elif op == "flat_map":
-                    ref = _flat_map_block.remote(ref, payload)
+                    ref = _flat_map_block.remote(ref, payload, stats_key)
             return ref
 
         if not stages and limit is None:
             return refs
+
+        # Resource-aware window: never hold more in-flight blocks than the
+        # cluster can actually execute (2x CPUs), capped by MAX_IN_FLIGHT.
+        try:
+            cpus = ray_tpu.cluster_resources().get("CPU", 4.0)
+        except Exception:  # noqa: BLE001
+            cpus = 4.0
+        window_cap = max(2, min(MAX_IN_FLIGHT, int(cpus * 2)))
 
         out = []
         window: List[Any] = []
@@ -175,7 +341,7 @@ class Dataset:
             if limit is not None and produced >= limit:
                 break
             window.append(apply_stages(ref))
-            if len(window) >= MAX_IN_FLIGHT:
+            if len(window) >= window_cap:
                 done = window.pop(0)
                 out.append(done)
                 if limit is not None:
@@ -188,6 +354,25 @@ class Dataset:
                     break
         if limit is not None:
             out = self._apply_limit(out, limit)
+        if pools:
+            all_actors = [a for lst in pools.values() for a in lst]
+            final = list(out)
+
+            def _teardown():
+                try:
+                    ray_tpu.wait(final, num_returns=len(final),
+                                 timeout=3600)
+                except Exception:  # noqa: BLE001
+                    pass
+                for a in all_actors:
+                    try:
+                        ray_tpu.kill(a)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            import threading as _threading
+
+            _threading.Thread(target=_teardown, daemon=True).start()
         return out
 
     @staticmethod
@@ -315,6 +500,26 @@ class Dataset:
             if t.num_columns:
                 return t.schema
         return None
+
+    def stats(self) -> str:
+        """Per-operator execution stats of the last run (reference:
+        ``Dataset.stats()`` / ``data/_internal/stats.py``)."""
+        key = self._last_stats_key
+        if key is None:
+            return "(dataset not executed yet)"
+        try:
+            data = ray_tpu.get(
+                ray_tpu.get_actor(_STATS_ACTOR).get.remote(key), timeout=10)
+        except Exception:  # noqa: BLE001
+            return "(no stats recorded)"
+        if not data:
+            return "(no stats recorded)"
+        lines = []
+        for op, (rin, rout, secs, blocks) in data.items():
+            lines.append(
+                f"{op}: {blocks} blocks, {rin} rows in -> {rout} rows out, "
+                f"{secs * 1000:.1f}ms total wall")
+        return "\n".join(lines)
 
     def num_blocks(self) -> int:
         return len(self._block_refs)

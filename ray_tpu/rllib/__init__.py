@@ -25,3 +25,8 @@ __all__ = [
     "ReplayBuffer", "SampleBatch", "SingleAgentEnvRunner", "Transition",
     "TransitionEnvRunner", "compute_gae",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("rllib")
+del _rlu

@@ -13,10 +13,18 @@ from ray_tpu.serve.api import (
     start_http,
     stop_http,
 )
+from ray_tpu.serve.api import DeploymentResponseGenerator
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application", "Deployment", "DeploymentHandle", "DeploymentResponse",
-    "batch", "delete", "deployment", "get_deployment_handle", "run",
-    "shutdown", "start_http", "stop_http",
+    "DeploymentResponseGenerator", "batch", "delete", "deployment",
+    "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
+    "run", "shutdown", "start_http", "stop_http",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("serve")
+del _rlu

@@ -112,16 +112,47 @@ class Replica:
         self._total = 0
         self._m_lock = threading.Lock()
 
-    def handle_request(self, method: str, args, kwargs):
+    def handle_request(self, method: str, args, kwargs,
+                       multiplexed_model_id: str = ""):
+        from ray_tpu.serve import multiplex
+
         with self._m_lock:
             self._ongoing += 1
             self._total += 1
+        token = multiplex._set_model_id(multiplexed_model_id)
         try:
             if self.is_function:
                 return self.instance(*args, **kwargs)
             target = getattr(self.instance, method or "__call__")
             return target(*args, **kwargs)
         finally:
+            multiplex._reset_model_id(token)
+            with self._m_lock:
+                self._ongoing -= 1
+
+    def handle_request_streaming(self, method: str, args, kwargs,
+                                 multiplexed_model_id: str = ""):
+        """Generator variant: each yield of the user method becomes one
+        streamed item when called with num_returns="streaming" (reference:
+        DeploymentResponseGenerator / RayServeHandle stream=True)."""
+        from ray_tpu.serve import multiplex
+
+        with self._m_lock:
+            self._ongoing += 1
+            self._total += 1
+        token = multiplex._set_model_id(multiplexed_model_id)
+        try:
+            target = (self.instance if self.is_function
+                      else getattr(self.instance, method or "__call__"))
+            result = target(*args, **kwargs)
+            if not hasattr(result, "__next__"):
+                raise TypeError(
+                    f"stream=True requires a generator; "
+                    f"{method or '__call__'!r} returned "
+                    f"{type(result).__name__}")
+            yield from result
+        finally:
+            multiplex._reset_model_id(token)
             with self._m_lock:
                 self._ongoing -= 1
 
@@ -310,6 +341,21 @@ class DeploymentResponse:
         return self._ref
 
 
+class DeploymentResponseGenerator:
+    """Iterates the values of a streaming deployment call as the replica
+    yields them (reference: ``DeploymentResponseGenerator`` — handle
+    ``stream=True``). Wraps the core ObjectRefGenerator."""
+
+    def __init__(self, obj_ref_gen):
+        self._gen = obj_ref_gen
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return ray_tpu.get(next(self._gen))
+
+
 class _RouterState:
     """Routing table + subscription shared by a handle and its clones."""
 
@@ -329,17 +375,28 @@ class DeploymentHandle:
     immediately and retries on a live replica."""
 
     def __init__(self, deployment_name: str, method_name: Optional[str] = None,
-                 _router: Optional["_RouterState"] = None):
+                 _router: Optional["_RouterState"] = None,
+                 _stream: bool = False, _model_id: str = ""):
         self._name = deployment_name
         self._method = method_name
+        self._stream = _stream
+        self._model_id = _model_id
         # Router state (replica table, in-flight counts, subscription) is
         # SHARED across options()/method clones: one subscription per
         # logical handle, not per call.
         self._router = _router or _RouterState()
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self._name, method_name,
-                                _router=self._router)
+    def options(self, method_name: Optional[str] = None, *,
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None
+                ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._name,
+            method_name if method_name is not None else self._method,
+            _router=self._router,
+            _stream=self._stream if stream is None else stream,
+            _model_id=(self._model_id if multiplexed_model_id is None
+                       else multiplexed_model_id))
 
     @property
     def _replicas(self):
@@ -396,8 +453,11 @@ class DeploymentHandle:
             st.inflight = {}
             st.dirty = not st.replicas
 
-    def _choose(self):
-        """Power-of-two-choices over in-flight counts."""
+    def _choose(self, model_id: str = ""):
+        """Power-of-two-choices over in-flight counts; multiplexed calls
+        instead hash the model id over the replica set so one model's
+        requests keep hitting the replica whose LRU already holds it
+        (reference: model-locality routing in serve/_private/multiplex)."""
         self._refresh()
         if not self._replicas:
             # A fresh deployment may still be starting replicas.
@@ -408,7 +468,11 @@ class DeploymentHandle:
         if not self._replicas:
             raise RuntimeError(f"deployment {self._name!r} has no replicas")
         with self._lock:
-            if len(self._replicas) == 1:
+            if model_id:
+                import zlib
+
+                idx = zlib.crc32(model_id.encode()) % len(self._replicas)
+            elif len(self._replicas) == 1:
                 idx = 0
             else:
                 a, b = random.sample(range(len(self._replicas)), 2)
@@ -417,9 +481,25 @@ class DeploymentHandle:
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
         return idx, self._replicas[idx]
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
-        idx, replica = self._choose()
-        ref = replica.handle_request.remote(self._method, args, kwargs)
+    def remote(self, *args, **kwargs):
+        idx, replica = self._choose(self._model_id)
+        if self._stream:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(
+                self._method, args, kwargs, self._model_id)
+
+            def _sdone(_fut):
+                with self._lock:
+                    self._inflight[idx] = max(
+                        self._inflight.get(idx, 1) - 1, 0)
+
+            try:
+                gen.completed().future().add_done_callback(_sdone)
+            except Exception:  # noqa: BLE001
+                _sdone(None)
+            return DeploymentResponseGenerator(gen)
+        ref = replica.handle_request.remote(self._method, args, kwargs,
+                                            self._model_id)
 
         def _done(_fut):
             with self._lock:

@@ -1,0 +1,85 @@
+"""Model multiplexing: many models served by few replicas, LRU-loaded.
+
+Reference: ``python/ray/serve/api.py`` ``@serve.multiplexed`` +
+``serve/_private/multiplex.py`` ``ModelMultiplexWrapper`` — a replica
+holds up to ``max_num_models_per_replica`` models in an LRU cache; the
+router keeps requests for one model id on the same replica so its cache
+hits. TPU-native simplification: affinity comes from consistent hashing
+of the model id over the replica set (the reference pushes loaded-model
+reports through the controller; hashing gives the same steady-state
+locality without the feedback loop).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import functools
+import inspect
+from collections import OrderedDict
+from typing import Callable, Optional
+
+_model_id_ctx: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """Model id of the in-flight request (reference:
+    ``serve.get_multiplexed_model_id``); "" outside a multiplexed call."""
+    return _model_id_ctx.get()
+
+
+def _set_model_id(model_id: str):
+    return _model_id_ctx.set(model_id)
+
+
+def _reset_model_id(token) -> None:
+    _model_id_ctx.reset(token)
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorate a model-loader method ``(self, model_id) -> model``: calls
+    hit a per-replica LRU so at most ``max_num_models_per_replica`` models
+    stay resident; older ones are evicted on overflow."""
+
+    def decorate(loader: Callable):
+        cache_attr = f"__serve_mux_cache_{loader.__name__}"
+
+        def _cache(self) -> OrderedDict:
+            cache = getattr(self, cache_attr, None)
+            if cache is None:
+                cache = OrderedDict()
+                setattr(self, cache_attr, cache)
+            return cache
+
+        if inspect.iscoroutinefunction(loader):
+            @functools.wraps(loader)
+            async def wrapper(self, model_id: str):
+                cache = _cache(self)
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+                model = await loader(self, model_id)
+                cache[model_id] = model
+                while len(cache) > max_num_models_per_replica:
+                    cache.popitem(last=False)
+                return model
+        else:
+            @functools.wraps(loader)
+            def wrapper(self, model_id: str):
+                cache = _cache(self)
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+                model = loader(self, model_id)
+                cache[model_id] = model
+                while len(cache) > max_num_models_per_replica:
+                    cache.popitem(last=False)
+                return model
+
+        wrapper.__serve_multiplexed__ = True
+        return wrapper
+
+    if func is not None:
+        return decorate(func)
+    return decorate

@@ -33,3 +33,8 @@ __all__ = [
     "ScalingConfig", "TrainWorker", "WorkerGroup", "get_checkpoint",
     "get_context", "load_pytree", "report", "save_pytree",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("train")
+del _rlu

@@ -30,3 +30,8 @@ __all__ = [
     "Tuner", "choice", "get_checkpoint", "grid_search", "loguniform",
     "randint", "report", "run", "sample_from", "uniform",
 ]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("tune")
+del _rlu

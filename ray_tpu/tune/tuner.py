@@ -132,30 +132,113 @@ class Tuner:
     def __init__(self, trainable: Callable, *,
                  param_space: Optional[Dict[str, Any]] = None,
                  tune_config: Optional[TuneConfig] = None,
-                 run_config=None):
+                 run_config=None, _restore_state: Optional[dict] = None):
         self.trainable = trainable
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config
+        self._restore_state = _restore_state
+
+    # -------------------------------------------------- experiment state
+    # Reference: the experiment-state snapshot Tune writes to the run dir
+    # (tune/execution/tune_controller.py checkpointing + Tuner.restore).
+    STATE_FILE = "tuner_state.pkl"
+    STATE_SNAPSHOT_PERIOD_S = 1.0
+
+    def _experiment_dir(self) -> str:
+        import os
+        import tempfile
+
+        storage = getattr(self.run_config, "storage_path", None) or             os.path.join(tempfile.gettempdir(), "ray_tpu_results")
+        name = getattr(self.run_config, "name", None) or "tune_experiment"
+        path = os.path.join(storage, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable,
+                resume_errored: bool = False) -> "Tuner":
+        """Resume an interrupted/failed experiment from its state snapshot
+        (reference: ``Tuner.restore(path, trainable)``). Unfinished trials
+        continue from their last reported checkpoint; errored trials rerun
+        from theirs when ``resume_errored``."""
+        import os
+        import pickle as _pickle
+
+        with open(os.path.join(path, cls.STATE_FILE), "rb") as f:
+            state = _pickle.load(f)
+        state["resume_errored"] = resume_errored
+        tuner = cls(trainable, param_space=state.get("param_space"),
+                    tune_config=state.get("tune_config"),
+                    run_config=state.get("run_config"),
+                    _restore_state=state)
+        return tuner
 
     def fit(self) -> ResultGrid:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         tc = self.tune_config
-        generator = BasicVariantGenerator(tc.num_samples, tc.search_seed)
-        configs = list(generator.variants(self.param_space))
         scheduler = tc.scheduler or sched_mod.FIFOScheduler()
-        limit = tc.max_concurrent_trials or len(configs)
+
+        results: List[TrialResult] = []
+        if self._restore_state is not None:
+            state = self._restore_state
+            resume_errored = state.get("resume_errored", False)
+            pending = []
+            for t in state["unfinished"]:
+                pending.append((t["trial_id"], t["config"],
+                                t.get("checkpoint")))
+            for r in state["results"]:
+                if r.error and resume_errored:
+                    ckpt = state["checkpoints"].get(r.trial_id)
+                    pending.append((r.trial_id, r.config, ckpt))
+                else:
+                    results.append(r)
+            checkpoints: Dict[str, Any] = dict(state["checkpoints"])
+        else:
+            generator = BasicVariantGenerator(tc.num_samples, tc.search_seed)
+            configs = list(generator.variants(self.param_space))
+            pending = [(f"trial_{i:05d}_{uuid.uuid4().hex[:6]}", cfg, None)
+                       for i, cfg in enumerate(configs)]
+            checkpoints = {}
+        limit = tc.max_concurrent_trials or max(len(pending), 1)
 
         trial_cls = ray_tpu.remote(_TrialActor)
-        pending = [(f"trial_{i:05d}_{uuid.uuid4().hex[:6]}", cfg)
-                   for i, cfg in enumerate(configs)]
         running: Dict[str, Dict[str, Any]] = {}
-        results: List[TrialResult] = []
-        # Last reported checkpoint per trial — PBT forks bottom-quantile
-        # trials from a top-quantile donor's entry (pbt.py exploit step).
-        checkpoints: Dict[str, Any] = {}
+        # checkpoints: last reported checkpoint per trial — PBT forks
+        # bottom-quantile trials from a top-quantile donor's entry, and the
+        # experiment-state snapshot persists them for Tuner.restore.
         is_pbt = getattr(scheduler, "requires_checkpoints", False)
+        exp_dir = self._experiment_dir()
+        last_snapshot = 0.0
+
+        def snapshot_state(force=False):
+            nonlocal last_snapshot
+            if not force and \
+                    time.monotonic() - last_snapshot < \
+                    self.STATE_SNAPSHOT_PERIOD_S:
+                return
+            last_snapshot = time.monotonic()
+            import cloudpickle as _cp
+            import os
+
+            state = {
+                "param_space": self.param_space,
+                "tune_config": tc,
+                "run_config": self.run_config,
+                "results": list(results),
+                "unfinished": [
+                    {"trial_id": tid, "config": st["config"],
+                     "checkpoint": checkpoints.get(tid)}
+                    for tid, st in running.items()
+                ] + [{"trial_id": tid, "config": cfg, "checkpoint": ckpt}
+                     for tid, cfg, ckpt in pending],
+                "checkpoints": dict(checkpoints),
+            }
+            tmp = os.path.join(exp_dir, f".{self.STATE_FILE}.tmp")
+            with open(tmp, "wb") as f:
+                _cp.dump(state, f)
+            os.replace(tmp, os.path.join(exp_dir, self.STATE_FILE))
 
         def launch(trial_id, cfg, checkpoint=None, st=None):
             actor = trial_cls.options(max_concurrency=2).remote()
@@ -170,8 +253,9 @@ class Tuner:
         while pending or running:
             # Launch up to the concurrency limit.
             while pending and len(running) < limit:
-                trial_id, cfg = pending.pop(0)
-                launch(trial_id, cfg)
+                trial_id, cfg, ckpt = pending.pop(0)
+                launch(trial_id, cfg, checkpoint=ckpt)
+            snapshot_state()
             # Poll every running trial.
             for trial_id, st in list(running.items()):
                 try:
@@ -231,6 +315,7 @@ class Tuner:
                     del running[trial_id]
             time.sleep(0.02)
 
+        snapshot_state(force=True)
         return ResultGrid(results, tc.metric, tc.mode)
 
 

@@ -119,3 +119,8 @@ def delete(workflow_id: str):
 
 
 __all__ = ["delete", "get_output", "init", "list_all", "run"]
+
+from ray_tpu._private.usage import record_library_usage as _rlu
+
+_rlu("workflow")
+del _rlu

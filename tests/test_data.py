@@ -102,3 +102,56 @@ def test_read_write_parquet(tmp_path):
     ds = rdata.read_parquet(str(tmp_path / "*.parquet"))
     assert ds.count() == 30
     assert sorted(r["x"] for r in ds.take_all()) == list(range(30))
+
+
+# ---------------------------------------------------- actor pools + stats
+
+def test_map_batches_actor_pool_stateful(ray_start_regular):
+    """A class UDF is constructed once per pool actor and reused across
+    batches (reference: ActorPoolMapOperator)."""
+    from ray_tpu import data
+    from ray_tpu.data.dataset import ActorPoolStrategy
+
+    class AddBias:
+        def __init__(self, bias):
+            self.bias = bias
+            self.constructions = 1
+
+        def __call__(self, batch):
+            batch["id"] = batch["id"] + self.bias
+            return batch
+
+    ds = data.range(64, parallelism=8).map_batches(
+        AddBias, compute=ActorPoolStrategy(size=2),
+        fn_constructor_args=(1000,))
+    rows = ds.take_all()
+    assert sorted(r["id"] for r in rows) == list(range(1000, 1064))
+
+
+def test_map_batches_concurrency_shorthand(ray_start_regular):
+    from ray_tpu import data
+
+    def double(batch):
+        batch["id"] = batch["id"] * 2
+        return batch
+
+    ds = data.range(20, parallelism=4).map_batches(double, concurrency=2)
+    assert sorted(r["id"] for r in ds.take_all()) == \
+        [i * 2 for i in range(20)]
+
+
+def test_dataset_stats_recorded(ray_start_regular):
+    from ray_tpu import data
+
+    ds = data.range(32, parallelism=4).map(lambda r: {"id": r["id"] + 1}) \
+        .filter(lambda r: r["id"] % 2 == 0)
+    ds.take_all()
+    import time
+
+    deadline = time.monotonic() + 10
+    stats = ds.stats()
+    while "map" not in stats and time.monotonic() < deadline:
+        time.sleep(0.2)  # stats reports are fire-and-forget
+        stats = ds.stats()
+    assert "map" in stats and "filter" in stats, stats
+    assert "rows in" in stats

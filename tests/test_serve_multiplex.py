@@ -1,0 +1,98 @@
+"""Serve model multiplexing + streaming responses.
+
+Reference: ``@serve.multiplexed`` / ``get_multiplexed_model_id``
+(``python/ray/serve/api.py``, ``serve/_private/multiplex.py``) and handle
+``stream=True`` (``DeploymentResponseGenerator``).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture
+def serve_session():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_multiplexed_lru_and_context(serve_session):
+    @serve.deployment(num_replicas=1)
+    class MuxModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return f"model-{model_id}"
+
+        def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            model = self.get_model(model_id)
+            return f"{model}:{x}", list(self.loads)
+
+    handle = serve.run(MuxModel.bind())
+    h_a = handle.options(multiplexed_model_id="a")
+    out, loads = h_a.remote(1).result()
+    assert out == "model-a:1" and loads == ["a"]
+    # Cache hit: no reload for the same model.
+    out, loads = h_a.remote(2).result()
+    assert out == "model-a:2" and loads == ["a"]
+    # Second model coexists (capacity 2)...
+    out, loads = handle.options(multiplexed_model_id="b").remote(3).result()
+    assert out == "model-b:3" and loads == ["a", "b"]
+    # ...third evicts the LRU ("a"), so "a" reloads afterwards.
+    handle.options(multiplexed_model_id="c").remote(4).result()
+    _, loads = h_a.remote(5).result()
+    assert loads == ["a", "b", "c", "a"]
+
+
+def test_multiplexed_model_affinity_across_replicas(serve_session):
+    @serve.deployment(num_replicas=2)
+    class Who:
+        def __init__(self):
+            import os
+            import uuid
+
+            self.replica_id = uuid.uuid4().hex[:8]
+
+        def __call__(self):
+            return (serve.get_multiplexed_model_id(), self.replica_id)
+
+    handle = serve.run(Who.bind())
+    for model in ("m1", "m2", "m3"):
+        h = handle.options(multiplexed_model_id=model)
+        seen = {h.remote().result()[1] for _ in range(5)}
+        assert len(seen) == 1, \
+            f"model {model} bounced across replicas: {seen}"
+
+
+def test_streaming_handle(serve_session):
+    @serve.deployment(num_replicas=1)
+    class Streamer:
+        def tokens(self, n):
+            for i in range(n):
+                yield f"tok{i}"
+
+    handle = serve.run(Streamer.bind())
+    gen = handle.options("tokens", stream=True).remote(4)
+    assert isinstance(gen, serve.DeploymentResponseGenerator)
+    assert list(gen) == ["tok0", "tok1", "tok2", "tok3"]
+
+
+def test_streaming_non_generator_errors(serve_session):
+    @serve.deployment(num_replicas=1)
+    class NotAGen:
+        def __call__(self):
+            return "plain"
+
+    handle = serve.run(NotAGen.bind())
+    gen = handle.options(stream=True).remote()
+    with pytest.raises(TypeError, match="stream=True requires a generator"):
+        list(gen)

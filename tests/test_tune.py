@@ -93,3 +93,62 @@ def test_tune_run_wrapper(ray8):
     results = tune.run(trainable, config={"a": tune.grid_search([5, 7])},
                        metric="v", mode="max")
     assert results.get_best_result().metrics["v"] == 8
+
+
+# ------------------------------------------------- experiment-state restore
+
+def test_tuner_restore_resumes_errored_trial(ray_start_regular, tmp_path):
+    """The experiment-state snapshot lets Tuner.restore rerun a failed
+    trial from its last checkpoint instead of from scratch (reference:
+    Tuner.restore + experiment checkpointing)."""
+    from ray_tpu import tune
+
+    class RC:
+        storage_path = str(tmp_path)
+        name = "restore_exp"
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        start = (ckpt or {"step": 0})["step"]
+        for step in range(start + 1, 6):
+            tune.report({"score": step}, checkpoint={"step": step})
+            if step == 3 and ckpt is None:
+                raise RuntimeError("boom at step 3")
+
+    grid = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RC(),
+    ).fit()
+    assert grid.errors and "boom" in grid.errors[0].error
+
+    grid2 = tune.Tuner.restore(str(tmp_path / "restore_exp"), trainable,
+                               resume_errored=True).fit()
+    assert not grid2.errors
+    best = grid2.get_best_result()
+    # Resumed from the step-3 checkpoint: reached 5 without re-raising.
+    assert best.metrics["score"] == 5
+
+
+def test_tuner_restore_keeps_completed_results(ray_start_regular, tmp_path):
+    from ray_tpu import tune
+
+    class RC:
+        storage_path = str(tmp_path)
+        name = "restore_done"
+
+    def trainable(config):
+        tune.report({"score": config["x"]})
+
+    grid = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([1, 2, 3])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RC(),
+    ).fit()
+    assert len(grid) == 3 and not grid.errors
+
+    grid2 = tune.Tuner.restore(str(tmp_path / "restore_done"),
+                               trainable).fit()
+    # Nothing to rerun: completed results round-trip through the snapshot.
+    assert len(grid2) == 3 and not grid2.errors
+    assert grid2.get_best_result().metrics["score"] == 3
