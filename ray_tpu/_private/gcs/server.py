@@ -23,7 +23,7 @@ import pickle
 import queue
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -52,6 +52,10 @@ class GcsServer:
         self._last_heartbeat: Dict[str, float] = {}
         # kv
         self._kv: Dict[Tuple[str, str], bytes] = {}
+        # Task-event sink (C32): bounded buffer of task state transitions
+        # pushed by workers over the TASK_EVENT pubsub channel.
+        self._task_events: "deque" = deque(
+            maxlen=int(os.environ.get("RAY_TPU_TASK_EVENTS_MAX", 10000)))
         # actors
         self._actors: Dict[bytes, pb.ActorInfo] = {}
         self._actor_names: Dict[Tuple[str, str], bytes] = {}
@@ -310,6 +314,10 @@ class GcsServer:
 
     # ------------------------------------------------------------- kv
     def KvPut(self, request, context):
+        if request.ns == "__task_events__":
+            # Reserved: reads in this namespace serve the task-event ring
+            # buffer, so stored values would be unreachable.
+            return pb.KvReply(ok=False)
         key = (request.ns, request.key)
         with self._lock:
             if not request.overwrite and key in self._kv:
@@ -319,6 +327,10 @@ class GcsServer:
         return pb.KvReply(ok=True)
 
     def KvGet(self, request, context):
+        if request.ns == "__task_events__":
+            with self._lock:
+                events = list(self._task_events)
+            return pb.KvReply(found=True, value=pickle.dumps(events))
         with self._lock:
             val = self._kv.get((request.ns, request.key))
         if val is None:
@@ -529,6 +541,17 @@ class GcsServer:
 
     # ------------------------------------------------------------- pubsub
     def Publish(self, request, context):
+        if request.channel == "TASK_EVENT":
+            # Cluster task-event sink (reference C32: workers push task
+            # state transitions to the GCS task-event GCS sink,
+            # gcs_task_manager.h). Ring-buffered; served through the KV
+            # read path under the reserved "__task_events__" namespace.
+            try:
+                events = pickle.loads(request.data)
+                with self._lock:
+                    self._task_events.extend(events)
+            except Exception:  # noqa: BLE001
+                pass
         self._publish(request.channel, request.data)
         return pb.Empty()
 

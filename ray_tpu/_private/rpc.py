@@ -73,6 +73,29 @@ def reset_chaos() -> None:
     _chaos = RpcChaos()
 
 
+_latency_hist = None
+_latency_lock = threading.Lock()
+
+
+def _latency_histogram():
+    """One process-wide handler-latency histogram (a per-serve() instance
+    would duplicate the metric in the registry)."""
+    global _latency_hist
+    with _latency_lock:
+        if _latency_hist is None:
+            try:
+                from ray_tpu.util.metrics import Histogram
+
+                _latency_hist = Histogram(
+                    "rpc_handler_seconds",
+                    description="server-side RPC handler latency",
+                    boundaries=[0.001, 0.01, 0.1, 1.0, 10.0],
+                    tag_keys=("service", "method"))
+            except Exception:  # noqa: BLE001
+                return None
+        return _latency_hist
+
+
 def serve(service_name: str, handler_obj: Any, port: int = 0,
           host: str = "127.0.0.1", max_workers: int = 32):
     """Start a gRPC server exposing ``handler_obj``'s methods as ``service_name``.
@@ -82,8 +105,30 @@ def serve(service_name: str, handler_obj: Any, port: int = 0,
     """
     desc = _SERVICES[service_name]
     handlers = {}
+    # Handler-latency instrumentation (reference C6: event-loop lag stats
+    # on the asio loops — the threaded analog is per-RPC service time,
+    # exported through util.metrics for the dashboard /metrics endpoint).
+    latency = _latency_histogram()
+
+    def _timed(fn, method_name):
+        if latency is None:
+            return fn
+
+        def wrapper(request, context):
+            t0 = time.perf_counter()
+            try:
+                return fn(request, context)
+            finally:
+                latency.observe(time.perf_counter() - t0,
+                                tags={"service": service_name,
+                                      "method": method_name})
+
+        return wrapper
+
     for method in desc.methods:
         fn = getattr(handler_obj, method.name)
+        if not method.server_streaming:
+            fn = _timed(fn, method.name)
         in_cls = method.input_type._concrete_class
         out_cls = method.output_type._concrete_class
         if method.server_streaming:

@@ -80,6 +80,21 @@ def init(
 
         if address is None and os.environ.get("RAY_TPU_ADDRESS"):
             address = os.environ["RAY_TPU_ADDRESS"]
+        if address is not None and address.startswith("ray://"):
+            # Remote-driver scheme (reference: Ray Client,
+            # util/client/server). No proxy tier is needed here: the driver
+            # protocol is already plain gRPC against the GCS/node control
+            # plane, so a remote driver connects exactly like a local one.
+            address = address[len("ray://"):]
+        if address == "auto":
+            from ray_tpu.scripts.cli import _auto_address
+
+            try:
+                address = _auto_address()
+            except SystemExit:  # CLI helper; re-raise catchably here
+                raise ConnectionError(
+                    "address='auto' found no running cluster: start a head "
+                    "node or set RAY_TPU_ADDRESS") from None
 
         if address is None:
             if num_cpus is None:

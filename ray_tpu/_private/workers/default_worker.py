@@ -80,6 +80,46 @@ class _LogTee:
         return False
 
 
+class _TaskEventReporter:
+    """Batch task state transitions to the GCS task-event sink
+    (reference C32: ``gcs_task_manager.h`` — workers buffer task events
+    and flush them periodically to the GCS)."""
+
+    FLUSH_PERIOD_S = 0.2
+
+    def __init__(self, gcs, worker_id: str, node_id: str):
+        self._gcs = gcs
+        self._worker_id = worker_id
+        self._node_id = node_id
+        self._buf: List[dict] = []
+        self._lock = threading.Lock()
+        threading.Thread(target=self._flush_loop, daemon=True,
+                         name="task-events").start()
+
+    def report(self, task_id_hex: str, name: str, state: str,
+               **extra) -> None:
+        with self._lock:
+            self._buf.append({
+                "task_id": task_id_hex, "name": name, "state": state,
+                "ts": time.time(), "worker_id": self._worker_id[:12],
+                "node_id": self._node_id[:12], **extra})
+            if len(self._buf) > 2000:
+                del self._buf[:1000]
+
+    def _flush_loop(self):
+        while True:
+            time.sleep(self.FLUSH_PERIOD_S)
+            with self._lock:
+                buf, self._buf = self._buf, []
+            if not buf:
+                continue
+            try:
+                self._gcs.Publish(pb.PublishRequest(
+                    channel="TASK_EVENT", data=pickle.dumps(buf)))
+            except Exception:  # noqa: BLE001
+                pass
+
+
 class _LogPublisher:
     def __init__(self, gcs, worker_id: str, namespace: str = "default"):
         self._gcs = gcs
@@ -184,6 +224,10 @@ class WorkerServer:
                                 namespace=self.runtime.namespace)
             sys.stdout = _LogTee(sys.stdout, "stdout", pub)
             sys.stderr = _LogTee(sys.stderr, "stderr", pub)
+        self.task_events: Optional[_TaskEventReporter] = None
+        if os.environ.get("RAY_TPU_TASK_EVENTS", "1") != "0":
+            self.task_events = _TaskEventReporter(self.runtime.gcs,
+                                                  worker_id, node_id)
         self.node.AnnounceWorker(pb.AnnounceWorkerRequest(
             worker_id=worker_id, address=self.address, pid=os.getpid()))
 
@@ -276,9 +320,15 @@ class WorkerServer:
             return self._push_actor_task(spec)
         return self._push_normal_task(spec)
 
+    def _report_task(self, spec, state: str, **extra) -> None:
+        if self.task_events is not None:
+            self.task_events.report(bytes(spec.task_id).hex()[:16],
+                                    spec.name, state, **extra)
+
     def _push_normal_task(self, spec) -> pb.PushTaskResult:
         with self._task_lock:
             renv_restore = None
+            self._report_task(spec, "RUNNING")
             try:
                 if spec.tpu_chips:
                     os.environ["TPU_VISIBLE_CHIPS"] = ",".join(
@@ -313,8 +363,11 @@ class WorkerServer:
                 elif hasattr(result, "__next__"):  # legacy generator tasks
                     result = tuple(result) if len(spec.return_ids) > 1 \
                         else list(result)
-                return self._package_results(result, spec.return_ids)
+                out = self._package_results(result, spec.return_ids)
+                self._report_task(spec, "FINISHED")
+                return out
             except BaseException as e:  # noqa: BLE001
+                self._report_task(spec, "FAILED", error=repr(e)[:200])
                 return self._error_result(e, spec.name)
             finally:
                 if renv_restore is not None:
@@ -342,6 +395,8 @@ class WorkerServer:
                     ActorID(bytes(spec.actor_id)), "actor died")
                 return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
         try:
+            self._report_task(spec, "RUNNING",
+                              actor_id=bytes(spec.actor_id).hex()[:12])
             (_, args, kwargs), n_borrows = \
                 loads_payload(self._payload_bytes(spec))
             if n_borrows:
@@ -365,11 +420,15 @@ class WorkerServer:
                     pg_context.clear()
             if spec.returns_stream:
                 result = self._stream_generator(result, spec)
-            return self._package_results(result, spec.return_ids)
+            out = self._package_results(result, spec.return_ids)
+            self._report_task(spec, "FINISHED")
+            return out
         except exceptions.AsyncioActorExit:
             self._terminate_actor(spec.actor_id, "exit_actor() called")
+            self._report_task(spec, "FINISHED")
             return self._package_results(None, spec.return_ids)
         except BaseException as e:  # noqa: BLE001
+            self._report_task(spec, "FAILED", error=repr(e)[:200])
             return self._error_result(e, f"{spec.method_name}")
         finally:
             if ordered:

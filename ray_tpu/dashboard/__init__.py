@@ -9,7 +9,10 @@ here: ``/api/cluster_status``, ``/api/nodes``, ``/api/actors``,
 from __future__ import annotations
 
 import json
+import pickle
 import threading
+import time
+from collections import deque
 from typing import Optional
 
 
@@ -47,6 +50,43 @@ class Dashboard:
                     out.append(json.loads(r.value))
             return out
 
+        log_buffer: deque = deque(maxlen=2000)
+
+        def _log_subscriber():
+            # The dashboard tails worker logs off the LOG pubsub channel
+            # into a ring buffer for /api/logs (reference: dashboard log
+            # viewing over the log agents).
+            while True:
+                try:
+                    stream = gcs.Subscribe(pb.SubscribeRequest(
+                        channels=["LOG"], subscriber_id="dashboard"))
+                    for msg in stream:
+                        try:
+                            rec = pickle.loads(msg.data)
+                            for line in rec.get("lines", ()):
+                                log_buffer.append({
+                                    "worker": rec.get("name", "?"),
+                                    "pid": rec.get("pid"),
+                                    "stream": rec.get("stream"),
+                                    "line": line})
+                        except Exception:  # noqa: BLE001
+                            pass
+                except Exception:  # noqa: BLE001
+                    pass
+                # Streams can also end CLEANLY (GCS stopping/restarting);
+                # always back off before re-subscribing.
+                time.sleep(1.0)
+
+        threading.Thread(target=_log_subscriber, daemon=True).start()
+
+        def logs():
+            return list(log_buffer)
+
+        def tasks():
+            reply = gcs.KvGet(pb.KvRequest(ns="__task_events__",
+                                           key="recent"))
+            return pickle.loads(reply.value) if reply.found else []
+
         def cluster_status():
             ns = nodes()
             total, avail = {}, {}
@@ -75,6 +115,8 @@ class Dashboard:
                             "/api/nodes": nodes,
                             "/api/actors": actors,
                             "/api/jobs": jobs,
+                            "/api/logs": logs,
+                            "/api/tasks": tasks,
                         }.get(self.path)
                         if route is None:
                             self.send_response(404)

@@ -78,6 +78,50 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
     return out
 
 
+def list_tasks(limit: int = 1000,
+               filters: Optional[Dict[str, Any]] = None
+               ) -> List[Dict[str, Any]]:
+    """Recent task state transitions from the GCS task-event sink
+    (reference C32: ``ray.util.state.list_tasks`` over the GCS task
+    manager). Cluster mode only; local mode returns []."""
+    core = _core()
+    gcs = getattr(core, "gcs", None)
+    if gcs is None:
+        return []
+    import pickle
+
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    reply = gcs.KvGet(pb.KvRequest(ns="__task_events__", key="recent"))
+    events = pickle.loads(reply.value) if reply.found else []
+    if filters:
+        events = [e for e in events
+                  if all(e.get(k) == v for k, v in filters.items())]
+    return events[-limit:]
+
+
+def task_timeline() -> List[Dict[str, Any]]:
+    """Chrome-trace events built from the cluster task-event sink
+    (reference: ``ray timeline`` merging task events)."""
+    spans: Dict[str, Dict[str, Any]] = {}
+    out: List[Dict[str, Any]] = []
+    for e in list_tasks(limit=100000):
+        tid = e["task_id"]
+        if e["state"] == "RUNNING":
+            spans[tid] = e
+        elif e["state"] in ("FINISHED", "FAILED") and tid in spans:
+            start = spans.pop(tid)
+            out.append({
+                "name": e["name"], "cat": "task",
+                "ph": "X", "ts": start["ts"] * 1e6,
+                "dur": max(e["ts"] - start["ts"], 0) * 1e6,
+                "pid": e.get("node_id", ""), "tid": e.get("worker_id", ""),
+                "args": {"state": e["state"], "task_id": tid,
+                         **({"error": e["error"]} if "error" in e else {})},
+            })
+    return out
+
+
 def summarize_cluster() -> Dict[str, Any]:
     return {
         "nodes": len([n for n in ray_tpu.nodes() if n.get("Alive", n.get("alive"))]),

@@ -205,3 +205,90 @@ def test_usage_stats_records_and_respects_optout(monkeypatch):
     usage.record_library_usage("hidden")
     assert "hidden" not in usage.usage_summary()["libraries"]
     assert not usage.usage_stats_enabled()
+
+
+# ------------------------------------------- task events + ray:// + /logs
+
+def test_task_events_state_api_and_timeline(cluster):
+    """Workers push task transitions to the GCS task-event sink; the state
+    API and timeline read them back (reference C32)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.util import state
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote
+    def traced(x):
+        return x * 2
+
+    assert ray_tpu.get(traced.remote(21), timeout=60) == 42
+
+    deadline = time.monotonic() + 15
+    events = []
+    while time.monotonic() < deadline:
+        events = [e for e in state.list_tasks()
+                  if e["name"].endswith("traced")]
+        if any(e["state"] == "FINISHED" for e in events):
+            break
+        time.sleep(0.2)
+    states = {e["state"] for e in events}
+    assert {"RUNNING", "FINISHED"} <= states, events
+
+    spans = [s for s in state.task_timeline()
+             if s["name"].endswith("traced")]
+    assert spans and all(s["ph"] == "X" and s["dur"] >= 0 for s in spans)
+    ray_tpu.shutdown()
+
+
+def test_init_ray_scheme(cluster):
+    import ray_tpu
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(address=f"ray://{cluster.address}")
+
+    @ray_tpu.remote
+    def f():
+        return "via-ray-scheme"
+
+    assert ray_tpu.get(f.remote(), timeout=60) == "via-ray-scheme"
+    ray_tpu.shutdown()
+
+
+def test_dashboard_logs_and_tasks_endpoints(cluster):
+    import time
+
+    import ray_tpu
+    from ray_tpu.dashboard import Dashboard
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(address=cluster.address)
+    dash = Dashboard(cluster.address, port=0)
+    try:
+        @ray_tpu.remote
+        def shout():
+            print("dashboard-log-marker")
+            return 1
+
+        assert ray_tpu.get(shout.remote(), timeout=60) == 1
+        deadline = time.monotonic() + 15
+        lines = []
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{dash.port}/api/logs",
+                    timeout=10) as r:
+                lines = json.loads(r.read())
+            if any("dashboard-log-marker" in l["line"] for l in lines):
+                break
+            time.sleep(0.2)
+        assert any("dashboard-log-marker" in l["line"] for l in lines), lines
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/api/tasks", timeout=10) as r:
+            tasks = json.loads(r.read())
+        assert any(t["name"].endswith("shout") for t in tasks), tasks[:5]
+    finally:
+        dash.stop()
+        ray_tpu.shutdown()
