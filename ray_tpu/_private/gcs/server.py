@@ -757,13 +757,29 @@ class GcsServer:
 
     # ------------------------------------------------------ object directory
     def UpdateObjectLocation(self, request, context):
+        sweep_addr = None
         with self._lock:
             if request.added:
-                self._locations[request.object_id].add(request.node_id)
-                if request.size:
-                    self._object_sizes[request.object_id] = request.size
+                if request.object_id in self._freed:
+                    # A late registration (e.g. an async put flush) for an
+                    # already-freed object must not resurrect it — and its
+                    # just-stored copy needs sweeping, since the free
+                    # broadcast preceded it.
+                    node = self._nodes.get(request.node_id)
+                    sweep_addr = getattr(node, "address", None) if node \
+                        else None
+                else:
+                    self._locations[request.object_id].add(request.node_id)
+                    if request.size:
+                        self._object_sizes[request.object_id] = request.size
             else:
                 self._locations[request.object_id].discard(request.node_id)
+        if sweep_addr:
+            oid = request.object_id
+            self._work_pool.submit(
+                lambda: rpc.get_stub("NodeService", sweep_addr).FreeObjects(
+                    pb.FreeObjectsRequest(object_ids=[oid])))
+            return pb.Empty()
         self._mark_dirty()
         if request.added:
             # Wake blocked get()/wait() callers (object-location pubsub,
@@ -778,6 +794,15 @@ class GcsServer:
             freed = request.object_id in self._freed
         return pb.GetObjectLocationsReply(node_ids=locs, size=size,
                                           freed=freed)
+
+    def GetObjectsLocations(self, request, context):
+        """Batched has-any-location probe for wait() fan-in (one RPC for
+        all pending refs instead of one per ref)."""
+        with self._lock:
+            found = [bool(self._locations.get(oid)) and
+                     oid not in self._freed
+                     for oid in request.object_ids]
+        return pb.GetObjectsMetaReply(found=found)
 
     def UpdateRefCounts(self, request, context):
         to_free: List[bytes] = []

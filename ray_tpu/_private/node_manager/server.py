@@ -61,6 +61,10 @@ class NodeManager:
         self.total = resources
         self.available = dict(resources)
         self._res_lock = threading.RLock()
+        # Shares the resource lock so queued lease RPCs wake on release.
+        self._res_cv = threading.Condition(self._res_lock)
+        self._lease_queue_slots = threading.Semaphore(
+            self.LEASE_QUEUE_SLOTS)
         # Instance-level TPU slot accounting (reference: per-GPU-slot
         # resource instances, common/scheduling/resource_instance_set.h):
         # whole-chip asks get concrete chip indices for TPU_VISIBLE_CHIPS.
@@ -117,7 +121,10 @@ class NodeManager:
         self._view_ts = 0.0
 
         self._stop = threading.Event()
-        self._server, self.port = rpc.serve("NodeService", self, port=port)
+        # Pool sized above any single driver's submit concurrency: queued
+        # lease RPCs briefly hold server threads (see _queue_for_resources).
+        self._server, self.port = rpc.serve("NodeService", self, port=port,
+                                            max_workers=128)
         self.address = f"127.0.0.1:{self.port}"
 
         info = pb.NodeInfo(node_id=self.node_id, address=self.address,
@@ -185,12 +192,13 @@ class NodeManager:
 
     def _release(self, demand: Dict[str, float],
                  holder: Optional[bytes] = None):
-        with self._res_lock:
+        with self._res_cv:
             for k, v in demand.items():
                 self.available[k] = min(
                     self.available.get(k, 0.0) + v, self.total.get(k, 0.0))
             if holder is not None:
                 self._tpu_free.extend(self._tpu_held.pop(holder, []))
+            self._res_cv.notify_all()  # wake queued lease requests
 
     def _acquire_from_bundle(self, group_id: bytes, bundle_index: int,
                              demand: Dict[str, float],
@@ -505,22 +513,7 @@ class NodeManager:
                                          spillback_node_id=best,
                                          spillback_address=best_node.address)
         if self._try_acquire(demand, holder=lease_id):
-            worker = self._pop_worker()
-            if worker is None:
-                self._release(demand, holder=lease_id)
-                return pb.LeaseReply(granted=False,
-                                     error="worker start timeout")
-            worker.leased_for = lease_id
-            worker.busy_since = time.monotonic()
-            with self._pool_lock:
-                if worker.worker_id in self._idle:
-                    self._idle.remove(worker.worker_id)
-            # Stash demand so ReturnWorker releases it.
-            self._leases[lease_id] = (worker.worker_id, demand)
-            return pb.LeaseReply(granted=True,
-                                 worker_address=worker.address,
-                                 worker_id=worker.worker_id,
-                                 tpu_chips=self._chips_for(lease_id))
+            return self._grant_lease(lease_id, demand)
         if spec.affinity_node_id and not spec.affinity_soft:
             # Hard node affinity (NodeAffinitySchedulingStrategy): never
             # spill; the task waits for local resources, or fails if this
@@ -528,7 +521,7 @@ class NodeManager:
             if not all(self.total.get(k, 0.0) + 1e-9 >= v
                        for k, v in demand.items()):
                 return pb.LeaseReply(granted=False, error="infeasible")
-            return pb.LeaseReply(granted=False)
+            return self._queue_for_resources(lease_id, demand)
         # Spillback: pick another node from the cluster view.
         nodes = [n for n in self._cluster_view() if n.node_id != self.node_id]
         picker = (policies.pick_node_spread if spec.strategy == "SPREAD"
@@ -537,10 +530,59 @@ class NodeManager:
         if target is None:
             if not policies.feasible_anywhere(self._cluster_view(), demand):
                 return pb.LeaseReply(granted=False, error="infeasible")
-            return pb.LeaseReply(granted=False)  # retry locally later
+            # Nowhere else to go right now: queue locally instead of making
+            # the client poll-with-backoff (the idle gaps between client
+            # retries were the dominant cost of task fan-out).
+            return self._queue_for_resources(lease_id, demand)
         addr = next(n.address for n in nodes if n.node_id == target)
         return pb.LeaseReply(granted=False, spillback_node_id=target,
                              spillback_address=addr)
+
+    def _grant_lease(self, lease_id: bytes, demand: Dict[str, float]):
+        worker = self._pop_worker()
+        if worker is None:
+            self._release(demand, holder=lease_id)
+            return pb.LeaseReply(granted=False,
+                                 error="worker start timeout")
+        worker.leased_for = lease_id
+        worker.busy_since = time.monotonic()
+        with self._pool_lock:
+            if worker.worker_id in self._idle:
+                self._idle.remove(worker.worker_id)
+        # Stash demand so ReturnWorker releases it.
+        self._leases[lease_id] = (worker.worker_id, demand)
+        return pb.LeaseReply(granted=True,
+                             worker_address=worker.address,
+                             worker_id=worker.worker_id,
+                             tpu_chips=self._chips_for(lease_id))
+
+    LEASE_QUEUE_WAIT_S = 2.0
+    # Cap on concurrently-queued lease RPCs: each holds a server thread,
+    # and filling the whole pool with them would starve ReturnWorker — the
+    # very RPC that frees the resources they wait for.
+    LEASE_QUEUE_SLOTS = 32
+
+    def _queue_for_resources(self, lease_id: bytes,
+                             demand: Dict[str, float]):
+        """Hold the lease RPC briefly until resources free up (reference:
+        the raylet queues lease requests; clients never poll). Bounded in
+        duration AND in concurrency — on either limit the client's retry
+        loop takes over."""
+        if not self._lease_queue_slots.acquire(blocking=False):
+            return pb.LeaseReply(granted=False)
+        try:
+            deadline = time.monotonic() + self.LEASE_QUEUE_WAIT_S
+            with self._res_cv:
+                while not self._stop.is_set() and \
+                        time.monotonic() < deadline:
+                    if self._try_acquire(demand, holder=lease_id):
+                        break
+                    self._res_cv.wait(0.05)
+                else:
+                    return pb.LeaseReply(granted=False)
+            return self._grant_lease(lease_id, demand)
+        finally:
+            self._lease_queue_slots.release()
 
     def ReturnWorker(self, request, context):
         lease_id = request.lease_id
@@ -854,6 +896,21 @@ class NodeManager:
         if request.metadata_only:
             return pb.GetObjectReply(found=True, size=len(data))
         return pb.GetObjectReply(found=True, data=data)
+
+    def GetObjectsMeta(self, request, context):
+        """Batched local readiness (reference: plasma Contains). One RPC
+        answers every object a wait() is watching on this node."""
+        found = []
+        for oid in request.object_ids:
+            hexid = oid.hex()
+            ok = False
+            if self._shm is not None:
+                ok = self._shm.contains(hexid) or hexid in self._spilled
+            if not ok:
+                with self._obj_lock:
+                    ok = oid in self._objects
+            found.append(ok)
+        return pb.GetObjectsMetaReply(found=found)
 
     def _read_object_bytes(self, object_id: bytes) -> Optional[bytes]:
         if self._shm is not None:

@@ -343,14 +343,42 @@ class ClusterRuntime(CoreRuntime):
             self._put_task_id = TaskID.for_normal_task(self.job_id)
         oid = ObjectID.from_task(self._put_task_id, self._next_put_index())
         data = dumps(value)
-        try:
-            put_bytes_to_node(self.node, oid.binary(), data, self.worker_id)
-        except Exception:  # noqa: BLE001
-            if not self._refresh_local_node():
-                raise
-            put_bytes_to_node(self.node, oid.binary(), data, self.worker_id)
+        # Owner semantics (reference: small objects live in the owner's
+        # in-process store): the value is immediately visible to this
+        # process; the node-store copy + directory registration that remote
+        # readers need flush asynchronously. Remote fetches racing the
+        # flush retry through the directory until it lands. Flushes get
+        # their own small pool — the shared submit pool blocks for whole
+        # task lifetimes, which could starve the flush behind the very
+        # tasks consuming the object.
         self.memory.put(oid, value)
+        if not hasattr(self, "_put_pool"):
+            self._put_pool = ThreadPoolExecutor(max_workers=4,
+                                                thread_name_prefix="put-flush")
+        self._put_pool.submit(self._flush_put, oid, data)
         return ObjectRef(oid, owner_address=self.node_address)
+
+    def _flush_put(self, oid: ObjectID, data: bytes) -> None:
+        deadline = time.monotonic() + 60.0
+        while not self._shutdown:
+            # Freed before the flush landed (local zero deletes the memory
+            # copy): registering a location now would resurrect a freed
+            # object and leak its store copy.
+            if not self.memory.contains(oid):
+                return
+            try:
+                put_bytes_to_node(self.node, oid.binary(), data,
+                                  self.worker_id)
+                return
+            except Exception:  # noqa: BLE001
+                self._refresh_local_node()
+            if time.monotonic() > deadline:
+                logger.error(
+                    "put flush for %s failed for 60s; the object exists "
+                    "only in this process and remote readers cannot fetch "
+                    "it", oid.hex()[:12])
+                return
+            time.sleep(0.2)
 
     def _next_put_index(self) -> int:
         with self._put_lock:
@@ -561,22 +589,54 @@ class ClusterRuntime(CoreRuntime):
         except Exception:  # noqa: BLE001
             return False
 
+    def _batch_ready(self, refs: List[ObjectRef]) -> List[ObjectRef]:
+        """Readiness for many refs in O(1) RPCs: in-process store first,
+        then one batched probe against the local node, then one batched
+        directory probe at the GCS (weak #6 r2: the per-ref probe loop was
+        O(refs) RPCs per wait tick, which cannot survive 10k-ref waits)."""
+        ready: List[ObjectRef] = []
+        rest: List[ObjectRef] = []
+        for r in refs:
+            (ready if self.memory.contains(r.id()) else rest).append(r)
+        if not rest:
+            return ready
+        node_found = None
+        try:
+            reply = self.node.GetObjectsMeta(pb.GetObjectsMetaRequest(
+                object_ids=[r.id().binary() for r in rest]))
+            node_found = list(reply.found)
+        except Exception:  # noqa: BLE001
+            self._refresh_local_node()
+        still: List[ObjectRef] = []
+        if node_found is not None and len(node_found) == len(rest):
+            for r, f in zip(rest, node_found):
+                (ready if f else still).append(r)
+        else:
+            still = rest
+        if still:
+            try:
+                reply = self.gcs.GetObjectsLocations(
+                    pb.GetObjectsMetaRequest(
+                        object_ids=[r.id().binary() for r in still]))
+                ready.extend(r for r, f in zip(still, reply.found) if f)
+            except Exception:  # noqa: BLE001
+                ready.extend(r for r in still if self._is_ready(r))
+        return ready
+
     def wait(self, refs, num_returns, timeout, fetch_local):
         deadline = None if timeout is None else time.monotonic() + timeout
         ready_ids = set()
         fetching = set()
         while True:
-            for ref in refs:
-                if ref.id() in ready_ids:
-                    continue
-                if self._is_ready(ref):
-                    ready_ids.add(ref.id())
-                    if fetch_local and not self.memory.contains(ref.id()) \
-                            and ref.id() not in fetching:
-                        fetching.add(ref.id())
-                        self._pool.submit(self._fetch_object, ref)
+            pending = [r for r in refs if r.id() not in ready_ids]
+            for ref in self._batch_ready(pending):
                 if len(ready_ids) >= num_returns:
-                    break
+                    break  # caller asked for N: don't fetch the surplus
+                ready_ids.add(ref.id())
+                if fetch_local and not self.memory.contains(ref.id()) \
+                        and ref.id() not in fetching:
+                    fetching.add(ref.id())
+                    self._pool.submit(self._fetch_object, ref)
             if len(ready_ids) >= num_returns or (
                     deadline is not None and time.monotonic() >= deadline):
                 ready = [r for r in refs if r.id() in ready_ids]
@@ -1036,7 +1096,10 @@ class ClusterRuntime(CoreRuntime):
                 raise exceptions.RayTpuError(
                     f"Timed out leasing a worker for {spec.name}")
             time.sleep(backoff)
-            backoff = min(backoff * 1.5, 0.5)
+            # The node queues lease requests server-side for up to 2s, so
+            # client retries are rare; a long backoff here would just leave
+            # freed workers idle between retries.
+            backoff = min(backoff * 1.5, 0.1)
         worker_stub = rpc.get_stub("WorkerService", reply.worker_address)
         if reply.tpu_chips:
             del spec.tpu_chips[:]
