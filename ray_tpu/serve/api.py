@@ -26,6 +26,7 @@ Condensed re-design of SURVEY.md §3.5's architecture:
 from __future__ import annotations
 
 import json
+import logging
 import math
 import random
 import threading
@@ -34,6 +35,8 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+
+logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "__serve_controller__"
 ROUTES_CHANNEL = "SERVE_ROUTES"
@@ -344,16 +347,21 @@ class DeploymentResponse:
 class DeploymentResponseGenerator:
     """Iterates the values of a streaming deployment call as the replica
     yields them (reference: ``DeploymentResponseGenerator`` — handle
-    ``stream=True``). Wraps the core ObjectRefGenerator."""
+    ``stream=True``). Wraps the core ObjectRefGenerator.
+    ``per_item_timeout_s`` bounds each item (None = wait indefinitely;
+    task failure still surfaces through the stream's stored error)."""
 
-    def __init__(self, obj_ref_gen):
+    def __init__(self, obj_ref_gen, per_item_timeout_s=None):
         self._gen = obj_ref_gen
+        self._timeout = per_item_timeout_s
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        return ray_tpu.get(next(self._gen))
+        ref = (next(self._gen) if self._timeout is None
+               else self._gen._next_internal(self._timeout))
+        return ray_tpu.get(ref, timeout=self._timeout)
 
 
 class _RouterState:
@@ -635,8 +643,29 @@ class _HttpProxy:
         handles: Dict[str, DeploymentHandle] = {}
 
         class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
             def do_POST(self):  # noqa: N802
-                name = self.path.strip("/").split("/")[0]
+                parts = self.path.strip("/").split("/")
+                name = parts[0]
+                # /<deployment>/<method> targets a specific method;
+                # /<deployment>/stream/<method> streams its yields as
+                # chunked NDJSON (reference: Serve StreamingResponse).
+                stream = len(parts) >= 2 and parts[1] == "stream"
+                method = (parts[2] if stream and len(parts) > 2 else
+                          parts[1] if len(parts) > 1 else None)
+                if method and method.startswith("_"):
+                    # Only public methods are network-routable.
+                    data = json.dumps({"error": "method not found"}).encode()
+                    self.send_response(404)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                # Model multiplexing rides the reference's request header.
+                model_id = self.headers.get(
+                    "serve_multiplexed_model_id", "")
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b"{}"
                 try:
@@ -645,7 +674,44 @@ class _HttpProxy:
                     if handle is None:
                         handle = DeploymentHandle(name)
                         handles[name] = handle
-                    result = handle.remote(payload).result(timeout_s=60)
+                    h = handle.options(method, stream=stream,
+                                       multiplexed_model_id=model_id)
+                    if stream:
+                        gen = h.remote(payload)
+                        gen._timeout = 60.0  # per-item bound, like result()
+                        # Pull the first item BEFORE committing to 200 so
+                        # pre-stream failures (bad method, non-generator)
+                        # surface as errors, not empty successful streams.
+                        items = iter(gen)
+                        try:
+                            first = next(items)
+                            pending = [first]
+                        except StopIteration:
+                            pending = []
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/x-ndjson")
+                        self.send_header("Transfer-Encoding", "chunked")
+                        self.end_headers()
+                        try:
+                            import itertools as _it
+
+                            for item in _it.chain(pending, items):
+                                chunk = json.dumps(item).encode() + b"\n"
+                                self.wfile.write(
+                                    f"{len(chunk):x}\r\n".encode()
+                                    + chunk + b"\r\n")
+                                self.wfile.flush()
+                            self.wfile.write(b"0\r\n\r\n")
+                        except Exception:  # noqa: BLE001
+                            # Mid-stream failure: abort the connection so
+                            # the client sees truncation, not completion.
+                            logger.exception(
+                                "streaming response for %s failed "
+                                "mid-stream", name)
+                            self.close_connection = True
+                        return
+                    result = h.remote(payload).result(timeout_s=60)
                     data = json.dumps(result).encode()
                     self.send_response(200)
                 except Exception as e:  # noqa: BLE001

@@ -25,11 +25,11 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.session import get_checkpoint, get_context, report
-from ray_tpu.train.trainer import JaxTrainer
+from ray_tpu.train.trainer import ControllerState, JaxTrainer
 
 __all__ = [
     "BackendExecutor", "Checkpoint", "CheckpointConfig", "CheckpointManager",
-    "FailureConfig", "JaxBackend", "JaxTrainer", "Result", "RunConfig",
+    "ControllerState", "FailureConfig", "JaxBackend", "JaxTrainer", "Result", "RunConfig",
     "ScalingConfig", "TrainWorker", "WorkerGroup", "get_checkpoint",
     "get_context", "load_pytree", "report", "save_pytree",
 ]

@@ -25,6 +25,10 @@ class ScalingConfig:
     """
 
     num_workers: int = 1
+    # Elastic lower bound (reference: Train v2 elastic training): after a
+    # failure the controller restarts with as many workers as the cluster
+    # can currently supply, as long as it's at least this. None = rigid.
+    min_workers: Optional[int] = None
     use_tpu: bool = False
     tpus_per_worker: Optional[float] = None
     cpus_per_worker: float = 1.0

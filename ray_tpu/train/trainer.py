@@ -32,6 +32,18 @@ from ray_tpu.train.config import (
 logger = logging.getLogger(__name__)
 
 
+class ControllerState:
+    """Controller lifecycle states (reference: Train v2 controller state
+    machine, ``train/v2/_internal/execution/controller/controller.py:85``)."""
+
+    INITIALIZING = "INITIALIZING"
+    SCHEDULING = "SCHEDULING"
+    RUNNING = "RUNNING"
+    RESTARTING = "RESTARTING"
+    FINISHED = "FINISHED"
+    ERRORED = "ERRORED"
+
+
 class JaxTrainer:
     def __init__(
         self,
@@ -49,6 +61,37 @@ class JaxTrainer:
         self.run_config = run_config or RunConfig()
         self.backend = backend
         self.resume_from_checkpoint = resume_from_checkpoint
+        self.controller_state = ControllerState.INITIALIZING
+        self.state_history: List[str] = [ControllerState.INITIALIZING]
+
+    def _set_state(self, state: str) -> None:
+        if state != self.controller_state:
+            logger.info("train controller: %s -> %s",
+                        self.controller_state, state)
+            self.controller_state = state
+            self.state_history.append(state)
+
+    def _elastic_worker_target(self) -> int:
+        """How many workers to (re)start with: the full ask when rigid, or
+        whatever the cluster can currently supply down to ``min_workers``
+        when elastic (reference: Train v2 elastic resizing on recovery)."""
+        sc = self.scaling_config
+        want = sc.num_workers
+        floor = sc.min_workers if sc.min_workers is not None else want
+        if floor >= want:
+            return want
+        try:
+            avail = ray_tpu.available_resources()
+        except Exception:  # noqa: BLE001
+            return want
+        # Feasibility is the min over EVERY resource the worker asks for
+        # (a CPU-only estimate would still deadlock TPU-constrained jobs).
+        feasible = want
+        for key, per in sc.worker_resources().items():
+            if per > 0:
+                feasible = min(feasible,
+                               int(avail.get(key, 0.0) // per))
+        return max(min(want, feasible), floor)
 
     def fit(self) -> Result:
         if not ray_tpu.is_initialized():
@@ -76,16 +119,29 @@ class JaxTrainer:
         error: Optional[BaseException] = None
 
         while True:
-            executor = BackendExecutor(self.scaling_config, self.backend)
+            self._set_state(ControllerState.SCHEDULING)
+            target = self._elastic_worker_target()
+            scaling = self.scaling_config
+            if target != scaling.num_workers:
+                import dataclasses as _dc
+
+                logger.warning(
+                    "elastic training: starting with %d/%d workers "
+                    "(min_workers=%s)", target, scaling.num_workers,
+                    scaling.min_workers)
+                scaling = _dc.replace(scaling, num_workers=target)
+            executor = BackendExecutor(scaling, self.backend)
             executor.start()
             run_refs = executor.start_training(
                 self.train_loop, self.train_loop_config,
                 restore.path if restore else None)
+            self._set_state(ControllerState.RUNNING)
             try:
                 self._drive(executor, run_refs, manager, history)
                 latest_metrics = history[-1]["metrics"] if history else None
                 error = None
                 executor.shutdown()
+                self._set_state(ControllerState.FINISHED)
                 break
             except (exceptions.RayTaskError, exceptions.ActorDiedError,
                     exceptions.WorkerCrashedError) as e:
@@ -96,7 +152,9 @@ class JaxTrainer:
                 if not recoverable:
                     error = e
                     latest_metrics = history[-1]["metrics"] if history else None
+                    self._set_state(ControllerState.ERRORED)
                     break
+                self._set_state(ControllerState.RESTARTING)
                 restore = manager.latest or restore
                 logger.warning(
                     "Training attempt %d failed (%s); restarting from %s",

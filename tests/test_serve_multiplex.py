@@ -96,3 +96,41 @@ def test_streaming_non_generator_errors(serve_session):
     gen = handle.options(stream=True).remote()
     with pytest.raises(TypeError, match="stream=True requires a generator"):
         list(gen)
+
+
+def test_http_streaming_and_multiplex_header(serve_session):
+    """HTTP ingress: /<dep>/stream/<method> chunk-streams generator yields
+    as NDJSON; the serve_multiplexed_model_id header routes models
+    (reference: Serve StreamingResponse + multiplexed header)."""
+    import json
+    import urllib.request
+
+    @serve.deployment(num_replicas=1)
+    class S:
+        def gen(self, payload):
+            for i in range(int(payload["n"])):
+                yield {"i": i}
+
+        def __call__(self, payload):
+            return {"model": serve.get_multiplexed_model_id()}
+
+    serve.run(S.bind())
+    port = serve.start_http(port=0)
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/S/stream/gen",
+            data=json.dumps({"n": 3}).encode(), method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.headers.get("Content-Type") == "application/x-ndjson"
+            lines = [json.loads(l) for l in r.read().splitlines()
+                     if l.strip()]
+        assert lines == [{"i": 0}, {"i": 1}, {"i": 2}]
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/S", data=b"{}", method="POST",
+            headers={"serve_multiplexed_model_id": "model-x"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        assert out == {"model": "model-x"}
+    finally:
+        serve.stop_http()

@@ -156,3 +156,43 @@ def test_training_integration_with_sharded_trainer(ray8, tmp_path):
     assert result.error is None
     losses = [h["metrics"]["loss"] for h in result.metrics_history]
     assert losses[-1] < losses[0]
+
+
+# -------------------------------------- controller state machine + elastic
+
+def test_controller_state_machine(ray_start_regular):
+    from ray_tpu.train import JaxTrainer, ScalingConfig, session
+    from ray_tpu.train.trainer import ControllerState
+
+    def loop():
+        session.report({"x": 1})
+        return 1
+
+    t = JaxTrainer(loop, scaling_config=ScalingConfig(num_workers=2))
+    assert t.controller_state == ControllerState.INITIALIZING
+    result = t.fit()
+    assert result.error is None
+    assert t.controller_state == ControllerState.FINISHED
+    assert ControllerState.RUNNING in t.state_history
+    assert t.state_history[0] == ControllerState.INITIALIZING
+
+
+def test_elastic_downscale_to_available(shutdown_only):
+    """num_workers beyond the cluster's CPUs starts elastically with what
+    fits (>= min_workers) instead of deadlocking on placement."""
+    import ray_tpu
+    from ray_tpu.train import JaxTrainer, ScalingConfig, session
+
+    ray_tpu.init(num_cpus=3, num_tpus=0)
+
+    def loop():
+        ctx = session.get_context()
+        session.report({"world": ctx.get_world_size()})
+        return ctx.get_world_size()
+
+    t = JaxTrainer(loop, scaling_config=ScalingConfig(
+        num_workers=8, min_workers=1, cpus_per_worker=1))
+    result = t.fit()
+    assert result.error is None
+    world = result.metrics["world"]
+    assert 1 <= world <= 3, world  # downscaled to the 3 available CPUs
