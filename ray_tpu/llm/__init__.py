@@ -59,3 +59,109 @@ def build_llama_app(config: Optional[llama.LlamaConfig] = None,
 
 
 __all__ = ["LlamaDeployment", "build_llama_app"]
+
+
+@serve.deployment
+class ContinuousLlamaDeployment:
+    """Continuous-batching completion replica (reference: the vLLM engine
+    behind ``ray.serve.llm``): one shared slot pool per replica; requests
+    join mid-flight and stream tokens as decode ticks produce them. Use
+    with handle ``stream=True`` (or plain calls for full completions)."""
+
+    def __init__(self, config: Optional[llama.LlamaConfig] = None,
+                 params=None, num_slots: int = 8, max_len: int = 512,
+                 eos_token: Optional[int] = None):
+        import queue
+        import threading
+
+        from ray_tpu.models.continuous_batching import ContinuousBatcher
+
+        self.config = config or llama.LlamaConfig.tiny()
+        self._queues: Dict[int, "queue.Queue"] = {}
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._queue_mod = queue
+        self.batcher = ContinuousBatcher(
+            self.config, params=params, num_slots=num_slots,
+            max_len=max_len, eos_token=eos_token,
+            token_callback=self._on_token)
+        threading.Thread(target=self._tick_loop, daemon=True,
+                         name="llm-ticks").start()
+
+    def _on_token(self, rid: int, token: int) -> None:
+        q = self._queues.get(rid)
+        if q is not None:
+            q.put(token)
+
+    def _tick_loop(self) -> None:
+        import logging
+
+        log = logging.getLogger(__name__)
+        while True:
+            self._work.wait()
+            try:
+                with self._lock:
+                    if not self.batcher.has_work():
+                        self._work.clear()
+                        continue
+                    finished = self.batcher.step()
+                for rid in finished:
+                    q = self._queues.get(rid)
+                    if q is not None:
+                        q.put(None)  # end-of-stream
+            except Exception as e:  # noqa: BLE001
+                # Engine error (OOM, bad request reaching the kernel):
+                # fail every in-flight stream explicitly and reset the
+                # slot pool, instead of dying silently and leaving
+                # clients blocked on their queues.
+                log.exception("continuous-batching tick failed; "
+                              "aborting in-flight requests")
+                with self._lock:
+                    self.batcher.reset()
+                    queues = dict(self._queues)
+                for q in queues.values():
+                    q.put(e)
+
+    def generate(self, prompt_token_ids: List[int],
+                 max_tokens: int = 16):
+        """Streaming generator of token ids (serve stream=True surface)."""
+        q = self._queue_mod.Queue()
+        with self._lock:
+            rid = self.batcher.submit(list(prompt_token_ids),
+                                      max_new_tokens=int(max_tokens))
+            self._queues[rid] = q
+        self._work.set()
+        done = False
+        try:
+            while True:
+                token = q.get(timeout=300)
+                if token is None:
+                    done = True
+                    return
+                if isinstance(token, Exception):
+                    done = True
+                    raise token
+                yield token
+        finally:
+            self._queues.pop(rid, None)
+            if not done:
+                # Abandoned stream (client disconnect): free the slot so
+                # the ghost request stops burning decode ticks.
+                with self._lock:
+                    self.batcher.cancel(rid)
+
+    def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        """Non-streaming completion."""
+        tokens = list(self.generate(request["prompt_token_ids"],
+                                    request.get("max_tokens", 16)))
+        return {"token_ids": tokens}
+
+
+def build_continuous_llama_app(config: Optional[llama.LlamaConfig] = None,
+                               num_replicas: int = 1, num_slots: int = 8,
+                               max_len: int = 512):
+    dep = ContinuousLlamaDeployment.options(num_replicas=num_replicas)
+    return dep.bind(config, None, num_slots, max_len)
+
+
+__all__ += ["ContinuousLlamaDeployment", "build_continuous_llama_app"]

@@ -1,0 +1,286 @@
+"""Continuous batching: iteration-level scheduling for LLM serving.
+
+Reference: the vLLM-style engine behind ``ray.serve.llm``
+(``python/ray/llm/_internal/serve``) — instead of batching whole
+requests (head-of-line blocking on the longest generation), the engine
+owns a fixed pool of KV-cache slots; requests prefill into a free slot
+and join the very next decode tick, and finished requests free their
+slot immediately for queued work.
+
+TPU-native shape discipline: the decode tick is ONE jitted program over
+all ``num_slots`` slots (static shapes; inactive slots compute masked
+garbage), per-slot absolute positions drive RoPE/cache scatter/causal
+masking, and prompt prefills pad to power-of-two buckets so the number
+of compiled programs stays logarithmic. Padded prefill is sound without
+length masking because a slot's garbage cache entries live only at
+positions strictly greater than its next decode position — every decode
+overwrites position ``p`` before attending ``[0..p]``.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import llama
+from ray_tpu.models.inference import KVCache, _forward_cached
+from ray_tpu.models.llama import rms_norm
+from ray_tpu.ops.rope import rope_frequencies
+
+
+def _apply_rope_batched(x, cos, sin):
+    """RoPE with per-batch angles: x [B, 1, H, D], cos/sin [B, D//2]."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[:, None, None, :]
+    s = sin[:, None, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(dtype)
+
+
+def _scatter_slot(cache, new, positions):
+    """cache [B, S_max, KVH, D]; new [B, KVH, D] written at per-slot
+    ``positions`` [B]."""
+    def one(c, n, p):
+        return jax.lax.dynamic_update_slice(c, n[None], (p, 0, 0))
+
+    return jax.vmap(one)(cache, new, positions)
+
+
+def _attend_decode(q, cache_k, cache_v, positions, scale):
+    """Single-token attention with per-slot positions.
+
+    q [B, H, D]; cache [B, S_max, KVH, D]; positions [B] (the absolute
+    position each slot's query occupies).
+    """
+    b, hq, d = q.shape
+    s_max, hkv = cache_k.shape[1], cache_k.shape[2]
+    group = hq // hkv
+    qg = q.reshape(b, hkv, group, d).astype(jnp.float32)
+    logits = jnp.einsum("bhgd,bkhd->bhgk", qg,
+                        cache_k.astype(jnp.float32)) * scale
+    slots = jnp.arange(s_max)
+    mask = positions[:, None] >= slots[None, :]             # [B, S_max]
+    logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhgk,bkhd->bhgd", probs,
+                     cache_v.astype(jnp.float32))
+    return out.reshape(b, hq, d).astype(q.dtype)
+
+
+def _decode_tick(params, tokens, positions, cache: KVCache,
+                 config: llama.LlamaConfig):
+    """One decode step for every slot: tokens [B] at per-slot absolute
+    ``positions`` [B]. Returns (logits [B, V], cache)."""
+    c = config
+    cos, sin = rope_frequencies(c.head_dim, 0, c.rope_theta,
+                                positions=positions)  # [B, D//2]
+    x = params["embed"].astype(c.dtype)[tokens][:, None, :]   # [B, 1, E]
+    scale = c.head_dim ** -0.5
+
+    def layer_fn(carry, inputs):
+        x = carry
+        layer, ck, cv = inputs
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(c.dtype))
+        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(c.dtype))
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(c.dtype))
+        q = _apply_rope_batched(q, cos, sin)
+        k = _apply_rope_batched(k, cos, sin)
+        ck = _scatter_slot(ck, k[:, 0].astype(ck.dtype), positions)
+        cv = _scatter_slot(cv, v[:, 0].astype(cv.dtype), positions)
+        o = _attend_decode(q[:, 0], ck, cv, positions, scale)
+        x = x + jnp.einsum("bhd,hde->be", o,
+                           layer["wo"].astype(c.dtype))[:, None, :]
+        h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+        gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(c.dtype))
+        up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
+        x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(gate) * up,
+                           layer["w_down"].astype(c.dtype))
+        return x, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_fn, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = jnp.einsum("bse,ev->bsv", x.astype(jnp.float32),
+                        params["lm_head"].astype(jnp.float32))
+    return logits[:, 0], KVCache(k=new_k, v=new_v)
+
+
+def _bucket(n: int, floor: int = 16) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class ContinuousBatcher:
+    """Iteration-level scheduler over a fixed pool of KV-cache slots."""
+
+    def __init__(self, config: llama.LlamaConfig, params=None,
+                 num_slots: int = 8, max_len: int = 512, seed: int = 0,
+                 eos_token: Optional[int] = None, token_callback=None):
+        """``token_callback(rid, token)`` fires for every generated token
+        as it is produced (serving streams ride this)."""
+        self.config = config
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.eos_token = eos_token
+        self.params = params if params is not None else llama.init_params(
+            config, jax.random.PRNGKey(seed))
+        self.token_callback = token_callback
+        self.cache = KVCache.create(config, num_slots, max_len)
+        self._free: List[int] = list(range(num_slots))
+        self._slots: Dict[int, Dict[str, Any]] = {}   # slot -> request
+        self._waiting: deque = deque()
+        self._rid = itertools.count()
+        self._finished: Dict[int, List[int]] = {}
+        cfg = config
+
+        @partial(jax.jit, donate_argnums=(2,))
+        def prefill(params, tokens, cache, slot):
+            # Slot extraction + write-back live INSIDE the jit with the
+            # pooled cache donated, so admission is an in-place update
+            # rather than eager whole-cache copies.
+            positions = jnp.arange(tokens.shape[1])
+            slot_cache = KVCache(
+                k=jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, 1),
+                v=jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, 1))
+            logits, sc = _forward_cached(params, tokens, positions,
+                                         slot_cache, cfg)
+            cache = KVCache(
+                k=jax.lax.dynamic_update_slice_in_dim(cache.k, sc.k,
+                                                      slot, 1),
+                v=jax.lax.dynamic_update_slice_in_dim(cache.v, sc.v,
+                                                      slot, 1))
+            return logits, cache
+
+        @partial(jax.jit, donate_argnums=(3,))
+        def tick(params, tokens, positions, cache):
+            return _decode_tick(params, tokens, positions, cache, cfg)
+
+        self._prefill = prefill
+        self._tick = tick
+
+    # ---------------------------------------------------------------- api
+    def submit(self, prompt_tokens: List[int],
+               max_new_tokens: int = 32) -> int:
+        """Queue a request; returns its id. It joins the next tick with a
+        free slot — no waiting for the current batch to drain."""
+        assert len(prompt_tokens) + max_new_tokens <= self.max_len
+        rid = next(self._rid)
+        if max_new_tokens <= 0:
+            # Nothing to generate: finish immediately, no slot occupied.
+            self._finished[rid] = []
+            return rid
+        self._waiting.append({"rid": rid,
+                              "prompt": list(prompt_tokens),
+                              "max_new": max_new_tokens})
+        return rid
+
+    def cancel(self, rid: int) -> bool:
+        """Drop a request (client disconnected): frees its slot / queue
+        spot so abandoned generations stop burning decode ticks."""
+        for i, req in enumerate(self._waiting):
+            if req["rid"] == rid:
+                del self._waiting[i]
+                return True
+        for slot, st in list(self._slots.items()):
+            if st["rid"] == rid:
+                del self._slots[slot]
+                self._free.append(slot)
+                return True
+        return self._finished.pop(rid, None) is not None
+
+    def reset(self) -> List[int]:
+        """Abort everything (recovery after an engine error). Returns the
+        request ids that were dropped."""
+        dropped = [st["rid"] for st in self._slots.values()]
+        dropped += [r["rid"] for r in self._waiting]
+        self._slots.clear()
+        self._waiting.clear()
+        self._free = list(range(self.num_slots))
+        self._finished.clear()
+        return dropped
+
+    @property
+    def active_count(self) -> int:
+        return len(self._slots)
+
+    def has_work(self) -> bool:
+        return bool(self._slots or self._waiting or self._finished)
+
+    def _admit(self) -> None:
+        while self._waiting and self._free:
+            req = self._waiting.popleft()
+            slot = self._free.pop()
+            prompt = req["prompt"]
+            true_len = len(prompt)
+            # Bucket for compile reuse, but never beyond the cache length.
+            padded_len = min(_bucket(true_len), self.max_len)
+            padded = prompt + [0] * (padded_len - true_len)
+            tokens = jnp.asarray([padded], jnp.int32)
+            logits, self.cache = self._prefill(self.params, tokens,
+                                               self.cache, slot)
+            first = int(jnp.argmax(logits[0, true_len - 1]))
+            if self.token_callback is not None:
+                self.token_callback(req["rid"], first)
+            out = [first]
+            self._slots[slot] = {
+                "rid": req["rid"], "out": out,
+                "max_new": req["max_new"],
+                "pos": true_len,       # next decode writes here
+                "last": first,
+            }
+            self._maybe_finish(slot)
+
+    def _maybe_finish(self, slot: int) -> None:
+        st = self._slots.get(slot)
+        if st is None:
+            return
+        done = len(st["out"]) >= st["max_new"] or (
+            self.eos_token is not None and st["out"][-1] == self.eos_token)
+        if done:
+            self._finished[st["rid"]] = st["out"]
+            del self._slots[slot]
+            self._free.append(slot)
+
+    def step(self) -> Dict[int, List[int]]:
+        """Admit waiting requests, run one decode tick over all active
+        slots, and return the requests that finished this tick."""
+        self._admit()
+        if self._slots:
+            tokens = np.zeros(self.num_slots, np.int32)
+            positions = np.zeros(self.num_slots, np.int32)
+            for slot, st in self._slots.items():
+                tokens[slot] = st["last"]
+                positions[slot] = st["pos"]
+            logits, self.cache = self._tick(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                self.cache)
+            logits = np.asarray(logits)
+            for slot, st in list(self._slots.items()):
+                nxt = int(np.argmax(logits[slot]))
+                if self.token_callback is not None:
+                    self.token_callback(st["rid"], nxt)
+                st["out"].append(nxt)
+                st["last"] = nxt
+                st["pos"] += 1
+                self._maybe_finish(slot)
+        out, self._finished = self._finished, {}
+        return out
+
+    def run_to_completion(self) -> Dict[int, List[int]]:
+        """Drive ticks until every submitted request finished."""
+        results: Dict[int, List[int]] = {}
+        while self.has_work():
+            results.update(self.step())
+        return results
