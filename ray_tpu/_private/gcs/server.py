@@ -17,6 +17,7 @@ subscribers reconnect through their streaming-retry loops.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import pickle
@@ -64,6 +65,8 @@ class GcsServer:
         # placement groups (+ ids with an in-flight _place_group run)
         self._pgroups: Dict[bytes, pb.PlacementGroupInfo] = {}
         self._placing: Set[bytes] = set()
+        # node_id -> actor placements in flight (scheduled, not yet ALIVE)
+        self._actor_placing: Dict[str, int] = {}
         # object directory
         self._locations: Dict[bytes, Set[str]] = defaultdict(set)
         self._object_sizes: Dict[bytes, int] = {}
@@ -471,18 +474,31 @@ class GcsServer:
         deadline = time.monotonic() + 60.0
         last_err = "no feasible node for restart"
         while not self._stop.is_set():
-            candidates = self._schedule_actor(info)
-            retriable = False
+            candidates, waitable = self._schedule_actor(info)
+            # waitable: every matching node is momentarily full but could
+            # fit the actor once capacity frees — retry instead of DEAD
+            # (mirrors the task path's queue-when-feasible semantics).
+            retriable = waitable
+            if waitable:
+                last_err = "matching nodes are full (retrying)"
             for node_id in candidates:
                 stub = self._node_stub(node_id)
                 if stub is None:
                     continue
+                with self._lock:
+                    self._actor_placing[node_id] = \
+                        self._actor_placing.get(node_id, 0) + 1
                 try:
                     reply = stub.CreateActorOnNode(
                         pb.CreateActorOnNodeRequest(info=info), timeout=60)
                 except Exception as e:  # noqa: BLE001
                     last_err = f"restart failed: {e}"
                     continue
+                finally:
+                    with self._lock:
+                        self._actor_placing[node_id] -= 1
+                        if self._actor_placing[node_id] <= 0:
+                            del self._actor_placing[node_id]
                 if reply.ok:
                     info.state = "ALIVE"
                     info.node_id = node_id
@@ -499,10 +515,15 @@ class GcsServer:
         info.death_cause = last_err
         self.UpdateActor(pb.UpdateActorRequest(info=info), None)
 
-    def _schedule_actor(self, info: pb.ActorInfo) -> List[str]:
+    def _schedule_actor(self, info: pb.ActorInfo):
         """Candidate nodes, best first (GcsActorScheduler). A PG-targeted
         actor's candidates are its bundle's node (or every bundle node for
-        bundle_index=-1), found after the group finishes placing."""
+        bundle_index=-1), found after the group finishes placing.
+
+        Returns ``(candidates, waitable)``: ``waitable=True`` means no
+        matching node has free capacity right now but at least one could
+        ever fit the demand — the caller should retry rather than declare
+        the actor DEAD (transient fullness is not infeasibility)."""
         spec = pickle.loads(info.spec)
         pg = spec.get("pg")
         if pg is not None:
@@ -512,32 +533,75 @@ class GcsServer:
                 with self._lock:
                     ginfo = self._pgroups.get(group_id)
                     if ginfo is None:
-                        return []
+                        return [], False
                     state = ginfo.state
                     if state == "CREATED":
                         if idx >= 0:
                             return [b.node_id for b in ginfo.bundles
-                                    if b.index == idx and b.node_id]
+                                    if b.index == idx and b.node_id], False
                         # De-dup, preserving bundle order.
                         return list(dict.fromkeys(
-                            b.node_id for b in ginfo.bundles if b.node_id))
+                            b.node_id for b in ginfo.bundles
+                            if b.node_id)), False
                     if state in ("REMOVED", "INFEASIBLE"):
-                        return []
+                        return [], False
                 time.sleep(0.05)
-            return []
+            return [], False
         demand: Dict[str, float] = spec.get("resources", {})
+
+        def fits(n):
+            return all(n.available.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items())
+
+        def ever_fits(n):
+            return all(n.resources.get(k, 0.0) + 1e-9 >= v
+                       for k, v in demand.items())
+
         with self._lock:
-            candidates = [
-                n for n in self._nodes.values()
-                if n.alive and all(
-                    n.available.get(k, 0.0) + 1e-9 >= v
-                    for k, v in demand.items())
-            ]
+            eligible = [n for n in self._nodes.values() if n.alive]
+        affinity = spec.get("affinity")
+        if affinity:
+            node_id, soft = affinity
+            pinned = [n for n in eligible if n.node_id == node_id]
+            if pinned or not soft:
+                eligible = pinned
+        preferred: List = []
+        labels_raw = spec.get("labels")
+        if labels_raw:
+            from ray_tpu._private.scheduler import policies
+
+            selector = json.loads(labels_raw)
+            hard = selector.get("hard") or {}
+            soft_sel = selector.get("soft") or {}
+            eligible = [n for n in eligible
+                        if policies.match_labels(dict(n.labels), hard)]
+            if soft_sel:
+                preferred = [n for n in eligible
+                             if policies.match_labels(dict(n.labels),
+                                                      soft_sel)]
+        candidates = [n for n in (preferred or eligible) if fits(n)]
+        if not candidates and preferred:
+            # Soft tier full: fall back to the hard tier.
+            candidates = [n for n in eligible if fits(n)]
         if not candidates:
-            return []
+            return [], any(ever_fits(n) for n in eligible)
+        if spec.get("strategy") == "SPREAD":
+            # Min-actor-count placement for explicit SPREAD actors.
+            # In-flight placements (scheduled, not yet ALIVE) count too, so
+            # a burst of concurrent creations doesn't pile onto one node;
+            # random tie-break splits identical loads.
+            with self._lock:
+                load = {n.node_id: self._actor_placing.get(n.node_id, 0)
+                        for n in candidates}
+                for a in self._actors.values():
+                    if a.state == "ALIVE" and a.node_id in load:
+                        load[a.node_id] += 1
+            best = min(candidates, key=lambda n: (load[n.node_id],
+                                                  random.random()))
+            return [best.node_id], False
         best = max(candidates,
                    key=lambda n: sum(n.available.values()))
-        return [best.node_id]
+        return [best.node_id], False
 
     # ------------------------------------------------------------- pubsub
     def Publish(self, request, context):

@@ -492,6 +492,9 @@ class NodeManager:
                                  worker_address=worker.address,
                                  worker_id=worker.worker_id,
                                  tpu_chips=self._chips_for(lease_id))
+        selector = policies.parse_label_selector(spec.label_selector)
+        if selector is not None:
+            return self._lease_with_labels(spec, demand, lease_id, selector)
         if spec.strategy == "SPREAD":
             # Min-utilization placement (reference: spread_scheduling_policy):
             # hand off when a clearly-less-loaded node exists; the margin
@@ -537,6 +540,53 @@ class NodeManager:
         addr = next(n.address for n in nodes if n.node_id == target)
         return pb.LeaseReply(granted=False, spillback_node_id=target,
                              spillback_address=addr)
+
+    def _lease_with_labels(self, spec, demand: Dict[str, float],
+                           lease_id: bytes, selector: Dict[str, dict]):
+        """Node-label scheduling (reference: node-label scheduling policy):
+        hard selectors gate eligibility, soft selectors rank, then the base
+        policy places among the surviving tier. The TPU-native use is
+        targeting one ICI slice (``hard={"tpu-slice": ...}``)."""
+        hard = selector.get("hard") or {}
+        soft = selector.get("soft") or {}
+        local_hard = policies.match_labels(self.labels, hard)
+        local_soft = local_hard and policies.match_labels(self.labels, soft)
+        view = self._cluster_view()
+        others = [n for n in view if n.node_id != self.node_id]
+        picker = (policies.pick_node_spread if spec.strategy == "SPREAD"
+                  else policies.pick_node_hybrid)
+        if soft:
+            if local_soft and self._try_acquire(demand, holder=lease_id):
+                return self._grant_lease(lease_id, demand)
+            # Prefer a soft-matching node with capacity right now; when the
+            # soft tier has no capacity anywhere, fall through to the hard
+            # tier instead of spilling forever (soft is a preference, not a
+            # requirement — a soft-only selector must not livelock).
+            soft_fit = [n for n in others if n.alive
+                        and policies.match_labels(dict(n.labels), hard)
+                        and policies.match_labels(dict(n.labels), soft)]
+            target = picker(soft_fit, demand)
+            if target is not None:
+                addr = next(n.address for n in others
+                            if n.node_id == target)
+                return pb.LeaseReply(granted=False,
+                                     spillback_node_id=target,
+                                     spillback_address=addr)
+        if local_hard and self._try_acquire(demand, holder=lease_id):
+            return self._grant_lease(lease_id, demand)
+        hard_fit = [n for n in others if n.alive
+                    and policies.match_labels(dict(n.labels), hard)]
+        target = picker(hard_fit, demand)
+        if target is not None:
+            addr = next(n.address for n in others if n.node_id == target)
+            return pb.LeaseReply(granted=False, spillback_node_id=target,
+                                 spillback_address=addr)
+        if not policies.feasible_with_labels(view, demand, selector):
+            return pb.LeaseReply(granted=False, error="infeasible")
+        if local_hard:
+            return self._queue_for_resources(lease_id, demand)
+        # Eligible nodes exist but are momentarily full: client backs off.
+        return pb.LeaseReply(granted=False)
 
     def _grant_lease(self, lease_id: bytes, demand: Dict[str, float]):
         worker = self._pop_worker()

@@ -106,6 +106,7 @@ class PlacementFields:
     affinity_node_id: str = ""
     affinity_soft: bool = False
     strategy: str = ""  # "" | "DEFAULT" | "SPREAD"
+    label_selector: bytes = b""  # JSON, NodeLabelSchedulingStrategy.encode()
 
 
 def resolve_placement(options: RemoteOptions) -> PlacementFields:
@@ -135,6 +136,9 @@ def resolve_placement(options: RemoteOptions) -> PlacementFields:
         elif hasattr(strat, "node_id"):
             out.affinity_node_id = strat.node_id
             out.affinity_soft = bool(strat.soft)
+            return out
+        elif hasattr(strat, "hard") and hasattr(strat, "encode"):
+            out.label_selector = strat.encode()
             return out
         else:
             raise ValueError(f"Unknown scheduling strategy {strat!r}")

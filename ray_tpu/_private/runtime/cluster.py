@@ -688,6 +688,8 @@ class ClusterRuntime(CoreRuntime):
             spec.affinity_soft = pf.affinity_soft
         if pf.strategy:
             spec.strategy = pf.strategy
+        if pf.label_selector:
+            spec.label_selector = pf.label_selector
         # Pin every contained ObjectRef (top-level AND nested in containers)
         # for the task's flight time so its refcount can't hit zero between
         # submit and the worker's borrow flush. A promoted payload gets the
@@ -882,7 +884,8 @@ class ClusterRuntime(CoreRuntime):
         if spec.placement_group_id or spec.affinity_node_id:
             return None
         return (tuple(sorted(spec.resources.items())),
-                bytes(spec.runtime_env))
+                bytes(spec.runtime_env), bytes(spec.label_selector),
+                spec.strategy)
 
     def _take_cached_lease(self, sig) -> Optional[dict]:
         with self._lease_cache_lock:
@@ -1168,6 +1171,12 @@ class ClusterRuntime(CoreRuntime):
             "pg": ((pf.placement_group_id, pf.bundle_index)
                    if pf.placement_group_id else None),
             "pg_capture": pf.capture_child_tasks,
+            # Non-PG strategies for actors (GcsActorScheduler analog):
+            # labels/affinity/spread are evaluated by GCS _schedule_actor.
+            "labels": pf.label_selector.decode() if pf.label_selector else None,
+            "affinity": ((pf.affinity_node_id, pf.affinity_soft)
+                         if pf.affinity_node_id else None),
+            "strategy": pf.strategy,
         })
         # Constructor args are pinned until the actor reaches a settled
         # state (ALIVE after the constructor's borrow flush, or DEAD):

@@ -83,6 +83,46 @@ def feasible_anywhere(nodes: Sequence[pb.NodeInfo], demand: Dict[str, float]) ->
     return any(_feasible(n, demand) for n in nodes if n.alive)
 
 
+# ------------------------------------------------------------- node labels
+
+def match_labels(labels: Dict[str, str], selector: Dict[str, dict]) -> bool:
+    """Evaluate a hard/soft selector map against one node's labels.
+
+    Value specs (see ``util/scheduling_strategies.py`` In/NotIn/Exists/
+    DoesNotExist; reference: node_label_scheduling_policy.h semantics):
+    ``{"in": [...]}`` requires presence + membership, ``{"not_in": [...]}``
+    passes when absent or not a member, ``{"exists": b}`` checks presence.
+    """
+    for key, spec in selector.items():
+        present = key in labels
+        if "in" in spec:
+            if not present or labels[key] not in spec["in"]:
+                return False
+        elif "not_in" in spec:
+            if present and labels[key] in spec["not_in"]:
+                return False
+        elif "exists" in spec:
+            if present != bool(spec["exists"]):
+                return False
+    return True
+
+
+def parse_label_selector(raw: bytes) -> Optional[Dict[str, dict]]:
+    """Decode TaskSpec.label_selector; None when unset."""
+    if not raw:
+        return None
+    import json
+
+    return json.loads(bytes(raw).decode())
+
+
+def feasible_with_labels(nodes: Sequence[pb.NodeInfo], demand: Dict[str, float],
+                         selector: Dict[str, dict]) -> bool:
+    hard = selector.get("hard") or {}
+    return any(_feasible(n, demand) for n in nodes
+               if n.alive and match_labels(dict(n.labels), hard))
+
+
 # ---------------------------------------------------------------- bundles
 
 def place_bundles(

@@ -209,6 +209,11 @@ class ContinuousBatcher:
         self._waiting.clear()
         self._free = list(range(self.num_slots))
         self._finished.clear()
+        # The prefill/tick jits donate the pooled cache; after a mid-step
+        # failure the old buffers may already be deleted, so rebuild the
+        # pool or every later step would raise "Array has been deleted".
+        self.cache = KVCache.create(self.config, self.num_slots,
+                                    self.max_len)
         return dropped
 
     @property

@@ -8,7 +8,12 @@ from ray_tpu.util.placement_group import (  # noqa: F401
     remove_placement_group,
 )
 from ray_tpu.util.scheduling_strategies import (  # noqa: F401
+    DoesNotExist,
+    Exists,
+    In,
     NodeAffinitySchedulingStrategy,
+    NodeLabelSchedulingStrategy,
+    NotIn,
     PlacementGroupSchedulingStrategy,
 )
 
@@ -20,4 +25,9 @@ __all__ = [
     "get_current_placement_group",
     "PlacementGroupSchedulingStrategy",
     "NodeAffinitySchedulingStrategy",
+    "NodeLabelSchedulingStrategy",
+    "In",
+    "NotIn",
+    "Exists",
+    "DoesNotExist",
 ]

@@ -36,6 +36,61 @@ class NodeAffinitySchedulingStrategy:
     soft: bool = False
 
 
+# Label-match operators (reference: ``ray.util.scheduling_strategies``
+# In/NotIn/Exists/DoesNotExist). Each lowers to the JSON value spec carried
+# in TaskSpec.label_selector and evaluated by the node-label policy
+# (``_private/scheduler/policies.py::match_labels``).
+
+def In(*values: str):
+    """Label value must be one of ``values``."""
+    return {"in": [str(v) for v in values]}
+
+
+def NotIn(*values: str):
+    """Label value must not be any of ``values`` (absent keys match)."""
+    return {"not_in": [str(v) for v in values]}
+
+
+def Exists():
+    """Label key must be present (any value)."""
+    return {"exists": True}
+
+
+def DoesNotExist():
+    """Label key must be absent."""
+    return {"exists": False}
+
+
+@dataclasses.dataclass
+class NodeLabelSchedulingStrategy:
+    """Constrain placement by node labels (reference:
+    NodeLabelSchedulingStrategy + the node-label scheduling policy,
+    ``raylet/scheduling/policy/node_label_scheduling_policy.h``).
+
+    ``hard`` selectors must all match or the node is ineligible; ``soft``
+    selectors rank eligible nodes (full-soft-match preferred). Values may be
+    a plain string (exact match) or one of :func:`In`/:func:`NotIn`/
+    :func:`Exists`/:func:`DoesNotExist`. TPU-native use: target one
+    ICI-connected slice with ``hard={"tpu-slice": "slice-0"}``.
+    """
+
+    hard: Optional[dict] = None
+    soft: Optional[dict] = None
+
+    def encode(self) -> bytes:
+        import json
+
+        def norm(sel):
+            out = {}
+            for k, v in (sel or {}).items():
+                out[k] = {"in": [str(v)]} if isinstance(v, str) else dict(v)
+            return out
+
+        return json.dumps(
+            {"hard": norm(self.hard), "soft": norm(self.soft)},
+            sort_keys=True).encode()
+
+
 # String strategies "DEFAULT" (hybrid pack-then-spread) and "SPREAD"
 # (min-utilization) are accepted anywhere a strategy object is.
 SchedulingStrategyT = Optional[Any]

@@ -1,0 +1,122 @@
+"""Node-label scheduling strategy tests.
+
+Reference: ``python/ray/tests/test_node_label_scheduling_strategy.py`` —
+NodeLabelSchedulingStrategy with In/NotIn/Exists/DoesNotExist operators for
+tasks and actors, hard vs soft semantics, and infeasibility errors. The
+TPU-native use case is pinning work to one ICI-connected slice via the
+``tpu-slice`` topology label.
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import RayTpuError
+from ray_tpu.util import (
+    DoesNotExist,
+    Exists,
+    In,
+    NodeLabelSchedulingStrategy,
+    NotIn,
+)
+
+
+@pytest.fixture(scope="module")
+def label_cluster():
+    c = Cluster(head_node_args={"num_cpus": 2,
+                                "labels": {"zone": "head"}})
+    a = c.add_node(num_cpus=2, labels={"zone": "a", "tier": "fast",
+                                       "tpu-slice": "slice-0"})
+    b = c.add_node(num_cpus=2, labels={"zone": "b",
+                                       "tpu-slice": "slice-1"})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    yield c, a.node_id, b.node_id
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+@ray_tpu.remote
+def where():
+    return ray_tpu.get_runtime_context().get_node_id()
+
+
+def _run_on(strategy):
+    return ray_tpu.get(where.options(
+        scheduling_strategy=strategy).remote(), timeout=60)
+
+
+def test_hard_exact_match(label_cluster):
+    _, node_a, node_b = label_cluster
+    assert _run_on(NodeLabelSchedulingStrategy(
+        hard={"zone": "a"})) == node_a
+    assert _run_on(NodeLabelSchedulingStrategy(
+        hard={"zone": In("b")})) == node_b
+
+
+def test_hard_in_multiple(label_cluster):
+    _, node_a, node_b = label_cluster
+    got = {_run_on(NodeLabelSchedulingStrategy(
+        hard={"zone": In("a", "b")})) for _ in range(4)}
+    assert got <= {node_a, node_b}
+
+
+def test_not_in_and_exists(label_cluster):
+    c, node_a, node_b = label_cluster
+    # tier label exists only on node a.
+    assert _run_on(NodeLabelSchedulingStrategy(
+        hard={"tier": Exists()})) == node_a
+    # NotIn excludes a; DoesNotExist(tier) excludes a too.
+    assert _run_on(NodeLabelSchedulingStrategy(
+        hard={"zone": NotIn("a", "head")})) == node_b
+    got = _run_on(NodeLabelSchedulingStrategy(
+        hard={"tier": DoesNotExist(), "zone": NotIn("head")}))
+    assert got == node_b
+
+
+def test_tpu_slice_targeting(label_cluster):
+    _, node_a, node_b = label_cluster
+    assert _run_on(NodeLabelSchedulingStrategy(
+        hard={"tpu-slice": "slice-1"})) == node_b
+
+
+def test_soft_prefers_but_falls_back(label_cluster):
+    _, node_a, node_b = label_cluster
+    # Soft preference for zone=a; should land there under no contention.
+    assert _run_on(NodeLabelSchedulingStrategy(
+        soft={"zone": "a"})) == node_a
+    # Soft preference for a zone that doesn't exist must still run.
+    got = _run_on(NodeLabelSchedulingStrategy(soft={"zone": "nowhere"}))
+    assert got  # executed somewhere
+
+
+def test_hard_infeasible_errors(label_cluster):
+    with pytest.raises(RayTpuError):
+        ray_tpu.get(where.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+            hard={"zone": "mars"})).remote(), timeout=30)
+
+
+def test_actor_label_scheduling(label_cluster):
+    _, node_a, node_b = label_cluster
+
+    @ray_tpu.remote
+    class Pin:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    a = Pin.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"zone": "b"})).remote()
+    assert ray_tpu.get(a.node.remote(), timeout=60) == node_b
+    ray_tpu.kill(a)
+
+
+def test_actor_label_infeasible_dies(label_cluster):
+    @ray_tpu.remote
+    class Pin:
+        def node(self):
+            return "ok"
+
+    a = Pin.options(scheduling_strategy=NodeLabelSchedulingStrategy(
+        hard={"zone": "mars"})).remote()
+    with pytest.raises(Exception):
+        ray_tpu.get(a.node.remote(), timeout=30)
