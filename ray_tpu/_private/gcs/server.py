@@ -22,6 +22,7 @@ import logging
 import os
 import pickle
 import queue
+import random
 import threading
 import time
 from collections import defaultdict, deque
@@ -317,9 +318,9 @@ class GcsServer:
 
     # ------------------------------------------------------------- kv
     def KvPut(self, request, context):
-        if request.ns == "__task_events__":
-            # Reserved: reads in this namespace serve the task-event ring
-            # buffer, so stored values would be unreachable.
+        if request.ns in ("__task_events__", "__memory__"):
+            # Reserved: reads in these namespaces serve the task-event ring
+            # buffer / memory report, so stored values would be unreachable.
             return pb.KvReply(ok=False)
         key = (request.ns, request.key)
         with self._lock:
@@ -334,6 +335,27 @@ class GcsServer:
             with self._lock:
                 events = list(self._task_events)
             return pb.KvReply(found=True, value=pickle.dumps(events))
+        if request.ns == "__memory__":
+            # Reserved: cluster memory report for `ray-tpu memory` / state
+            # API (reference: `ray memory` over the owner refcount tables).
+            with self._lock:
+                objects = []
+                for oid, holders in self._refcounts.items():
+                    if not holders:
+                        continue
+                    objects.append({
+                        "object_id": oid.hex(),
+                        "size": self._object_sizes.get(oid, 0),
+                        "locations": sorted(self._locations.get(oid, ())),
+                        "holders": dict(holders),
+                    })
+                report = {
+                    "objects": objects,
+                    "num_tracked": len(objects),
+                    "total_bytes": sum(o["size"] for o in objects),
+                    "num_freed_remembered": len(self._freed),
+                }
+            return pb.KvReply(found=True, value=pickle.dumps(report))
         with self._lock:
             val = self._kv.get((request.ns, request.key))
         if val is None:
@@ -508,6 +530,11 @@ class GcsServer:
                 last_err = reply.error
                 if "pg-wait" in (reply.error or ""):
                     retriable = True
+                if "insufficient resources" in (reply.error or ""):
+                    # The scheduler's available-view was stale (e.g. a just
+                    # -killed actor's resources not yet released): transient
+                    # fullness, same as waitable above — retry, not DEAD.
+                    retriable = True
             if not retriable or time.monotonic() > deadline:
                 break
             time.sleep(0.2)
@@ -563,8 +590,13 @@ class GcsServer:
         if affinity:
             node_id, soft = affinity
             pinned = [n for n in eligible if n.node_id == node_id]
-            if pinned or not soft:
+            if not soft:
                 eligible = pinned
+            elif pinned and any(fits(n) for n in pinned):
+                eligible = pinned
+            # soft + (pinned node dead or full): fall back to any node —
+            # soft affinity is a preference, mirroring the task path's
+            # pick_node_affinity fallback.
         preferred: List = []
         labels_raw = spec.get("labels")
         if labels_raw:

@@ -145,15 +145,84 @@ def serve(service_name: str, handler_obj: Any, port: int = 0,
             )
     generic = grpc.method_handlers_generic_handler(
         f"ray_tpu.rpc.{service_name}", handlers)
+    executor = futures.ThreadPoolExecutor(max_workers=max_workers)
     server = grpc.server(
-        futures.ThreadPoolExecutor(max_workers=max_workers),
+        executor,
         options=[("grpc.max_send_message_length", 512 * 1024 * 1024),
                  ("grpc.max_receive_message_length", 512 * 1024 * 1024)],
     )
     server.add_generic_rpc_handlers((generic,))
     bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
+    _start_lag_probe(service_name, executor)
     return server, bound
+
+
+def _start_lag_probe(service_name: str, executor) -> None:
+    """Event-loop instrumentation (reference C6: instrumented_io_context /
+    event_stats.h loop-lag stats). The threaded analog: periodically submit
+    a no-op into the server's executor and gauge how long it queued — a
+    saturated handler pool shows up as lag — plus the work-queue depth."""
+    try:
+        lag = _lag_gauges()
+    except Exception:  # noqa: BLE001
+        return
+
+    import weakref
+
+    ref = weakref.ref(executor)
+
+    def probe():
+        while True:
+            ex = ref()
+            if ex is None:
+                return
+            t0 = time.perf_counter()
+            try:
+                fut = ex.submit(lambda: time.perf_counter() - t0)
+            except Exception:  # noqa: BLE001 — executor shut down
+                return
+            try:
+                queued = fut.result(timeout=30.0)
+            except futures.TimeoutError:
+                # Saturation is the signal, not a shutdown: record the
+                # observed floor of the lag and keep probing.
+                queued = 30.0
+            except Exception:  # noqa: BLE001 — executor shut down
+                return
+            try:
+                lag["lag"].set(queued, tags={"service": service_name})
+                lag["depth"].set(ex._work_queue.qsize(),
+                                 tags={"service": service_name})
+            except Exception:  # noqa: BLE001
+                return
+            del ex
+            time.sleep(2.0)
+
+    threading.Thread(target=probe, daemon=True,
+                     name=f"rpc-lag-{service_name}").start()
+
+
+_lag_metrics = None
+
+
+def _lag_gauges():
+    global _lag_metrics
+    with _latency_lock:
+        if _lag_metrics is None:
+            from ray_tpu.util.metrics import Gauge
+
+            _lag_metrics = {
+                "lag": Gauge(
+                    "rpc_executor_lag_seconds",
+                    description="time a no-op waits for a handler thread",
+                    tag_keys=("service",)),
+                "depth": Gauge(
+                    "rpc_executor_queue_depth",
+                    description="handler work-queue depth",
+                    tag_keys=("service",)),
+            }
+        return _lag_metrics
 
 
 class Stub:

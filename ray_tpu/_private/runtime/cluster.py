@@ -1054,6 +1054,7 @@ class ClusterRuntime(CoreRuntime):
             target = self.node
         deadline = time.monotonic() + 300.0
         backoff = 0.01
+        spillbacks = 0
         while True:
             try:
                 reply = target.RequestWorkerLease(pb.LeaseRequest(spec=spec))
@@ -1094,6 +1095,13 @@ class ClusterRuntime(CoreRuntime):
                 target = pg_targets[0]
             if reply.spillback_address:
                 target = rpc.get_stub("NodeService", reply.spillback_address)
+                # Damp spillback ping-pong: nodes with stale views can bounce
+                # a lease between each other (label soft tiers especially);
+                # after a burst of hops, pause long enough for heartbeats to
+                # refresh the views instead of spinning RPCs.
+                spillbacks += 1
+                if spillbacks % 8 == 0:
+                    time.sleep(min(0.05 * (spillbacks // 8), 0.5))
                 continue
             if time.monotonic() > deadline:
                 raise exceptions.RayTpuError(

@@ -155,6 +155,163 @@ def cmd_jobs(args):
                   f"{info['entrypoint']}")
 
 
+def _connect(args):
+    import ray_tpu
+
+    ray_tpu.init(address=args.address or _auto_address(),
+                 ignore_reinit_error=True)
+    return ray_tpu
+
+
+def cmd_timeline(args):
+    """Chrome-trace dump of cluster task events (reference: ``ray
+    timeline`` -> GlobalState.chrome_tracing_dump, _private/state.py:442).
+    Open the output in chrome://tracing or https://ui.perfetto.dev."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    events = state.task_timeline()
+    out = args.output or f"ray-tpu-timeline-{int(time.time())}.json"
+    with open(out, "w") as f:
+        json.dump(events, f)
+    print(f"wrote {len(events)} trace events to {out}")
+
+
+def cmd_list(args):
+    """State CLI (reference: ``ray list tasks|actors|...``,
+    ``ray/util/state/state_cli.py``)."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    kind = args.kind
+    if kind == "nodes":
+        rows = state.list_nodes()
+    elif kind == "actors":
+        rows = state.list_actors()
+    elif kind == "tasks":
+        rows = state.list_tasks(limit=args.limit)
+    elif kind == "objects":
+        rows = state.memory_summary()["objects"][:args.limit]
+    elif kind == "placement-groups":
+        rows = state.list_placement_groups()
+    else:  # pragma: no cover - argparse choices guard this
+        raise SystemExit(f"unknown kind {kind}")
+    if args.format == "json":
+        print(json.dumps(rows, indent=2, default=str))
+        return
+    for r in rows[:args.limit]:
+        print("  ".join(f"{k}={v}" for k, v in r.items()))
+    print(f"({min(len(rows), args.limit)} of {len(rows)} rows)")
+
+
+def cmd_memory(args):
+    """Cluster object-store report (reference: ``ray memory``)."""
+    _connect(args)
+    from ray_tpu.util import state
+
+    rep = state.memory_summary()
+    print(f"Tracked objects: {rep['num_tracked']}  "
+          f"total bytes: {rep['total_bytes']}  "
+          f"freed (remembered): {rep['num_freed_remembered']}")
+    rows = sorted(rep["objects"], key=lambda o: -o["size"])
+    for o in rows[:args.limit]:
+        holders = ", ".join(f"{h[:12]}:{c}" for h, c in o["holders"].items())
+        locs = ", ".join(n[:8] for n in o["locations"]) or "inline/owner"
+        print(f"  {o['object_id'][:16]} {o['size']:>12}B  "
+              f"nodes=[{locs}]  refs=[{holders}]")
+
+
+def cmd_logs(args):
+    """Tail cluster logs (reference: ``ray logs`` + the dashboard log
+    viewer over the LOG pubsub channel)."""
+    if args.job:
+        from ray_tpu.job_submission import JobSubmissionClient
+
+        client = JobSubmissionClient(args.address or _auto_address())
+        print(client.get_job_logs(args.job), end="")
+        return
+    import pickle
+
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    gcs = rpc.get_stub("GcsService", args.address or _auto_address())
+    # The tail is bounded with a gRPC deadline (the stream blocks between
+    # messages, so a wall-clock check alone would never fire). The Stub
+    # treats timeout=None as "use the 30s default", so --follow passes an
+    # explicit year-long deadline.
+    stream = gcs.Subscribe(
+        pb.SubscribeRequest(channels=["LOG"],
+                            subscriber_id=f"cli-{os.getpid()}"),
+        timeout=365 * 86400.0 if args.follow else args.duration)
+    try:
+        for msg in stream:
+            try:
+                rec = pickle.loads(msg.data)
+            except Exception:  # noqa: BLE001
+                continue
+            for line in rec.get("lines", ()):
+                print(f"[{rec.get('name', '?')} pid={rec.get('pid')}] {line}")
+    except KeyboardInterrupt:
+        pass
+    except Exception as e:  # noqa: BLE001
+        # Deadline expiry is how a non-follow tail ends; anything else
+        # (dead GCS, dropped stream) must not exit 0 silently.
+        if args.follow:
+            raise SystemExit(f"log stream ended: {e}")
+        if "deadline" not in str(e).lower():
+            raise SystemExit(f"log stream failed: {e}")
+
+
+def cmd_health_check(args):
+    """Exit 0 when the GCS answers (reference: ``ray health-check``)."""
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    try:
+        gcs = rpc.get_stub("GcsService", args.address or _auto_address())
+        nodes = gcs.GetNodes(pb.GetNodesRequest(), timeout=5).nodes
+    except Exception as e:  # noqa: BLE001
+        print(f"unhealthy: {e}")
+        raise SystemExit(1)
+    alive = sum(n.alive for n in nodes)
+    print(f"healthy: {alive}/{len(nodes)} nodes alive")
+    if args.min_nodes and alive < args.min_nodes:
+        raise SystemExit(1)
+
+
+def cmd_stack(args):
+    """Dump stack traces of live actor workers (reference: ``ray stack``)."""
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    targets = {}
+    gcs = rpc.get_stub("GcsService", args.address or _auto_address())
+    for a in gcs.ListActors(pb.ListActorsRequest(all_namespaces=True)).actors:
+        if a.state == "ALIVE" and a.address:
+            targets[a.actor_id.hex()[:12] + " " + a.class_name] = a.address
+    if not targets:
+        print("no live actor workers")
+        return
+    for name, addr in targets.items():
+        print(f"=== {name} @ {addr} ===")
+        try:
+            stub = rpc.get_stub("WorkerService", addr)
+            reply = stub.Stacktrace(pb.WorkerStacktraceRequest(), timeout=5)
+            print(reply.stacktrace)
+        except Exception as e:  # noqa: BLE001
+            print(f"  <unreachable: {e}>")
+
+
+def cmd_resources(args):
+    import ray_tpu
+
+    _connect(args)
+    print("total:", json.dumps(ray_tpu.cluster_resources(), sort_keys=True))
+    print("avail:", json.dumps(ray_tpu.available_resources(),
+                               sort_keys=True))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray-tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -189,6 +346,46 @@ def main(argv=None):
     p = sub.add_parser("jobs", help="list jobs")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_jobs)
+
+    p = sub.add_parser("timeline",
+                       help="dump a chrome-trace of cluster task events")
+    p.add_argument("--address")
+    p.add_argument("--output", "-o")
+    p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("list", help="list cluster state")
+    p.add_argument("kind", choices=["nodes", "actors", "tasks", "objects",
+                                    "placement-groups"])
+    p.add_argument("--address")
+    p.add_argument("--limit", type=int, default=100)
+    p.add_argument("--format", choices=["table", "json"], default="table")
+    p.set_defaults(fn=cmd_list)
+
+    p = sub.add_parser("memory", help="cluster object-store memory report")
+    p.add_argument("--address")
+    p.add_argument("--limit", type=int, default=50)
+    p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("logs", help="tail worker logs (or one job's logs)")
+    p.add_argument("--address")
+    p.add_argument("--job", help="print this job's captured logs and exit")
+    p.add_argument("--follow", "-f", action="store_true")
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="seconds to tail when not following")
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("health-check", help="probe the GCS; exit 0 if healthy")
+    p.add_argument("--address")
+    p.add_argument("--min-nodes", type=int, default=0)
+    p.set_defaults(fn=cmd_health_check)
+
+    p = sub.add_parser("stack", help="dump live actor worker stack traces")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_stack)
+
+    p = sub.add_parser("resources", help="cluster total/available resources")
+    p.add_argument("--address")
+    p.set_defaults(fn=cmd_resources)
 
     args = parser.parse_args(argv)
     args.fn(args)

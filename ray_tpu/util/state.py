@@ -122,6 +122,24 @@ def task_timeline() -> List[Dict[str, Any]]:
     return out
 
 
+def memory_summary() -> Dict[str, Any]:
+    """Cluster object-memory report (reference: ``ray memory`` — per-object
+    size, store locations, and reference holders from the GCS tables)."""
+    core = _core()
+    gcs = getattr(core, "gcs", None)
+    if gcs is None:
+        store = getattr(core, "store", None) or getattr(core, "memory", None)
+        n = store.size() if store is not None else 0
+        return {"objects": [], "num_tracked": n, "total_bytes": 0,
+                "num_freed_remembered": 0}
+    import pickle
+
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    reply = gcs.KvGet(pb.KvRequest(ns="__memory__", key=""))
+    return pickle.loads(reply.value)
+
+
 def summarize_cluster() -> Dict[str, Any]:
     return {
         "nodes": len([n for n in ray_tpu.nodes() if n.get("Alive", n.get("alive"))]),

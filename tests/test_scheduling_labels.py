@@ -110,6 +110,44 @@ def test_actor_label_scheduling(label_cluster):
     ray_tpu.kill(a)
 
 
+def test_spread_actors_use_multiple_nodes(label_cluster):
+    """SPREAD actor placement distributes a creation burst (in-flight
+    placements count toward load, random tie-break)."""
+    @ray_tpu.remote
+    class A:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    actors = [A.options(scheduling_strategy="SPREAD").remote()
+              for _ in range(4)]
+    nodes = {ray_tpu.get(a.node.remote(), timeout=60) for a in actors}
+    assert len(nodes) >= 2, nodes
+    for a in actors:
+        ray_tpu.kill(a)
+
+
+def test_soft_affinity_actor_falls_back(label_cluster):
+    """Soft node affinity to a full node falls back instead of DEAD."""
+    from ray_tpu.util import NodeAffinitySchedulingStrategy
+
+    @ray_tpu.remote(num_cpus=2)
+    class Big:
+        def node(self):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    _, node_a, _ = label_cluster
+    # Fill node a completely, then soft-pin another big actor to it.
+    filler = Big.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_a, soft=False)).remote()
+    assert ray_tpu.get(filler.node.remote(), timeout=60) == node_a
+    soft = Big.options(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_a, soft=True)).remote()
+    got = ray_tpu.get(soft.node.remote(), timeout=60)
+    assert got and got != node_a  # fell back to a node with room
+    ray_tpu.kill(filler)
+    ray_tpu.kill(soft)
+
+
 def test_actor_label_infeasible_dies(label_cluster):
     @ray_tpu.remote
     class Pin:

@@ -139,6 +139,94 @@ def test_cli_status(cluster, capsys):
     assert "nodes alive" in out
 
 
+def test_cli_state_commands(cluster, capsys, tmp_path):
+    """State CLI breadth: list/memory/timeline/health-check/resources
+    (reference: ``ray list|memory|timeline|health-check|status``)."""
+    from ray_tpu.scripts.cli import main
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote
+    def make(n):
+        return bytes(n)
+
+    # A large object lands in the shm store and the refcount tables.
+    ref = make.remote(512 * 1024)
+    assert len(ray_tpu.get(ref)) == 512 * 1024
+
+    main(["health-check", "--address", cluster.address, "--min-nodes", "1"])
+    assert "healthy" in capsys.readouterr().out
+
+    main(["list", "nodes", "--address", cluster.address])
+    assert "nodeid" in capsys.readouterr().out.lower()
+
+    main(["list", "tasks", "--address", cluster.address])
+    out = capsys.readouterr().out
+    assert "make" in out or "rows" in out
+
+    main(["memory", "--address", cluster.address])
+    out = capsys.readouterr().out
+    assert "Tracked objects" in out
+
+    trace = tmp_path / "trace.json"
+    main(["timeline", "--address", cluster.address, "-o", str(trace)])
+    assert "trace events" in capsys.readouterr().out
+    events = json.loads(trace.read_text())
+    assert isinstance(events, list)
+
+    main(["resources", "--address", cluster.address])
+    assert "CPU" in capsys.readouterr().out
+    del ref
+    ray_tpu.shutdown()
+
+
+def test_rpc_executor_lag_gauges(cluster):
+    """C6 analog: the RPC servers export executor lag + queue depth
+    (reference: instrumented_io_context / event_stats loop-lag stats)."""
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        text = rmetrics.prometheus_text()
+        if "rpc_executor_lag_seconds" in text and \
+                "rpc_executor_queue_depth" in text:
+            return
+        time.sleep(0.5)
+    raise AssertionError("lag gauges never appeared in metrics")
+
+
+def test_cli_stack_and_logs(cluster, capsys):
+    from ray_tpu.scripts.cli import main
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote
+    class Holder:
+        def ping(self):
+            return 1
+
+    h = Holder.remote()
+    ray_tpu.get(h.ping.remote())
+    main(["stack", "--address", cluster.address])
+    out = capsys.readouterr().out
+    assert "Holder" in out and ("File" in out or "unreachable" in out)
+
+    @ray_tpu.remote
+    def chatty():
+        print("cli-logs-marker")
+        return 1
+
+    ray_tpu.get(chatty.remote())
+    main(["logs", "--address", cluster.address, "--duration", "0.5"])
+    # The subscription attaches after the task printed, so the marker may
+    # or may not be replayed; the command itself must run cleanly.
+    capsys.readouterr()
+    ray_tpu.kill(h)
+    ray_tpu.shutdown()
+
+
 # -------------------------------------------------- log streaming to driver
 
 def test_worker_logs_stream_to_driver():
