@@ -154,11 +154,22 @@ def serve(service_name: str, handler_obj: Any, port: int = 0,
     server.add_generic_rpc_handlers((generic,))
     bound = server.add_insecure_port(f"{host}:{port}")
     server.start()
-    _start_lag_probe(service_name, executor)
+    probe_stop = _start_lag_probe(service_name, executor)
+    if probe_stop is not None:
+        # End the probe when the server stops (the caller keeps the server
+        # object alive, so a weakref on the executor alone would leak one
+        # probe thread per stopped server).
+        orig_stop = server.stop
+
+        def stop(grace=None):
+            probe_stop.set()
+            return orig_stop(grace)
+
+        server.stop = stop
     return server, bound
 
 
-def _start_lag_probe(service_name: str, executor) -> None:
+def _start_lag_probe(service_name: str, executor):
     """Event-loop instrumentation (reference C6: instrumented_io_context /
     event_stats.h loop-lag stats). The threaded analog: periodically submit
     a no-op into the server's executor and gauge how long it queued — a
@@ -166,14 +177,15 @@ def _start_lag_probe(service_name: str, executor) -> None:
     try:
         lag = _lag_gauges()
     except Exception:  # noqa: BLE001
-        return
+        return None
 
     import weakref
 
     ref = weakref.ref(executor)
+    stop = threading.Event()
 
     def probe():
-        while True:
+        while not stop.wait(2.0):
             ex = ref()
             if ex is None:
                 return
@@ -197,10 +209,10 @@ def _start_lag_probe(service_name: str, executor) -> None:
             except Exception:  # noqa: BLE001
                 return
             del ex
-            time.sleep(2.0)
 
     threading.Thread(target=probe, daemon=True,
                      name=f"rpc-lag-{service_name}").start()
+    return stop
 
 
 _lag_metrics = None

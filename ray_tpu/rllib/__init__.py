@@ -10,20 +10,24 @@ from ray_tpu.rllib.core import (
     Transition,
     compute_gae,
 )
+from ray_tpu.rllib.core import ImpalaLearner, vtrace
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import (
     EnvRunnerGroup,
     SingleAgentEnvRunner,
+    TrajectoryEnvRunner,
     TransitionEnvRunner,
 )
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.learner_group import LearnerGroup
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
 __all__ = [
     "DQN", "DQNConfig", "DQNLearner", "DQNModule", "EnvRunnerGroup",
-    "LearnerGroup", "PPO", "PPOConfig", "PPOLearner", "PPOModule",
-    "ReplayBuffer", "SampleBatch", "SingleAgentEnvRunner", "Transition",
-    "TransitionEnvRunner", "compute_gae",
+    "IMPALA", "IMPALAConfig", "ImpalaLearner", "LearnerGroup", "PPO",
+    "PPOConfig", "PPOLearner", "PPOModule", "ReplayBuffer", "SampleBatch",
+    "SingleAgentEnvRunner", "TrajectoryEnvRunner", "Transition",
+    "TransitionEnvRunner", "compute_gae", "vtrace",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

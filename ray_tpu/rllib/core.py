@@ -166,6 +166,114 @@ class PPOLearner:
         self.params = jax.tree.map(jnp.asarray, weights)
 
 
+def vtrace(target_logp, behavior_logp, rewards, dones, values,
+           bootstrap_value, gamma, rho_bar=1.0, c_bar=1.0):
+    """V-trace off-policy corrected value targets + policy-gradient
+    advantages (reference: IMPALA — ``rllib/algorithms/impala``; the
+    algorithm of Espeholt et al. 2018, implemented here as a jit-friendly
+    reversed ``lax.scan`` over the trajectory instead of a Python loop).
+
+    All inputs are [T, N]; ``bootstrap_value`` is [N]. Returns
+    ``(vs, pg_advantages)``, both [T, N], with gradients stopped.
+    """
+    rhos = jnp.exp(target_logp - behavior_logp)
+    clipped_rhos = jnp.minimum(rho_bar, rhos)
+    cs = jnp.minimum(c_bar, rhos)
+    discounts = gamma * (1.0 - dones)
+    next_values = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (rewards + discounts * next_values - values)
+
+    def backward(acc, t):
+        acc = deltas[t] + discounts[t] * cs[t] * acc
+        return acc, acc
+
+    _, vs_minus_v = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        jnp.arange(values.shape[0] - 1, -1, -1))
+    vs_minus_v = vs_minus_v[::-1]
+    vs = values + vs_minus_v
+    next_vs = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    pg_adv = clipped_rhos * (rewards + discounts * next_vs - values)
+    return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_adv)
+
+
+class ImpalaLearner:
+    """Jitted IMPALA learner: actor-critic update on V-trace-corrected
+    trajectories collected by decoupled (stale-policy) env runners
+    (reference: ``rllib/algorithms/impala`` — the learner half of the
+    decoupled actor/learner architecture; here the update is one jitted
+    function and gradients split from application so a LearnerGroup can
+    allreduce across learner actors)."""
+
+    def __init__(self, module: PPOModule, lr: float = 5e-4,
+                 gamma: float = 0.99, vf_coeff: float = 0.5,
+                 entropy_coeff: float = 0.01, rho_bar: float = 1.0,
+                 c_bar: float = 1.0, seed: int = 0):
+        self.module = module
+        self.optimizer = optax.adam(lr)
+        self.params = module.init(jax.random.PRNGKey(seed))
+        self.opt_state = self.optimizer.init(self.params)
+        mod, g, vf_c, ent_c = module, gamma, vf_coeff, entropy_coeff
+
+        def loss_fn(params, b):
+            T, N = b["actions"].shape
+            flat_obs = b["obs"].reshape((T * N,) + b["obs"].shape[2:])
+            logits = mod.logits(params, flat_obs).reshape((T, N, -1))
+            values = mod.value(params, flat_obs).reshape((T, N))
+            bootstrap = mod.value(params, b["bootstrap_obs"])
+            logp_all = jax.nn.log_softmax(logits)
+            logp = jnp.take_along_axis(
+                logp_all, b["actions"][..., None], axis=-1)[..., 0]
+            vs, pg_adv = vtrace(logp, b["behavior_logp"], b["rewards"],
+                                b["dones"], values, bootstrap, g,
+                                rho_bar, c_bar)
+            pg_loss = -jnp.mean(logp * pg_adv)
+            vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
+            entropy = -jnp.mean(
+                jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+            total = pg_loss + vf_c * vf_loss - ent_c * entropy
+            return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                           "entropy": entropy,
+                           "mean_rho": jnp.mean(
+                               jnp.exp(logp - b["behavior_logp"]))}
+
+        self._grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply_fn = jax.jit(apply_fn)
+
+    @staticmethod
+    def _to_device(traj: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        return {k: jnp.asarray(v) for k, v in traj.items()}
+
+    def compute_gradients(self, traj: Dict[str, np.ndarray]):
+        (loss, metrics), grads = self._grad_fn(self.params,
+                                               self._to_device(traj))
+        metrics = {k: float(v) for k, v in metrics.items()}
+        metrics["total_loss"] = float(loss)
+        return grads, metrics
+
+    def apply_gradients(self, grads) -> None:
+        self.params, self.opt_state = self._apply_fn(
+            self.params, self.opt_state, grads)
+
+    def update_from_batch(self, traj) -> Dict[str, float]:
+        grads, metrics = self.compute_gradients(traj)
+        self.apply_gradients(grads)
+        return metrics
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
 class DQNModule:
     """Q-network module for discrete action spaces (reference:
     ``rllib/algorithms/dqn`` default RLModule)."""

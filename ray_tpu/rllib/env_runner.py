@@ -127,6 +127,74 @@ class EnvRunnerGroup:
         return batches, episode_returns
 
 
+class TrajectoryEnvRunner:
+    """Decoupled IMPALA-style rollout collector: steps its (stale) behavior
+    policy and returns raw [T, N] trajectories with behavior log-probs for
+    V-trace correction (reference: the actor half of
+    ``rllib/algorithms/impala`` — actors never wait for the learner)."""
+
+    def __init__(self, env_creator: Callable, module_spec: Dict[str, Any],
+                 num_envs: int = 1, seed: int = 0):
+        import gymnasium as gym
+        import jax
+
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda i=i: env_creator() for i in range(num_envs)])
+        self.num_envs = num_envs
+        self.module = PPOModule(**module_spec)
+        self.params = None
+        self.rng = np.random.default_rng(seed)
+        self._jax = jax
+        self._logp = jax.jit(
+            lambda p, o: jax.nn.log_softmax(self.module.logits(p, o)))
+        self.obs, _ = self.envs.reset(seed=seed)
+        self._episode_returns = np.zeros(num_envs, dtype=np.float64)
+        self._finished_returns: List[float] = []
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+
+        self.params = self._jax.tree.map(jnp.asarray, weights)
+        return True
+
+    def sample(self, num_steps: int):
+        T, N = num_steps, self.num_envs
+        obs_buf = np.zeros((T, N) + self.obs.shape[1:], np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        logp_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        for t in range(T):
+            logp_all = np.asarray(
+                self._logp(self.params, self.obs.astype(np.float32)))
+            probs = np.exp(logp_all)
+            probs /= probs.sum(-1, keepdims=True)
+            actions = np.array([self.rng.choice(len(p), p=p)
+                                for p in probs])
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = logp_all[np.arange(N), actions]
+            self.obs, rewards, terms, truncs, _ = self.envs.step(actions)
+            dones = np.logical_or(terms, truncs)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            self._episode_returns += rewards
+            for i, d in enumerate(dones):
+                if d:
+                    self._finished_returns.append(self._episode_returns[i])
+                    self._episode_returns[i] = 0.0
+        traj = {
+            "obs": obs_buf, "actions": act_buf, "behavior_logp": logp_buf,
+            "rewards": rew_buf, "dones": done_buf,
+            "bootstrap_obs": self.obs.astype(np.float32),
+        }
+        finished, self._finished_returns = self._finished_returns, []
+        return traj, finished
+
+    def ping(self):
+        return True
+
+
 class TransitionEnvRunner:
     """Epsilon-greedy transition collector for value-based algorithms
     (reference: the DQN rollout path of ``single_agent_env_runner.py`` —

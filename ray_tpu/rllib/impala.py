@@ -1,0 +1,198 @@
+"""IMPALA: decoupled actor/learner RL with V-trace correction.
+
+Reference: ``rllib/algorithms/impala`` — env-runner actors sample with a
+stale behavior policy and never block on the learner; the learner consumes
+trajectories as they arrive and corrects the off-policyness with V-trace
+(``core.vtrace``). This build keeps rollouts in flight continuously: each
+``train()`` waits for whichever runner finishes first, updates the
+multi-learner :class:`~ray_tpu.rllib.learner_group.LearnerGroup`, pushes
+fresh weights to that runner only, and immediately resubmits its next
+rollout — the other runners keep sampling under their older policies, which
+is exactly the staleness V-trace exists to correct.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.env_runner import TrajectoryEnvRunner
+from ray_tpu.rllib.learner_group import LearnerGroup
+
+
+@dataclasses.dataclass
+class IMPALAConfig:
+    env: Optional[str] = None
+    env_creator: Optional[Callable] = None
+    num_env_runners: int = 2
+    num_envs_per_env_runner: int = 4
+    rollout_fragment_length: int = 32
+    lr: float = 5e-4
+    gamma: float = 0.99
+    vf_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    rho_bar: float = 1.0
+    c_bar: float = 1.0
+    updates_per_iteration: int = 8
+    num_learners: int = 1
+    hidden_sizes: tuple = (64, 64)
+    seed: int = 0
+
+    # -- fluent builder (reference AlgorithmConfig style) ------------------
+    def environment(self, env: Optional[str] = None, *,
+                    env_creator: Optional[Callable] = None
+                    ) -> "IMPALAConfig":
+        self.env = env
+        self.env_creator = env_creator
+        return self
+
+    def env_runners(self, num_env_runners: Optional[int] = None,
+                    num_envs_per_env_runner: Optional[int] = None,
+                    rollout_fragment_length: Optional[int] = None
+                    ) -> "IMPALAConfig":
+        for k, v in dict(num_env_runners=num_env_runners,
+                         num_envs_per_env_runner=num_envs_per_env_runner,
+                         rollout_fragment_length=rollout_fragment_length
+                         ).items():
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def training(self, **kwargs) -> "IMPALAConfig":
+        known = {f.name for f in dataclasses.fields(self)}
+        bad = set(kwargs) - known
+        if bad:
+            raise ValueError(f"Unknown IMPALA training options: "
+                             f"{sorted(bad)}")
+        for k, v in kwargs.items():
+            if v is not None:
+                setattr(self, k, v)
+        return self
+
+    def learners(self, num_learners: Optional[int] = None) -> "IMPALAConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+def _resolve_env(config) -> Callable:
+    if config.env_creator is not None:
+        return config.env_creator
+    if config.env is None:
+        raise ValueError("IMPALAConfig needs .environment(env=...) or "
+                         "env_creator")
+    import gymnasium as gym
+
+    name = config.env
+    return lambda: gym.make(name)
+
+
+class IMPALA:
+    def __init__(self, config: IMPALAConfig):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.config = config
+        creator = _resolve_env(config)
+        probe = creator()
+        obs_dim = int(np.prod(probe.observation_space.shape))
+        num_actions = int(probe.action_space.n)
+        probe.close()
+        module_spec = {"obs_dim": obs_dim, "num_actions": num_actions,
+                       "hidden": tuple(config.hidden_sizes)}
+        self._spec = module_spec
+        self._creator = creator
+        cfg = config
+
+        def builder():
+            from ray_tpu.rllib.core import ImpalaLearner, PPOModule
+
+            return ImpalaLearner(PPOModule(**module_spec), lr=cfg.lr,
+                                 gamma=cfg.gamma, vf_coeff=cfg.vf_coeff,
+                                 entropy_coeff=cfg.entropy_coeff,
+                                 rho_bar=cfg.rho_bar, c_bar=cfg.c_bar,
+                                 seed=cfg.seed)
+
+        self.learner_group = LearnerGroup(builder,
+                                          num_learners=config.num_learners)
+        runner_cls = ray_tpu.remote(TrajectoryEnvRunner)
+        self.runners = [
+            runner_cls.remote(creator, module_spec,
+                              config.num_envs_per_env_runner, seed)
+            for seed in range(config.num_env_runners)
+        ]
+        weights = self.learner_group.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners],
+                    timeout=120)
+        # Continuous in-flight rollouts: ref -> runner index.
+        self._inflight: Dict[Any, int] = {
+            r.sample.remote(config.rollout_fragment_length): i
+            for i, r in enumerate(self.runners)}
+        self.iteration = 0
+        self._returns: List[float] = []
+
+    def train(self) -> Dict[str, Any]:
+        """One iteration = ``updates_per_iteration`` learner updates, each
+        on the first trajectory to arrive (actors stay decoupled)."""
+        c = self.config
+        t0 = time.monotonic()
+        metrics: Dict[str, float] = {}
+        episodes = 0
+        for _ in range(c.updates_per_iteration):
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1,
+                                    timeout=300)
+            if not ready:
+                break
+            ref = ready[0]
+            idx = self._inflight.pop(ref)
+            try:
+                traj, finished = ray_tpu.get(ref, timeout=60)
+            except Exception:  # noqa: BLE001 — runner died: respawn
+                runner_cls = ray_tpu.remote(TrajectoryEnvRunner)
+                self.runners[idx] = runner_cls.remote(
+                    self._creator, self._spec, c.num_envs_per_env_runner,
+                    c.seed + 1000 + idx)
+                ray_tpu.get(self.runners[idx].set_weights.remote(
+                    self.learner_group.get_weights()), timeout=120)
+                self._inflight[self.runners[idx].sample.remote(
+                    c.rollout_fragment_length)] = idx
+                continue
+            self._returns.extend(finished)
+            episodes += len(finished)
+            metrics = self.learner_group.update(traj)
+            # Fresh weights to the runner that just delivered; resubmit.
+            runner = self.runners[idx]
+            runner.set_weights.remote(self.learner_group.get_weights())
+            self._inflight[runner.sample.remote(
+                c.rollout_fragment_length)] = idx
+        self._returns = self._returns[-100:]
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "episode_return_mean": (float(np.mean(self._returns))
+                                    if self._returns else float("nan")),
+            "episodes_this_iter": episodes,
+            "time_this_iter_s": time.monotonic() - t0,
+            **metrics,
+        }
+
+    def get_weights(self):
+        return self.learner_group.get_weights()
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        for a in self.learner_group.learners:
+            try:
+                ray_tpu.kill(a)
+            except Exception:  # noqa: BLE001
+                pass

@@ -82,7 +82,27 @@ class LearnerGroup:
     def _shard(batch, n: int) -> List[Any]:
         if n == 1:
             return [batch]
+        if isinstance(batch, dict):
+            # [T, N] trajectory dict (IMPALA): shard along the env axis;
+            # bootstrap_obs is [N]-leading.
+            size = batch["actions"].shape[1]
+            if size < n:
+                # Fewer envs than learners: every learner grads the full
+                # batch — the allreduce average then equals a single
+                # learner's update (zero-width shards would reshape-crash
+                # and NaN the mean).
+                return [batch] * n
+            bounds = [size * i // n for i in range(n + 1)]
+            out = []
+            for i in range(n):
+                lo, hi = bounds[i], bounds[i + 1]
+                out.append({k: (v[lo:hi] if k == "bootstrap_obs"
+                                else v[:, lo:hi])
+                            for k, v in batch.items()})
+            return out
         size = len(batch.obs)
+        if size < n:
+            return [batch] * n
         bounds = [size * i // n for i in range(n + 1)]
         return [type(batch)(*[f[bounds[i]:bounds[i + 1]] for f in batch])
                 for i in range(n)]

@@ -7,6 +7,7 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
+    TPESearch,
     choice,
     grid_search,
     loguniform,
@@ -26,9 +27,9 @@ from ray_tpu.tune.tuner import (
 
 __all__ = [
     "AsyncHyperBandScheduler", "FIFOScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "ResultGrid", "TrialResult", "TuneConfig",
-    "Tuner", "choice", "get_checkpoint", "grid_search", "loguniform",
-    "randint", "report", "run", "sample_from", "uniform",
+    "PopulationBasedTraining", "ResultGrid", "TPESearch", "TrialResult",
+    "TuneConfig", "Tuner", "choice", "get_checkpoint", "grid_search",
+    "loguniform", "randint", "report", "run", "sample_from", "uniform",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

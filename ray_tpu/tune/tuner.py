@@ -80,6 +80,10 @@ class TuneConfig:
     max_concurrent_trials: Optional[int] = None
     scheduler: Any = None
     search_seed: Optional[int] = None
+    # Model-based searcher (e.g. search.TPESearch): proposes configs
+    # sequentially from completed-trial scores instead of the upfront
+    # random/grid expansion (reference: tune/search/ search algorithms).
+    search_alg: Any = None
 
 
 @dataclasses.dataclass
@@ -195,13 +199,30 @@ class Tuner:
                 else:
                     results.append(r)
             checkpoints: Dict[str, Any] = dict(state["checkpoints"])
+            # A restored search_alg (pickled inside tune_config with its
+            # observation history) keeps proposing the not-yet-run samples;
+            # trials already proposed (finished or snapshotted as pending)
+            # count toward num_samples.
+            search_alg = tc.search_alg
+            proposed = len(results) + len(pending)
+        elif tc.search_alg is not None:
+            search_alg = tc.search_alg
+            pending = []  # proposed one at a time in the loop below
+            checkpoints = {}
+            proposed = 0
         else:
             generator = BasicVariantGenerator(tc.num_samples, tc.search_seed)
             configs = list(generator.variants(self.param_space))
             pending = [(f"trial_{i:05d}_{uuid.uuid4().hex[:6]}", cfg, None)
                        for i, cfg in enumerate(configs)]
             checkpoints = {}
-        limit = tc.max_concurrent_trials or max(len(pending), 1)
+            search_alg = None
+            proposed = 0
+        if search_alg is not None:
+            search_alg.configure(self.param_space, tc.metric, tc.mode,
+                                 tc.search_seed)
+        limit = tc.max_concurrent_trials or max(len(pending), 1,
+                                                4 if search_alg else 1)
 
         trial_cls = ray_tpu.remote(_TrialActor)
         running: Dict[str, Dict[str, Any]] = {}
@@ -250,18 +271,31 @@ class Tuner:
             st.update(actor=actor, config=cfg, run_ref=run_ref)
             running[trial_id] = st
 
-        while pending or running:
+        def finish(tr: TrialResult):
+            results.append(tr)
+            if search_alg is not None and tr.metrics and tc.metric and \
+                    tc.metric in tr.metrics:
+                search_alg.on_trial_complete(tr.config,
+                                             float(tr.metrics[tc.metric]))
+
+        while pending or running or \
+                (search_alg is not None and proposed < tc.num_samples):
             # Launch up to the concurrency limit.
             while pending and len(running) < limit:
                 trial_id, cfg, ckpt = pending.pop(0)
                 launch(trial_id, cfg, checkpoint=ckpt)
+            while search_alg is not None and proposed < tc.num_samples \
+                    and len(running) < limit:
+                cfg = search_alg.suggest()
+                launch(f"trial_{proposed:05d}_{uuid.uuid4().hex[:6]}", cfg)
+                proposed += 1
             snapshot_state()
             # Poll every running trial.
             for trial_id, st in list(running.items()):
                 try:
                     poll = ray_tpu.get(st["actor"].poll.remote(), timeout=30)
                 except Exception as e:  # actor died
-                    results.append(TrialResult(
+                    finish(TrialResult(
                         trial_id, st["config"],
                         st["history"][-1] if st["history"] else None,
                         st["history"], error=str(e)))
@@ -295,7 +329,7 @@ class Tuner:
                         continue
                 if stop and not poll["finished"]:
                     ray_tpu.kill(st["actor"])
-                    results.append(TrialResult(
+                    finish(TrialResult(
                         trial_id, st["config"],
                         st["history"][-1] if st["history"] else None,
                         st["history"], stopped_early=True))
@@ -307,7 +341,7 @@ class Tuner:
                         ray_tpu.get(st["run_ref"], timeout=30)
                     except Exception as e:  # noqa: BLE001
                         error = str(e)
-                    results.append(TrialResult(
+                    finish(TrialResult(
                         trial_id, st["config"],
                         st["history"][-1] if st["history"] else None,
                         st["history"], error=error))

@@ -152,3 +152,56 @@ def test_tuner_restore_keeps_completed_results(ray_start_regular, tmp_path):
     # Nothing to rerun: completed results round-trip through the snapshot.
     assert len(grid2) == 3 and not grid2.errors
     assert grid2.get_best_result().metrics["score"] == 3
+
+
+# ------------------------------------------------------------ TPE search
+
+def test_tpe_search_concentrates_on_optimum(ray8):
+    """Model-based search (TPESearch) must concentrate samples near the
+    optimum and beat the random-startup phase (reference: the BayesOpt-class
+    searchers under python/ray/tune/search/)."""
+    def objective(config):
+        x, y = config["x"], config["y"]
+        return {"loss": (x - 0.3) ** 2 + (y + 0.2) ** 2}
+
+    results = tune.Tuner(
+        objective,
+        param_space={"x": tune.uniform(-1, 1), "y": tune.uniform(-1, 1)},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=40,
+            max_concurrent_trials=1,
+            search_alg=tune.TPESearch(n_startup=10, seed=7)),
+    ).fit()
+    assert len(results) == 40
+    losses = [r.metrics["loss"] for r in results]
+    assert min(losses) < 0.05
+    # Later proposals (model-guided) concentrate vs the random startup.
+    assert sum(losses[-10:]) / 10 < sum(losses[:10]) / 10
+
+
+def test_tpe_mixed_space_types(ray8):
+    """TPE handles categorical / randint / loguniform dimensions."""
+    def objective(config):
+        bonus = 1.0 if config["act"] == "gelu" else 0.0
+        return {"score": bonus - abs(config["layers"] - 6) * 0.1
+                - abs(config["lr"] - 1e-3)}
+
+    results = tune.Tuner(
+        objective,
+        param_space={"act": tune.choice(["relu", "gelu", "silu"]),
+                     "layers": tune.randint(2, 12),
+                     "lr": tune.loguniform(1e-5, 1e-1)},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", num_samples=25,
+            max_concurrent_trials=1,
+            search_alg=tune.TPESearch(n_startup=8, seed=3)),
+    ).fit()
+    assert len(results) == 25
+    best = results.get_best_result()
+    assert best.config["act"] in ("relu", "gelu", "silu")
+    assert isinstance(best.config["layers"], int)
+    assert 2 <= best.config["layers"] < 12
+    assert 1e-5 <= best.config["lr"] <= 1e-1
+    # The categorical model should discover the gelu bonus.
+    last = [r.config["act"] for r in list(results)[-8:]]
+    assert last.count("gelu") >= 4
