@@ -1,0 +1,79 @@
+// ray_tpu C++ worker API.
+//
+// Reference: the standalone C++ Ray API (cpp/include/ray/api.h in the
+// reference tree). This build's runtime is Python+gRPC, so the C++ binding
+// point is the framed-protobuf client gateway (ray_tpu/cross_language.py —
+// the Ray-Client-server analog): the C++ client submits named cross-language
+// functions, puts/gets language-neutral values, and reads the cluster KV,
+// all with plain sockets + libprotobuf (no gRPC/pickle dependency).
+//
+// Usage:
+//   ray_tpu::Client c;
+//   c.Connect("127.0.0.1", port);
+//   auto ref = c.Submit("add", {ray_tpu::V(int64_t(2)), V(int64_t(3))});
+//   ray_tpu::rpc::XLangValue out; std::string err;
+//   c.Get(ref, &out, &err);   // out.i() == 5
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "ray_tpu/protobuf/ray_tpu.pb.h"
+
+namespace ray_tpu {
+
+// Convenience constructors for the language-neutral value type.
+rpc::XLangValue V(double d);
+rpc::XLangValue V(int64_t i);
+rpc::XLangValue V(const std::string& s);
+rpc::XLangValue VBytes(const std::string& b);
+rpc::XLangValue VBool(bool f);
+
+class Client {
+ public:
+  Client() : fd_(-1) {}
+  ~Client();
+
+  // Connect to a ClientGateway (ray_tpu.cross_language.ClientGateway).
+  bool Connect(const std::string& host, int port);
+  void Close();
+
+  // Object store: put a value, returns the object id ("" on failure).
+  std::string Put(const rpc::XLangValue& value);
+
+  // Submit a registered cross-language function; returns the result
+  // object id ("" on failure). `resources` uses scheduler names
+  // ("CPU", "TPU", custom).
+  std::string Submit(const std::string& function,
+                     const std::vector<rpc::XLangValue>& args,
+                     const std::map<std::string, double>& resources = {});
+
+  // Block until the object is available (gateway-side timeout 120s) and
+  // fill `out`. Returns false with `error` set on task failure.
+  bool Get(const std::string& object_id, rpc::XLangValue* out,
+           std::string* error);
+
+  // Non-blocking readiness probe.
+  bool Wait(const std::string& object_id);
+
+  // Cluster KV (reference: ray internal KV).
+  bool KvPut(const std::string& ns, const std::string& key,
+             const std::string& value);
+  bool KvGet(const std::string& ns, const std::string& key,
+             std::string* value);
+
+  const std::string& last_error() const { return last_error_; }
+
+ private:
+  bool Call(uint8_t op, const std::string& body, std::string* reply);
+  bool SendAll(const char* data, size_t n);
+  bool RecvAll(char* data, size_t n);
+
+  int fd_;
+  std::string last_error_;
+};
+
+}  // namespace ray_tpu

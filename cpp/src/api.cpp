@@ -1,0 +1,228 @@
+// ray_tpu C++ worker API implementation — see include/ray_tpu/api.h.
+
+#include "ray_tpu/api.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+
+namespace ray_tpu {
+
+namespace {
+// Gateway ops (must match ray_tpu/cross_language.py).
+constexpr uint8_t kOpKvPut = 1;
+constexpr uint8_t kOpKvGet = 2;
+constexpr uint8_t kOpPut = 3;
+constexpr uint8_t kOpGet = 4;
+constexpr uint8_t kOpSubmit = 5;
+constexpr uint8_t kOpWait = 6;
+}  // namespace
+
+rpc::XLangValue V(double d) {
+  rpc::XLangValue v;
+  v.set_d(d);
+  return v;
+}
+rpc::XLangValue V(int64_t i) {
+  rpc::XLangValue v;
+  v.set_i(i);
+  return v;
+}
+rpc::XLangValue V(const std::string& s) {
+  rpc::XLangValue v;
+  v.set_s(s);
+  return v;
+}
+rpc::XLangValue VBytes(const std::string& b) {
+  rpc::XLangValue v;
+  v.set_b(b);
+  return v;
+}
+rpc::XLangValue VBool(bool f) {
+  rpc::XLangValue v;
+  v.set_flag(f);
+  return v;
+}
+
+Client::~Client() { Close(); }
+
+void Client::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+bool Client::Connect(const std::string& host, int port) {
+  Close();
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd_ < 0) {
+    last_error_ = "socket() failed";
+    return false;
+  }
+  int one = 1;
+  ::setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    last_error_ = "bad host address";
+    Close();
+    return false;
+  }
+  if (::connect(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    last_error_ = "connect() failed";
+    Close();
+    return false;
+  }
+  return true;
+}
+
+bool Client::SendAll(const char* data, size_t n) {
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd_, data + sent, n - sent, 0);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool Client::RecvAll(char* data, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd_, data + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool Client::Call(uint8_t op, const std::string& body, std::string* reply) {
+  if (fd_ < 0) {
+    last_error_ = "not connected";
+    return false;
+  }
+  // Frame: [u32le len][u8 op][body]; reply [u32le len][u8 ok][body].
+  uint32_t len = static_cast<uint32_t>(body.size());
+  char header[5];
+  std::memcpy(header, &len, 4);
+  header[4] = static_cast<char>(op);
+  if (!SendAll(header, 5) || !SendAll(body.data(), body.size())) {
+    last_error_ = "send failed";
+    Close();
+    return false;
+  }
+  char rhead[5];
+  if (!RecvAll(rhead, 5)) {
+    last_error_ = "recv failed";
+    Close();
+    return false;
+  }
+  uint32_t rlen;
+  std::memcpy(&rlen, rhead, 4);
+  reply->resize(rlen);
+  if (rlen > 0 && !RecvAll(&(*reply)[0], rlen)) {
+    last_error_ = "recv failed";
+    Close();
+    return false;
+  }
+  if (rhead[4] == 0) {
+    last_error_ = *reply;  // gateway sends the error text as the body
+    return false;
+  }
+  return true;
+}
+
+std::string Client::Put(const rpc::XLangValue& value) {
+  std::string reply;
+  if (!Call(kOpPut, value.SerializeAsString(), &reply)) return "";
+  rpc::GatewayRef ref;
+  if (!ref.ParseFromString(reply)) {
+    last_error_ = "bad GatewayRef reply";
+    return "";
+  }
+  return ref.object_id();
+}
+
+std::string Client::Submit(const std::string& function,
+                           const std::vector<rpc::XLangValue>& args,
+                           const std::map<std::string, double>& resources) {
+  rpc::XLangCall call;
+  call.set_function(function);
+  for (const auto& a : args) *call.add_args() = a;
+  for (const auto& kv : resources)
+    (*call.mutable_resources())[kv.first] = kv.second;
+  std::string reply;
+  if (!Call(kOpSubmit, call.SerializeAsString(), &reply)) return "";
+  rpc::GatewayRef ref;
+  if (!ref.ParseFromString(reply)) {
+    last_error_ = "bad GatewayRef reply";
+    return "";
+  }
+  return ref.object_id();
+}
+
+bool Client::Get(const std::string& object_id, rpc::XLangValue* out,
+                 std::string* error) {
+  rpc::GatewayRef ref;
+  ref.set_object_id(object_id);
+  std::string reply;
+  if (!Call(kOpGet, ref.SerializeAsString(), &reply)) {
+    if (error) *error = last_error_;
+    return false;
+  }
+  rpc::XLangResult result;
+  if (!result.ParseFromString(reply)) {
+    last_error_ = "bad XLangResult reply";
+    if (error) *error = last_error_;
+    return false;
+  }
+  if (!result.ok()) {
+    if (error) *error = result.error();
+    return false;
+  }
+  *out = result.value();
+  return true;
+}
+
+bool Client::Wait(const std::string& object_id) {
+  rpc::GatewayRef ref;
+  ref.set_object_id(object_id);
+  std::string reply;
+  if (!Call(kOpWait, ref.SerializeAsString(), &reply)) return false;
+  rpc::XLangResult result;
+  return result.ParseFromString(reply) && result.ok();
+}
+
+bool Client::KvPut(const std::string& ns, const std::string& key,
+                   const std::string& value) {
+  rpc::KvRequest req;
+  req.set_ns(ns);
+  req.set_key(key);
+  req.set_value(value);
+  req.set_overwrite(true);
+  std::string reply;
+  if (!Call(kOpKvPut, req.SerializeAsString(), &reply)) return false;
+  rpc::KvReply kv;
+  return kv.ParseFromString(reply) && kv.ok();
+}
+
+bool Client::KvGet(const std::string& ns, const std::string& key,
+                   std::string* value) {
+  rpc::KvRequest req;
+  req.set_ns(ns);
+  req.set_key(key);
+  std::string reply;
+  if (!Call(kOpKvGet, req.SerializeAsString(), &reply)) return false;
+  rpc::KvReply kv;
+  if (!kv.ParseFromString(reply) || !kv.found()) return false;
+  *value = kv.value();
+  return true;
+}
+
+}  // namespace ray_tpu

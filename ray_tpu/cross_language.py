@@ -1,0 +1,263 @@
+"""Cross-language function registry + client gateway.
+
+Reference: two reference components collapse into one mechanism here —
+``ray.cross_language`` (calling functions across language workers by
+descriptor) and the Ray Client server (``util/client/server/server.py:96``,
+a proxy that runs driver operations on behalf of a remote thin client).
+
+Python registers functions by name (exported through the GCS KV, like the
+reference's function exports); any non-Python client connects to the
+:class:`ClientGateway` over a framed-protobuf TCP socket and submits calls
+by name with language-neutral ``XLangValue`` arguments. The gateway is a
+real driver: it resolves the named function, submits it through the normal
+task path, and translates results back — so the C++ API in ``cpp/`` gets
+tasks, objects, and the KV without needing a gRPC or pickle stack.
+
+Wire protocol (little-endian): request ``[u32 len][u8 op][protobuf]``,
+reply ``[u32 len][u8 ok][protobuf]``. Ops: 1 KvPut, 2 KvGet, 3 Put,
+4 Get, 5 Submit, 6 Wait.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+logger = logging.getLogger(__name__)
+
+_KV_NS = "__xlang_fns__"
+
+OP_KV_PUT = 1
+OP_KV_GET = 2
+OP_PUT = 3
+OP_GET = 4
+OP_SUBMIT = 5
+OP_WAIT = 6
+
+
+def register_function(name: str, fn=None):
+    """Export ``fn`` under ``name`` for cross-language callers
+    (reference: function exports via GCS KV). Usable as a decorator."""
+    from ray_tpu.experimental.internal_kv import internal_kv_put
+
+    def do(f):
+        internal_kv_put(name, cloudpickle.dumps(f), overwrite=True,
+                        namespace=_KV_NS)
+        return f
+
+    return do if fn is None else do(fn)
+
+
+def to_xlang_value(v) -> "Any":
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    out = pb.XLangValue()
+    if isinstance(v, bool):
+        out.flag = v
+    elif isinstance(v, int):
+        out.i = v
+    elif isinstance(v, float):
+        out.d = v
+    elif isinstance(v, str):
+        out.s = v
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        out.b = bytes(v)
+    else:
+        raise TypeError(
+            f"value of type {type(v).__name__} is not cross-language "
+            "portable (use float/int/str/bytes/bool)")
+    return out
+
+
+def from_xlang_value(x) -> Any:
+    kind = x.WhichOneof("kind")
+    if kind is None:
+        return None
+    return getattr(x, kind)
+
+
+class ClientGateway:
+    """Framed-protobuf TCP server proxying a driver for thin clients."""
+
+    def __init__(self, gcs_address: str, host: str = "127.0.0.1",
+                 port: int = 0):
+        import ray_tpu
+
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(address=gcs_address, ignore_reinit_error=True)
+        self._ray = ray_tpu
+        self._fns: Dict[str, Any] = {}          # name -> remote function
+        self._refs: Dict[bytes, Any] = {}       # object id -> ObjectRef
+        self._lock = threading.Lock()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="xlang-gateway")
+        self._thread.start()
+
+    # ------------------------------------------------------------ serving
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                header = self._recv_exact(conn, 5)
+                if header is None:
+                    return
+                (length,), op = struct.unpack("<I", header[:4]), header[4]
+                body = self._recv_exact(conn, length)
+                if body is None:
+                    return
+                try:
+                    ok, reply = self._dispatch(op, body)
+                except Exception as e:  # noqa: BLE001
+                    ok, reply = False, str(e).encode()
+                conn.sendall(struct.pack("<IB", len(reply), 1 if ok else 0)
+                             + reply)
+        except Exception:  # noqa: BLE001 — client went away
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv_exact(conn, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    # ------------------------------------------------------------ dispatch
+    def _dispatch(self, op: int, body: bytes):
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        ray_tpu = self._ray
+        if op == OP_KV_PUT:
+            from ray_tpu.experimental.internal_kv import internal_kv_put
+
+            req = pb.KvRequest.FromString(body)
+            ok = internal_kv_put(req.key, bytes(req.value), overwrite=True,
+                                 namespace=req.ns or "default")
+            return True, pb.KvReply(ok=bool(ok)).SerializeToString()
+        if op == OP_KV_GET:
+            from ray_tpu.experimental.internal_kv import internal_kv_get
+
+            req = pb.KvRequest.FromString(body)
+            val = internal_kv_get(req.key, namespace=req.ns or "default")
+            if val is None:
+                return True, pb.KvReply(found=False).SerializeToString()
+            return True, pb.KvReply(found=True,
+                                    value=val).SerializeToString()
+        if op == OP_PUT:
+            val = from_xlang_value(pb.XLangValue.FromString(body))
+            ref = ray_tpu.put(val)
+            with self._lock:
+                self._refs[ref.id().binary()] = ref
+            return True, pb.GatewayRef(
+                object_id=ref.id().binary()).SerializeToString()
+        if op == OP_GET:
+            ref_msg = pb.GatewayRef.FromString(body)
+            with self._lock:
+                ref = self._refs.get(bytes(ref_msg.object_id))
+            if ref is None:
+                return True, pb.XLangResult(
+                    ok=False,
+                    error="unknown object id (gateway-held refs only)"
+                ).SerializeToString()
+            try:
+                value = ray_tpu.get(ref, timeout=120)
+                return True, pb.XLangResult(
+                    ok=True,
+                    value=to_xlang_value(value)).SerializeToString()
+            except Exception as e:  # noqa: BLE001
+                return True, pb.XLangResult(
+                    ok=False, error=str(e)).SerializeToString()
+        if op == OP_SUBMIT:
+            call = pb.XLangCall.FromString(body)
+            fn = self._resolve(call.function)
+            args = [from_xlang_value(a) for a in call.args]
+            opts = {}
+            res = dict(call.resources)
+            if "CPU" in res:
+                opts["num_cpus"] = res.pop("CPU")
+            if "TPU" in res:
+                opts["num_tpus"] = res.pop("TPU")
+            if res:
+                opts["resources"] = res
+            remote = fn.options(**opts) if opts else fn
+            ref = remote.remote(*args)
+            with self._lock:
+                self._refs[ref.id().binary()] = ref
+            return True, pb.GatewayRef(
+                object_id=ref.id().binary()).SerializeToString()
+        if op == OP_WAIT:
+            ref_msg = pb.GatewayRef.FromString(body)
+            with self._lock:
+                ref = self._refs.get(bytes(ref_msg.object_id))
+            ready = []
+            if ref is not None:
+                ready, _ = ray_tpu.wait([ref], timeout=0)
+            return True, pb.XLangResult(
+                ok=bool(ready)).SerializeToString()
+        raise ValueError(f"unknown gateway op {op}")
+
+    def _resolve(self, name: str):
+        with self._lock:
+            fn = self._fns.get(name)
+        if fn is not None:
+            return fn
+        import ray_tpu
+        from ray_tpu.experimental.internal_kv import internal_kv_get
+
+        blob = internal_kv_get(name, namespace=_KV_NS)
+        if blob is None:
+            raise KeyError(f"no cross-language function registered as "
+                           f"{name!r}")
+        fn = ray_tpu.remote(cloudpickle.loads(blob))
+        with self._lock:
+            self._fns[name] = fn
+        return fn
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def main(argv=None):  # pragma: no cover - thin CLI entry
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--address", required=True)
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args(argv)
+    gw = ClientGateway(args.address, port=args.port)
+    print(f"GATEWAY_PORT={gw.port}", flush=True)
+    import time
+
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,81 @@
+"""Internal KV: cluster-wide key/value store access.
+
+Reference: ``python/ray/experimental/internal_kv.py`` — the KV the runtime
+itself uses for function exports and runtime-env URIs, exposed for
+libraries. Cluster mode hits the GCS KV; the in-process LocalRuntime keeps
+a process-local dict with the same semantics.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+_local_kv = {}
+_local_lock = threading.Lock()
+
+
+def _gcs():
+    from ray_tpu._private import worker as _worker
+
+    core = _worker.global_worker().core
+    return getattr(core, "gcs", None)
+
+
+def _internal_kv_put(key: str, value: bytes, overwrite: bool = True,
+                     namespace: str = "default") -> bool:
+    gcs = _gcs()
+    if gcs is None:
+        with _local_lock:
+            if not overwrite and (namespace, key) in _local_kv:
+                return False
+            _local_kv[(namespace, key)] = bytes(value)
+        return True
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    reply = gcs.KvPut(pb.KvRequest(ns=namespace, key=key,
+                                   value=bytes(value), overwrite=overwrite))
+    return bool(reply.ok)
+
+
+def _internal_kv_get(key: str,
+                     namespace: str = "default") -> Optional[bytes]:
+    gcs = _gcs()
+    if gcs is None:
+        with _local_lock:
+            return _local_kv.get((namespace, key))
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    reply = gcs.KvGet(pb.KvRequest(ns=namespace, key=key))
+    return bytes(reply.value) if reply.found else None
+
+
+def _internal_kv_del(key: str, namespace: str = "default") -> bool:
+    gcs = _gcs()
+    if gcs is None:
+        with _local_lock:
+            return _local_kv.pop((namespace, key), None) is not None
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    return bool(gcs.KvDel(pb.KvRequest(ns=namespace, key=key)).ok)
+
+
+def _internal_kv_list(prefix: str = "",
+                      namespace: str = "default") -> List[str]:
+    gcs = _gcs()
+    if gcs is None:
+        with _local_lock:
+            return [k for ns, k in _local_kv
+                    if ns == namespace and k.startswith(prefix)]
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    return list(gcs.KvKeys(pb.KvRequest(ns=namespace,
+                                        prefix=prefix)).keys)
+
+
+# Public aliases (the reference names carry the leading underscore for
+# "internal but stable"; both spellings are accepted here).
+internal_kv_put = _internal_kv_put
+internal_kv_get = _internal_kv_get
+internal_kv_del = _internal_kv_del
+internal_kv_list = _internal_kv_list

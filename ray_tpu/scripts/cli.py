@@ -303,6 +303,20 @@ def cmd_stack(args):
             print(f"  <unreachable: {e}>")
 
 
+def cmd_gateway(args):
+    """Serve the cross-language client gateway (C++ API / thin remote
+    clients; reference: the Ray Client server)."""
+    from ray_tpu.cross_language import ClientGateway
+
+    gw = ClientGateway(args.address or _auto_address(), port=args.port)
+    print(f"GATEWAY_PORT={gw.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        gw.stop()
+
+
 def cmd_resources(args):
     import ray_tpu
 
@@ -386,6 +400,12 @@ def main(argv=None):
     p = sub.add_parser("resources", help="cluster total/available resources")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_resources)
+
+    p = sub.add_parser("gateway",
+                       help="serve the cross-language client gateway")
+    p.add_argument("--address")
+    p.add_argument("--port", type=int, default=0)
+    p.set_defaults(fn=cmd_gateway)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -118,10 +118,24 @@ class TPESearch:
 
     def configure(self, param_space: Dict[str, Any], metric: Optional[str],
                   mode: str, seed: Optional[int] = None) -> None:
+        if metric is None:
+            raise ValueError(
+                "TPESearch needs TuneConfig.metric set: without scores the "
+                "model never trains and every trial would be a silent "
+                "random draw")
+        if any(isinstance(v, GridSearch) for v in param_space.values()):
+            raise ValueError(
+                "grid_search() dimensions are exhaustive by contract and "
+                "a model-based searcher samples instead of enumerating — "
+                "use tune.choice() for TPE-searchable categoricals, or "
+                "drop search_alg to run the full grid")
         self._space = dict(param_space)
         self._metric = metric
         self._mode = mode
-        if seed is not None:
+        # Seed only a fresh searcher: a restored one (non-empty history)
+        # must keep its pickled RNG state or post-restore suggestions would
+        # replay the pre-crash random stream and duplicate early trials.
+        if seed is not None and not self._history:
             self.rng = random.Random(seed)
 
     # ------------------------------------------------------------ internals
@@ -213,12 +227,7 @@ class TPESearch:
         use_model = len(self._history) >= self.n_startup
         good, bad = self._split() if use_model else ([], [])
         for k, dom in self._space.items():
-            if isinstance(dom, GridSearch):
-                choice_v = (self._suggest_categorical(k, dom.values, good,
-                                                      bad)
-                            if use_model else self.rng.choice(dom.values))
-                cfg[k] = choice_v
-            elif isinstance(dom, Categorical):
+            if isinstance(dom, Categorical):
                 cfg[k] = (self._suggest_categorical(k, dom.categories, good,
                                                     bad)
                           if use_model else dom.sample(self.rng))
