@@ -73,6 +73,11 @@ int main(int argc, char** argv) {
   }
   std::printf("CHECK task error propagated\n");
 
+  // Release gateway-held pins.
+  if (!client.Free(oid) || !client.Free(ref)) return 1;
+  if (client.Get(oid, &out, &err)) return 1;  // freed -> unknown id
+  std::printf("CHECK free ok\n");
+
   std::printf("ALL CHECKS PASSED\n");
   return 0;
 }

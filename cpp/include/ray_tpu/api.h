@@ -59,6 +59,10 @@ class Client {
   // Non-blocking readiness probe.
   bool Wait(const std::string& object_id);
 
+  // Release the gateway's pin on an object (call when done with a ref;
+  // the gateway also caps held refs with oldest-first eviction).
+  bool Free(const std::string& object_id);
+
   // Cluster KV (reference: ray internal KV).
   bool KvPut(const std::string& ns, const std::string& key,
              const std::string& value);

@@ -20,6 +20,23 @@ constexpr uint8_t kOpPut = 3;
 constexpr uint8_t kOpGet = 4;
 constexpr uint8_t kOpSubmit = 5;
 constexpr uint8_t kOpWait = 6;
+constexpr uint8_t kOpFree = 7;
+
+// The wire protocol is explicitly little-endian; encode/decode byte-wise
+// so the client also works on big-endian hosts.
+void PutU32LE(uint32_t v, char* out) {
+  out[0] = static_cast<char>(v & 0xff);
+  out[1] = static_cast<char>((v >> 8) & 0xff);
+  out[2] = static_cast<char>((v >> 16) & 0xff);
+  out[3] = static_cast<char>((v >> 24) & 0xff);
+}
+
+uint32_t GetU32LE(const char* in) {
+  return static_cast<uint32_t>(static_cast<unsigned char>(in[0])) |
+         (static_cast<uint32_t>(static_cast<unsigned char>(in[1])) << 8) |
+         (static_cast<uint32_t>(static_cast<unsigned char>(in[2])) << 16) |
+         (static_cast<uint32_t>(static_cast<unsigned char>(in[3])) << 24);
+}
 }  // namespace
 
 rpc::XLangValue V(double d) {
@@ -108,9 +125,8 @@ bool Client::Call(uint8_t op, const std::string& body, std::string* reply) {
     return false;
   }
   // Frame: [u32le len][u8 op][body]; reply [u32le len][u8 ok][body].
-  uint32_t len = static_cast<uint32_t>(body.size());
   char header[5];
-  std::memcpy(header, &len, 4);
+  PutU32LE(static_cast<uint32_t>(body.size()), header);
   header[4] = static_cast<char>(op);
   if (!SendAll(header, 5) || !SendAll(body.data(), body.size())) {
     last_error_ = "send failed";
@@ -123,8 +139,7 @@ bool Client::Call(uint8_t op, const std::string& body, std::string* reply) {
     Close();
     return false;
   }
-  uint32_t rlen;
-  std::memcpy(&rlen, rhead, 4);
+  uint32_t rlen = GetU32LE(rhead);
   reply->resize(rlen);
   if (rlen > 0 && !RecvAll(&(*reply)[0], rlen)) {
     last_error_ = "recv failed";
@@ -195,6 +210,15 @@ bool Client::Wait(const std::string& object_id) {
   ref.set_object_id(object_id);
   std::string reply;
   if (!Call(kOpWait, ref.SerializeAsString(), &reply)) return false;
+  rpc::XLangResult result;
+  return result.ParseFromString(reply) && result.ok();
+}
+
+bool Client::Free(const std::string& object_id) {
+  rpc::GatewayRef ref;
+  ref.set_object_id(object_id);
+  std::string reply;
+  if (!Call(kOpFree, ref.SerializeAsString(), &reply)) return false;
   rpc::XLangResult result;
   return result.ParseFromString(reply) && result.ok();
 }

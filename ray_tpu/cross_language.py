@@ -15,7 +15,7 @@ tasks, objects, and the KV without needing a gRPC or pickle stack.
 
 Wire protocol (little-endian): request ``[u32 len][u8 op][protobuf]``,
 reply ``[u32 len][u8 ok][protobuf]``. Ops: 1 KvPut, 2 KvGet, 3 Put,
-4 Get, 5 Submit, 6 Wait.
+4 Get, 5 Submit, 6 Wait, 7 Free (release a gateway-held ref).
 """
 
 from __future__ import annotations
@@ -38,6 +38,12 @@ OP_PUT = 3
 OP_GET = 4
 OP_SUBMIT = 5
 OP_WAIT = 6
+OP_FREE = 7
+
+# Backstop for clients that never Free: the gateway pins at most this many
+# refs, evicting oldest-first (an evicted ref just loses its pin; the
+# cluster refcount plane frees the object when no one else holds it).
+MAX_HELD_REFS = 16384
 
 
 def register_function(name: str, fn=None):
@@ -91,8 +97,10 @@ class ClientGateway:
         if not ray_tpu.is_initialized():
             ray_tpu.init(address=gcs_address, ignore_reinit_error=True)
         self._ray = ray_tpu
-        self._fns: Dict[str, Any] = {}          # name -> remote function
-        self._refs: Dict[bytes, Any] = {}       # object id -> ObjectRef
+        self._fns: Dict[str, Any] = {}   # name -> (kv blob, remote function)
+        # object id -> ObjectRef, insertion-ordered for MAX_HELD_REFS
+        # eviction; clients release explicitly with OP_FREE.
+        self._refs: Dict[bytes, Any] = {}
         self._lock = threading.Lock()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -169,8 +177,7 @@ class ClientGateway:
         if op == OP_PUT:
             val = from_xlang_value(pb.XLangValue.FromString(body))
             ref = ray_tpu.put(val)
-            with self._lock:
-                self._refs[ref.id().binary()] = ref
+            self._hold(ref)
             return True, pb.GatewayRef(
                 object_id=ref.id().binary()).SerializeToString()
         if op == OP_GET:
@@ -204,8 +211,7 @@ class ClientGateway:
                 opts["resources"] = res
             remote = fn.options(**opts) if opts else fn
             ref = remote.remote(*args)
-            with self._lock:
-                self._refs[ref.id().binary()] = ref
+            self._hold(ref)
             return True, pb.GatewayRef(
                 object_id=ref.id().binary()).SerializeToString()
         if op == OP_WAIT:
@@ -217,23 +223,38 @@ class ClientGateway:
                 ready, _ = ray_tpu.wait([ref], timeout=0)
             return True, pb.XLangResult(
                 ok=bool(ready)).SerializeToString()
+        if op == OP_FREE:
+            ref_msg = pb.GatewayRef.FromString(body)
+            with self._lock:
+                found = self._refs.pop(bytes(ref_msg.object_id),
+                                       None) is not None
+            return True, pb.XLangResult(ok=found).SerializeToString()
         raise ValueError(f"unknown gateway op {op}")
 
-    def _resolve(self, name: str):
+    def _hold(self, ref) -> None:
         with self._lock:
-            fn = self._fns.get(name)
-        if fn is not None:
-            return fn
+            self._refs[ref.id().binary()] = ref
+            while len(self._refs) > MAX_HELD_REFS:
+                self._refs.pop(next(iter(self._refs)))
+
+    def _resolve(self, name: str):
         import ray_tpu
         from ray_tpu.experimental.internal_kv import internal_kv_get
 
+        # The KV is re-read every call (one cheap RPC) so re-registering a
+        # name takes effect immediately; the unpickle + remote-wrap is
+        # cached keyed on the blob bytes.
         blob = internal_kv_get(name, namespace=_KV_NS)
         if blob is None:
             raise KeyError(f"no cross-language function registered as "
                            f"{name!r}")
+        with self._lock:
+            cached = self._fns.get(name)
+            if cached is not None and cached[0] == blob:
+                return cached[1]
         fn = ray_tpu.remote(cloudpickle.loads(blob))
         with self._lock:
-            self._fns[name] = fn
+            self._fns[name] = (blob, fn)
         return fn
 
     def stop(self):
@@ -242,22 +263,3 @@ class ClientGateway:
             self._sock.close()
         except OSError:
             pass
-
-
-def main(argv=None):  # pragma: no cover - thin CLI entry
-    import argparse
-
-    p = argparse.ArgumentParser()
-    p.add_argument("--address", required=True)
-    p.add_argument("--port", type=int, default=0)
-    args = p.parse_args(argv)
-    gw = ClientGateway(args.address, port=args.port)
-    print(f"GATEWAY_PORT={gw.port}", flush=True)
-    import time
-
-    while True:
-        time.sleep(3600)
-
-
-if __name__ == "__main__":  # pragma: no cover
-    main()

@@ -187,8 +187,19 @@ def forward(
     config: LlamaConfig,
     mesh: Optional[Mesh] = None,
     return_hidden: bool = False,
-) -> jnp.ndarray:
-    """Compute logits [B, S, V] (fp32) for int32 tokens [B, S]."""
+    mlp_fn=None,
+):
+    """Compute logits [B, S, V] (fp32) for int32 tokens [B, S].
+
+    ``mlp_fn(h, layer) -> (out, aux_scalar)`` swaps the dense SwiGLU block
+    for another token-mixing-free sublayer — the MoE family
+    (:mod:`ray_tpu.models.mixtral`) routes through here so the attention
+    backbone, remat policy, and sharding constraints are shared, not
+    copied. With ``return_hidden=True`` the return value is the tuple
+    ``(hidden [B, S, E], aux_total)`` where ``aux_total`` is the per-layer
+    auxiliary scalar (router load-balancing loss) summed over layers;
+    otherwise just the logits array.
+    """
     c = config
     seq_len = tokens.shape[1]
     cos, sin = rope_frequencies(c.head_dim, seq_len, c.rope_theta)
@@ -198,7 +209,19 @@ def forward(
 
     from jax.ad_checkpoint import checkpoint_name
 
-    def layer_fn(x, layer):
+    def dense_mlp(h, layer):
+        gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(c.dtype))
+        up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
+        act = jax.nn.silu(gate) * up
+        if mesh is not None:
+            act = constrain(act, mesh, "batch", "seq", "act_mlp")
+        down = jnp.einsum("bsm,me->bse", act, layer["w_down"].astype(c.dtype))
+        return down, jnp.zeros((), jnp.float32)
+
+    mlp = mlp_fn or dense_mlp
+
+    def layer_fn(carry, layer):
+        x, aux_sum = carry
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
         q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(c.dtype))
         k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(c.dtype))
@@ -220,16 +243,11 @@ def forward(
             x = constrain(x, mesh, "batch", "seq", "act_embed")
 
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
-        gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(c.dtype))
-        up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
-        act = jax.nn.silu(gate) * up
-        if mesh is not None:
-            act = constrain(act, mesh, "batch", "seq", "act_mlp")
-        down = jnp.einsum("bsm,me->bse", act, layer["w_down"].astype(c.dtype))
+        down, aux = mlp(h, layer)
         x = x + down
         if mesh is not None:
             x = constrain(x, mesh, "batch", "seq", "act_embed")
-        return x, None
+        return (x, aux_sum + aux), None
 
     body = layer_fn
     if c.remat:
@@ -245,11 +263,13 @@ def forward(
                 "expected 'full' or 'mlp_only'"
             )
         body = jax.checkpoint(layer_fn, policy=policy)
-    x, _ = jax.lax.scan(lambda carry, lp: body(carry, lp), x, params["layers"])
+    (x, aux_total), _ = jax.lax.scan(
+        lambda carry, lp: body(carry, lp),
+        (x, jnp.zeros((), jnp.float32)), params["layers"])
 
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     if return_hidden:
-        return x
+        return x, aux_total
     logits = jnp.einsum(
         "bse,ev->bsv", x.astype(jnp.float32), params["lm_head"].astype(jnp.float32)
     )
@@ -263,9 +283,11 @@ def hidden_states(
     tokens: jnp.ndarray,
     config: LlamaConfig,
     mesh: Optional[Mesh] = None,
-) -> jnp.ndarray:
-    """Final-norm hidden states [B, S, E] (logits head applied separately)."""
-    return forward(params, tokens, config, mesh, return_hidden=True)
+    mlp_fn=None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(final-norm hidden states [B, S, E], summed aux scalar)."""
+    return forward(params, tokens, config, mesh, return_hidden=True,
+                   mlp_fn=mlp_fn)
 
 
 def loss_fn(
@@ -274,6 +296,8 @@ def loss_fn(
     config: LlamaConfig,
     mesh: Optional[Mesh] = None,
     vocab_chunks: int = 8,
+    mlp_fn=None,
+    aux_coeff: float = 0.0,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Next-token cross-entropy. batch: {"tokens": [B,S] int32, "mask": [B,S]}.
 
@@ -281,10 +305,15 @@ def loss_fn(
     [B, S, V] logits tensor is never materialized (V=32k dominates HBM at
     long seq) — the standard memory-side optimization for LLM training on
     16GB-HBM chips; remat recomputes each chunk's logits in the backward.
+
+    ``mlp_fn``/``aux_coeff`` support MoE variants: the per-layer auxiliary
+    scalar (router load balancing) is summed by the backbone and added to
+    the loss with weight ``aux_coeff``.
     """
     tokens = batch["tokens"]
     mask = batch.get("mask")
-    x = hidden_states(params, tokens, config, mesh)      # [B, S, E]
+    x, aux = hidden_states(params, tokens, config, mesh,
+                           mlp_fn=mlp_fn)                # [B, S, E]
     targets = tokens[:, 1:]
     x = x[:, :-1]
     m = (mask[:, 1:] if mask is not None else
@@ -320,7 +349,12 @@ def loss_fn(
     total = jnp.maximum(jnp.sum(m), 1.0)
     loss = nll_sum / total
     acc = correct_sum / total
-    return loss, {"loss": loss, "accuracy": acc, "tokens": total}
+    metrics = {"loss": loss, "accuracy": acc, "tokens": total}
+    if aux_coeff:
+        metrics["aux_loss"] = aux
+        loss = loss + aux_coeff * aux
+        metrics["total_loss"] = loss
+    return loss, metrics
 
 
 def num_params(config: LlamaConfig) -> int:

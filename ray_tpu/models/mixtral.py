@@ -1,0 +1,176 @@
+"""Mixtral-family sparse-MoE decoder: the second native model family.
+
+Reference: the reference framework hosts MoE models via external engines
+(SURVEY.md §2.3 — vLLM under ``python/ray/llm``); ray_tpu ships the model
+natively, TPU-first. The attention backbone, remat policy, scan layer
+stack, and GSPMD sharding constraints are the Llama ones
+(:mod:`ray_tpu.models.llama` with an ``mlp_fn`` hook) — this module swaps
+every dense SwiGLU block for a top-k routed expert layer
+(:func:`ray_tpu.ops.moe.moe_layer`) whose stacked expert weights carry the
+``experts`` logical axis, so dispatch/combine lower to ICI all-to-alls
+when the mesh has an ``expert`` axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ray_tpu.models import llama
+from ray_tpu.ops.moe import moe_layer
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 14336   # per-expert FFN width
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    max_seq_len: int = 32768
+    rope_theta: float = 1e6
+    rms_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    remat_policy: str = "full"
+    attention: str = "auto"
+    # MoE
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_coeff: float = 0.02          # router load-balancing weight
+
+    @staticmethod
+    def mixtral_8x7b(**kw) -> "MixtralConfig":
+        return MixtralConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "MixtralConfig":
+        """CPU-runnable config for tests."""
+        kw.setdefault("vocab_size", 256)
+        kw.setdefault("hidden_size", 64)
+        kw.setdefault("intermediate_size", 96)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("num_kv_heads", 2)
+        kw.setdefault("head_dim", 16)
+        kw.setdefault("max_seq_len", 128)
+        kw.setdefault("num_experts", 4)
+        kw.setdefault("top_k", 2)
+        kw.setdefault("remat", False)
+        return MixtralConfig(**kw)
+
+    def backbone(self) -> llama.LlamaConfig:
+        """The Llama config driving the shared attention backbone."""
+        return llama.LlamaConfig(
+            vocab_size=self.vocab_size, hidden_size=self.hidden_size,
+            intermediate_size=self.intermediate_size,
+            num_layers=self.num_layers, num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads, head_dim=self.head_dim,
+            max_seq_len=self.max_seq_len, rope_theta=self.rope_theta,
+            rms_eps=self.rms_eps, dtype=self.dtype, remat=self.remat,
+            remat_policy=self.remat_policy, attention=self.attention)
+
+
+def logical_axes(config: MixtralConfig) -> Params:
+    """Pytree of logical-axis tuples matching :func:`init_params`."""
+    axes = llama.logical_axes(config.backbone())
+    layer = dict(axes["layers"])
+    for k in ("w_gate", "w_up", "w_down"):
+        del layer[k]
+    layer.update({
+        "w_router": ("layers", "embed", None),
+        "moe_gate": ("layers", "experts", "embed", "mlp"),
+        "moe_up": ("layers", "experts", "embed", "mlp"),
+        "moe_down": ("layers", "experts", "mlp", "embed"),
+    })
+    axes["layers"] = layer
+    return axes
+
+
+def init_params(config: MixtralConfig, key: jax.Array) -> Params:
+    c = config
+    k_backbone, k_router, kg, ku, kd = jax.random.split(key, 5)
+    params = llama.init_params(c.backbone(), k_backbone)
+    layers = dict(params["layers"])
+    for k in ("w_gate", "w_up", "w_down"):
+        del layers[k]
+    L, E, M, X = c.num_layers, c.hidden_size, c.intermediate_size, \
+        c.num_experts
+    scale_in, scale_out = E ** -0.5, M ** -0.5
+    layers["w_router"] = (jax.random.normal(k_router, (L, E, X))
+                          * scale_in).astype(jnp.float32)
+    layers["moe_gate"] = (jax.random.normal(kg, (L, X, E, M))
+                          * scale_in).astype(c.dtype)
+    layers["moe_up"] = (jax.random.normal(ku, (L, X, E, M))
+                        * scale_in).astype(c.dtype)
+    layers["moe_down"] = (jax.random.normal(kd, (L, X, M, E))
+                          * scale_out).astype(c.dtype)
+    params["layers"] = layers
+    return params
+
+
+def _moe_mlp(config: MixtralConfig):
+    c = config
+
+    def mlp_fn(h, layer):
+        out, aux = moe_layer(
+            h,
+            {"w_router": layer["w_router"],
+             "w_gate": layer["moe_gate"],
+             "w_up": layer["moe_up"],
+             "w_down": layer["moe_down"]},
+            num_experts=c.num_experts, top_k=c.top_k,
+            capacity_factor=c.capacity_factor)
+        return out, aux["aux_loss"]
+
+    return mlp_fn
+
+
+def forward(params: Params, tokens: jnp.ndarray, config: MixtralConfig,
+            mesh: Optional[Mesh] = None) -> jnp.ndarray:
+    """Logits [B, S, V] (fp32)."""
+    return llama.forward(params, tokens, config.backbone(), mesh,
+                         mlp_fn=_moe_mlp(config))
+
+
+def loss_fn(params: Params, batch: Dict[str, jnp.ndarray],
+            config: MixtralConfig, mesh: Optional[Mesh] = None,
+            vocab_chunks: int = 8
+            ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Next-token CE + router load-balancing aux loss."""
+    return llama.loss_fn(params, batch, config.backbone(), mesh,
+                         vocab_chunks=vocab_chunks,
+                         mlp_fn=_moe_mlp(config),
+                         aux_coeff=config.aux_coeff)
+
+
+def num_params(config: MixtralConfig) -> int:
+    c = config
+    attn = (2 * c.hidden_size
+            + c.hidden_size * c.num_heads * c.head_dim * 2
+            + c.hidden_size * c.num_kv_heads * c.head_dim * 2)
+    moe = (c.hidden_size * c.num_experts
+           + 3 * c.num_experts * c.hidden_size * c.intermediate_size)
+    return (c.vocab_size * c.hidden_size * 2 + c.hidden_size
+            + c.num_layers * (attn + moe))
+
+
+def active_params(config: MixtralConfig) -> int:
+    """Per-token active parameters (top_k experts of num_experts)."""
+    c = config
+    attn = (2 * c.hidden_size
+            + c.hidden_size * c.num_heads * c.head_dim * 2
+            + c.hidden_size * c.num_kv_heads * c.head_dim * 2)
+    moe = (c.hidden_size * c.num_experts
+           + 3 * c.top_k * c.hidden_size * c.intermediate_size)
+    return (c.vocab_size * c.hidden_size * 2 + c.hidden_size
+            + c.num_layers * (attn + moe))

@@ -219,8 +219,13 @@ class Tuner:
             search_alg = None
             proposed = 0
         if search_alg is not None:
-            search_alg.configure(self.param_space, tc.metric, tc.mode,
-                                 tc.search_seed)
+            # A restored run never reseeds: even with an empty completed
+            # history the pre-crash RNG stream already produced the
+            # snapshotted pending configs, and replaying it would duplicate
+            # them.
+            search_alg.configure(
+                self.param_space, tc.metric, tc.mode,
+                tc.search_seed if self._restore_state is None else None)
         limit = tc.max_concurrent_trials or max(len(pending), 1,
                                                 4 if search_alg else 1)
 

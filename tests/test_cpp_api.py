@@ -62,7 +62,7 @@ def test_cpp_client_end_to_end(gateway):
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     out = r.stdout
     for marker in ("CHECK kv ok", "CHECK put_get ok", "CHECK task add=5 ok",
-                   "CHECK task shout ok", "CHECK task error propagated",
+                   "CHECK task shout ok", "CHECK task error propagated", "CHECK free ok",
                    "ALL CHECKS PASSED"):
         assert marker in out, f"missing {marker!r} in:\n{out}"
 

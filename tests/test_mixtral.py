@@ -1,0 +1,128 @@
+"""Mixtral (sparse-MoE model family) tests.
+
+Covers: forward shapes, dense-equivalence at num_experts=1 (the MoE layer
+with one expert must reproduce the dense SwiGLU it replaces), loss/grad
+flow including the router aux loss, expert-parallel execution on an 8-dev
+CPU mesh, and a tiny overfit run showing the loss actually goes down.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu.models import llama, mixtral
+from ray_tpu.parallel import MeshConfig, make_mesh, tree_shardings
+
+
+def test_forward_shapes_and_finiteness():
+    cfg = mixtral.MixtralConfig.tiny()
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size)
+    logits = jax.jit(lambda p, t: mixtral.forward(p, t, cfg))(params, tokens)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_single_expert_matches_dense_llama():
+    """num_experts=1, top_k=1: routing is the identity, so Mixtral must
+    reproduce the dense Llama forward with the same weights."""
+    mcfg = mixtral.MixtralConfig.tiny(num_experts=1, top_k=1,
+                                      capacity_factor=2.0,
+                                      attention="reference")
+    lcfg = mcfg.backbone()
+    mp = mixtral.init_params(mcfg, jax.random.PRNGKey(0))
+    lp = llama.init_params(lcfg, jax.random.PRNGKey(0))
+    # Shared backbone weights come from the same key; copy the expert-0
+    # FFN into the dense slots.
+    lp["embed"] = mp["embed"]
+    lp["lm_head"] = mp["lm_head"]
+    lp["final_norm"] = mp["final_norm"]
+    for k in ("attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"):
+        lp["layers"][k] = mp["layers"][k]
+    lp["layers"]["w_gate"] = mp["layers"]["moe_gate"][:, 0]
+    lp["layers"]["w_up"] = mp["layers"]["moe_up"][:, 0]
+    lp["layers"]["w_down"] = mp["layers"]["moe_down"][:, 0]
+
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0,
+                                mcfg.vocab_size)
+    out_moe = mixtral.forward(mp, tokens, mcfg)
+    out_dense = llama.forward(lp, tokens, lcfg)
+    np.testing.assert_allclose(np.asarray(out_moe), np.asarray(out_dense),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_loss_includes_aux_and_grads_flow():
+    cfg = mixtral.MixtralConfig.tiny()
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+
+    (loss, metrics), grads = jax.jit(
+        lambda p: jax.value_and_grad(
+            lambda q: mixtral.loss_fn(q, batch, cfg), has_aux=True)(p)
+    )(params)
+    assert np.isfinite(float(loss))
+    assert "aux_loss" in metrics and np.isfinite(float(metrics["aux_loss"]))
+    # Router gradients must be nonzero — the aux loss trains the router
+    # even when the CE path's top-k hard routing blocks most signal.
+    router_grad = np.asarray(grads["layers"]["w_router"])
+    assert np.abs(router_grad).max() > 0
+    expert_grad = np.asarray(grads["layers"]["moe_gate"])
+    assert np.abs(expert_grad).max() > 0
+
+
+def test_expert_parallel_mesh_execution():
+    """Expert-sharded loss on an 8-device CPU mesh (expert=4 × fsdp=2)."""
+    cfg = mixtral.MixtralConfig.tiny()
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    mesh = make_mesh(MeshConfig(expert=4, fsdp=2))
+    shardings = tree_shardings(mesh, mixtral.logical_axes(cfg))
+    params = jax.device_put(params, shardings)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                cfg.vocab_size)
+
+    @jax.jit
+    def step(p, t):
+        loss, m = mixtral.loss_fn(p, {"tokens": t}, cfg, mesh)
+        return loss
+
+    with mesh:
+        loss = step(params, tokens)
+    assert np.isfinite(float(loss))
+
+
+def test_tiny_overfit_loss_decreases():
+    cfg = mixtral.MixtralConfig.tiny(num_layers=1)
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                cfg.vocab_size)
+    batch = {"tokens": tokens}
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        (loss, _), g = jax.value_and_grad(
+            lambda q: mixtral.loss_fn(q, batch, cfg), has_aux=True)(p)
+        updates, s = opt.update(g, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    first = None
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state)
+        first = first if first is not None else float(loss)
+    assert float(loss) < first * 0.7, (first, float(loss))
+
+
+def test_param_counts():
+    cfg = mixtral.MixtralConfig.tiny()
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0))
+    actual = sum(int(np.prod(x.shape))
+                 for x in jax.tree.leaves(params))
+    assert actual == mixtral.num_params(cfg)
+    assert mixtral.active_params(cfg) < mixtral.num_params(cfg)
