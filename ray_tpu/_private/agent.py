@@ -1,0 +1,198 @@
+"""Per-node agent: runtime-env pre-warm + node stats.
+
+Reference: ``raylet/agent_manager.h`` (the raylet spawns and supervises a
+dashboard agent + runtime-env agent per node) and
+``runtime_env/agent/runtime_env_agent.py:167``. Here workers materialize
+runtime envs themselves (the agentless design documented in
+``_private/runtime_env``), so this agent's env role is *pre-warming*: the
+node manager forwards incoming runtime envs so the venv build / package
+download runs while the lease is still being placed, and the worker's own
+``apply`` then hits a warm cache (the builds are concurrency-safe by
+atomic rename). The agent also samples /proc for per-node cpu/mem/disk
+stats (the reference dashboard-agent role) served over HTTP and registers
+its address in the GCS KV under ``__agents__/<node_id>``.
+
+Supervised: the node manager respawns the agent if it dies (reference
+AgentManager restart semantics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict
+
+AGENT_KV_NS = "__agents__"
+
+
+def read_proc_stats(spill_dir: str = "") -> Dict[str, Any]:
+    """Node stats from /proc (cgroup-unaware fallback values on error)."""
+    stats: Dict[str, Any] = {"ts": time.time(), "pid": os.getpid()}
+    try:
+        with open("/proc/meminfo") as f:
+            mem = {}
+            for line in f:
+                parts = line.split()
+                if parts and parts[0].rstrip(":") in (
+                        "MemTotal", "MemAvailable"):
+                    mem[parts[0].rstrip(":")] = int(parts[1]) * 1024
+        stats["mem_total_bytes"] = mem.get("MemTotal", 0)
+        stats["mem_available_bytes"] = mem.get("MemAvailable", 0)
+    except OSError:
+        pass
+    try:
+        stats["loadavg_1m"] = os.getloadavg()[0]
+        stats["num_cpus"] = os.cpu_count()
+    except OSError:
+        pass
+    if spill_dir:
+        try:
+            st = os.statvfs(spill_dir if os.path.isdir(spill_dir)
+                            else os.path.dirname(spill_dir) or "/")
+            stats["disk_free_bytes"] = st.f_bavail * st.f_frsize
+        except OSError:
+            pass
+    return stats
+
+
+class NodeAgent:
+    """HTTP agent process body (also embeddable in-process for tests)."""
+
+    def __init__(self, gcs_address: str, node_id: str,
+                 host: str = "127.0.0.1", port: int = 0,
+                 spill_dir: str = ""):
+        self.gcs_address = gcs_address
+        self.node_id = node_id
+        self.spill_dir = spill_dir
+        # env hash -> "building" | "ready" | "failed: ..."
+        self._prewarm: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        agent = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _send(self, code: int, payload: Dict[str, Any]):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 - http.server API
+                if self.path == "/healthz":
+                    self._send(200, {"ok": True,
+                                     "node_id": agent.node_id})
+                elif self.path == "/stats":
+                    self._send(200, read_proc_stats(agent.spill_dir))
+                elif self.path.startswith("/runtime_env/status"):
+                    with agent._lock:
+                        self._send(200, dict(agent._prewarm))
+                else:
+                    self._send(404, {"error": "unknown path"})
+
+            def do_POST(self):  # noqa: N802 - http.server API
+                if self.path != "/runtime_env/prewarm":
+                    self._send(404, {"error": "unknown path"})
+                    return
+                length = int(self.headers.get("Content-Length", "0"))
+                try:
+                    renv = json.loads(self.rfile.read(length) or b"{}")
+                except ValueError:
+                    self._send(400, {"error": "bad json"})
+                    return
+                key = agent.start_prewarm(renv)
+                self._send(200, {"started": True, "key": key})
+
+            def log_message(self, *a):  # silence per-request lines
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="node-agent")
+        self._thread.start()
+        self._register()
+
+    # ------------------------------------------------------------ pre-warm
+    def start_prewarm(self, renv: Dict[str, Any]) -> str:
+        """Kick off background materialization of a runtime env; returns a
+        status key for /runtime_env/status."""
+        import hashlib
+
+        key = hashlib.sha256(
+            json.dumps(renv, sort_keys=True).encode()).hexdigest()[:16]
+        with self._lock:
+            if key in self._prewarm:
+                return key
+            self._prewarm[key] = "building"
+        threading.Thread(target=self._do_prewarm, args=(renv, key),
+                         daemon=True).start()
+        return key
+
+    def _do_prewarm(self, renv: Dict[str, Any], key: str) -> None:
+        try:
+            specs = renv.get("pip") or []
+            if specs:
+                from ray_tpu._private.runtime_env.pip_env import \
+                    ensure_pip_env
+
+                ensure_pip_env(list(specs))
+            uris = [u for u in ([renv.get("working_dir")]
+                                + list(renv.get("py_modules") or []))
+                    if isinstance(u, str) and u.startswith("pkg://")]
+            if uris:
+                from ray_tpu._private import rpc
+                from ray_tpu._private.runtime_env.packaging import \
+                    ensure_local
+
+                gcs = rpc.get_stub("GcsService", self.gcs_address)
+                for uri in uris:
+                    ensure_local(uri, gcs)
+            with self._lock:
+                self._prewarm[key] = "ready"
+        except Exception as e:  # noqa: BLE001
+            with self._lock:
+                self._prewarm[key] = f"failed: {e}"
+
+    # ------------------------------------------------------------ registry
+    def _register(self) -> None:
+        try:
+            from ray_tpu._private import rpc
+            from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+            gcs = rpc.get_stub("GcsService", self.gcs_address)
+            gcs.KvPut(pb.KvRequest(
+                ns=AGENT_KV_NS, key=self.node_id,
+                value=f"127.0.0.1:{self.port}".encode(), overwrite=True))
+        except Exception:  # noqa: BLE001 — registration is best-effort
+            pass
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def main(argv=None):  # pragma: no cover - subprocess entry
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--gcs-address", required=True)
+    p.add_argument("--node-id", required=True)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--spill-dir", default="")
+    args = p.parse_args(argv)
+    agent = NodeAgent(args.gcs_address, args.node_id, port=args.port,
+                      spill_dir=args.spill_dir)
+    print(f"AGENT_PORT={agent.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        agent.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -89,6 +89,18 @@ class NodeManager:
         self._spilled: Dict[str, Tuple[str, int]] = {}  # oid -> (path, size)
         self._spill_lock = threading.Lock()
         self._spill_event = threading.Event()
+        # Per-node agent fields (reference C21) — initialized BEFORE the
+        # gRPC server / heartbeat thread go live so early RPC ticks can't
+        # hit missing attributes.
+        self._agent_enabled = \
+            os.environ.get("RAY_TPU_DISABLE_AGENT") != "1"
+        self._agent_proc: Optional[subprocess.Popen] = None
+        self._agent_port = 0
+        self._agent_respawn_after = 0.0
+        self._agent_started_at = 0.0
+        # Envs seen before the agent finished starting: bounded queue,
+        # flushed on start so a fresh node's first leases still pre-warm.
+        self._pending_prewarm: List[bytes] = []
         try:
             from ray_tpu._private.shm import ShmStore
 
@@ -156,6 +168,13 @@ class NodeManager:
         if self._shm is not None:
             threading.Thread(target=self._spill_loop, daemon=True,
                              name="nm-spill").start()
+        # Per-node agent (reference C21, raylet/agent_manager.h): spawned
+        # as a subprocess, supervised (respawned) from the heartbeat loop,
+        # does runtime-env pre-warm + node stats. Disabled via env for
+        # tests that count processes.
+        if self._agent_enabled:
+            threading.Thread(target=self._start_agent, daemon=True,
+                             name="nm-agent-start").start()
 
     def _prestart_workers(self):
         n = min(int(self.total.get("CPU", 1)), 4)
@@ -286,6 +305,121 @@ class NodeManager:
                 pass
             self._reap_idle_workers()
             self._check_dead_workers()
+            self._check_agent()
+
+    # ------------------------------------------------------------- agent
+    AGENT_START_GRACE_S = 60.0
+
+    def _start_agent(self) -> None:
+        """Spawn the per-node agent subprocess and read its port."""
+        import sys
+
+        if self._stop.is_set():
+            return
+        self._agent_started_at = time.monotonic()
+        env = dict(os.environ)
+        # The agent must import ray_tpu from wherever this process got it
+        # (same rule as worker spawns above).
+        env["PYTHONPATH"] = os.pathsep.join(
+            dict.fromkeys(filter(None, list(sys.path)
+                                 + [env.get("PYTHONPATH", "")])))
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.agent",
+                 "--gcs-address", self.gcs_address,
+                 "--node-id", self.node_id,
+                 "--spill-dir", self._spill_dir],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=env)
+        except Exception:  # noqa: BLE001
+            # _check_agent retries after the respawn window (a one-off
+            # fork failure must not kill supervision for good).
+            logger.exception("node agent spawn failed")
+            self._agent_respawn_after = time.monotonic() + 5.0
+            return
+        self._agent_proc = proc
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not self._stop.is_set():
+            line = proc.stdout.readline().strip()
+            if line.startswith("AGENT_PORT="):
+                self._agent_port = int(line.split("=", 1)[1])
+                if self._stop.is_set():
+                    break
+                pending, self._pending_prewarm = \
+                    self._pending_prewarm[-16:], []
+                for blob in pending:
+                    self._prewarm_runtime_env(blob)
+                return
+            if not line and proc.poll() is not None:
+                return
+        # Stopped (or timed out) mid-start: don't orphan the subprocess.
+        if self._stop.is_set():
+            try:
+                proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _check_agent(self) -> None:
+        """Respawn a dead/hung/never-started agent (reference AgentManager
+        supervision), rate-limited so a crash loop doesn't spin."""
+        if not self._agent_enabled or self._stop.is_set():
+            return
+        now = time.monotonic()
+        proc = self._agent_proc
+        if proc is not None and proc.poll() is None:
+            if self._agent_port:
+                return
+            # Alive but never reported a port: give it the start grace,
+            # then treat as hung and recycle.
+            if now - self._agent_started_at < self.AGENT_START_GRACE_S:
+                return
+            try:
+                proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
+        if now < self._agent_respawn_after:
+            return
+        self._agent_respawn_after = now + 5.0
+        self._agent_proc = None
+        self._agent_port = 0
+        if proc is not None:
+            logger.warning("node agent died/hung (rc=%s); respawning",
+                           proc.returncode)
+        threading.Thread(target=self._start_agent, daemon=True,
+                         name="nm-agent-start").start()
+
+    def _prewarm_runtime_env(self, runtime_env_blob: bytes) -> None:
+        """Forward a lease's runtime env to the agent so the venv build /
+        package download overlaps with placement (fire-and-forget)."""
+        if not runtime_env_blob or not self._agent_enabled:
+            return
+        try:
+            renv = pickle.loads(bytes(runtime_env_blob))
+        except Exception:  # noqa: BLE001
+            return
+        if not (renv.get("pip") or renv.get("working_dir")
+                or renv.get("py_modules")):
+            return  # env_vars-only: nothing to build, no thread to spawn
+        if not self._agent_port:
+            if len(self._pending_prewarm) < 16:
+                self._pending_prewarm.append(bytes(runtime_env_blob))
+            return
+
+        def post():
+            try:
+                import json as _json
+                import urllib.request
+
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{self._agent_port}"
+                    "/runtime_env/prewarm",
+                    data=_json.dumps(renv).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:  # noqa: BLE001 — pre-warm is best-effort
+                pass
+
+        threading.Thread(target=post, daemon=True).start()
 
     def _cluster_view(self) -> List[pb.NodeInfo]:
         now = time.monotonic()
@@ -469,6 +603,8 @@ class NodeManager:
         spec = request.spec
         demand = dict(spec.resources)
         lease_id = uuid.uuid4().bytes
+        if spec.runtime_env:
+            self._prewarm_runtime_env(spec.runtime_env)
         if spec.placement_group_id:
             # PG-targeted: charge the bundle reservation; never spill back —
             # the bundle lives here or nowhere (bundle_scheduling_policy.h).
@@ -1038,6 +1174,11 @@ class NodeManager:
                 except Exception:  # noqa: BLE001
                     pass
             time.sleep(0.1)
+        if self._agent_proc is not None:
+            try:
+                self._agent_proc.terminate()
+            except Exception:  # noqa: BLE001
+                pass
         self._server.stop(grace=0.2)
         if self._shm is not None:
             try:

@@ -11,6 +11,9 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# One agent subprocess per node would slow every cluster test; the
+# dedicated agent test re-enables it for its own cluster.
+os.environ.setdefault("RAY_TPU_DISABLE_AGENT", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
