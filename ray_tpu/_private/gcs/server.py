@@ -265,13 +265,24 @@ class GcsServer:
         return pb.Empty()
 
     def Heartbeat(self, request, context):
+        changed = False
         with self._lock:
             info = self._nodes.get(request.node_id)
             if info is None:
                 return pb.HeartbeatReply(ok=False)  # unknown: re-register
             self._last_heartbeat[request.node_id] = time.monotonic()
             for k, v in request.available.items():
+                if info.available.get(k) != v:
+                    changed = True
                 info.available[k] = v
+        if changed:
+            # Resource-view gossip (reference C9, ray_syncer.h:83): instead
+            # of every node polling GetNodes, availability *changes* are
+            # pushed as deltas over the NODE_RES pubsub channel; subscribed
+            # node managers patch their cluster view incrementally.
+            self._publish("NODE_RES", pickle.dumps(
+                {"node_id": request.node_id,
+                 "available": dict(request.available)}))
         return pb.HeartbeatReply(ok=True)
 
     def GetNodes(self, request, context):

@@ -48,6 +48,17 @@ class _Worker:
         self.busy_since = 0.0  # set when leased (memory-monitor kill order)
 
 
+def _child_pythonpath(env: Dict[str, str],
+                      include_cwd: bool = False) -> str:
+    """Module search path for child processes (workers, the node agent):
+    they must import ray_tpu + pickled-by-reference modules from the same
+    universe as this process."""
+    parts = list(sys.path) + [env.get("PYTHONPATH", "")]
+    if include_cwd:
+        parts.append(os.getcwd())
+    return os.pathsep.join(dict.fromkeys(filter(None, parts)))
+
+
 class NodeManager:
     def __init__(self, gcs_address: str, port: int = 0,
                  resources: Optional[Dict[str, float]] = None,
@@ -98,6 +109,7 @@ class NodeManager:
         self._agent_port = 0
         self._agent_respawn_after = 0.0
         self._agent_started_at = 0.0
+        self._agent_starting = False
         # Envs seen before the agent finished starting: bounded queue,
         # flushed on start so a fresh node's first leases still pre-warm.
         self._pending_prewarm: List[bytes] = []
@@ -128,9 +140,19 @@ class NodeManager:
         self._leases: Dict[bytes, Tuple[str, Dict[str, float]]] = {}
         self._actor_demands: Dict[bytes, Tuple[str, Dict[str, float]]] = {}
 
-        # cluster view cache (ray_syncer analog: polled via GCS)
+        # cluster view: seeded/backstopped by a GetNodes poll, kept fresh
+        # by NODE_RES availability deltas + NODE liveness events pushed
+        # over pubsub (reference C9 ray_syncer gossip — push, not poll).
         self._view: List[pb.NodeInfo] = []
         self._view_ts = 0.0
+        self._view_lock = threading.Lock()
+        self._view_subscribed = False
+
+        # Sender-side transfer caps (reference C13 PushManager,
+        # push_manager.h:30): bound concurrent outbound object streams so
+        # a hot object can't monopolize every handler thread + the NIC.
+        self._push_slots = threading.BoundedSemaphore(
+            int(os.environ.get("RAY_TPU_MAX_CONCURRENT_PUSHES", 8)))
 
         self._stop = threading.Event()
         # Pool sized above any single driver's submit concurrency: queued
@@ -152,6 +174,8 @@ class NodeManager:
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True, name="nm-heartbeat")
         self._hb_thread.start()
+        threading.Thread(target=self._view_subscriber_loop, daemon=True,
+                         name="nm-view-sub").start()
         # Prestart workers so first leases don't pay process-spawn latency
         # (reference: worker pool prestart, worker_pool.h:216).
         threading.Thread(target=self._prestart_workers, daemon=True).start()
@@ -173,8 +197,7 @@ class NodeManager:
         # does runtime-env pre-warm + node stats. Disabled via env for
         # tests that count processes.
         if self._agent_enabled:
-            threading.Thread(target=self._start_agent, daemon=True,
-                             name="nm-agent-start").start()
+            self._launch_agent()
 
     def _prestart_workers(self):
         n = min(int(self.total.get("CPU", 1)), 4)
@@ -310,8 +333,23 @@ class NodeManager:
     # ------------------------------------------------------------- agent
     AGENT_START_GRACE_S = 60.0
 
+    def _launch_agent(self) -> None:
+        """Start _start_agent at most once at a time: without the flag a
+        slow Popen lets the supervisor double-spawn and leak the loser."""
+        if self._agent_starting or self._stop.is_set():
+            return
+        self._agent_starting = True
+        threading.Thread(target=self._start_agent, daemon=True,
+                         name="nm-agent-start").start()
+
     def _start_agent(self) -> None:
         """Spawn the per-node agent subprocess and read its port."""
+        try:
+            self._start_agent_inner()
+        finally:
+            self._agent_starting = False
+
+    def _start_agent_inner(self) -> None:
         import sys
 
         if self._stop.is_set():
@@ -319,10 +357,8 @@ class NodeManager:
         self._agent_started_at = time.monotonic()
         env = dict(os.environ)
         # The agent must import ray_tpu from wherever this process got it
-        # (same rule as worker spawns above).
-        env["PYTHONPATH"] = os.pathsep.join(
-            dict.fromkeys(filter(None, list(sys.path)
-                                 + [env.get("PYTHONPATH", "")])))
+        # (same rule as worker spawns).
+        env["PYTHONPATH"] = _child_pythonpath(env)
         try:
             proc = subprocess.Popen(
                 [sys.executable, "-m", "ray_tpu._private.agent",
@@ -362,7 +398,8 @@ class NodeManager:
     def _check_agent(self) -> None:
         """Respawn a dead/hung/never-started agent (reference AgentManager
         supervision), rate-limited so a crash loop doesn't spin."""
-        if not self._agent_enabled or self._stop.is_set():
+        if not self._agent_enabled or self._stop.is_set() \
+                or self._agent_starting:
             return
         now = time.monotonic()
         proc = self._agent_proc
@@ -385,8 +422,7 @@ class NodeManager:
         if proc is not None:
             logger.warning("node agent died/hung (rc=%s); respawning",
                            proc.returncode)
-        threading.Thread(target=self._start_agent, daemon=True,
-                         name="nm-agent-start").start()
+        self._launch_agent()
 
     def _prewarm_runtime_env(self, runtime_env_blob: bytes) -> None:
         """Forward a lease's runtime env to the agent so the venv build /
@@ -421,16 +457,62 @@ class NodeManager:
 
         threading.Thread(target=post, daemon=True).start()
 
-    def _cluster_view(self) -> List[pb.NodeInfo]:
-        now = time.monotonic()
-        if now - self._view_ts > CLUSTER_VIEW_TTL_S:
+    def _view_subscriber_loop(self):
+        """Consume NODE_RES availability deltas + NODE liveness events
+        (reference C9: ray_syncer's push-based resource view). While the
+        stream is live the GetNodes poll drops to a slow backstop."""
+        while not self._stop.is_set():
             try:
-                self._view = list(
-                    self.gcs.GetNodes(pb.GetNodesRequest(), timeout=2).nodes)
-                self._view_ts = now
+                stream = self.gcs.Subscribe(pb.SubscribeRequest(
+                    channels=["NODE_RES", "NODE"],
+                    subscriber_id=f"nm-{self.node_id[:12]}"),
+                    timeout=3600.0)
+                self._view_subscribed = True
+                for msg in stream:
+                    if self._stop.is_set():
+                        return
+                    try:
+                        ev = pickle.loads(msg.data)
+                    except Exception:  # noqa: BLE001
+                        continue
+                    if msg.channel == "NODE_RES":
+                        # Copy-on-write: snapshots handed out by
+                        # _cluster_view share these messages, so patch a
+                        # fresh copy instead of mutating one a scheduler
+                        # thread may be iterating.
+                        with self._view_lock:
+                            for i, n in enumerate(self._view):
+                                if n.node_id == ev["node_id"]:
+                                    cp = pb.NodeInfo()
+                                    cp.CopyFrom(n)
+                                    for k, v in ev["available"].items():
+                                        cp.available[k] = v
+                                    self._view[i] = cp
+                                    break
+                    else:  # NODE liveness change: force a full refresh
+                        self._view_ts = 0.0
             except Exception:  # noqa: BLE001
                 pass
-        return self._view
+            finally:
+                self._view_subscribed = False
+            if self._stop.wait(1.0):
+                return
+
+    def _cluster_view(self) -> List[pb.NodeInfo]:
+        now = time.monotonic()
+        ttl = (10 * CLUSTER_VIEW_TTL_S if self._view_subscribed
+               else CLUSTER_VIEW_TTL_S)
+        if now - self._view_ts > ttl:
+            try:
+                fresh = list(
+                    self.gcs.GetNodes(pb.GetNodesRequest(), timeout=2).nodes)
+                with self._view_lock:
+                    self._view = fresh
+                    self._view_ts = now
+            except Exception:  # noqa: BLE001
+                pass
+        with self._view_lock:
+            return list(self._view)
 
     # ------------------------------------------------------------ worker pool
     def _spawn_worker(self) -> _Worker:
@@ -446,9 +528,7 @@ class NodeManager:
         # Workers must resolve pickled-by-reference functions from the same
         # module universe as the submitting process (includes pytest's
         # sys.path injections when the node manager runs in a test process).
-        env["PYTHONPATH"] = os.pathsep.join(
-            dict.fromkeys(filter(None, list(sys.path)
-                                 + [env.get("PYTHONPATH", ""), os.getcwd()])))
+        env["PYTHONPATH"] = _child_pythonpath(env, include_cwd=True)
         if not self.total.get("TPU"):
             # CPU-only node: skip the TPU PJRT plugin registration in
             # sitecustomize (it imports jax at interpreter start, ~2s per
@@ -1118,18 +1198,31 @@ class NodeManager:
 
     def PullObject(self, request, context):
         """Chunked streaming transfer (reference: ObjectManager 64MB chunks,
-        object_manager.h:117)."""
+        object_manager.h:117). Outbound streams are capped (PushManager
+        analog, push_manager.h:30): a hot object fanned out to many nodes
+        queues behind the slot limit instead of saturating every handler
+        thread at once."""
         data = self._read_object_bytes(request.object_id)
         if data is None:
             yield pb.ObjectChunk(object_id=request.object_id, found=False,
                                  eof=True)
             return
-        total = len(data)
-        for off in range(0, max(total, 1), CHUNK_SIZE):
-            chunk = data[off:off + CHUNK_SIZE]
-            yield pb.ObjectChunk(object_id=request.object_id,
-                                 total_size=total, offset=off, data=chunk,
-                                 found=True, eof=off + CHUNK_SIZE >= total)
+        if not self._push_slots.acquire(timeout=60.0):
+            # Saturated for a full minute: fail the pull; the client
+            # retries another location or re-requests.
+            yield pb.ObjectChunk(object_id=request.object_id, found=False,
+                                 eof=True)
+            return
+        try:
+            total = len(data)
+            for off in range(0, max(total, 1), CHUNK_SIZE):
+                chunk = data[off:off + CHUNK_SIZE]
+                yield pb.ObjectChunk(object_id=request.object_id,
+                                     total_size=total, offset=off,
+                                     data=chunk, found=True,
+                                     eof=off + CHUNK_SIZE >= total)
+        finally:
+            self._push_slots.release()
 
     def FreeObjects(self, request, context):
         with self._obj_lock:

@@ -113,6 +113,53 @@ def read_object_reply(reply) -> Any:
     return loads(reply.data)
 
 
+class _PullManager:
+    """Receiver-side transfer admission (reference C13 PullManager,
+    ``pull_manager.h:53``): bounds the bytes of concurrently in-flight
+    pulls and dedups concurrent pulls of one object inside a process."""
+
+    def __init__(self, budget_bytes: int):
+        self._budget = max(budget_bytes, 1)
+        self._avail = self._budget
+        self._cv = threading.Condition()
+        self._inflight: Dict[bytes, threading.Event] = {}
+
+    def _cost(self, size: int) -> int:
+        return min(max(size, 1), self._budget)
+
+    def begin(self, oid: bytes, size: int, wait_s: float = 60.0):
+        """Admit a pull. Returns None when this caller should pull, or the
+        in-flight pull's Event to wait on when someone else already is.
+        ``wait_s`` bounds the budget wait (callers pass their remaining
+        get() deadline); expiry fails open — admission is advisory and
+        must never extend a timeout."""
+        cost = self._cost(size)
+        deadline = time.monotonic() + max(wait_s, 0.0)
+        with self._cv:
+            ev = self._inflight.get(oid)
+            if ev is not None:
+                return ev
+            while self._avail < cost:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break  # fail open: a stuck budget must not deadlock
+                self._cv.wait(timeout=min(remaining, 1.0))
+                ev = self._inflight.get(oid)
+                if ev is not None:
+                    return ev
+            self._avail -= cost
+            self._inflight[oid] = threading.Event()
+            return None
+
+    def end(self, oid: bytes, size: int) -> None:
+        with self._cv:
+            self._avail += self._cost(size)
+            ev = self._inflight.pop(oid, None)
+            self._cv.notify_all()
+        if ev is not None:
+            ev.set()
+
+
 class _HexId(str):
     """Node/worker ids travel as hex strings in this runtime; ``.hex()``
     (the ID-object protocol runtime_context expects) is identity."""
@@ -136,6 +183,8 @@ class ClusterRuntime(CoreRuntime):
         self.gcs = rpc.get_stub("GcsService", gcs_address)
         self.node = rpc.get_stub("NodeService", node_address)
         self.memory = MemoryStore()
+        self._pulls = _PullManager(int(os.environ.get(
+            "RAY_TPU_PULL_BUDGET_BYTES", 512 << 20)))
         self._pool = ThreadPoolExecutor(max_workers=64,
                                         thread_name_prefix="submit")
         self._actor_cache: Dict[bytes, pb.ActorInfo] = {}
@@ -385,10 +434,12 @@ class ClusterRuntime(CoreRuntime):
             self._put_index += 1
             return self._put_index
 
-    def _fetch_object(self, ref: ObjectRef) -> Tuple[bool, Any, bool]:
-        """Try all known locations once. Returns (found, value, freed) —
-        ``freed`` means the GCS refcount hit zero and the object is gone for
-        good (borrowers surface ObjectLostError instead of spinning)."""
+    def _fetch_object(self, ref: ObjectRef, deadline=None):
+        """Try all known locations once. Returns (found, value, freed,
+        pending) — ``freed`` means the GCS refcount hit zero and the object
+        is gone for good (borrowers surface ObjectLostError instead of
+        spinning); ``pending`` means another thread's pull of this object
+        is in flight, so a miss must NOT trigger lineage reconstruction."""
         oid = ref.id()
         freed = False
         try:
@@ -401,14 +452,16 @@ class ClusterRuntime(CoreRuntime):
             value = read_object_reply(reply)
             if value is not None or not reply.shm_name:
                 self.memory.put(oid, value)
-                return True, value, freed
+                return True, value, freed, False
         candidates = []
+        size = 0
         if ref.owner_address() and ref.owner_address() != self.node_address:
             candidates.append(ref.owner_address())
         try:
             locs = self.gcs.GetObjectLocations(
                 pb.GetObjectLocationsRequest(object_id=oid.binary()))
             freed = locs.freed
+            size = int(locs.size)
             nodes = {n.node_id: n.address
                      for n in self.gcs.GetNodes(pb.GetNodesRequest()).nodes
                      if n.alive}
@@ -416,32 +469,59 @@ class ClusterRuntime(CoreRuntime):
                               if nid in nodes)
         except Exception:  # noqa: BLE001
             pass
-        for addr in dict.fromkeys(candidates):
-            try:
-                stub = rpc.get_stub("NodeService", addr)
-                chunks = stub.PullObject(
-                    pb.PullObjectRequest(object_id=oid.binary()))
-                buf = bytearray()
-                found = False
-                for chunk in chunks:
-                    if not chunk.found:
-                        break
-                    found = True
-                    buf.extend(chunk.data)
-                    if chunk.eof:
-                        break
-                if found:
-                    value = loads(bytes(buf))
-                    self.memory.put(oid, value)
-                    try:  # cache on this node for future consumers
-                        put_bytes_to_node(self.node, oid.binary(),
-                                          bytes(buf), self.worker_id)
-                    except Exception:  # noqa: BLE001
-                        pass
-                    return True, value, freed
-            except Exception:  # noqa: BLE001
-                continue
-        return False, None, freed
+        if not candidates:
+            return False, None, freed, False
+        # Pull admission (reference C13 PullManager, pull_manager.h:53):
+        # bound in-flight pull bytes and dedup concurrent pulls of the
+        # same object within this process. All waits are clipped to the
+        # caller's remaining deadline.
+        def remaining(cap):
+            if deadline is None:
+                return cap
+            return max(0.0, min(cap, deadline - time.monotonic()))
+
+        waited = self._pulls.begin(oid.binary(), size,
+                                   wait_s=remaining(60.0))
+        if waited is not None:
+            waited.wait(timeout=remaining(120.0))
+            hit = self.memory.get_if_ready(oid, default=None)
+            if hit is not None or self.memory.contains(oid):
+                return True, hit, freed, False
+            waited = self._pulls.begin(oid.binary(), size,
+                                       wait_s=remaining(5.0))
+            if waited is not None:
+                # Still contended; let the in-flight pull finish — the
+                # caller's retry loop re-checks shortly.
+                return False, None, freed, True
+        try:
+            for addr in dict.fromkeys(candidates):
+                try:
+                    stub = rpc.get_stub("NodeService", addr)
+                    chunks = stub.PullObject(
+                        pb.PullObjectRequest(object_id=oid.binary()))
+                    buf = bytearray()
+                    found = False
+                    for chunk in chunks:
+                        if not chunk.found:
+                            break
+                        found = True
+                        buf.extend(chunk.data)
+                        if chunk.eof:
+                            break
+                    if found:
+                        value = loads(bytes(buf))
+                        self.memory.put(oid, value)
+                        try:  # cache on this node for future consumers
+                            put_bytes_to_node(self.node, oid.binary(),
+                                              bytes(buf), self.worker_id)
+                        except Exception:  # noqa: BLE001
+                            pass
+                        return True, value, freed, False
+                except Exception:  # noqa: BLE001
+                    continue
+            return False, None, freed, False
+        finally:
+            self._pulls.end(oid.binary(), size)
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]) -> List[Any]:
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -464,10 +544,10 @@ class ClusterRuntime(CoreRuntime):
                 return self.memory.get_if_ready(oid)
             except KeyError:
                 pass
-            found, value, freed = self._fetch_object(ref)
+            found, value, freed, pending = self._fetch_object(ref, deadline)
             if found:
                 return value
-            if rebuilds < 3 and self._maybe_reconstruct(ref):
+            if not pending and rebuilds < 3 and self._maybe_reconstruct(ref):
                 rebuilds += 1
                 continue
             if freed:
