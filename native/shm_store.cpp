@@ -89,9 +89,13 @@ void* shm_store_create(const char* prefix, uint64_t capacity) {
 
 void shm_store_destroy(void* handle) {
   auto* s = static_cast<Store*>(handle);
-  std::lock_guard<std::mutex> g(s->mu);
-  for (auto& kv : s->index) {
-    shm_unlink(kv.second.name.c_str());
+  {
+    // The guard must release before delete: unlocking a mutex inside the
+    // freed Store is a use-after-free (found by the TSAN stress target).
+    std::lock_guard<std::mutex> g(s->mu);
+    for (auto& kv : s->index) {
+      shm_unlink(kv.second.name.c_str());
+    }
   }
   delete s;
 }
