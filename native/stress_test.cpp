@@ -17,6 +17,16 @@
 #include <thread>
 #include <vector>
 
+// Portable TSAN detection: GCC defines __SANITIZE_THREAD__, Clang exposes
+// it via __has_feature(thread_sanitizer).
+#if defined(__SANITIZE_THREAD__)
+#define RT_TSAN 1
+#elif defined(__has_feature)
+#if __has_feature(thread_sanitizer)
+#define RT_TSAN 1
+#endif
+#endif
+
 extern "C" {
 void* chan_create(const char* name, uint64_t capacity, uint32_t n_readers);
 void* chan_attach(const char* name, int reader_idx);
@@ -86,7 +96,7 @@ void worker(void* store, int tid, int iters) {
   }
 }
 
-#ifndef __SANITIZE_THREAD__
+#ifndef RT_TSAN
 // Mutable-channel stress (compiled-DAG data plane, shm_channel.cpp):
 // 1 writer + N readers pump checksummed payloads through the seqlock
 // protocol. Excluded under TSAN: the reader's pre-validation copy of the
@@ -145,16 +155,17 @@ int channel_stress(int readers, int rounds) {
   chan_unlink(name.c_str());
   return bad.load() == 0 ? 0 : 1;
 }
-#endif  // !__SANITIZE_THREAD__
+#endif  // !RT_TSAN
 
 }  // namespace
 
 int main(int argc, char** argv) {
   int threads = argc > 1 ? std::atoi(argv[1]) : 8;
   int iters = argc > 2 ? std::atoi(argv[2]) : 2000;
-  // Small capacity forces the eviction path under concurrency.
+  // Capacity below the ~1.1MB peak working set so EvictLocked churns
+  // under concurrency (eviction racing shm_client_map is the hot race).
   std::string prefix = "stress" + std::to_string(getpid());
-  void* store = shm_store_create(prefix.c_str(), 2 << 20);
+  void* store = shm_store_create(prefix.c_str(), 1 << 19);
   if (store == nullptr) {
     std::fprintf(stderr, "store create failed\n");
     return 2;
@@ -171,7 +182,7 @@ int main(int argc, char** argv) {
               static_cast<unsigned long long>(shm_store_count(store)));
   shm_store_destroy(store);
   if (errors != 0) return 1;
-#ifndef __SANITIZE_THREAD__
+#ifndef RT_TSAN
   int rc = channel_stress(/*readers=*/3, /*rounds=*/1000);
   if (rc != 0) {
     std::fprintf(stderr, "channel stress failed rc=%d\n", rc);
