@@ -16,6 +16,68 @@ from collections import deque
 from typing import Optional
 
 
+# Single-file frontend (reference: dashboard/client React app, condensed to
+# a dependency-free page over the same JSON API).
+_INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:ui-monospace,Menlo,monospace;background:#111;color:#ddd;
+      margin:0;padding:1rem}
+ h1{font-size:1.1rem} h2{font-size:.95rem;margin:.8rem 0 .3rem;color:#8cf}
+ table{border-collapse:collapse;width:100%;font-size:.8rem}
+ td,th{border:1px solid #333;padding:.15rem .4rem;text-align:left}
+ th{background:#1c1c1c;color:#aaa} tr:nth-child(even){background:#181818}
+ .ok{color:#7c6} .bad{color:#e66} #status{color:#aaa;font-size:.8rem}
+ pre{background:#181818;padding:.5rem;max-height:14rem;overflow:auto;
+     font-size:.75rem}
+</style></head><body>
+<h1>ray_tpu dashboard <span id="status"></span></h1>
+<h2>Cluster</h2><div id="cluster"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Node agents</h2><table id="agents"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Recent tasks</h2><table id="tasks"></table>
+<h2>Logs</h2><pre id="logs"></pre>
+<script>
+const esc=s=>String(s).replace(/[&<>"']/g,c=>({"&":"&amp;","<":"&lt;",
+  ">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+const fmt=v=>esc(typeof v==="object"?JSON.stringify(v):v);
+function table(el,rows,cols){
+  if(!rows.length){el.innerHTML="<tr><td>(none)</td></tr>";return;}
+  cols=cols||Object.keys(rows[0]);
+  el.innerHTML="<tr>"+cols.map(c=>`<th>${esc(c)}</th>`).join("")+"</tr>"+
+    rows.map(r=>"<tr>"+cols.map(c=>`<td>${fmt(r[c])}</td>`).join("")
+    +"</tr>").join("");
+}
+async function j(p){const r=await fetch(p);return r.json();}
+async function refresh(){
+  try{
+    const cs=await j("/api/cluster_status");
+    document.getElementById("cluster").innerHTML=
+      `<span class="ok">${cs.nodes_alive}/${cs.nodes_total} nodes</span>`+
+      ` &nbsp; total=${fmt(cs.resources_total)}`+
+      ` avail=${fmt(cs.resources_available)}`;
+    table(document.getElementById("nodes"),await j("/api/nodes"));
+    table(document.getElementById("agents"),await j("/api/agents"));
+    table(document.getElementById("actors"),await j("/api/actors"));
+    table(document.getElementById("jobs"),await j("/api/jobs"));
+    table(document.getElementById("tasks"),
+          (await j("/api/tasks")).slice(-30).reverse());
+    const logs=await j("/api/logs");
+    document.getElementById("logs").textContent=logs.slice(-200)
+      .map(l=>`[${l.worker} ${l.pid}] ${l.line}`).join("\\n");
+    document.getElementById("status").textContent=
+      "updated "+new Date().toLocaleTimeString();
+  }catch(e){
+    document.getElementById("status").textContent="refresh failed: "+e;
+  }
+}
+refresh();setInterval(refresh,2000);
+</script></body></html>
+"""
+
+
 class Dashboard:
     def __init__(self, gcs_address: str, host: str = "127.0.0.1",
                  port: int = 8265):
@@ -87,6 +149,48 @@ class Dashboard:
                                            key="recent"))
             return pickle.loads(reply.value) if reply.found else []
 
+        agents_cache = {"ts": 0.0, "value": []}
+        agents_lock = threading.Lock()
+
+        def agents():
+            # Per-node agent stats (reference: dashboard agents): resolve
+            # agent addresses from the __agents__ KV registry, probe them
+            # CONCURRENTLY (dead agents cost one shared 2s timeout, not 2s
+            # each), and cache briefly so the frontend's poll loop can't
+            # pile requests behind unreachable agents.
+            import urllib.request
+            from concurrent.futures import ThreadPoolExecutor
+
+            with agents_lock:
+                if time.monotonic() - agents_cache["ts"] < 2.0:
+                    return agents_cache["value"]
+
+            def probe(node_id):
+                r = gcs.KvGet(pb.KvRequest(ns="__agents__", key=node_id))
+                if not r.found:
+                    return None
+                addr = r.value.decode()
+                entry = {"node_id": node_id, "agent_address": addr}
+                try:
+                    with urllib.request.urlopen(
+                            f"http://{addr}/stats", timeout=2) as resp:
+                        entry["stats"] = json.loads(resp.read())
+                except Exception as e:  # noqa: BLE001
+                    entry["error"] = str(e)
+                return entry
+
+            keys = list(gcs.KvKeys(pb.KvRequest(ns="__agents__",
+                                                prefix="")).keys)
+            out = []
+            if keys:
+                with ThreadPoolExecutor(max_workers=min(16,
+                                                        len(keys))) as ex:
+                    out = [e for e in ex.map(probe, keys) if e is not None]
+            with agents_lock:
+                agents_cache["ts"] = time.monotonic()
+                agents_cache["value"] = out
+            return out
+
         def cluster_status():
             ns = nodes()
             total, avail = {}, {}
@@ -109,6 +213,9 @@ class Dashboard:
 
                         body = prometheus_text().encode()
                         ctype = "text/plain; version=0.0.4"
+                    elif self.path in ("/", "/index.html"):
+                        body = _INDEX_HTML.encode()
+                        ctype = "text/html; charset=utf-8"
                     else:
                         route = {
                             "/api/cluster_status": cluster_status,
@@ -117,6 +224,7 @@ class Dashboard:
                             "/api/jobs": jobs,
                             "/api/logs": logs,
                             "/api/tasks": tasks,
+                            "/api/agents": agents,
                         }.get(self.path)
                         if route is None:
                             self.send_response(404)

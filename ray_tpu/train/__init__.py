@@ -25,13 +25,16 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.storage import AsyncCheckpointer, StorageContext
 from ray_tpu.train.trainer import ControllerState, JaxTrainer
 
 __all__ = [
-    "BackendExecutor", "Checkpoint", "CheckpointConfig", "CheckpointManager",
-    "ControllerState", "FailureConfig", "JaxBackend", "JaxTrainer", "Result", "RunConfig",
-    "ScalingConfig", "TrainWorker", "WorkerGroup", "get_checkpoint",
-    "get_context", "load_pytree", "report", "save_pytree",
+    "AsyncCheckpointer", "BackendExecutor", "Checkpoint",
+    "CheckpointConfig", "CheckpointManager", "ControllerState",
+    "FailureConfig", "JaxBackend", "JaxTrainer", "Result", "RunConfig",
+    "ScalingConfig", "StorageContext", "TrainWorker", "WorkerGroup",
+    "get_checkpoint", "get_context", "load_pytree", "report",
+    "save_pytree",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -94,26 +94,78 @@ def load_pytree(directory: str, name: str = "state") -> Any:
 
 
 class CheckpointManager:
-    """Top-k checkpoint retention (reference: ``_internal/checkpoint_manager.py``)."""
+    """Top-k checkpoint retention (reference: ``_internal/checkpoint_manager.py``).
+
+    ``async_write=True`` moves the copy-to-root (and the optional
+    ``storage`` upload — a :class:`~ray_tpu.train.storage.StorageContext`)
+    onto a background thread, orbax-style: at most one persist in flight,
+    and :meth:`flush` joins it before anyone reads ``latest``/``best``.
+    """
 
     def __init__(self, root: str, num_to_keep: Optional[int] = None,
-                 score_attribute: Optional[str] = None, score_order: str = "max"):
+                 score_attribute: Optional[str] = None,
+                 score_order: str = "max", async_write: bool = False,
+                 storage=None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.num_to_keep = num_to_keep
         self.score_attribute = score_attribute
         self.score_order = score_order
+        self.storage = storage
         self._ckpts: list = []  # (score, path, metrics)
+        self._executor = None
+        self._pending = None
+        if async_write:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-persist")
 
     def register(self, checkpoint: Checkpoint,
                  metrics: Optional[Dict[str, Any]] = None) -> Checkpoint:
         metrics = metrics or {}
         dest = os.path.join(self.root, f"checkpoint_{uuid.uuid4().hex[:8]}")
-        persisted = Checkpoint(checkpoint.to_directory(dest))
+
+        def persist():
+            checkpoint.to_directory(dest)
+            if self.storage is not None:
+                self.storage.upload_dir(dest, os.path.basename(dest))
+            return dest
+
+        if self._executor is not None:
+            self.flush()  # one persist in flight, in submission order
+            self._pending = (self._executor.submit(persist), dest)
+        else:
+            persist()
+        persisted = Checkpoint(dest)
         score = metrics.get(self.score_attribute) if self.score_attribute else None
         self._ckpts.append((score, persisted, metrics))
         self._evict()
         return persisted
+
+    def flush(self) -> None:
+        """Join the in-flight async persist. A failed persist is dropped
+        from the retention list (its directory never completed) before the
+        error re-raises, so ``latest``/``best`` can't hand out a
+        half-written checkpoint."""
+        pending, self._pending = self._pending, None
+        if pending is None:
+            return
+        fut, dest = pending
+        try:
+            fut.result()
+        except Exception:
+            self._ckpts = [c for c in self._ckpts if c[1].path != dest]
+            raise
+
+    def close(self) -> None:
+        """Join outstanding persists and release the worker thread."""
+        try:
+            self.flush()
+        finally:
+            if self._executor is not None:
+                self._executor.shutdown(wait=True)
+                self._executor = None
 
     def _evict(self):
         if self.num_to_keep is None or len(self._ckpts) <= self.num_to_keep:
@@ -131,8 +183,22 @@ class CheckpointManager:
         keep = ordered[: self.num_to_keep] if self.score_attribute else \
             self._ckpts[-self.num_to_keep:]
         drop = [c for c in self._ckpts if not any(c[1] is k[1] for k in keep)]
+        # Flush only when a dropped directory is the one still being
+        # persisted (possible with score-based eviction); the common FIFO
+        # case keeps async writes actually asynchronous.
+        if self._pending is not None and any(
+                c[1].path == self._pending[1] for c in drop):
+            self.flush()
         for _, ckpt, _ in drop:
             shutil.rmtree(ckpt.path, ignore_errors=True)
+            if self.storage is not None:
+                # num_to_keep governs the mirror too, or remote usage
+                # grows without bound.
+                try:
+                    self.storage.delete_dir(self.storage.join(
+                        os.path.basename(ckpt.path)))
+                except Exception:  # noqa: BLE001 — best-effort prune
+                    pass
         self._ckpts = [c for c in self._ckpts if any(c[1] is k[1] for k in keep)]
 
     @property

@@ -69,6 +69,9 @@ class CheckpointConfig:
     checkpoint_score_attribute: Optional[str] = None
     checkpoint_score_order: str = "max"
     checkpoint_frequency: int = 0
+    # Persist checkpoints on a background thread (orbax-style: one write
+    # in flight; the trainer joins it before restarts/results).
+    async_write: bool = False
 
 
 @dataclasses.dataclass

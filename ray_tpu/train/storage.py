@@ -1,0 +1,154 @@
+"""Checkpoint storage: pyarrow-fs persistence + async writes.
+
+Reference: ``train/_internal/storage.py:358`` (``StorageContext`` — local ↔
+cloud filesystem paths via pyarrow.fs) and the orbax-style async
+checkpointing the reference reaches through Train's checkpoint upload
+path: the device→host snapshot is taken synchronously (so the saved state
+is consistent even if training mutates it immediately after), while
+serialization and the filesystem write happen on a background thread that
+the trainer only joins at the next save or at shutdown.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import posixpath
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Optional
+
+_URI_SEP = "://"
+
+
+class StorageContext:
+    """Resolves an experiment's storage root onto a pyarrow FileSystem.
+
+    ``storage_path`` may be a plain local path or a pyarrow-fs URI
+    (``file:///...``, ``s3://bucket/...``); uploads/downloads then work
+    against whichever filesystem backs it.
+    """
+
+    def __init__(self, storage_path: str, experiment_name: str):
+        from pyarrow import fs as pafs
+
+        if _URI_SEP in storage_path:
+            self.fs, base = pafs.FileSystem.from_uri(storage_path)
+        else:
+            self.fs = pafs.LocalFileSystem()
+            base = os.path.abspath(storage_path)
+        self.storage_path = storage_path
+        self.experiment_dir = posixpath.join(base, experiment_name)
+        self.fs.create_dir(self.experiment_dir, recursive=True)
+
+    def join(self, *parts: str) -> str:
+        return posixpath.join(self.experiment_dir, *parts)
+
+    def upload_dir(self, local_dir: str, remote_rel: str) -> str:
+        """Recursively copy ``local_dir`` under the experiment dir; returns
+        the storage path of the uploaded directory."""
+        dest_root = self.join(remote_rel)
+        self.fs.create_dir(dest_root, recursive=True)
+        for root, _, files in os.walk(local_dir):
+            rel = os.path.relpath(root, local_dir)
+            droot = dest_root if rel == "." else posixpath.join(
+                dest_root, rel.replace(os.sep, "/"))
+            self.fs.create_dir(droot, recursive=True)
+            for fname in files:
+                with open(os.path.join(root, fname), "rb") as src, \
+                        self.fs.open_output_stream(
+                            posixpath.join(droot, fname)) as dst:
+                    dst.write(src.read())
+        return dest_root
+
+    def download_dir(self, remote_path: str, local_dir: str) -> str:
+        """Copy a stored directory back to ``local_dir``."""
+        from pyarrow import fs as pafs
+
+        os.makedirs(local_dir, exist_ok=True)
+        selector = pafs.FileSelector(remote_path, recursive=True)
+        for entry in self.fs.get_file_info(selector):
+            rel = posixpath.relpath(entry.path, remote_path)
+            target = os.path.join(local_dir, rel)
+            if entry.type == pafs.FileType.Directory:
+                os.makedirs(target, exist_ok=True)
+                continue
+            os.makedirs(os.path.dirname(target), exist_ok=True)
+            with self.fs.open_input_stream(entry.path) as src, \
+                    open(target, "wb") as dst:
+                dst.write(src.read())
+        return local_dir
+
+    def delete_dir(self, remote_path: str) -> None:
+        from pyarrow import fs as pafs
+
+        if self.fs.get_file_info(remote_path).type != pafs.FileType.NotFound:
+            self.fs.delete_dir(remote_path)
+
+    def exists(self, remote_path: str) -> bool:
+        from pyarrow import fs as pafs
+
+        info = self.fs.get_file_info(remote_path)
+        return info.type != pafs.FileType.NotFound
+
+
+class AsyncCheckpointer:
+    """Orbax-style async checkpoint writer.
+
+    ``save()`` snapshots device arrays to host *synchronously* (the part
+    that must be consistent with the training step), then hands
+    serialization + the write to a single background thread. A new save
+    first waits for the previous one — at most one write is ever in
+    flight, matching orbax AsyncCheckpointer semantics — so checkpoints
+    can never interleave on disk.
+    """
+
+    def __init__(self, storage: Optional[StorageContext] = None):
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="async-ckpt")
+        self._pending: Optional[Future] = None
+        self._lock = threading.Lock()
+        self.storage = storage
+
+    def save(self, tree: Any, directory: str, name: str = "state",
+             upload_rel: Optional[str] = None) -> Future:
+        """Snapshot now, write later. Returns the write's Future (resolves
+        to the checkpoint directory, or the storage path if uploaded)."""
+        import jax
+        import numpy as np
+
+        self.wait()  # one write in flight, in order
+        leaves, treedef = jax.tree.flatten(tree)
+        host_leaves = [np.asarray(x) for x in leaves]  # snapshot point
+
+        def write() -> str:
+            os.makedirs(directory, exist_ok=True)
+            tmp = os.path.join(directory, f".{name}.npz.tmp")
+            with open(tmp, "wb") as f:
+                np.savez(f, **{str(i): a for i, a in
+                               enumerate(host_leaves)})
+            os.replace(tmp, os.path.join(directory, f"{name}.npz"))
+            with open(os.path.join(directory,
+                                   f"{name}.treedef.pkl"), "wb") as f:
+                pickle.dump(treedef, f)
+            if self.storage is not None and upload_rel is not None:
+                return self.storage.upload_dir(directory, upload_rel)
+            return directory
+
+        fut = self._executor.submit(write)
+        with self._lock:
+            self._pending = fut
+        return fut
+
+    def wait(self) -> None:
+        """Block until the in-flight write (if any) completes; re-raises
+        its error so a failed persist is never silent."""
+        with self._lock:
+            fut = self._pending
+            self._pending = None
+        if fut is not None:
+            fut.result()
+
+    def close(self) -> None:
+        self.wait()
+        self._executor.shutdown(wait=True)

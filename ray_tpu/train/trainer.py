@@ -97,10 +97,21 @@ class JaxTrainer:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         rc = self.run_config
-        storage = rc.storage_path or os.path.join(
+        storage_path = rc.storage_path or os.path.join(
             tempfile.gettempdir(), "ray_tpu_results")
         name = rc.name or f"JaxTrainer_{int(time.time())}"
-        exp_dir = os.path.join(storage, name)
+        storage = None
+        if "://" in storage_path:
+            # Cloud-fs persistence (reference StorageContext): the run's
+            # working dir stays local; checkpoints mirror to the pyarrow
+            # filesystem behind the URI.
+            from ray_tpu.train.storage import StorageContext
+
+            storage = StorageContext(storage_path, name)
+            exp_dir = os.path.join(tempfile.gettempdir(),
+                                   "ray_tpu_results", name)
+        else:
+            exp_dir = os.path.join(storage_path, name)
         os.makedirs(exp_dir, exist_ok=True)
 
         ckpt_cfg: CheckpointConfig = rc.checkpoint_config
@@ -109,6 +120,8 @@ class JaxTrainer:
             num_to_keep=ckpt_cfg.num_to_keep,
             score_attribute=ckpt_cfg.checkpoint_score_attribute,
             score_order=ckpt_cfg.checkpoint_score_order,
+            async_write=ckpt_cfg.async_write,
+            storage=storage,
         )
 
         failure_cfg: FailureConfig = rc.failure_config
@@ -155,12 +168,26 @@ class JaxTrainer:
                     self._set_state(ControllerState.ERRORED)
                     break
                 self._set_state(ControllerState.RESTARTING)
+                try:
+                    # Restore only from fully-persisted dirs; a failed
+                    # async persist drops its entry and must not abort
+                    # the recovery it exists to serve.
+                    manager.flush()
+                except Exception as persist_err:  # noqa: BLE001
+                    logger.warning("checkpoint persist failed (%s); "
+                                   "restoring from the previous one",
+                                   persist_err)
                 restore = manager.latest or restore
                 logger.warning(
                     "Training attempt %d failed (%s); restarting from %s",
                     failures, e,
                     restore.path if restore else "scratch")
 
+        try:
+            manager.close()
+        except Exception as persist_err:  # noqa: BLE001
+            logger.warning("final checkpoint persist failed: %s",
+                           persist_err)
         return Result(
             metrics=latest_metrics,
             checkpoint=manager.best,

@@ -131,6 +131,24 @@ def test_dashboard_endpoints(cluster):
         dash.stop()
 
 
+def test_dashboard_frontend_and_agents(cluster):
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(cluster.address, port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/", timeout=10) as r:
+            html = r.read().decode()
+        assert "ray_tpu dashboard" in html
+        assert "/api/cluster_status" in html
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/api/agents",
+                timeout=10) as r:
+            assert isinstance(json.loads(r.read()), list)
+    finally:
+        dash.stop()
+
+
 def test_cli_status(cluster, capsys):
     from ray_tpu.scripts.cli import main
 

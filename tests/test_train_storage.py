@@ -1,0 +1,117 @@
+"""Checkpoint storage layer tests (reference: train/_internal/storage.py
+StorageContext + the async/cloud checkpoint persistence path)."""
+
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train
+from ray_tpu.train.storage import AsyncCheckpointer, StorageContext
+
+
+def test_storage_context_roundtrip(tmp_path):
+    remote = tmp_path / "bucket"
+    local = tmp_path / "work"
+    (local / "sub").mkdir(parents=True)
+    (local / "a.txt").write_text("A")
+    (local / "sub" / "b.bin").write_bytes(b"\x00\x01")
+
+    ctx = StorageContext(f"file://{remote}", "exp1")
+    dest = ctx.upload_dir(str(local), "checkpoint_0")
+    assert ctx.exists(dest)
+
+    back = tmp_path / "restored"
+    ctx.download_dir(dest, str(back))
+    assert (back / "a.txt").read_text() == "A"
+    assert (back / "sub" / "b.bin").read_bytes() == b"\x00\x01"
+
+
+def test_storage_context_plain_path(tmp_path):
+    ctx = StorageContext(str(tmp_path / "plain"), "exp2")
+    local = tmp_path / "src"
+    local.mkdir()
+    (local / "x").write_text("x")
+    dest = ctx.upload_dir(str(local), "ck")
+    assert os.path.exists(os.path.join(dest, "x"))
+
+
+def test_async_checkpointer_snapshot_isolation(tmp_path):
+    """The saved state is the state at save() time, even when training
+    mutates the tree immediately afterwards (orbax snapshot semantics)."""
+    ck = AsyncCheckpointer()
+    tree = {"w": jnp.ones((4, 4)), "step": jnp.asarray(3)}
+    fut = ck.save(tree, str(tmp_path / "c0"))
+    tree["w"] = tree["w"] * 100.0  # mutate after snapshot
+    fut.result()
+    restored = train.load_pytree(str(tmp_path / "c0"))
+    np.testing.assert_allclose(np.asarray(restored["w"]), np.ones((4, 4)))
+    ck.close()
+
+
+def test_async_checkpointer_single_flight_and_upload(tmp_path):
+    ctx = StorageContext(f"file://{tmp_path / 'store'}", "exp")
+    ck = AsyncCheckpointer(storage=ctx)
+    for step in range(3):
+        ck.save({"s": jnp.asarray(step)}, str(tmp_path / f"c{step}"),
+                upload_rel=f"ck_{step}")
+    ck.wait()
+    for step in range(3):
+        assert ctx.exists(ctx.join(f"ck_{step}", "state.npz"))
+    ck.close()
+
+
+def test_checkpoint_manager_async_topk(tmp_path):
+    mgr = train.CheckpointManager(str(tmp_path / "ckpts"), num_to_keep=2,
+                                  async_write=True)
+    src = tmp_path / "src"
+    src.mkdir()
+    for i in range(4):
+        (src / "v.txt").write_text(str(i))
+        mgr.register(train.Checkpoint(str(src)), metrics={"i": i})
+    mgr.flush()
+    kept = [p for p in os.listdir(tmp_path / "ckpts")]
+    assert len(kept) == 2
+    assert mgr.latest is not None
+    with open(os.path.join(mgr.latest.path, "v.txt")) as f:
+        assert f.read() == "3"
+
+
+def test_trainer_with_uri_storage_and_async(tmp_path):
+    """End-to-end: JaxTrainer mirrors checkpoints to a file:// URI with
+    async persistence on."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        def loop():
+            for step in range(3):
+                train.report({"loss": 1.0 / (step + 1)},
+                             checkpoint=train.Checkpoint.from_dict(
+                                 {"step": step}))
+
+        result = train.JaxTrainer(
+            loop,
+            scaling_config=train.ScalingConfig(num_workers=1),
+            run_config=train.RunConfig(
+                name="uri_exp",
+                storage_path=f"file://{tmp_path / 'remote'}",
+                checkpoint_config=train.CheckpointConfig(
+                    num_to_keep=2, async_write=True)),
+        ).fit()
+        assert result.error is None
+        assert result.checkpoint is not None
+        assert result.checkpoint.to_dict()["step"] == 2
+        # Mirrored to the URI filesystem.
+        ctx = StorageContext(f"file://{tmp_path / 'remote'}", "uri_exp")
+        from pyarrow import fs as pafs
+
+        entries = ctx.fs.get_file_info(
+            pafs.FileSelector(ctx.experiment_dir, recursive=False))
+        assert any(e.base_name.startswith("checkpoint_")
+                   for e in entries)
+    finally:
+        ray_tpu.shutdown()
