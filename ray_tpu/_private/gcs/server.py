@@ -58,6 +58,23 @@ class GcsServer:
         # pushed by workers over the TASK_EVENT pubsub channel.
         self._task_events: "deque" = deque(
             maxlen=int(os.environ.get("RAY_TPU_TASK_EVENTS_MAX", 10000)))
+        # Export-event framework (C11, reference util/event.h RayEvent +
+        # protobuf/export_api): structured lifecycle events (node / actor /
+        # placement-group transitions) in a bounded buffer served through
+        # the __events__ KV namespace, and appended as JSONL to
+        # RAY_TPU_EVENT_DIR for external consumers when set.
+        self._export_events: "deque" = deque(
+            maxlen=int(os.environ.get("RAY_TPU_EXPORT_EVENTS_MAX", 10000)))
+        self._event_dir = os.environ.get("RAY_TPU_EVENT_DIR") or None
+        self._event_file_lock = threading.Lock()
+        self._event_file_bytes = 0
+        if self._event_dir:
+            os.makedirs(self._event_dir, exist_ok=True)
+            try:  # rotation threshold survives GCS restarts
+                self._event_file_bytes = os.path.getsize(
+                    os.path.join(self._event_dir, "events.jsonl"))
+            except OSError:
+                pass
         # actors
         self._actors: Dict[bytes, pb.ActorInfo] = {}
         self._actor_names: Dict[Tuple[str, str], bytes] = {}
@@ -247,6 +264,29 @@ class GcsServer:
         return rpc.get_stub("NodeService", info.address)
 
     # ------------------------------------------------------------- nodes
+    EVENT_FILE_MAX_BYTES = 16 << 20
+
+    def _export_event(self, etype: str, **fields) -> None:
+        """Record a structured lifecycle event (reference C11: RayEvent
+        JSON event files + export API). Buffered for the __events__ KV
+        read path; appended to a rotating JSONL when RAY_TPU_EVENT_DIR."""
+        rec = {"ts": time.time(), "type": etype, **fields}
+        self._export_events.append(rec)
+        if not self._event_dir:
+            return
+        try:
+            line = json.dumps(rec, default=str) + "\n"
+            path = os.path.join(self._event_dir, "events.jsonl")
+            with self._event_file_lock:
+                if self._event_file_bytes > self.EVENT_FILE_MAX_BYTES:
+                    os.replace(path, path + ".1")  # single-slot rotation
+                    self._event_file_bytes = 0
+                with open(path, "a") as f:
+                    f.write(line)
+                self._event_file_bytes += len(line)
+        except Exception:  # noqa: BLE001 — export is best-effort
+            pass
+
     def RegisterNode(self, request, context):
         info = request.info
         with self._lock:
@@ -254,6 +294,9 @@ class GcsServer:
             self._nodes[info.node_id] = info
             self._last_heartbeat[info.node_id] = time.monotonic()
         logger.info("node %s registered at %s", info.node_id[:8], info.address)
+        self._export_event("NODE_ALIVE", node_id=info.node_id,
+                           address=info.address,
+                           resources=dict(info.resources))
         self._publish("NODE", pickle.dumps(
             {"event": "alive", "node_id": info.node_id}))
         if getattr(self, "_restore_pending", None):
@@ -323,13 +366,14 @@ class GcsServer:
                 return
             info.alive = False
         logger.warning("node %s marked dead: %s", node_id[:8], reason)
+        self._export_event("NODE_DEAD", node_id=node_id, reason=reason)
         self._publish("NODE", pickle.dumps(
             {"event": "dead", "node_id": node_id, "reason": reason}))
         self._on_node_dead(node_id)
 
     # ------------------------------------------------------------- kv
     def KvPut(self, request, context):
-        if request.ns in ("__task_events__", "__memory__"):
+        if request.ns in ("__task_events__", "__memory__", "__events__"):
             # Reserved: reads in these namespaces serve the task-event ring
             # buffer / memory report, so stored values would be unreachable.
             return pb.KvReply(ok=False)
@@ -345,6 +389,10 @@ class GcsServer:
         if request.ns == "__task_events__":
             with self._lock:
                 events = list(self._task_events)
+            return pb.KvReply(found=True, value=pickle.dumps(events))
+        if request.ns == "__events__":
+            with self._lock:
+                events = list(self._export_events)
             return pb.KvReply(found=True, value=pickle.dumps(events))
         if request.ns == "__memory__":
             # Reserved: cluster memory report for `ray-tpu memory` / state
@@ -400,6 +448,8 @@ class GcsServer:
                 self._actor_names[key] = info.actor_id
             self._actors[info.actor_id] = info
         self._mark_dirty()
+        self._export_event("ACTOR_REGISTERED", actor_id=info.actor_id.hex(),
+                           class_name=info.class_name, name=info.name)
         self._publish("ACTOR", info.SerializeToString())
         if info.state == "PENDING":
             # GCS-direct actor creation (reference: GcsActorScheduler
@@ -426,6 +476,10 @@ class GcsServer:
                 if self._actor_names.get(key) == info.actor_id:
                     del self._actor_names[key]
         self._mark_dirty()
+        self._export_event("ACTOR_STATE", actor_id=info.actor_id.hex(),
+                           state=info.state, node_id=info.node_id,
+                           num_restarts=info.num_restarts,
+                           death_cause=info.death_cause)
         self._publish("ACTOR", info.SerializeToString())
         if restart:
             self._work_pool.submit(self._restart_actor, info)
@@ -690,6 +744,10 @@ class GcsServer:
         with self._lock:
             self._pgroups[request.group_id] = info
         self._mark_dirty()
+        self._export_event("PLACEMENT_GROUP_CREATED",
+                           group_id=request.group_id.hex(),
+                           name=request.name, strategy=request.strategy,
+                           num_bundles=len(request.bundles))
         self._submit_place(info)
         return pb.Empty()
 

@@ -9,14 +9,17 @@ from ray_tpu.data.dataset import (
     from_numpy,
     from_pandas,
     range,
+    read_binary_files,
     read_csv,
     read_json,
     read_parquet,
+    read_text,
 )
 
 __all__ = [
     "ActorPoolStrategy", "DataIterator", "Dataset", "from_arrow", "from_items", "from_numpy",
-    "from_pandas", "range", "read_csv", "read_json", "read_parquet",
+    "from_pandas", "range", "read_binary_files", "read_csv", "read_json",
+    "read_parquet", "read_text",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -18,6 +18,8 @@ from __future__ import annotations
 import builtins
 import glob as glob_mod
 import itertools
+import os
+import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
@@ -334,6 +336,26 @@ class Dataset:
             cpus = 4.0
         window_cap = max(2, min(MAX_IN_FLIGHT, int(cpus * 2)))
 
+        # Memory backpressure (reference: execution/backpressure_policy/):
+        # when the cluster object store holds more than the budget, drain
+        # the whole window before launching more block tasks — in-flight
+        # outputs get consumed/freed instead of piling into a spill storm.
+        mem_budget = int(os.environ.get(
+            "RAY_TPU_DATA_MEMORY_BUDGET_BYTES", 2 << 30))
+        mem_check = {"next": 0.0}
+
+        def over_memory_budget() -> bool:
+            now = time.monotonic()
+            if now < mem_check["next"]:
+                return False
+            mem_check["next"] = now + 0.5  # probe at most 2x/sec
+            try:
+                from ray_tpu.util.state import memory_summary
+
+                return memory_summary()["total_bytes"] > mem_budget
+            except Exception:  # noqa: BLE001
+                return False
+
         out = []
         window: List[Any] = []
         produced = 0
@@ -341,8 +363,13 @@ class Dataset:
             if limit is not None and produced >= limit:
                 break
             window.append(apply_stages(ref))
-            if len(window) >= window_cap:
+            if len(window) >= window_cap or \
+                    (window and over_memory_budget()):
                 done = window.pop(0)
+                # BLOCK until the oldest in-flight block finishes — without
+                # this wait the window would only shuffle refs between
+                # lists while every task launches at full speed.
+                ray_tpu.wait([done], num_returns=1, timeout=None)
                 out.append(done)
                 if limit is not None:
                     produced += len(ray_tpu.get(done))
@@ -621,14 +648,23 @@ def from_arrow(table: pa.Table) -> Dataset:
     return Dataset([ray_tpu.put(table)])
 
 
-def _read_files(paths, fmt: str, parallelism: int) -> Dataset:
+def _expand_paths(paths) -> List[str]:
+    """Files from a path / glob / directory / list thereof."""
     if isinstance(paths, str):
         paths = [paths]
     files: List[str] = []
     for p in paths:
+        if os.path.isdir(p):
+            for root, _, fnames in os.walk(p):
+                files.extend(os.path.join(root, f) for f in sorted(fnames))
+            continue
         matches = sorted(glob_mod.glob(p))
         files.extend(matches if matches else [p])
-    refs = [_read_file_block.remote(f, fmt) for f in files]
+    return files
+
+
+def _read_files(paths, fmt: str, parallelism: int) -> Dataset:
+    refs = [_read_file_block.remote(f, fmt) for f in _expand_paths(paths)]
     return Dataset(refs)
 
 
@@ -642,3 +678,47 @@ def read_csv(paths, *, parallelism: int = 8) -> Dataset:
 
 def read_json(paths, *, parallelism: int = 8) -> Dataset:
     return _read_files(paths, "json", parallelism)
+
+
+def read_binary_files(paths, *, include_paths: bool = True,
+                      parallelism: int = 8) -> Dataset:
+    """One row per file: ``{"bytes": ..., "path": ...}`` (reference:
+    ``ray.data.read_binary_files`` — the raw-ingest entry point image/audio
+    pipelines decode with ``map``)."""
+    files = _expand_paths(paths)
+    groups = [files[i::parallelism]
+              for i in builtins.range(parallelism)
+              if files[i::parallelism]]
+
+    @ray_tpu.remote
+    def load(group):
+        rows = {"bytes": []}
+        if include_paths:
+            rows["path"] = []
+        for path in group:
+            with open(path, "rb") as f:
+                rows["bytes"].append(f.read())
+            if include_paths:
+                rows["path"].append(path)
+        return pa.table(rows)
+
+    return Dataset([load.remote(g) for g in groups])
+
+
+def read_text(paths, *, parallelism: int = 8) -> Dataset:
+    """One row per line: ``{"text": ...}`` (reference:
+    ``ray.data.read_text``)."""
+    files = _expand_paths(paths)
+    groups = [files[i::parallelism]
+              for i in builtins.range(parallelism)
+              if files[i::parallelism]]
+
+    @ray_tpu.remote
+    def load(group):
+        lines = []
+        for path in group:
+            with open(path, encoding="utf-8") as f:
+                lines.extend(line.rstrip("\n") for line in f)
+        return pa.table({"text": lines})
+
+    return Dataset([load.remote(g) for g in groups])

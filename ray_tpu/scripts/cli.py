@@ -194,6 +194,8 @@ def cmd_list(args):
         rows = state.memory_summary()["objects"][:args.limit]
     elif kind == "placement-groups":
         rows = state.list_placement_groups()
+    elif kind == "cluster-events":
+        rows = state.list_cluster_events(limit=args.limit)
     else:  # pragma: no cover - argparse choices guard this
         raise SystemExit(f"unknown kind {kind}")
     if args.format == "json":
@@ -369,7 +371,7 @@ def main(argv=None):
 
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("kind", choices=["nodes", "actors", "tasks", "objects",
-                                    "placement-groups"])
+                                    "placement-groups", "cluster-events"])
     p.add_argument("--address")
     p.add_argument("--limit", type=int, default=100)
     p.add_argument("--format", choices=["table", "json"], default="table")

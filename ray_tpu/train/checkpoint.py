@@ -125,16 +125,21 @@ class CheckpointManager:
                  metrics: Optional[Dict[str, Any]] = None) -> Checkpoint:
         metrics = metrics or {}
         dest = os.path.join(self.root, f"checkpoint_{uuid.uuid4().hex[:8]}")
+        # The local copy is ALWAYS synchronous: callers may reuse/mutate
+        # the source directory right after register(), so a background
+        # copy would capture mixed state. Async mode offloads only the
+        # storage upload — the slow leg — which reads the stable `dest`.
+        checkpoint.to_directory(dest)
 
         def persist():
-            checkpoint.to_directory(dest)
             if self.storage is not None:
                 self.storage.upload_dir(dest, os.path.basename(dest))
             return dest
 
         if self._executor is not None:
             self.flush()  # one persist in flight, in submission order
-            self._pending = (self._executor.submit(persist), dest)
+            if self.storage is not None:
+                self._pending = (self._executor.submit(persist), dest)
         else:
             persist()
         persisted = Checkpoint(dest)

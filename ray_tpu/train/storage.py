@@ -55,10 +55,12 @@ class StorageContext:
                 dest_root, rel.replace(os.sep, "/"))
             self.fs.create_dir(droot, recursive=True)
             for fname in files:
+                import shutil
+
                 with open(os.path.join(root, fname), "rb") as src, \
                         self.fs.open_output_stream(
                             posixpath.join(droot, fname)) as dst:
-                    dst.write(src.read())
+                    shutil.copyfileobj(src, dst, 1 << 20)
         return dest_root
 
     def download_dir(self, remote_path: str, local_dir: str) -> str:
@@ -74,9 +76,11 @@ class StorageContext:
                 os.makedirs(target, exist_ok=True)
                 continue
             os.makedirs(os.path.dirname(target), exist_ok=True)
+            import shutil
+
             with self.fs.open_input_stream(entry.path) as src, \
                     open(target, "wb") as dst:
-                dst.write(src.read())
+                shutil.copyfileobj(src, dst, 1 << 20)
         return local_dir
 
     def delete_dir(self, remote_path: str) -> None:
@@ -119,7 +123,10 @@ class AsyncCheckpointer:
 
         self.wait()  # one write in flight, in order
         leaves, treedef = jax.tree.flatten(tree)
-        host_leaves = [np.asarray(x) for x in leaves]  # snapshot point
+        # Snapshot point: np.array COPIES (np.asarray would alias numpy
+        # leaves, letting in-place mutation after save() corrupt the
+        # checkpoint the background thread is still serializing).
+        host_leaves = [np.array(x) for x in leaves]
 
         def write() -> str:
             os.makedirs(directory, exist_ok=True)

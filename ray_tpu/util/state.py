@@ -122,6 +122,23 @@ def task_timeline() -> List[Dict[str, Any]]:
     return out
 
 
+def list_cluster_events(limit: int = 1000) -> List[Dict[str, Any]]:
+    """Structured lifecycle events from the GCS export-event buffer
+    (reference C11: RayEvent export framework; `ray list cluster-events`).
+    Cluster mode only."""
+    core = _core()
+    gcs = getattr(core, "gcs", None)
+    if gcs is None:
+        return []
+    import pickle
+
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    reply = gcs.KvGet(pb.KvRequest(ns="__events__", key=""))
+    events = pickle.loads(reply.value) if reply.found else []
+    return events[-limit:]
+
+
 def memory_summary() -> Dict[str, Any]:
     """Cluster object-memory report (reference: ``ray memory`` — per-object
     size, store locations, and reference holders from the GCS tables)."""

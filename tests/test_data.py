@@ -155,3 +155,35 @@ def test_dataset_stats_recorded(ray_start_regular):
         stats = ds.stats()
     assert "map" in stats and "filter" in stats, stats
     assert "rows in" in stats
+
+
+def test_read_binary_files_and_text(tmp_path):
+    (tmp_path / "a.bin").write_bytes(b"\x00\x01\x02")
+    (tmp_path / "b.bin").write_bytes(b"hello")
+    ds = rdata.read_binary_files(str(tmp_path / "*.bin"))
+    rows = sorted(ds.take_all(), key=lambda r: r["path"])
+    assert rows[0]["bytes"] == b"\x00\x01\x02"
+    assert rows[1]["bytes"] == b"hello"
+    assert rows[0]["path"].endswith("a.bin")
+
+    (tmp_path / "t.txt").write_text("line1\nline2\n")
+    txt = rdata.read_text(str(tmp_path / "t.txt")).take_all()
+    assert [r["text"] for r in txt] == ["line1", "line2"]
+
+
+def test_read_directory_expansion(tmp_path):
+    sub = tmp_path / "nested"
+    sub.mkdir()
+    (sub / "x.txt").write_text("deep\n")
+    (tmp_path / "top.txt").write_text("top\n")
+    rows = rdata.read_text(str(tmp_path)).take_all()
+    assert sorted(r["text"] for r in rows) == ["deep", "top"]
+
+
+def test_memory_backpressure_env_drains_window(monkeypatch):
+    """With a zero budget every block drains immediately — the pipeline
+    still completes and produces correct results."""
+    monkeypatch.setenv("RAY_TPU_DATA_MEMORY_BUDGET_BYTES", "0")
+    ds = rdata.range(100, parallelism=8).map(lambda r: {"v": r["id"] * 2})
+    got = sorted(r["v"] for r in ds.take_all())
+    assert got == [i * 2 for i in __import__('builtins').range(100)]

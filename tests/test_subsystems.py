@@ -398,3 +398,40 @@ def test_dashboard_logs_and_tasks_endpoints(cluster):
     finally:
         dash.stop()
         ray_tpu.shutdown()
+
+
+# -------------------------------------------------- export events (C11)
+
+def test_export_events_buffer_and_file(tmp_path, monkeypatch):
+    """Lifecycle transitions produce structured export events, readable
+    via the state API and appended as JSONL when RAY_TPU_EVENT_DIR is set
+    (reference C11: RayEvent files + export API)."""
+    monkeypatch.setenv("RAY_TPU_EVENT_DIR", str(tmp_path / "events"))
+    from ray_tpu.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 2})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)
+    try:
+        @ray_tpu.remote
+        class E:
+            def ping(self):
+                return 1
+
+        a = E.remote()
+        ray_tpu.get(a.ping.remote())
+        events = rstate.list_cluster_events()
+        types = {e["type"] for e in events}
+        assert "NODE_ALIVE" in types
+        assert "ACTOR_REGISTERED" in types or "ACTOR_STATE" in types
+        path = tmp_path / "events" / "events.jsonl"
+        assert path.exists()
+        lines = [json.loads(line) for line in
+                 path.read_text().splitlines() if line]
+        assert any(rec["type"] == "NODE_ALIVE" for rec in lines)
+        ray_tpu.kill(a)
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
