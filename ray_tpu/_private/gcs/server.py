@@ -271,7 +271,8 @@ class GcsServer:
         JSON event files + export API). Buffered for the __events__ KV
         read path; appended to a rotating JSONL when RAY_TPU_EVENT_DIR."""
         rec = {"ts": time.time(), "type": etype, **fields}
-        self._export_events.append(rec)
+        with self._lock:  # KvGet(__events__) list()s this concurrently
+            self._export_events.append(rec)
         if not self._event_dir:
             return
         try:

@@ -655,7 +655,8 @@ def _expand_paths(paths) -> List[str]:
     files: List[str] = []
     for p in paths:
         if os.path.isdir(p):
-            for root, _, fnames in os.walk(p):
+            for root, dirs, fnames in os.walk(p):
+                dirs.sort()  # deterministic traversal order
                 files.extend(os.path.join(root, f) for f in sorted(fnames))
             continue
         matches = sorted(glob_mod.glob(p))
@@ -680,17 +681,21 @@ def read_json(paths, *, parallelism: int = 8) -> Dataset:
     return _read_files(paths, "json", parallelism)
 
 
+def _read_grouped(paths, parallelism: int, loader) -> Dataset:
+    """Stride files into groups and run ``loader(group) -> pa.Table`` as
+    one remote task per group (shared scaffold for whole-file readers)."""
+    files = _expand_paths(paths)
+    groups = [g for i in builtins.range(parallelism)
+              if (g := files[i::parallelism])]
+    remote_loader = ray_tpu.remote(loader)
+    return Dataset([remote_loader.remote(g) for g in groups])
+
+
 def read_binary_files(paths, *, include_paths: bool = True,
                       parallelism: int = 8) -> Dataset:
     """One row per file: ``{"bytes": ..., "path": ...}`` (reference:
     ``ray.data.read_binary_files`` — the raw-ingest entry point image/audio
     pipelines decode with ``map``)."""
-    files = _expand_paths(paths)
-    groups = [files[i::parallelism]
-              for i in builtins.range(parallelism)
-              if files[i::parallelism]]
-
-    @ray_tpu.remote
     def load(group):
         rows = {"bytes": []}
         if include_paths:
@@ -702,23 +707,17 @@ def read_binary_files(paths, *, include_paths: bool = True,
                 rows["path"].append(path)
         return pa.table(rows)
 
-    return Dataset([load.remote(g) for g in groups])
+    return _read_grouped(paths, parallelism, load)
 
 
 def read_text(paths, *, parallelism: int = 8) -> Dataset:
     """One row per line: ``{"text": ...}`` (reference:
     ``ray.data.read_text``)."""
-    files = _expand_paths(paths)
-    groups = [files[i::parallelism]
-              for i in builtins.range(parallelism)
-              if files[i::parallelism]]
-
-    @ray_tpu.remote
     def load(group):
         lines = []
         for path in group:
             with open(path, encoding="utf-8") as f:
-                lines.extend(line.rstrip("\n") for line in f)
+                lines.extend(f.read().splitlines())
         return pa.table({"text": lines})
 
-    return Dataset([load.remote(g) for g in groups])
+    return _read_grouped(paths, parallelism, load)

@@ -10,9 +10,10 @@ from ray_tpu.rllib.core import (
     Transition,
     compute_gae,
 )
-from ray_tpu.rllib.core import ImpalaLearner, vtrace
+from ray_tpu.rllib.core import ImpalaLearner, SACLearner, SACModule, vtrace
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.env_runner import (
+    ContinuousEnvRunner,
     EnvRunnerGroup,
     SingleAgentEnvRunner,
     TrajectoryEnvRunner,
@@ -20,14 +21,16 @@ from ray_tpu.rllib.env_runner import (
 )
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.learner_group import LearnerGroup
+from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
 __all__ = [
-    "DQN", "DQNConfig", "DQNLearner", "DQNModule", "EnvRunnerGroup",
-    "IMPALA", "IMPALAConfig", "ImpalaLearner", "LearnerGroup", "PPO",
-    "PPOConfig", "PPOLearner", "PPOModule", "ReplayBuffer", "SampleBatch",
-    "SingleAgentEnvRunner", "TrajectoryEnvRunner", "Transition",
-    "TransitionEnvRunner", "compute_gae", "vtrace",
+    "ContinuousEnvRunner", "DQN", "DQNConfig", "DQNLearner", "DQNModule",
+    "EnvRunnerGroup", "IMPALA", "IMPALAConfig", "ImpalaLearner",
+    "LearnerGroup", "PPO", "PPOConfig", "PPOLearner", "PPOModule",
+    "ReplayBuffer", "SAC", "SACConfig", "SACLearner", "SACModule",
+    "SampleBatch", "SingleAgentEnvRunner", "TrajectoryEnvRunner",
+    "Transition", "TransitionEnvRunner", "compute_gae", "vtrace",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

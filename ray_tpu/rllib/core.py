@@ -11,7 +11,7 @@ learner mesh with the same sharding machinery as ray_tpu.models.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -302,13 +302,19 @@ class Transition(NamedTuple):
 
 class ReplayBuffer:
     """Uniform ring-buffer replay (reference:
-    ``rllib/utils/replay_buffers/replay_buffer.py``)."""
+    ``rllib/utils/replay_buffers/replay_buffer.py``). ``action_dim=None``
+    stores discrete int actions (DQN); an int stores float action vectors
+    (SAC/continuous control)."""
 
-    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0,
+                 action_dim: Optional[int] = None):
         self.capacity = capacity
         self.obs = np.zeros((capacity, obs_dim), np.float32)
         self.next_obs = np.zeros((capacity, obs_dim), np.float32)
-        self.actions = np.zeros((capacity,), np.int64)
+        if action_dim is None:
+            self.actions = np.zeros((capacity,), np.int64)
+        else:
+            self.actions = np.zeros((capacity, action_dim), np.float32)
         self.rewards = np.zeros((capacity,), np.float32)
         self.dones = np.zeros((capacity,), np.float32)
         self.idx = 0
@@ -330,6 +336,157 @@ class ReplayBuffer:
         ix = self._rng.integers(0, self.size, size=batch_size)
         return Transition(self.obs[ix], self.actions[ix], self.rewards[ix],
                           self.next_obs[ix], self.dones[ix])
+
+
+class SACModule:
+    """Squashed-Gaussian actor + twin Q critics for continuous action
+    spaces (reference: ``rllib/algorithms/sac`` default RLModule)."""
+
+    LOG_STD_MIN, LOG_STD_MAX = -10.0, 2.0
+
+    def __init__(self, obs_dim: int, action_dim: int, hidden=(128, 128)):
+        self.obs_dim = obs_dim
+        self.action_dim = action_dim
+        self.hidden = tuple(hidden)
+
+    def init(self, key) -> Dict[str, Any]:
+        kp, k1, k2 = jax.random.split(key, 3)
+        return {
+            "pi": mlp_init(kp, (self.obs_dim, *self.hidden,
+                                2 * self.action_dim), scale=0.01),
+            "q1": mlp_init(k1, (self.obs_dim + self.action_dim,
+                                *self.hidden, 1), scale=1.0),
+            "q2": mlp_init(k2, (self.obs_dim + self.action_dim,
+                                *self.hidden, 1), scale=1.0),
+        }
+
+    def pi_dist(self, params, obs):
+        out = mlp_apply(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, self.LOG_STD_MIN, self.LOG_STD_MAX)
+        return mean, log_std
+
+    def sample_action(self, params, obs, key):
+        """Reparameterized tanh-squashed sample: (action in (-1,1), logp)."""
+        mean, log_std = self.pi_dist(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(key, mean.shape)
+        pre = mean + std * eps
+        action = jnp.tanh(pre)
+        # logp with tanh change-of-variables (SAC appendix C).
+        logp = jnp.sum(
+            -0.5 * (eps ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+            - jnp.log(1 - action ** 2 + 1e-6), axis=-1)
+        return action, logp
+
+    @staticmethod
+    def q_value(params, name, obs, action):
+        x = jnp.concatenate([obs, action], axis=-1)
+        return mlp_apply(params[name], x)[..., 0]
+
+
+class SACLearner:
+    """Jitted soft actor-critic update (reference:
+    ``rllib/algorithms/sac`` losses): twin-critic TD with target-network
+    polyak averaging, reparameterized actor loss, and automatic
+    temperature tuning toward -|A| target entropy. Gradients are computed
+    jointly over {pi, q1, q2, log_alpha} and applied in one optimizer, so
+    the LearnerGroup's flatten-allreduce works unchanged."""
+
+    def __init__(self, module: SACModule, lr: float = 3e-4,
+                 gamma: float = 0.99, tau: float = 0.005, seed: int = 0):
+        self.module = module
+        self.optimizer = optax.adam(lr)
+        self.gamma = gamma
+        self.tau = tau
+        net = module.init(jax.random.PRNGKey(seed))
+        self.params = {**net, "log_alpha": jnp.zeros(())}
+        self.target_params = jax.tree.map(jnp.asarray,
+                                          {"q1": net["q1"],
+                                           "q2": net["q2"]})
+        self.opt_state = self.optimizer.init(self.params)
+        self._key = jax.random.PRNGKey(seed + 1)
+        target_entropy = -float(module.action_dim)
+        mod, g = module, gamma
+
+        def loss_fn(params, target, b, key):
+            ka, kn = jax.random.split(key)
+            alpha = jnp.exp(params["log_alpha"])
+            # Critic target: r + γ(1-d)(min target-Q(s',ã') - α logπ(ã'|s'))
+            next_a, next_logp = mod.sample_action(params, b["next_obs"], kn)
+            next_q = jnp.minimum(
+                mod.q_value(target, "q1", b["next_obs"], next_a),
+                mod.q_value(target, "q2", b["next_obs"], next_a))
+            y = b["rewards"] + g * (1.0 - b["dones"]) * \
+                jax.lax.stop_gradient(
+                    next_q - jax.lax.stop_gradient(alpha) * next_logp)
+            q1 = mod.q_value(params, "q1", b["obs"], b["actions"])
+            q2 = mod.q_value(params, "q2", b["obs"], b["actions"])
+            critic_loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
+            # Actor: α logπ(ã|s) - min Q(s,ã) with critics frozen.
+            a_new, logp = mod.sample_action(params, b["obs"], ka)
+            q_pi = jnp.minimum(
+                mod.q_value(jax.lax.stop_gradient(
+                    {"q1": params["q1"], "q2": params["q2"]}),
+                    "q1", b["obs"], a_new),
+                mod.q_value(jax.lax.stop_gradient(
+                    {"q1": params["q1"], "q2": params["q2"]}),
+                    "q2", b["obs"], a_new))
+            actor_loss = jnp.mean(
+                jax.lax.stop_gradient(alpha) * logp - q_pi)
+            # Temperature: drive entropy toward -|A|.
+            alpha_loss = -jnp.mean(
+                params["log_alpha"] *
+                jax.lax.stop_gradient(logp + target_entropy))
+            total = critic_loss + actor_loss + alpha_loss
+            return total, {"critic_loss": critic_loss,
+                           "actor_loss": actor_loss,
+                           "alpha": alpha,
+                           "entropy": -jnp.mean(logp)}
+
+        self._grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+        def apply_fn(params, opt_state, target, grads):
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            target = jax.tree.map(
+                lambda t, p: (1 - self.tau) * t + self.tau * p,
+                target, {"q1": params["q1"], "q2": params["q2"]})
+            return params, opt_state, target
+
+        self._apply_fn = jax.jit(apply_fn)
+
+    @staticmethod
+    def _to_batch(t: Transition) -> Dict[str, Any]:
+        return {"obs": jnp.asarray(t.obs),
+                "actions": jnp.asarray(t.actions),
+                "rewards": jnp.asarray(t.rewards),
+                "next_obs": jnp.asarray(t.next_obs),
+                "dones": jnp.asarray(t.dones)}
+
+    def compute_gradients(self, t: Transition):
+        self._key, sub = jax.random.split(self._key)
+        (loss, metrics), grads = self._grad_fn(
+            self.params, self.target_params, self._to_batch(t), sub)
+        metrics = {k: float(v) for k, v in metrics.items()}
+        metrics["total_loss"] = float(loss)
+        return grads, metrics
+
+    def apply_gradients(self, grads) -> None:
+        self.params, self.opt_state, self.target_params = self._apply_fn(
+            self.params, self.opt_state, self.target_params, grads)
+
+    def update_from_batch(self, t: Transition) -> Dict[str, float]:
+        grads, metrics = self.compute_gradients(t)
+        self.apply_gradients(grads)
+        return metrics
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.tree.map(jnp.asarray, weights)
 
 
 class DQNLearner:

@@ -195,6 +195,77 @@ class TrajectoryEnvRunner:
         return True
 
 
+class ContinuousEnvRunner:
+    """Transition collector for continuous action spaces (the SAC actor
+    side): samples squashed-Gaussian actions from the current policy and
+    rescales them into the env's bounds."""
+
+    def __init__(self, env_creator: Callable, module_spec: Dict[str, Any],
+                 num_envs: int = 1, seed: int = 0):
+        import gymnasium as gym
+        import jax
+
+        from ray_tpu.rllib.core import SACModule
+
+        self.envs = gym.vector.SyncVectorEnv(
+            [lambda i=i: env_creator() for i in range(num_envs)])
+        self.num_envs = num_envs
+        self.module = SACModule(**module_spec)
+        self.params = None
+        space = self.envs.single_action_space
+        self._low = np.asarray(space.low, np.float32)
+        self._high = np.asarray(space.high, np.float32)
+        self._jax = jax
+        self._key = jax.random.PRNGKey(seed)
+        self._sample = jax.jit(self.module.sample_action)
+        self.obs, _ = self.envs.reset(seed=seed)
+        self._episode_returns = np.zeros(num_envs, dtype=np.float64)
+        self._finished_returns: List[float] = []
+        self._resetting = np.zeros(num_envs, dtype=bool)
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+
+        self.params = self._jax.tree.map(jnp.asarray, weights)
+        return True
+
+    def sample(self, num_steps: int):
+        from ray_tpu.rllib.core import Transition
+
+        T, N = num_steps, self.num_envs
+        rows = {k: [] for k in
+                ("obs", "actions", "rewards", "next_obs", "dones")}
+        for _ in range(T):
+            self._key, sub = self._jax.random.split(self._key)
+            unit, _ = self._sample(self.params,
+                                   self.obs.astype(np.float32), sub)
+            unit = np.asarray(unit)  # in (-1, 1)
+            actions = self._low + (unit + 1.0) * 0.5 * (self._high
+                                                        - self._low)
+            nxt, rewards, terms, truncs, _ = self.envs.step(actions)
+            valid = ~self._resetting
+            rows["obs"].append(self.obs[valid].astype(np.float32))
+            # Replay stores the UNIT action (the policy's own space).
+            rows["actions"].append(unit[valid])
+            rows["rewards"].append(rewards[valid].astype(np.float32))
+            rows["next_obs"].append(nxt[valid].astype(np.float32))
+            rows["dones"].append(terms[valid].astype(np.float32))
+            dones = np.logical_or(terms, truncs)
+            self._episode_returns[valid] += rewards[valid]
+            for i in np.nonzero(dones & valid)[0]:
+                self._finished_returns.append(self._episode_returns[i])
+                self._episode_returns[i] = 0.0
+            self._resetting = dones
+            self.obs = nxt
+        finished, self._finished_returns = self._finished_returns, []
+        return Transition(*[np.concatenate(rows[k]) for k in
+                            ("obs", "actions", "rewards", "next_obs",
+                             "dones")]), finished
+
+    def ping(self):
+        return True
+
+
 class TransitionEnvRunner:
     """Epsilon-greedy transition collector for value-based algorithms
     (reference: the DQN rollout path of ``single_agent_env_runner.py`` —

@@ -31,6 +31,17 @@ class _LearnerActor:
 
             self.group = init_collective_group(world_size, rank, group_name)
 
+    def update_many(self, shards) -> Dict[str, float]:
+        """Apply a sequence of update batches in one RPC (off-policy
+        algorithms do tens of replay updates per rollout; one RPC per
+        update would dominate the step time). Collective ordering stays
+        aligned across learners because every learner receives the same
+        number of shards in the same order."""
+        metrics: Dict[str, float] = {}
+        for shard in shards:
+            metrics = self.update(shard)
+        return metrics
+
     def update(self, shard) -> Dict[str, float]:
         import jax
 
@@ -114,6 +125,20 @@ class LearnerGroup:
         metrics = ray_tpu.get(
             [a.update.remote(s) for a, s in zip(self.learners, shards)],
             timeout=300)
+        return metrics[0]
+
+    def update_many(self, batches) -> Dict[str, float]:
+        """Apply many update batches with ONE RPC per learner (replay-heavy
+        algorithms like SAC/DQN do dozens of updates per rollout)."""
+        per_learner = [[] for _ in range(self.num_learners)]
+        for batch in batches:
+            for i, shard in enumerate(self._shard(batch,
+                                                  self.num_learners)):
+                per_learner[i].append(shard)
+        metrics = ray_tpu.get(
+            [a.update_many.remote(s)
+             for a, s in zip(self.learners, per_learner)],
+            timeout=600)
         return metrics[0]
 
     def get_weights(self):

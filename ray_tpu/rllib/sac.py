@@ -1,10 +1,11 @@
-"""DQN algorithm (reference: ``rllib/algorithms/dqn/dqn.py``).
+"""SAC: soft actor-critic for continuous action spaces.
 
-The SURVEY §3.6 loop, value-based variant: epsilon-greedy env runners feed
-a uniform replay buffer; a :class:`~ray_tpu.rllib.learner_group.LearnerGroup`
-of one or more learner actors performs double-DQN updates (gradients
-allreduced across learners); weights broadcast back to the runners each
-iteration.
+Reference: ``rllib/algorithms/sac`` — off-policy replay, twin critics with
+polyak target networks, reparameterized squashed-Gaussian actor, automatic
+temperature tuning. The loop mirrors :mod:`ray_tpu.rllib.dqn`: continuous
+env runners fill a uniform replay buffer, a
+:class:`~ray_tpu.rllib.learner_group.LearnerGroup` of SAC learners applies
+allreduced updates, and fresh weights broadcast back each iteration.
 """
 
 from __future__ import annotations
@@ -17,34 +18,31 @@ import numpy as np
 
 import ray_tpu
 from ray_tpu.rllib.core import ReplayBuffer
-from ray_tpu.rllib.env_runner import TransitionEnvRunner
+from ray_tpu.rllib.env_runner import ContinuousEnvRunner
 from ray_tpu.rllib.learner_group import LearnerGroup
 
 
 @dataclasses.dataclass
-class DQNConfig:
+class SACConfig:
     env: Optional[str] = None
     env_creator: Optional[Callable] = None
-    num_env_runners: int = 2
-    num_envs_per_env_runner: int = 2
-    rollout_fragment_length: int = 32
-    lr: float = 5e-4
+    num_env_runners: int = 1
+    num_envs_per_env_runner: int = 1
+    rollout_fragment_length: int = 64
+    lr: float = 3e-4
     gamma: float = 0.99
-    buffer_size: int = 50_000
-    train_batch_size: int = 64
-    num_updates_per_iteration: int = 16
+    tau: float = 0.005
+    buffer_size: int = 100_000
+    train_batch_size: int = 128
+    num_updates_per_iteration: int = 32
     learning_starts: int = 500
-    epsilon_initial: float = 1.0
-    epsilon_final: float = 0.05
-    epsilon_decay_iterations: int = 30
-    target_update_freq: int = 100
     num_learners: int = 1
-    hidden_sizes: tuple = (64, 64)
+    hidden_sizes: tuple = (128, 128)
     seed: int = 0
 
     # -- fluent builder (reference AlgorithmConfig style) ------------------
     def environment(self, env: Optional[str] = None, *,
-                    env_creator: Optional[Callable] = None) -> "DQNConfig":
+                    env_creator: Optional[Callable] = None) -> "SACConfig":
         self.env = env
         self.env_creator = env_creator
         return self
@@ -52,7 +50,7 @@ class DQNConfig:
     def env_runners(self, num_env_runners: Optional[int] = None,
                     num_envs_per_env_runner: Optional[int] = None,
                     rollout_fragment_length: Optional[int] = None
-                    ) -> "DQNConfig":
+                    ) -> "SACConfig":
         for k, v in dict(num_env_runners=num_env_runners,
                          num_envs_per_env_runner=num_envs_per_env_runner,
                          rollout_fragment_length=rollout_fragment_length
@@ -61,30 +59,30 @@ class DQNConfig:
                 setattr(self, k, v)
         return self
 
-    def training(self, **kwargs) -> "DQNConfig":
+    def training(self, **kwargs) -> "SACConfig":
         known = {f.name for f in dataclasses.fields(self)}
         bad = set(kwargs) - known
         if bad:
-            raise ValueError(f"Unknown DQN training options: {sorted(bad)}")
+            raise ValueError(f"Unknown SAC training options: {sorted(bad)}")
         for k, v in kwargs.items():
             if v is not None:
                 setattr(self, k, v)
         return self
 
-    def learners(self, num_learners: Optional[int] = None) -> "DQNConfig":
+    def learners(self, num_learners: Optional[int] = None) -> "SACConfig":
         if num_learners is not None:
             self.num_learners = num_learners
         return self
 
-    def build(self) -> "DQN":
-        return DQN(self)
+    def build(self) -> "SAC":
+        return SAC(self)
 
 
 def _resolve_env(config) -> Callable:
     if config.env_creator is not None:
         return config.env_creator
     if config.env is None:
-        raise ValueError("DQNConfig needs .environment(env=...) or "
+        raise ValueError("SACConfig needs .environment(env=...) or "
                          "env_creator")
     import gymnasium as gym
 
@@ -92,56 +90,45 @@ def _resolve_env(config) -> Callable:
     return lambda: gym.make(name)
 
 
-class DQN:
-    def __init__(self, config: DQNConfig):
+class SAC:
+    def __init__(self, config: SACConfig):
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         self.config = config
         creator = _resolve_env(config)
         probe = creator()
         obs_dim = int(np.prod(probe.observation_space.shape))
-        num_actions = int(probe.action_space.n)
+        action_dim = int(np.prod(probe.action_space.shape))
         probe.close()
-        module_spec = {"obs_dim": obs_dim, "num_actions": num_actions,
+        module_spec = {"obs_dim": obs_dim, "action_dim": action_dim,
                        "hidden": tuple(config.hidden_sizes)}
         cfg = config
 
         def builder():
-            from ray_tpu.rllib.core import DQNLearner, DQNModule
+            from ray_tpu.rllib.core import SACLearner, SACModule
 
-            return DQNLearner(DQNModule(**module_spec), lr=cfg.lr,
-                              gamma=cfg.gamma,
-                              target_update_freq=cfg.target_update_freq,
-                              seed=cfg.seed)
+            return SACLearner(SACModule(**module_spec), lr=cfg.lr,
+                              gamma=cfg.gamma, tau=cfg.tau, seed=cfg.seed)
 
         self.learner_group = LearnerGroup(builder,
                                           num_learners=config.num_learners)
-        runner_cls = ray_tpu.remote(TransitionEnvRunner)
+        runner_cls = ray_tpu.remote(ContinuousEnvRunner)
         self.runners = [
             runner_cls.remote(creator, module_spec,
                               config.num_envs_per_env_runner, seed)
             for seed in range(config.num_env_runners)
         ]
         self.buffer = ReplayBuffer(config.buffer_size, obs_dim,
-                                   seed=config.seed)
+                                   seed=config.seed, action_dim=action_dim)
         self.iteration = 0
         self._returns: List[float] = []
-
-    def _epsilon(self) -> float:
-        c = self.config
-        frac = min(self.iteration / max(c.epsilon_decay_iterations, 1), 1.0)
-        return c.epsilon_initial + frac * (c.epsilon_final
-                                           - c.epsilon_initial)
 
     def train(self) -> Dict[str, Any]:
         """One iteration: sample -> replay -> N learner updates -> sync."""
         c = self.config
         t0 = time.monotonic()
-        eps = self._epsilon()
         weights = self.learner_group.get_weights()
         ray_tpu.get([r.set_weights.remote(weights) for r in self.runners],
-                    timeout=120)
-        ray_tpu.get([r.set_epsilon.remote(eps) for r in self.runners],
                     timeout=120)
         sampled = ray_tpu.get(
             [r.sample.remote(c.rollout_fragment_length)
@@ -160,7 +147,6 @@ class DQN:
         self.iteration += 1
         return {
             "training_iteration": self.iteration,
-            "epsilon": eps,
             "buffer_size": self.buffer.size,
             "episode_return_mean": (float(np.mean(self._returns))
                                     if self._returns else float("nan")),

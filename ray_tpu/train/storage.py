@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import pickle
 import posixpath
+import shutil
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, Optional
@@ -55,8 +56,6 @@ class StorageContext:
                 dest_root, rel.replace(os.sep, "/"))
             self.fs.create_dir(droot, recursive=True)
             for fname in files:
-                import shutil
-
                 with open(os.path.join(root, fname), "rb") as src, \
                         self.fs.open_output_stream(
                             posixpath.join(droot, fname)) as dst:
@@ -76,8 +75,6 @@ class StorageContext:
                 os.makedirs(target, exist_ok=True)
                 continue
             os.makedirs(os.path.dirname(target), exist_ok=True)
-            import shutil
-
             with self.fs.open_input_stream(entry.path) as src, \
                     open(target, "wb") as dst:
                 shutil.copyfileobj(src, dst, 1 << 20)
