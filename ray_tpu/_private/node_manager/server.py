@@ -554,6 +554,10 @@ class NodeManager:
         if for_actor:
             cap = int(os.environ.get("RAY_TPU_MAX_ACTOR_WORKERS", 128))
         else:
+            # 2x CPU: the headroom matters for nested tasks — parents
+            # blocked in ray.get occupy workers, and a 1x cap would
+            # livelock a full-width nested fan-out (workers are not
+            # released while blocked).
             cap = int(os.environ.get(
                 "RAY_TPU_MAX_WORKERS",
                 max(4, int(self.total.get("CPU", 4)) * 2)))

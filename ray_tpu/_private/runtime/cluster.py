@@ -185,8 +185,24 @@ class ClusterRuntime(CoreRuntime):
         self.memory = MemoryStore()
         self._pulls = _PullManager(int(os.environ.get(
             "RAY_TPU_PULL_BUDGET_BYTES", 512 << 20)))
+        # The pool carries every background work item (task submits,
+        # actor pushes, prefetches, stream polls): it stays WIDE so slow
+        # tasks can't head-of-line block gets and actor calls. Raw submit
+        # throughput is protected separately: _submit_slots bounds how
+        # many submitters are in their RPC-ACTIVE phase at once — beyond
+        # ~8 concurrently-active submitters, GIL + grpc contention makes
+        # submission slower than sequential (measured: 150 vs 500
+        # tasks/s). Slots are NOT held during task execution.
         self._pool = ThreadPoolExecutor(max_workers=64,
                                         thread_name_prefix="submit")
+        self._submit_slots = threading.BoundedSemaphore(
+            int(os.environ.get("RAY_TPU_SUBMIT_RPC_SLOTS", 8)))
+        # Completion processing uses its OWN slots: if tails shared the
+        # submit semaphore, lease-waiting submitters (blocked until a
+        # worker frees) would starve the very result processing that
+        # frees workers — a deadlock cycle.
+        self._completion_slots = threading.BoundedSemaphore(
+            int(os.environ.get("RAY_TPU_SUBMIT_RPC_SLOTS", 8)))
         self._actor_cache: Dict[bytes, pb.ActorInfo] = {}
         self._actor_dead: Dict[bytes, str] = {}
         self._actor_create_pins: Dict[bytes, List[bytes]] = {}
@@ -963,9 +979,12 @@ class ClusterRuntime(CoreRuntime):
         affinity-targeted leases are placement-specific)."""
         if spec.placement_group_id or spec.affinity_node_id:
             return None
+        if spec.strategy == "SPREAD":
+            # Lease reuse would serialize a fan-out onto one node — the
+            # opposite of what SPREAD promises. Always negotiate.
+            return None
         return (tuple(sorted(spec.resources.items())),
-                bytes(spec.runtime_env), bytes(spec.label_selector),
-                spec.strategy)
+                bytes(spec.runtime_env), bytes(spec.label_selector))
 
     def _take_cached_lease(self, sig) -> Optional[dict]:
         with self._lease_cache_lock:
@@ -1105,111 +1124,166 @@ class ClusterRuntime(CoreRuntime):
 
     def _lease_and_push_once(self, spec: pb.TaskSpec,
                              return_ids: List[ObjectID]):
+        """Submit one task: consume a cached lease when available, else
+        negotiate a fresh one.
+
+        CPU-active phases (lease negotiation, completion processing) are
+        bounded by small semaphores — beyond ~8 concurrently-active
+        submitters, GIL + grpc contention makes concurrent submission
+        slower than sequential (measured: 150 vs 500 tasks/s) — while the
+        execution wait holds neither (it sleeps in grpc with the GIL
+        dropped), so in-flight task count is bounded only by the wide
+        pool. The two phases use SEPARATE semaphores: lease-waiting
+        submitters must never starve the completion processing that frees
+        their workers.
+        """
         sig = self._lease_signature(spec)
-        if sig is not None:
-            lease = self._take_cached_lease(sig)
-            if lease is not None:
-                del spec.tpu_chips[:]
-                spec.tpu_chips.extend(lease["tpu_chips"])
-                stub = rpc.get_stub("WorkerService", lease["worker_address"])
-                try:
-                    result = stub.PushTask(pb.PushTaskRequest(spec=spec),
-                                           timeout=PUSH_TIMEOUT_S)
-                except Exception:  # noqa: BLE001
-                    # Stale cached lease (worker died idle): drop it and
-                    # fall through to a fresh lease — the task never ran.
-                    self._return_lease(lease)
-                else:
-                    if not self._cache_lease(sig, lease):
-                        self._return_lease(lease)
-                    self._apply_push_result(result, return_ids, spec.name)
-                    return
-        pg_targets: List[Any] = []
-        if spec.placement_group_id:
-            pg_targets = self._pg_lease_targets(spec)
-            target = pg_targets[0]
-        elif spec.affinity_node_id:
-            target = self._affinity_target(spec)
-        else:
-            target = self.node
-        deadline = time.monotonic() + 300.0
-        backoff = 0.01
-        spillbacks = 0
         while True:
-            try:
-                reply = target.RequestWorkerLease(pb.LeaseRequest(spec=spec))
-            except Exception:  # noqa: BLE001 — lease target died; re-route
-                if spec.placement_group_id:
-                    # Bundle node died: GCS reschedules the bundle; wait for
-                    # the new assignment and retry there.
-                    time.sleep(0.1)
+            if sig is not None:
+                lease = self._take_cached_lease(sig)
+                if lease is not None:
+                    if self._push_with_lease(spec, return_ids, sig, lease,
+                                             fresh=False):
+                        return
+                    continue  # stale cached lease (worker died): retry
+            lease = self._negotiate_lease(spec, sig)
+            if lease is None:
+                continue  # aborted to consume a newly-cached lease
+            self._push_with_lease(spec, return_ids, sig, lease, fresh=True)
+            return
+
+    def _push_with_lease(self, spec: pb.TaskSpec,
+                         return_ids: List[ObjectID], sig, lease: dict,
+                         fresh: bool) -> bool:
+        """Dispatch the push (cheap, unslotted), wait for the result
+        (GIL-free), then process it under a completion slot. Returns False
+        for a stale cached lease so the caller falls back to a fresh one;
+        a fresh lease's worker dying mid-task raises WorkerCrashedError
+        (the retry machinery above decides whether to re-run)."""
+        del spec.tpu_chips[:]
+        spec.tpu_chips.extend(lease["tpu_chips"])
+        stub = rpc.get_stub("WorkerService", lease["worker_address"])
+        try:
+            fut = stub.PushTask(pb.PushTaskRequest(spec=spec),
+                                timeout=PUSH_TIMEOUT_S, wait=False)
+            result = fut.result(timeout=PUSH_TIMEOUT_S + 5)
+        except Exception as e:  # noqa: BLE001
+            self._return_lease(lease)
+            if fresh:
+                raise exceptions.WorkerCrashedError(
+                    f"Worker executing {spec.name} died: {e}") from None
+            return False
+        with self._completion_slots:
+            # Keep the lease for the reuse window instead of returning it
+            # (the reaper returns it after LEASE_CACHE_TTL_S idle).
+            if sig is None or not self._cache_lease(sig, lease):
+                self._return_lease(lease)
+            self._apply_push_result(result, return_ids, spec.name)
+        return True
+
+    def _has_cached_lease(self, sig) -> bool:
+        with self._lease_cache_lock:
+            return bool(self._lease_cache.get(sig))
+
+    def _negotiate_lease(self, spec: pb.TaskSpec, sig) -> Optional[dict]:
+        """Acquire a fresh worker lease under a submit slot.
+
+        Returns None (without a lease) when a cached lease for the same
+        signature appears mid-negotiation: the caller consumes it instead.
+        Without this abort the system deadlocks under fan-out — every
+        worker can end up parked in the lease cache while all slot-holding
+        negotiators wait for a worker to free."""
+        self._submit_slots.acquire()
+        slot_acquired = time.monotonic()
+        try:
+            pg_targets: List[Any] = []
+            if spec.placement_group_id:
+                pg_targets = self._pg_lease_targets(spec)
+                target = pg_targets[0]
+            elif spec.affinity_node_id:
+                target = self._affinity_target(spec)
+            else:
+                target = self.node
+            deadline = time.monotonic() + 300.0
+            backoff = 0.01
+            spillbacks = 0
+            while True:
+                if sig is not None and self._has_cached_lease(sig):
+                    return None
+                # Fairness: a capacity-starved negotiation (lease waits can
+                # last minutes) must not camp on its slot and head-of-line
+                # block placeable tasks — cycle the slot periodically.
+                if time.monotonic() - slot_acquired > 2.0:
+                    self._submit_slots.release()
+                    time.sleep(0.005)
+                    self._submit_slots.acquire()
+                    slot_acquired = time.monotonic()
+                try:
+                    reply = target.RequestWorkerLease(
+                        pb.LeaseRequest(spec=spec))
+                except Exception:  # noqa: BLE001 — lease target died
+                    if spec.placement_group_id:
+                        # Bundle node died: GCS reschedules the bundle;
+                        # wait for the new assignment and retry there.
+                        time.sleep(0.1)
+                        pg_targets = self._pg_lease_targets(spec)
+                        target = pg_targets[0]
+                        continue
+                    if spec.affinity_node_id and not spec.affinity_soft:
+                        raise exceptions.RayTpuError(
+                            f"Node {spec.affinity_node_id[:8]} died while "
+                            f"task {spec.name} was pinned to it")
+                    if not self._refresh_local_node():
+                        raise exceptions.RayTpuError(
+                            "no alive nodes in cluster")
+                    target = self.node
+                    continue
+                if reply.granted:
+                    break
+                if reply.error == "infeasible":
+                    where = ("placement group bundle"
+                             if spec.placement_group_id else "cluster node")
+                    raise exceptions.RayTpuError(
+                        f"Task {spec.name} demands {dict(spec.resources)} "
+                        f"which no {where} can ever satisfy.")
+                if reply.error == "pg-unknown":
+                    # The bundle was rescheduled off this node; re-resolve.
+                    time.sleep(0.05)
                     pg_targets = self._pg_lease_targets(spec)
                     target = pg_targets[0]
                     continue
-                if spec.affinity_node_id and not spec.affinity_soft:
+                if reply.error == "pg-wait" and len(pg_targets) > 1:
+                    # Any-bundle task: rotate across the group's nodes
+                    # before backing off.
+                    pg_targets = pg_targets[1:] + pg_targets[:1]
+                    target = pg_targets[0]
+                if reply.spillback_address:
+                    target = rpc.get_stub("NodeService",
+                                          reply.spillback_address)
+                    # Damp spillback ping-pong: nodes with stale views can
+                    # bounce a lease between each other (label soft tiers
+                    # especially); after a burst of hops, pause long enough
+                    # for heartbeats to refresh the views.
+                    spillbacks += 1
+                    if spillbacks % 8 == 0:
+                        time.sleep(min(0.05 * (spillbacks // 8), 0.5))
+                    continue
+                if time.monotonic() > deadline:
                     raise exceptions.RayTpuError(
-                        f"Node {spec.affinity_node_id[:8]} died while task "
-                        f"{spec.name} was pinned to it")
-                if not self._refresh_local_node():
-                    raise exceptions.RayTpuError("no alive nodes in cluster")
-                target = self.node
-                continue
-            if reply.granted:
-                break
-            if reply.error == "infeasible":
-                where = (f"placement group bundle" if spec.placement_group_id
-                         else "cluster node")
-                raise exceptions.RayTpuError(
-                    f"Task {spec.name} demands {dict(spec.resources)} which "
-                    f"no {where} can ever satisfy.")
-            if reply.error == "pg-unknown":
-                # The bundle was rescheduled off this node; re-resolve.
-                time.sleep(0.05)
-                pg_targets = self._pg_lease_targets(spec)
-                target = pg_targets[0]
-                continue
-            if reply.error == "pg-wait" and len(pg_targets) > 1:
-                # Any-bundle task: rotate across the group's nodes before
-                # backing off.
-                pg_targets = pg_targets[1:] + pg_targets[:1]
-                target = pg_targets[0]
-            if reply.spillback_address:
-                target = rpc.get_stub("NodeService", reply.spillback_address)
-                # Damp spillback ping-pong: nodes with stale views can bounce
-                # a lease between each other (label soft tiers especially);
-                # after a burst of hops, pause long enough for heartbeats to
-                # refresh the views instead of spinning RPCs.
-                spillbacks += 1
-                if spillbacks % 8 == 0:
-                    time.sleep(min(0.05 * (spillbacks // 8), 0.5))
-                continue
-            if time.monotonic() > deadline:
-                raise exceptions.RayTpuError(
-                    f"Timed out leasing a worker for {spec.name}")
-            time.sleep(backoff)
-            # The node queues lease requests server-side for up to 2s, so
-            # client retries are rare; a long backoff here would just leave
-            # freed workers idle between retries.
-            backoff = min(backoff * 1.5, 0.1)
-        worker_stub = rpc.get_stub("WorkerService", reply.worker_address)
-        if reply.tpu_chips:
-            del spec.tpu_chips[:]
-            spec.tpu_chips.extend(reply.tpu_chips)
-        lease = {"node": target, "worker_id": reply.worker_id,
-                 "worker_address": reply.worker_address,
-                 "tpu_chips": list(reply.tpu_chips)}
-        try:
-            result = worker_stub.PushTask(
-                pb.PushTaskRequest(spec=spec), timeout=PUSH_TIMEOUT_S)
-        except Exception as e:  # noqa: BLE001
-            self._return_lease(lease)
-            raise exceptions.WorkerCrashedError(
-                f"Worker executing {spec.name} died: {e}") from None
-        # Keep the lease for the reuse window instead of returning it
-        # (returned by the reaper after LEASE_CACHE_TTL_S idle).
-        if sig is None or not self._cache_lease(sig, lease):
-            self._return_lease(lease)
-        self._apply_push_result(result, return_ids, spec.name)
+                        f"Timed out leasing a worker for {spec.name}")
+                time.sleep(backoff)
+                # The node queues lease requests server-side for up to 2s,
+                # so client retries are rare; a long backoff here would
+                # just leave freed workers idle between retries.
+                backoff = min(backoff * 1.5, 0.1)
+            if reply.tpu_chips:
+                del spec.tpu_chips[:]
+                spec.tpu_chips.extend(reply.tpu_chips)
+            return {"node": target, "worker_id": reply.worker_id,
+                    "worker_address": reply.worker_address,
+                    "tpu_chips": list(reply.tpu_chips)}
+        finally:
+            self._submit_slots.release()
 
     def _apply_push_result(self, result: pb.PushTaskResult,
                            return_ids: List[ObjectID], name: str):

@@ -717,7 +717,9 @@ def read_text(paths, *, parallelism: int = 8) -> Dataset:
         lines = []
         for path in group:
             with open(path, encoding="utf-8") as f:
-                lines.extend(f.read().splitlines())
+                # Only \n terminates rows (str.splitlines would also split
+                # on \u2028 etc. inside records); rstrip handles CRLF.
+                lines.extend(line.rstrip("\r\n") for line in f)
         return pa.table({"text": lines})
 
     return _read_grouped(paths, parallelism, load)

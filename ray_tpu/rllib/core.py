@@ -425,13 +425,11 @@ class SACLearner:
             critic_loss = jnp.mean((q1 - y) ** 2) + jnp.mean((q2 - y) ** 2)
             # Actor: α logπ(ã|s) - min Q(s,ã) with critics frozen.
             a_new, logp = mod.sample_action(params, b["obs"], ka)
+            frozen_q = jax.lax.stop_gradient(
+                {"q1": params["q1"], "q2": params["q2"]})
             q_pi = jnp.minimum(
-                mod.q_value(jax.lax.stop_gradient(
-                    {"q1": params["q1"], "q2": params["q2"]}),
-                    "q1", b["obs"], a_new),
-                mod.q_value(jax.lax.stop_gradient(
-                    {"q1": params["q1"], "q2": params["q2"]}),
-                    "q2", b["obs"], a_new))
+                mod.q_value(frozen_q, "q1", b["obs"], a_new),
+                mod.q_value(frozen_q, "q2", b["obs"], a_new))
             actor_loss = jnp.mean(
                 jax.lax.stop_gradient(alpha) * logp - q_pi)
             # Temperature: drive entropy toward -|A|.

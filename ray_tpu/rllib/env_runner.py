@@ -195,60 +195,45 @@ class TrajectoryEnvRunner:
         return True
 
 
-class ContinuousEnvRunner:
-    """Transition collector for continuous action spaces (the SAC actor
-    side): samples squashed-Gaussian actions from the current policy and
-    rescales them into the env's bounds."""
+class _TransitionCollector:
+    """Shared transition-collection loop for value-based / off-policy
+    runners. Owns the subtle invariants exactly once: gymnasium's
+    next-step autoreset (the step after a done is the reset — its action
+    is ignored and must not be recorded), termination-vs-truncation
+    bootstrapping (time-limit truncations keep their value), and episode
+    return tracking. Subclasses supply ``_select(obs) ->
+    (env_actions, stored_actions)``."""
 
-    def __init__(self, env_creator: Callable, module_spec: Dict[str, Any],
-                 num_envs: int = 1, seed: int = 0):
+    def __init__(self, env_creator: Callable, num_envs: int, seed: int):
         import gymnasium as gym
-        import jax
-
-        from ray_tpu.rllib.core import SACModule
 
         self.envs = gym.vector.SyncVectorEnv(
             [lambda i=i: env_creator() for i in range(num_envs)])
         self.num_envs = num_envs
-        self.module = SACModule(**module_spec)
-        self.params = None
-        space = self.envs.single_action_space
-        self._low = np.asarray(space.low, np.float32)
-        self._high = np.asarray(space.high, np.float32)
-        self._jax = jax
-        self._key = jax.random.PRNGKey(seed)
-        self._sample = jax.jit(self.module.sample_action)
         self.obs, _ = self.envs.reset(seed=seed)
         self._episode_returns = np.zeros(num_envs, dtype=np.float64)
         self._finished_returns: List[float] = []
         self._resetting = np.zeros(num_envs, dtype=bool)
 
-    def set_weights(self, weights):
-        import jax.numpy as jnp
-
-        self.params = self._jax.tree.map(jnp.asarray, weights)
-        return True
+    def _select(self, obs):
+        raise NotImplementedError
 
     def sample(self, num_steps: int):
         from ray_tpu.rllib.core import Transition
 
-        T, N = num_steps, self.num_envs
         rows = {k: [] for k in
                 ("obs", "actions", "rewards", "next_obs", "dones")}
-        for _ in range(T):
-            self._key, sub = self._jax.random.split(self._key)
-            unit, _ = self._sample(self.params,
-                                   self.obs.astype(np.float32), sub)
-            unit = np.asarray(unit)  # in (-1, 1)
-            actions = self._low + (unit + 1.0) * 0.5 * (self._high
-                                                        - self._low)
-            nxt, rewards, terms, truncs, _ = self.envs.step(actions)
+        for _ in range(num_steps):
+            env_actions, stored = self._select(self.obs)
+            nxt, rewards, terms, truncs, _ = self.envs.step(env_actions)
             valid = ~self._resetting
             rows["obs"].append(self.obs[valid].astype(np.float32))
-            # Replay stores the UNIT action (the policy's own space).
-            rows["actions"].append(unit[valid])
+            rows["actions"].append(stored[valid])
             rows["rewards"].append(rewards[valid].astype(np.float32))
             rows["next_obs"].append(nxt[valid].astype(np.float32))
+            # Bootstrapping cuts only at true terminations; time-limit
+            # truncations keep their value (partial-episode bootstrap,
+            # and `nxt` at the done step is the episode's true final obs).
             rows["dones"].append(terms[valid].astype(np.float32))
             dones = np.logical_or(terms, truncs)
             self._episode_returns[valid] += rewards[valid]
@@ -266,34 +251,67 @@ class ContinuousEnvRunner:
         return True
 
 
-class TransitionEnvRunner:
+class ContinuousEnvRunner(_TransitionCollector):
+    """Transition collector for continuous action spaces (the SAC actor
+    side): samples squashed-Gaussian actions from the current policy and
+    rescales them into the env's bounds (replay stores the UNIT action —
+    the policy's own space)."""
+
+    def __init__(self, env_creator: Callable, module_spec: Dict[str, Any],
+                 num_envs: int = 1, seed: int = 0):
+        import jax
+
+        from ray_tpu.rllib.core import SACModule
+
+        super().__init__(env_creator, num_envs, seed)
+        self.module = SACModule(**module_spec)
+        self.params = None
+        space = self.envs.single_action_space
+        self._low = np.asarray(space.low, np.float32)
+        self._high = np.asarray(space.high, np.float32)
+        if not (np.all(np.isfinite(self._low))
+                and np.all(np.isfinite(self._high))):
+            raise ValueError(
+                "ContinuousEnvRunner needs a bounded Box action space "
+                f"(got low={space.low}, high={space.high}): the tanh "
+                "policy rescales unit actions into [low, high]")
+        self._jax = jax
+        self._key = jax.random.PRNGKey(seed)
+        self._sample_fn = jax.jit(self.module.sample_action)
+
+    def set_weights(self, weights):
+        import jax.numpy as jnp
+
+        self.params = self._jax.tree.map(jnp.asarray, weights)
+        return True
+
+    def _select(self, obs):
+        self._key, sub = self._jax.random.split(self._key)
+        unit, _ = self._sample_fn(self.params, obs.astype(np.float32), sub)
+        unit = np.asarray(unit)  # in (-1, 1)
+        env_actions = self._low + (unit + 1.0) * 0.5 * (self._high
+                                                        - self._low)
+        return env_actions, unit
+
+
+class TransitionEnvRunner(_TransitionCollector):
     """Epsilon-greedy transition collector for value-based algorithms
     (reference: the DQN rollout path of ``single_agent_env_runner.py`` —
     transitions, not GAE trajectories)."""
 
     def __init__(self, env_creator: Callable, module_spec: Dict[str, Any],
                  num_envs: int = 1, seed: int = 0):
-        import gymnasium as gym
         import jax
 
         from ray_tpu.rllib.core import DQNModule
 
-        self.envs = gym.vector.SyncVectorEnv(
-            [lambda i=i: env_creator() for i in range(num_envs)])
-        self.num_envs = num_envs
+        super().__init__(env_creator, num_envs, seed)
         self.module = DQNModule(**module_spec)
         self.params = None
         self.epsilon = 1.0
         self.rng = np.random.default_rng(seed)
         self._jax = jax
         self._q = jax.jit(self.module.q_values)
-        self.obs, _ = self.envs.reset(seed=seed)
-        self._episode_returns = np.zeros(num_envs, dtype=np.float64)
-        self._finished_returns: List[float] = []
-        # Envs that finished last step: with gymnasium's next-step
-        # autoreset, their next step() is the reset (action ignored) and
-        # must not be recorded as a transition.
-        self._resetting = np.zeros(num_envs, dtype=bool)
 
     def set_weights(self, weights):
         import jax.numpy as jnp
@@ -305,41 +323,10 @@ class TransitionEnvRunner:
         self.epsilon = float(epsilon)
         return True
 
-    def sample(self, num_steps: int):
-        from ray_tpu.rllib.core import Transition
-
-        T, N = num_steps, self.num_envs
-        rows = {k: [] for k in
-                ("obs", "actions", "rewards", "next_obs", "dones")}
-        for _ in range(T):
-            q = np.asarray(self._q(self.params, self.obs.astype(np.float32)))
-            greedy = q.argmax(axis=-1)
-            explore = self.rng.random(N) < self.epsilon
-            random_a = self.rng.integers(0, q.shape[-1], size=N)
-            actions = np.where(explore, random_a, greedy)
-            nxt, rewards, terms, truncs, _ = self.envs.step(actions)
-            # Next-step autoreset: rows where the env was resetting this
-            # step are not transitions (action ignored, reward 0) — skip.
-            valid = ~self._resetting
-            rows["obs"].append(self.obs[valid].astype(np.float32))
-            rows["actions"].append(actions[valid])
-            rows["rewards"].append(rewards[valid].astype(np.float32))
-            rows["next_obs"].append(nxt[valid].astype(np.float32))
-            # Bootstrapping cuts only at true terminations; time-limit
-            # truncations keep their value (partial-episode bootstrap, and
-            # `nxt` at the done step is the episode's true final obs).
-            rows["dones"].append(terms[valid].astype(np.float32))
-            dones = np.logical_or(terms, truncs)
-            self._episode_returns[valid] += rewards[valid]
-            for i in np.nonzero(dones & valid)[0]:
-                self._finished_returns.append(self._episode_returns[i])
-                self._episode_returns[i] = 0.0
-            self._resetting = dones
-            self.obs = nxt
-        finished, self._finished_returns = self._finished_returns, []
-        return Transition(*[np.concatenate(rows[k]) for k in
-                            ("obs", "actions", "rewards", "next_obs",
-                             "dones")]), finished
-
-    def ping(self):
-        return True
+    def _select(self, obs):
+        q = np.asarray(self._q(self.params, obs.astype(np.float32)))
+        greedy = q.argmax(axis=-1)
+        explore = self.rng.random(self.num_envs) < self.epsilon
+        random_a = self.rng.integers(0, q.shape[-1], size=self.num_envs)
+        actions = np.where(explore, random_a, greedy)
+        return actions, actions

@@ -127,7 +127,9 @@ class SAC:
         """One iteration: sample -> replay -> N learner updates -> sync."""
         c = self.config
         t0 = time.monotonic()
-        weights = self.learner_group.get_weights()
+        # Runners only sample the policy: shipping the twin critics +
+        # temperature too would ~3x the broadcast payload for nothing.
+        weights = {"pi": self.learner_group.get_weights()["pi"]}
         ray_tpu.get([r.set_weights.remote(weights) for r in self.runners],
                     timeout=120)
         sampled = ray_tpu.get(
