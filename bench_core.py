@@ -169,12 +169,16 @@ def main():
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
         text=True)
     address = f"127.0.0.1:{_read_port(gcs_proc, 'GCS_PORT')}"
-    nm_proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu._private.node_manager.server",
-         "--gcs-address", address, "--num-cpus", "4"],
-        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
-        text=True)
-    _read_port(nm_proc, "NODE_PORT")
+    try:
+        nm_proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_manager.server",
+             "--gcs-address", address, "--num-cpus", "4"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+            text=True)
+        _read_port(nm_proc, "NODE_PORT")
+    except BaseException:
+        gcs_proc.terminate()
+        raise
 
     import ray_tpu
 

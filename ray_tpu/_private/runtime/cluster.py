@@ -1163,16 +1163,30 @@ class ClusterRuntime(CoreRuntime):
         del spec.tpu_chips[:]
         spec.tpu_chips.extend(lease["tpu_chips"])
         stub = rpc.get_stub("WorkerService", lease["worker_address"])
-        try:
-            fut = stub.PushTask(pb.PushTaskRequest(spec=spec),
-                                timeout=PUSH_TIMEOUT_S, wait=False)
-            result = fut.result(timeout=PUSH_TIMEOUT_S + 5)
-        except Exception as e:  # noqa: BLE001
-            self._return_lease(lease)
-            if fresh:
-                raise exceptions.WorkerCrashedError(
-                    f"Worker executing {spec.name} died: {e}") from None
-            return False
+        attempts = 0
+        while True:
+            try:
+                fut = stub.PushTask(pb.PushTaskRequest(spec=spec),
+                                    timeout=PUSH_TIMEOUT_S, wait=False)
+                result = fut.result(timeout=PUSH_TIMEOUT_S + 5)
+                break
+            except Exception as e:  # noqa: BLE001
+                # wait=False bypasses the stub's retry wrapper; re-dispatch
+                # UNAVAILABLE blips here (the call never reached the
+                # worker, so the retry is safe even for non-idempotent
+                # pushes) instead of burning a task-level attempt.
+                import grpc as _grpc
+
+                code = e.code() if hasattr(e, "code") else None
+                if code == _grpc.StatusCode.UNAVAILABLE and attempts < 2:
+                    attempts += 1
+                    time.sleep(0.05 * attempts)
+                    continue
+                self._return_lease(lease)
+                if fresh:
+                    raise exceptions.WorkerCrashedError(
+                        f"Worker executing {spec.name} died: {e}")                         from None
+                return False
         with self._completion_slots:
             # Keep the lease for the reuse window instead of returning it
             # (the reaper returns it after LEASE_CACHE_TTL_S idle).

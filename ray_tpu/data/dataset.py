@@ -113,7 +113,27 @@ def _table_from_batch(batch) -> pa.Table:
     if isinstance(batch, pa.Table):
         return batch
     if isinstance(batch, dict):
-        return pa.table({k: pa.array(np.asarray(v)) for k, v in batch.items()})
+        cols = {}
+        for k, v in batch.items():
+            if isinstance(v, np.ndarray):
+                arr = v
+            else:
+                try:
+                    arr = np.asarray(v)
+                except Exception:  # noqa: BLE001 — truly ragged input
+                    arr = np.asarray(v, dtype=object)
+            if arr.dtype == object or arr.ndim > 1:
+                # Ragged / nested rows (token-id lists, embeddings):
+                # build an Arrow list array instead of a flat one.
+                cols[k] = pa.array([
+                    None if x is None
+                    else (list(x) if hasattr(x, "__len__")
+                          and not isinstance(x, (str, bytes, dict))
+                          else x)
+                    for x in v])
+            else:
+                cols[k] = pa.array(arr)
+        return pa.table(cols)
     import pandas as pd
 
     if isinstance(batch, pd.DataFrame):

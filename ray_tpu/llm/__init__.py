@@ -165,3 +165,7 @@ def build_continuous_llama_app(config: Optional[llama.LlamaConfig] = None,
 
 
 __all__ += ["ContinuousLlamaDeployment", "build_continuous_llama_app"]
+
+from ray_tpu.llm.batch import LLMBatchWorker, batch_generate  # noqa: E402
+
+__all__ += ["LLMBatchWorker", "batch_generate"]
