@@ -169,14 +169,18 @@ def main():
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
         text=True)
     address = f"127.0.0.1:{_read_port(gcs_proc, 'GCS_PORT')}"
+    nm_proc = None
     try:
         nm_proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.node_manager.server",
-             "--gcs-address", address, "--num-cpus", "4"],
+             "--gcs-address", address, "--num-cpus", "4",
+             "--num-tpus", "0"],
             stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
             text=True)
         _read_port(nm_proc, "NODE_PORT")
     except BaseException:
+        if nm_proc is not None:
+            nm_proc.terminate()
         gcs_proc.terminate()
         raise
 

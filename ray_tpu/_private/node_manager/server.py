@@ -1310,7 +1310,8 @@ def main():  # pragma: no cover - run as subprocess
     parser.add_argument("--gcs-address", required=True)
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--num-cpus", type=float, default=float(os.cpu_count() or 4))
-    parser.add_argument("--num-tpus", type=float, default=0.0)
+    parser.add_argument("--num-tpus", type=float, default=-1.0,
+                        help="-1 = auto-detect, 0 = explicitly none")
     parser.add_argument("--resources", default="{}")
     parser.add_argument("--labels", default="{}")
     args = parser.parse_args()
@@ -1319,11 +1320,33 @@ def main():  # pragma: no cover - run as subprocess
     import json
 
     resources = {"CPU": args.num_cpus}
-    if args.num_tpus:
+    labels = json.loads(args.labels)
+    if args.num_tpus > 0:
         resources["TPU"] = args.num_tpus
+    elif args.num_tpus < 0:
+        # Auto-detect TPU hardware (reference TPUAcceleratorManager
+        # detection, tpu.py:47-118): contributes TPU chips, the
+        # accelerator_type marker, the per-slice TPU-<type>-head resource
+        # (exactly one coordination actor per slice), and the ICI
+        # topology labels the slice-aware PACK/label policies consume.
+        try:
+            from ray_tpu._private.accelerators.tpu import \
+                TPUAcceleratorManager
+
+            resources.update(TPUAcceleratorManager.node_resources())
+            acc = TPUAcceleratorManager.accelerator_type()
+            pod = TPUAcceleratorManager.pod_name()
+            if pod:
+                labels.setdefault("tpu-slice", pod)
+            if acc:
+                labels.setdefault("tpu-pod-type", acc)
+        except Exception:  # noqa: BLE001 — no TPU on this host
+            logger.exception(
+                "TPU auto-detection failed; registering without TPU "
+                "resources (pass --num-tpus to set them explicitly)")
     resources.update(json.loads(args.resources))
     nm = NodeManager(args.gcs_address, port=args.port, resources=resources,
-                     labels=json.loads(args.labels))
+                     labels=labels)
     print(f"NODE_PORT={nm.port}", flush=True)
     print(f"NODE_ID={nm.node_id}", flush=True)
     try:

@@ -26,8 +26,11 @@ class LLMBatchWorker:
                  max_len: int = 256, eos_token: Optional[int] = None,
                  input_column: str = "prompt_ids",
                  output_column: str = "generated_ids"):
+        import ray_tpu
         from ray_tpu.models.continuous_batching import ContinuousBatcher
 
+        if isinstance(params, ray_tpu.ObjectRef):
+            params = ray_tpu.get(params)
         self.batcher = ContinuousBatcher(config, params=params,
                                          num_slots=num_slots,
                                          max_len=max_len,
@@ -57,8 +60,15 @@ def batch_generate(ds, config: llama.LlamaConfig, *, params=None,
     Returns a Dataset with ``output_column`` holding generated token ids
     (reference: the build_llm_processor entry of ``llm/_internal/batch``).
     ``concurrency`` engine actors each compile the model once and stream
-    the dataset's blocks through their continuous batcher.
+    the dataset's blocks through their continuous batcher. Params ship
+    through the object store (one put, fetched per actor) instead of
+    being pickled into the plan once per actor.
     """
+    import ray_tpu
+
+    if params is not None and not isinstance(params, ray_tpu.ObjectRef) \
+            and ray_tpu.is_initialized():
+        params = ray_tpu.put(params)
     return ds.map_batches(
         LLMBatchWorker,
         concurrency=concurrency,

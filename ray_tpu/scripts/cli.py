@@ -53,9 +53,10 @@ def cmd_start(args):
 
     nm_cmd = [sys.executable, "-m", "ray_tpu._private.node_manager.server",
               "--gcs-address", address,
-              "--num-cpus", str(args.num_cpus or os.cpu_count())]
-    if args.num_tpus:
-        nm_cmd += ["--num-tpus", str(args.num_tpus)]
+              "--num-cpus", str(args.num_cpus or os.cpu_count()),
+              # None = auto-detect on the node; an explicit 0 opts out.
+              "--num-tpus", str(-1 if args.num_tpus is None
+                                else args.num_tpus)]
     if args.resources:
         nm_cmd += ["--resources", args.resources]
     if args.labels:
@@ -337,7 +338,8 @@ def main(argv=None):
     p.add_argument("--address")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--num-cpus", type=float)
-    p.add_argument("--num-tpus", type=float, default=0)
+    p.add_argument("--num-tpus", type=float, default=None,
+                   help="unset = auto-detect; 0 = no TPU resources")
     p.add_argument("--resources", help='JSON, e.g. \'{"special": 2}\'')
     p.add_argument("--labels", help='JSON, e.g. \'{"tpu-slice": "s0"}\'')
     p.add_argument("--dashboard", action="store_true", default=True)

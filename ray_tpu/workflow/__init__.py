@@ -89,6 +89,77 @@ class _WorkflowRunner:
         return value
 
 
+# ------------------------------------------------------------- events
+class EventListener:
+    """External-event hook for durable workflows (reference:
+    ``workflow/event_listener.py``): subclass and implement
+    :meth:`poll_for_event`; the returned payload becomes the step's
+    checkpointed result, so a resumed workflow does NOT re-wait for an
+    event it already received."""
+
+    def poll_for_event(self, *args, **kwargs) -> Any:
+        raise NotImplementedError
+
+
+class KVEventListener(EventListener):
+    """Default listener: waits for ``send_event(key, payload)`` via the
+    cluster KV (cross-process, works in both runtimes)."""
+
+    POLL_PERIOD_S = 0.2
+    EVENT_NS = "__wf_events__"
+
+    def poll_for_event(self, key: str,
+                       timeout: Optional[float] = None) -> Any:
+        import time
+
+        from ray_tpu.experimental.internal_kv import internal_kv_get
+
+        deadline = None if timeout is None else \
+            time.monotonic() + timeout
+        while True:
+            blob = internal_kv_get(key, namespace=self.EVENT_NS)
+            if blob is not None:
+                # Consume-on-read: the payload persists as the STEP's
+                # checkpoint, so deleting the KV entry keeps resume free
+                # while preventing stale satisfaction of a reused key
+                # (and unbounded KV growth).
+                from ray_tpu.experimental.internal_kv import \
+                    internal_kv_del
+
+                internal_kv_del(key, namespace=self.EVENT_NS)
+                return pickle.loads(blob)
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"workflow event {key!r} not received in {timeout}s")
+            time.sleep(self.POLL_PERIOD_S)
+
+
+def send_event(key: str, payload: Any = None) -> None:
+    """Deliver an event to every workflow step waiting on ``key``."""
+    from ray_tpu.experimental.internal_kv import internal_kv_put
+
+    internal_kv_put(key, pickle.dumps(payload),
+                    namespace=KVEventListener.EVENT_NS)
+
+
+def wait_for_event(*args, listener_cls=KVEventListener,
+                   **kwargs) -> DAGNode:
+    """A DAG step that blocks until the listener observes its event and
+    checkpoints the payload (reference: ``workflow.wait_for_event``).
+    Resume semantics come for free: a received event is a persisted step
+    result, so re-running the workflow never re-waits.
+
+    Step identity is content-addressed from the listener class + args —
+    pass plain values (strings/numbers), not live objects.
+    """
+    return _wait_for_event_step.bind(listener_cls, args, kwargs)
+
+
+@ray_tpu.remote
+def _wait_for_event_step(listener_cls, args, kwargs):
+    return listener_cls().poll_for_event(*args, **kwargs)
+
+
 def run(dag: DAGNode, *, workflow_id: str) -> Any:
     """Run (or resume) a workflow; completed steps are skipped on resume."""
     if not ray_tpu.is_initialized():
@@ -118,7 +189,8 @@ def delete(workflow_id: str):
     shutil.rmtree(os.path.join(_storage(), workflow_id), ignore_errors=True)
 
 
-__all__ = ["delete", "get_output", "init", "list_all", "run"]
+__all__ = ["EventListener", "KVEventListener", "delete", "get_output",
+           "init", "list_all", "run", "send_event", "wait_for_event"]
 
 from ray_tpu._private.usage import record_library_usage as _rlu
 

@@ -130,3 +130,63 @@ def test_embedded_agent_prewarm_pip_failure_reported():
         assert status.startswith("failed"), status
     finally:
         agent.stop()
+
+
+# --------------------------------------------- TPU auto-detection (main())
+
+def test_node_main_auto_detects_tpu_resources(monkeypatch):
+    """The node-manager subprocess entry contributes auto-detected TPU
+    chips, the slice-head resource, and ICI topology labels (reference:
+    TPUAcceleratorManager + TPU-<pod>-head, tpu.py:330)."""
+    import subprocess
+    import sys
+
+    import ray_tpu
+    from ray_tpu._private import rpc
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(initialize_head=False)
+    env = dict(os.environ,
+               RAY_TPU_NUM_CHIPS="8",
+               TPU_ACCELERATOR_TYPE="v5litepod-16",
+               TPU_WORKER_ID="0",
+               TPU_NAME="myslice",
+               RAY_TPU_DISABLE_AGENT="1")
+    # sitecustomize pins TPU_ACCELERATOR_TYPE at interpreter start on TPU
+    # hosts: assert against the value the subprocess will actually see.
+    eff = subprocess.run(
+        [sys.executable, "-c",
+         "import os;print(os.environ.get('TPU_ACCELERATOR_TYPE',''))"],
+        capture_output=True, text=True, env=env,
+    ).stdout.strip() or "v5litepod-16"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + list(filter(None, [env.get("PYTHONPATH", "")])))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_manager.server",
+         "--gcs-address", c.address, "--num-cpus", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True)
+    try:
+        deadline = time.time() + 30
+        node_id = None
+        while time.time() < deadline and node_id is None:
+            line = proc.stdout.readline().strip()
+            if line.startswith("NODE_ID="):
+                node_id = line.split("=", 1)[1]
+        assert node_id
+        gcs = rpc.get_stub("GcsService", c.address)
+        info = next(n for n in gcs.GetNodes(pb.GetNodesRequest()).nodes
+                    if n.node_id == node_id)
+        assert info.resources["TPU"] == 8.0
+        assert info.resources[f"accelerator_type:{eff}"] == 1.0
+        assert info.resources[f"TPU-{eff}-head"] == 1.0
+        assert info.resources["TPU-slice:myslice"] == 8.0
+        assert info.labels["tpu-pod-type"] == eff
+        assert info.labels["tpu-slice"] == "myslice"
+    finally:
+        proc.terminate()
+        c.shutdown()

@@ -435,3 +435,49 @@ def test_export_events_buffer_and_file(tmp_path, monkeypatch):
     finally:
         ray_tpu.shutdown()
         c.shutdown()
+
+
+# ------------------------------------------------ workflow event listeners
+
+def test_workflow_wait_for_event(ray_local, tmp_path):
+    """A wait_for_event step blocks until send_event, checkpoints the
+    payload, and never re-waits on resume (reference:
+    workflow/event_listener.py + workflow.wait_for_event)."""
+    import threading
+
+    workflow.init(str(tmp_path))
+
+    @ray_tpu.remote
+    def combine(payload, base):
+        return f"{base}:{payload}"
+
+    dag = combine.bind(
+        workflow.wait_for_event("order-123", timeout=30), "handled")
+
+    result_box = {}
+
+    def run_wf():
+        result_box["out"] = workflow.run(dag, workflow_id="wf-events")
+
+    t = threading.Thread(target=run_wf)
+    t.start()
+    time.sleep(0.5)
+    assert t.is_alive()  # still waiting for the event
+    workflow.send_event("order-123", {"sku": 42})
+    t.join(timeout=30)
+    assert result_box["out"] == "handled:{'sku': 42}"
+    # Resume: the event payload is a persisted step result — no re-wait
+    # (send_event is NOT called again; run must return immediately).
+    t0 = time.time()
+    assert workflow.run(dag, workflow_id="wf-events") == \
+        "handled:{'sku': 42}"
+    assert time.time() - t0 < 5
+    workflow.delete("wf-events")
+
+
+def test_workflow_event_timeout(ray_local, tmp_path):
+    workflow.init(str(tmp_path))
+    dag = workflow.wait_for_event("never-sent", timeout=0.5)
+    with pytest.raises(Exception, match="not received"):
+        workflow.run(dag, workflow_id="wf-timeout")
+    workflow.delete("wf-timeout")
