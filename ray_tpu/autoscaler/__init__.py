@@ -18,6 +18,7 @@ from __future__ import annotations
 import abc
 import json
 import logging
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional
@@ -65,6 +66,74 @@ class FakeNodeProvider(NodeProvider):
 
     def non_terminated_nodes(self) -> List[str]:
         return list(self._nodes)
+
+
+class LocalNodeProvider(NodeProvider):
+    """Nodes are node-manager SUBPROCESSES on this host — the
+    cluster-launcher provider for single-machine clusters (reference: the
+    local node provider under ``autoscaler/_private``; cloud providers
+    slot in through the same three-method ABC)."""
+
+    def __init__(self, gcs_address: str,
+                 defaults: Optional[Dict[str, Any]] = None):
+        self.gcs_address = gcs_address
+        self.defaults = defaults or {}
+        self._procs: Dict[str, Any] = {}
+
+    def create_node(self, node_config: Dict[str, Any]) -> str:
+        import json as _json
+        import subprocess
+        import sys
+
+        cfg = {**self.defaults, **(node_config or {})}
+        resources = dict(cfg.get("resources", {}))
+        num_cpus = float(resources.pop("CPU", cfg.get("num_cpus", 4)))
+        num_tpus = cfg.get("num_tpus")
+        cmd = [sys.executable, "-m",
+               "ray_tpu._private.node_manager.server",
+               "--gcs-address", self.gcs_address,
+               "--num-cpus", str(num_cpus),
+               "--num-tpus", str(-1 if num_tpus is None else num_tpus),
+               "--resources", _json.dumps(resources),
+               "--labels", _json.dumps(cfg.get("labels", {}))]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(dict.fromkeys(filter(None, (
+            list(sys.path) + [env.get("PYTHONPATH", "")]))))
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, env=env,
+                                text=True)
+        node_id = None
+        deadline = time.monotonic() + 60.0
+        while node_id is None:
+            line = proc.stdout.readline().strip()
+            if line.startswith("NODE_ID="):
+                node_id = line.split("=", 1)[1]
+            elif not line and proc.poll() is not None:
+                raise RuntimeError("worker node process died at startup")
+            elif time.monotonic() > deadline:
+                proc.terminate()
+                raise RuntimeError("worker node start timed out")
+        self._procs[node_id] = proc
+        return node_id
+
+    def terminate_all(self) -> None:
+        for nid in list(self._procs):
+            self.terminate_node(nid)
+
+    def terminate_node(self, node_id: str) -> None:
+        proc = self._procs.pop(node_id, None)
+        if proc is not None:
+            proc.terminate()
+
+    def non_terminated_nodes(self) -> List[str]:
+        dead = [nid for nid, p in self._procs.items()
+                if p.poll() is not None]
+        for nid in dead:
+            self._procs.pop(nid, None)
+        return list(self._procs)
+
+    def pids(self) -> List[int]:
+        return [p.pid for p in self._procs.values()]
 
 
 def request_resources(gcs_address: str,

@@ -1,0 +1,59 @@
+"""Autoscaler monitor process (reference: ``autoscaler/_private/
+monitor.py:127`` — the head-side daemon running the reconcile loop).
+
+Launched by ``ray-tpu up`` when the cluster config enables autoscaling:
+reads cluster load from the GCS each tick and drives a
+:class:`~ray_tpu.autoscaler.LocalNodeProvider` (or any provider named in
+the config) to launch/terminate worker nodes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import time
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--config", required=True,
+                        help="JSON cluster config (worker defaults + "
+                             "min/max workers)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    cfg = json.loads(args.config)
+
+    from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider
+
+    provider = LocalNodeProvider(args.gcs_address,
+                                 defaults=cfg.get("worker", {}))
+    scaler = Autoscaler(
+        args.gcs_address, provider,
+        node_config=cfg.get("worker", {}),
+        min_workers=int(cfg.get("min_workers", 0)),
+        max_workers=int(cfg.get("max_workers", 4)),
+        idle_timeout_s=float(cfg.get("idle_timeout_s", 60.0)))
+    # `ray-tpu down` SIGTERMs this process; the provider's node-manager
+    # subprocesses are OUR children and must die with us or they'd run on
+    # as orphans holding ports.
+    import signal
+    import sys
+
+    def _shutdown(*_):
+        provider.terminate_all()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    scaler.start()
+    print("MONITOR_STARTED=1", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        provider.terminate_all()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

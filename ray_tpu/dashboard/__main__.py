@@ -1,0 +1,24 @@
+"""Dashboard subprocess entry (used by ``ray-tpu up``)."""
+
+import argparse
+import time
+
+from ray_tpu.dashboard import Dashboard
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--gcs-address", required=True)
+    p.add_argument("--port", type=int, default=8265)
+    args = p.parse_args(argv)
+    dash = Dashboard(args.gcs_address, port=args.port)
+    print(f"DASHBOARD_PORT={dash.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        dash.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -306,6 +306,90 @@ def cmd_stack(args):
             print(f"  <unreachable: {e}>")
 
 
+def cmd_up(args):
+    """Launch a cluster from a YAML config (reference: ``ray up`` +
+    the cluster launcher). Single-host: the head plus min_workers worker
+    node-manager processes start locally; with ``autoscaling: true`` a
+    monitor process scales workers between min and max."""
+    import yaml
+
+    with open(args.config) as f:
+        cfg = yaml.safe_load(f) or {}
+    os.makedirs(STATE_DIR, exist_ok=True)
+    env = dict(os.environ)
+
+    gcs = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.gcs.server", "--port",
+         str(cfg.get("gcs_port", 0))],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
+    _save_pid(gcs.pid)
+    address = f"127.0.0.1:{_read_port(gcs, 'GCS_PORT')}"
+    with open(ADDRESS_FILE, "w") as f:
+        f.write(address)
+    print(f"GCS started at {address}")
+
+    from ray_tpu.autoscaler import LocalNodeProvider
+
+    head_cfg = cfg.get("head", {"resources": {"CPU": float(
+        os.cpu_count() or 4)}})
+    provider = LocalNodeProvider(address)
+
+    def _launch(node_cfg):
+        nid = provider.create_node(node_cfg or {})
+        # Record the pid IMMEDIATELY: a later launch failing must not
+        # leave already-started nodes invisible to `ray-tpu down`.
+        _save_pid(provider._procs[nid].pid)
+        return nid
+
+    _launch(head_cfg)
+    print("head node started")
+    if not cfg.get("autoscaling"):
+        # With autoscaling the MONITOR owns the workers (its provider
+        # enforces min_workers); pre-spawning here would double-provision
+        # and leave unmanaged nodes the scaler can never scale down.
+        for _ in range(int(cfg.get("min_workers", 0))):
+            _launch(cfg.get("worker", {}))
+        if cfg.get("min_workers"):
+            print(f"{cfg['min_workers']} worker node(s) started")
+
+    if cfg.get("autoscaling"):
+        monitor_cfg = json.dumps({
+            "worker": cfg.get("worker", {}),
+            "min_workers": cfg.get("min_workers", 0),
+            "max_workers": cfg.get("max_workers", 4),
+            "idle_timeout_s": cfg.get("idle_timeout_s", 60.0),
+        })
+        mon = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.autoscaler.monitor",
+             "--gcs-address", address, "--config", monitor_cfg],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env)
+        _save_pid(mon.pid)
+        print("autoscaler monitor started")
+    if cfg.get("dashboard", True):
+        # The dashboard runs as its own subprocess: an in-CLI thread
+        # would die the moment `up` returns.
+        dash = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.dashboard",
+             "--gcs-address", address,
+             "--port", str(cfg.get("dashboard_port", 8265))],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
+        _save_pid(dash.pid)
+        dash_port = _read_port(dash, "DASHBOARD_PORT")
+        print(f"Dashboard at http://127.0.0.1:{dash_port}")
+    print(f"\nConnect with: ray_tpu.init(address={address!r})")
+    if cfg.get("block"):
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+
+
+def cmd_down(args):
+    """Tear the launched cluster down (reference: ``ray down``)."""
+    cmd_stop(args)
+
+
 def cmd_gateway(args):
     """Serve the cross-language client gateway (C++ API / thin remote
     clients; reference: the Ray Client server)."""
@@ -404,6 +488,13 @@ def main(argv=None):
     p = sub.add_parser("resources", help="cluster total/available resources")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_resources)
+
+    p = sub.add_parser("up", help="launch a cluster from a YAML config")
+    p.add_argument("config")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down the launched cluster")
+    p.set_defaults(fn=cmd_down)
 
     p = sub.add_parser("gateway",
                        help="serve the cross-language client gateway")

@@ -82,6 +82,9 @@ class _WorkflowRunner:
             else:
                 value = ray_tpu.get(node._remote_fn.remote(*args, **kwargs))
                 self.save(key, value)
+                hook = getattr(node, "_post_commit", None)
+                if hook is not None:
+                    hook()
         else:
             raise TypeError(
                 f"workflow steps must be task nodes, got {type(node).__name__}")
@@ -119,14 +122,10 @@ class KVEventListener(EventListener):
         while True:
             blob = internal_kv_get(key, namespace=self.EVENT_NS)
             if blob is not None:
-                # Consume-on-read: the payload persists as the STEP's
-                # checkpoint, so deleting the KV entry keeps resume free
-                # while preventing stale satisfaction of a reused key
-                # (and unbounded KV growth).
-                from ray_tpu.experimental.internal_kv import \
-                    internal_kv_del
-
-                internal_kv_del(key, namespace=self.EVENT_NS)
+                # NOT deleted here: consumption commits only after the
+                # step result persists (the post-commit hook in
+                # wait_for_event), so a crash between receipt and
+                # checkpoint can't lose the event.
                 return pickle.loads(blob)
             if deadline is not None and time.monotonic() > deadline:
                 raise TimeoutError(
@@ -135,7 +134,9 @@ class KVEventListener(EventListener):
 
 
 def send_event(key: str, payload: Any = None) -> None:
-    """Deliver an event to every workflow step waiting on ``key``."""
+    """Publish an event. Single-consumer semantics: the first waiting
+    step to checkpoint the payload consumes the key (post-commit), so a
+    reused key is never satisfied by a stale event."""
     from ray_tpu.experimental.internal_kv import internal_kv_put
 
     internal_kv_put(key, pickle.dumps(payload),
@@ -152,7 +153,19 @@ def wait_for_event(*args, listener_cls=KVEventListener,
     Step identity is content-addressed from the listener class + args —
     pass plain values (strings/numbers), not live objects.
     """
-    return _wait_for_event_step.bind(listener_cls, args, kwargs)
+    node = _wait_for_event_step.bind(listener_cls, args, kwargs)
+    if listener_cls is KVEventListener and args:
+        key = args[0]
+
+        def _consume():
+            from ray_tpu.experimental.internal_kv import internal_kv_del
+
+            internal_kv_del(key, namespace=KVEventListener.EVENT_NS)
+
+        # Runs AFTER the step result is durably checkpointed — exactly-
+        # once consumption without a lost-event crash window.
+        node._post_commit = _consume
+    return node
 
 
 @ray_tpu.remote

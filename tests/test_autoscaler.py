@@ -53,3 +53,76 @@ def test_min_workers_maintained(cluster):
     assert len(provider.non_terminated_nodes()) == 2
     for node_id in provider.non_terminated_nodes():
         provider.terminate_node(node_id)
+
+
+# ------------------------------------------------- cluster launcher (up/down)
+
+def test_local_node_provider_spawns_real_nodes():
+    import os
+
+    from ray_tpu._private import rpc
+    from ray_tpu.autoscaler import LocalNodeProvider
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    os.environ.setdefault("RAY_TPU_DISABLE_AGENT", "1")
+    c = Cluster(initialize_head=False)
+    provider = LocalNodeProvider(c.address,
+                                 defaults={"resources": {"CPU": 2}})
+    try:
+        nid = provider.create_node({"labels": {"role": "w"},
+                                    "num_tpus": 0})
+        assert nid in provider.non_terminated_nodes()
+        gcs = rpc.get_stub("GcsService", c.address)
+        deadline = time.time() + 30
+        info = None
+        while time.time() < deadline:
+            hits = [n for n in gcs.GetNodes(pb.GetNodesRequest()).nodes
+                    if n.node_id == nid and n.alive]
+            if hits:
+                info = hits[0]
+                break
+            time.sleep(0.2)
+        assert info is not None
+        assert info.resources["CPU"] == 2.0
+        assert info.labels["role"] == "w"
+        provider.terminate_node(nid)
+        assert nid not in provider.non_terminated_nodes()
+    finally:
+        for nid in provider.non_terminated_nodes():
+            provider.terminate_node(nid)
+        c.shutdown()
+
+
+def test_cli_up_and_down(tmp_path, monkeypatch, capsys):
+    """ray-tpu up <yaml> launches GCS + head + workers; down stops them
+    (reference: ray up/down cluster launcher)."""
+    import os
+    import subprocess
+
+    import ray_tpu
+    from ray_tpu.scripts import cli as cli_mod
+
+    monkeypatch.setenv("RAY_TPU_DISABLE_AGENT", "1")
+    state = tmp_path / "state"
+    monkeypatch.setattr(cli_mod, "STATE_DIR", str(state))
+    monkeypatch.setattr(cli_mod, "ADDRESS_FILE", str(state / "address"))
+    monkeypatch.setattr(cli_mod, "PIDS_FILE", str(state / "pids"))
+    cfg = tmp_path / "cluster.yaml"
+    cfg.write_text(
+        "head:\n  resources: {CPU: 2}\n  num_tpus: 0\n"
+        "worker:\n  resources: {CPU: 2}\n  num_tpus: 0\n"
+        "min_workers: 1\ndashboard: false\n")
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    cli_mod.main(["up", str(cfg)])
+    out = capsys.readouterr().out
+    assert "GCS started" in out and "head node started" in out
+    address = (state / "address").read_text().strip()
+    ray_tpu.init(address=address)
+    try:
+        assert ray_tpu.cluster_resources().get("CPU") == 4.0  # head+worker
+    finally:
+        ray_tpu.shutdown()
+        cli_mod.main(["down"])
+        capsys.readouterr()
