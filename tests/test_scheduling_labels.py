@@ -7,6 +7,8 @@ TPU-native use case is pinning work to one ICI-connected slice via the
 ``tpu-slice`` topology label.
 """
 
+import time
+
 import pytest
 
 import ray_tpu
@@ -80,9 +82,27 @@ def test_tpu_slice_targeting(label_cluster):
         hard={"tpu-slice": "slice-1"})) == node_b
 
 
+def _wait_node_idle(cluster, node_id, cpus, timeout=20):
+    """Wait until a node's full CPU capacity is released (prior tests'
+    leases/actors release asynchronously; soft preference is only
+    deterministic on an uncontended node)."""
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    gcs = rpc.get_stub("GcsService", cluster.address)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for n in gcs.GetNodes(pb.GetNodesRequest()).nodes:
+            if n.node_id == node_id and \
+                    n.available.get("CPU", 0) >= cpus:
+                return
+        time.sleep(0.2)
+
+
 def test_soft_prefers_but_falls_back(label_cluster):
-    _, node_a, node_b = label_cluster
+    c, node_a, node_b = label_cluster
     # Soft preference for zone=a; should land there under no contention.
+    _wait_node_idle(c, node_a, 2)
     assert _run_on(NodeLabelSchedulingStrategy(
         soft={"zone": "a"})) == node_a
     # Soft preference for a zone that doesn't exist must still run.
