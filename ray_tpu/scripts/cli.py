@@ -12,7 +12,10 @@ import subprocess
 import sys
 import time
 
-STATE_DIR = "/tmp/ray_tpu"
+# NOT /tmp/ray_tpu: a directory named like the package next to a
+# script's cwd becomes an importable namespace package and shadows
+# the real ray_tpu.
+STATE_DIR = "/tmp/ray_tpu_state"
 ADDRESS_FILE = os.path.join(STATE_DIR, "address")
 PIDS_FILE = os.path.join(STATE_DIR, "pids")
 

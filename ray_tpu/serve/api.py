@@ -178,22 +178,37 @@ class ServeController:
         self._route_version: Dict[str, int] = {}
         # autoscaler intent: name -> (desired, first_seen_monotonic)
         self._scale_intent: Dict[str, Any] = {}
+        self._pg_cleanups: Dict[str, list] = {}
+        self._replica_birth: Dict[int, float] = {}
+        self._reconcile_lock = threading.Lock()
         self._stop = False
         threading.Thread(target=self._reconcile_loop, daemon=True).start()
 
     def deploy(self, name: str, cls_or_fn, init_args, init_kwargs,
                num_replicas: int, is_function: bool,
                max_concurrency: int,
-               autoscaling_config: Optional[Dict[str, Any]] = None) -> bool:
+               autoscaling_config: Optional[Dict[str, Any]] = None,
+               placement_strategy: Optional[str] = None,
+               ray_actor_options: Optional[Dict[str, Any]] = None) -> bool:
         cfg = None
         if autoscaling_config is not None or num_replicas == "auto":
             cfg = dict(DEFAULT_AUTOSCALING)
             cfg.update(autoscaling_config or {})
             num_replicas = cfg["min_replicas"]
+        prev = self.deployments.get(name) or {}
         self.deployments[name] = {
             "cls": cls_or_fn, "args": init_args, "kwargs": init_kwargs,
             "num_replicas": num_replicas, "is_function": is_function,
             "max_concurrency": max_concurrency, "autoscaling": cfg,
+            # Deployment scheduler (reference: deployment_scheduler.py
+            # compact placement): COMPACT gangs replicas onto as few
+            # nodes as possible via a PACK placement group; SPREAD
+            # spreads them with the min-utilization policy.
+            "placement": placement_strategy,
+            "actor_options": dict(ray_actor_options or {}),
+            # A redeploy must inherit the existing group or its
+            # reservation would leak unreachable.
+            "_pg": prev.get("_pg"),
         }
         self._reconcile_once(name)
         return True
@@ -238,10 +253,20 @@ class ServeController:
         self._reconcile_once(name)
 
     def delete(self, name: str) -> bool:
-        self.deployments.pop(name, None)
+        spec = self.deployments.pop(name, None)
         for r in self.replicas.pop(name, []):
+            self._replica_birth.pop(id(r), None)
             try:
                 ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        for cleanup in self._pg_cleanups.pop(name, []):
+            cleanup()
+        if spec is not None and spec.get("_pg") is not None:
+            try:
+                from ray_tpu.util import remove_placement_group
+
+                remove_placement_group(spec["_pg"])
             except Exception:  # noqa: BLE001
                 pass
         return True
@@ -259,27 +284,77 @@ class ServeController:
                 for name, spec in self.deployments.items()}
 
     def _reconcile_once(self, name: str):
+        # One reconcile at a time: the deploy RPC thread and the loop
+        # thread would otherwise race group creation / replica lists
+        # (last-write-wins leaks the loser's group and replicas).
+        with self._reconcile_lock:
+            self._reconcile_locked(name)
+
+    def _reconcile_locked(self, name: str):
         spec = self.deployments.get(name)
         if spec is None:
             return
         replica_cls = ray_tpu.remote(Replica)
         current = self.replicas.setdefault(name, [])
-        # Remove dead replicas (probe with a cheap health call).
+        # Remove dead replicas (probe with a cheap health call) — but a
+        # replica still STARTING (worker spawn + placement-group bundle
+        # admission can take many seconds) must not be declared dead by a
+        # 2s probe, or the reconciler churns forever: each dropped-but-
+        # actually-starting replica still holds its bundle, so every
+        # replacement starves on pg-wait.
+        now = time.monotonic()
         live = []
         for r in current:
             try:
                 ray_tpu.get(r.health.remote(), timeout=2)
                 live.append(r)
-            except Exception:  # noqa: BLE001
-                pass
+                self._replica_birth.pop(id(r), None)  # confirmed up
+            except ray_tpu.exceptions.ActorDiedError:
+                # Confirmed dead: replace immediately (no grace).
+                self._replica_birth.pop(id(r), None)
+            except Exception:  # noqa: BLE001 — timeout: starting OR dead
+                birth = self._replica_birth.get(id(r))
+                if birth is not None and \
+                        now - birth < self.REPLICA_STARTUP_GRACE_S:
+                    live.append(r)  # still starting: keep, don't churn
+                else:
+                    self._replica_birth.pop(id(r), None)
         current = live
+        opts: Dict[str, Any] = dict(spec.get("actor_options") or {})
+        opts["max_concurrency"] = spec["max_concurrency"]
+        placement = spec.get("placement")
+        if placement == "COMPACT":
+            strategy, regrown = self._compact_group_strategy(name, spec)
+            if strategy is None:
+                # No feasible group yet: keep whatever runs, retry later.
+                self.replicas[name] = current
+                return
+            opts["scheduling_strategy"] = strategy
+            if regrown:
+                # Migrate: the whole gang restarts inside the new group so
+                # compactness holds for ALL replicas, then the old group's
+                # reservation is released (even when no replica was live —
+                # a dead gang's old group must not hold reservations).
+                for r in current:
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._replica_birth.pop(id(r), None)
+                current = []
+                for cleanup in self._pg_cleanups.pop(name, []):
+                    cleanup()
+        elif placement == "SPREAD":
+            opts["scheduling_strategy"] = "SPREAD"
         while len(current) < spec["num_replicas"]:
-            current.append(replica_cls.options(
-                max_concurrency=spec["max_concurrency"]).remote(
+            replica = replica_cls.options(**opts).remote(
                 spec["cls"], spec["args"], spec["kwargs"],
-                spec["is_function"]))
+                spec["is_function"])
+            self._replica_birth[id(replica)] = time.monotonic()
+            current.append(replica)
         while len(current) > spec["num_replicas"]:
             victim = current.pop()
+            self._replica_birth.pop(id(victim), None)
             try:
                 ray_tpu.kill(victim)
             except Exception:  # noqa: BLE001
@@ -292,6 +367,71 @@ class ServeController:
             # LongPollHost notify, long_poll.py:204).
             self._route_version[name] = self._route_version.get(name, 0) + 1
             _publish_route_event(name)
+
+    REPLICA_STARTUP_GRACE_S = 60.0
+
+    @staticmethod
+    def _replica_bundle(actor_options: Dict[str, Any]) -> Dict[str, float]:
+        """The full resource demand of one replica (TPU serving is the
+        flagship case — CPU-only bundles could never admit it)."""
+        opts = actor_options or {}
+        bundle: Dict[str, float] = {"CPU": float(
+            opts.get("num_cpus", 1) or 1)}
+        if opts.get("num_gpus"):
+            bundle["GPU"] = float(opts["num_gpus"])
+        if opts.get("num_tpus"):
+            bundle["TPU"] = float(opts["num_tpus"])
+        if opts.get("memory"):
+            bundle["memory"] = float(opts["memory"])
+        for k, v in (opts.get("resources") or {}).items():
+            bundle[k] = float(v)
+        return bundle
+
+    def _compact_group_strategy(self, name: str, spec):
+        """PACK placement group sized to the deployment; regrown (new
+        group, replicas recreated into it) when scale-up outgrows it —
+        scale-down keeps the group and simply leaves bundles idle. An
+        infeasible regrow keeps the OLD (working) group and backs off,
+        never trading a live gang for an unplaceable one."""
+        from ray_tpu.util import (PlacementGroupSchedulingStrategy,
+                                  placement_group, remove_placement_group)
+
+        per_replica = self._replica_bundle(spec.get("actor_options"))
+        pg = spec.get("_pg")
+        regrown = False
+        needs_grow = pg is None or \
+            len(pg.bundle_specs) < spec["num_replicas"]
+        if needs_grow and time.monotonic() < spec.get("_pg_backoff", 0.0):
+            needs_grow = False  # recent infeasible regrow: don't thrash
+        if needs_grow:
+            new_pg = placement_group(
+                [dict(per_replica)] * spec["num_replicas"],
+                strategy="PACK")
+            if not new_pg.wait(30):
+                # Couldn't place: discard the new group, keep serving on
+                # the old one (if any), and retry later.
+                try:
+                    remove_placement_group(new_pg)
+                except Exception:  # noqa: BLE001
+                    pass
+                spec["_pg_backoff"] = time.monotonic() + 30.0
+            else:
+                if pg is not None:
+                    regrown = True
+                    old = pg
+
+                    def _cleanup(old=old):
+                        try:
+                            remove_placement_group(old)
+                        except Exception:  # noqa: BLE001
+                            pass
+
+                    self._pg_cleanups.setdefault(name, []).append(_cleanup)
+                spec["_pg"] = pg = new_pg
+        if pg is None:
+            return None, False  # nowhere to place yet; retry next tick
+        return PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=-1), regrown
 
     def _reconcile_loop(self):
         while not self._stop:
@@ -542,26 +682,32 @@ class Deployment:
     def __init__(self, cls_or_fn, name: str, num_replicas: int = 1,
                  max_ongoing_requests: int = 100,
                  ray_actor_options: Optional[Dict] = None,
-                 autoscaling_config: Optional[Dict[str, Any]] = None):
+                 autoscaling_config: Optional[Dict[str, Any]] = None,
+                 placement_strategy: Optional[str] = None):
         self._cls_or_fn = cls_or_fn
         self.name = name
         self.num_replicas = num_replicas
         self.max_ongoing_requests = max_ongoing_requests
         self.ray_actor_options = ray_actor_options or {}
         self.autoscaling_config = autoscaling_config
+        self.placement_strategy = placement_strategy
 
     def options(self, *, num_replicas: Optional[Any] = None,
                 name: Optional[str] = None,
                 max_ongoing_requests: Optional[int] = None,
                 autoscaling_config: Optional[Dict[str, Any]] = None,
+                placement_strategy: Optional[str] = None,
+                ray_actor_options: Optional[Dict] = None,
                 **_) -> "Deployment":
         return Deployment(
             self._cls_or_fn, name or self.name,
             num_replicas or self.num_replicas,
             max_ongoing_requests or self.max_ongoing_requests,
-            self.ray_actor_options,
+            ray_actor_options if ray_actor_options is not None
+            else self.ray_actor_options,
             autoscaling_config if autoscaling_config is not None
-            else self.autoscaling_config)
+            else self.autoscaling_config,
+            placement_strategy or self.placement_strategy)
 
     def bind(self, *args, **kwargs) -> Application:
         return Application(self, args, kwargs)
@@ -570,6 +716,8 @@ class Deployment:
 def deployment(_cls=None, *, name: Optional[str] = None,
                num_replicas: Any = 1, max_ongoing_requests: int = 100,
                autoscaling_config: Optional[Dict[str, Any]] = None,
+               placement_strategy: Optional[str] = None,
+               ray_actor_options: Optional[Dict] = None,
                **kwargs):
     """``@serve.deployment`` decorator (class or function).
 
@@ -581,7 +729,9 @@ def deployment(_cls=None, *, name: Optional[str] = None,
     def decorate(cls_or_fn):
         return Deployment(cls_or_fn, name or cls_or_fn.__name__,
                           num_replicas, max_ongoing_requests,
-                          autoscaling_config=autoscaling_config)
+                          ray_actor_options=ray_actor_options,
+                          autoscaling_config=autoscaling_config,
+                          placement_strategy=placement_strategy)
 
     if _cls is not None:
         return decorate(_cls)
@@ -609,7 +759,8 @@ def run(app: Application, *, name: str = "default",
     is_function = not inspect.isclass(dep._cls_or_fn)
     ray_tpu.get(controller.deploy.remote(
         dep.name, dep._cls_or_fn, app.args, app.kwargs, dep.num_replicas,
-        is_function, dep.max_ongoing_requests, dep.autoscaling_config),
+        is_function, dep.max_ongoing_requests, dep.autoscaling_config,
+        dep.placement_strategy, dep.ray_actor_options),
         timeout=120)
     return DeploymentHandle(dep.name)
 

@@ -66,3 +66,48 @@ def test_cluster_replica_death_retry():
         ok += 1
     assert ok == 10
     serve.delete("Worky")
+
+
+# --------------------------------------------- deployment placement strategy
+
+def _ensure_extra_nodes(cluster, n=2):
+    if not getattr(cluster, "_extra_nodes_added", False):
+        for _ in range(n):
+            cluster.add_node(num_cpus=4)
+        cluster.wait_for_nodes()
+        cluster._extra_nodes_added = True
+
+
+def test_compact_placement_gangs_replicas(serve_cluster):
+    """COMPACT deployments reserve a PACK placement group and land every
+    replica on one node (reference: deployment_scheduler compact
+    placement)."""
+    _ensure_extra_nodes(serve_cluster)
+
+    @serve.deployment(name="WhereCompact", num_replicas=3,
+                      placement_strategy="COMPACT",
+                      ray_actor_options={"num_cpus": 1})
+    class Where:
+        def __call__(self, _):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    h = serve.run(Where.bind(), name="compact_app")
+    nodes = {h.remote(i).result(timeout_s=60) for i in range(6)}
+    assert len(nodes) == 1, nodes
+    serve.delete("WhereCompact")
+
+
+def test_spread_placement_uses_multiple_nodes(serve_cluster):
+    _ensure_extra_nodes(serve_cluster)
+
+    @serve.deployment(name="WhereSpread", num_replicas=4,
+                      placement_strategy="SPREAD",
+                      ray_actor_options={"num_cpus": 1})
+    class Where:
+        def __call__(self, _):
+            return ray_tpu.get_runtime_context().get_node_id()
+
+    h = serve.run(Where.bind(), name="spread_app")
+    nodes = {h.remote(i).result(timeout_s=60) for i in range(12)}
+    assert len(nodes) >= 2, nodes
+    serve.delete("WhereSpread")
