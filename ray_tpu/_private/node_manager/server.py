@@ -717,8 +717,10 @@ class NodeManager:
             return self._lease_with_labels(spec, demand, lease_id, selector)
         if spec.strategy == "SPREAD":
             # Min-utilization placement (reference: spread_scheduling_policy):
-            # hand off when a clearly-less-loaded node exists; the margin
-            # damps spillback ping-pong between nodes with stale views.
+            # compare POST-charge utilization — what each node would look
+            # like with this task on it — or an idle-but-small local node
+            # swallows a whole fan-out serially. A small margin damps
+            # spillback ping-pong between nodes with stale views.
             others = [n for n in self._cluster_view()
                       if n.node_id != self.node_id]
             best = policies.pick_node_spread(others, demand)
@@ -730,8 +732,8 @@ class NodeManager:
                     for k, v in self.available.items():
                         me.available[k] = v
                 best_node = next(n for n in others if n.node_id == best)
-                if policies._utilization(best_node) + 0.05 < \
-                        policies._utilization(me):
+                if policies.util_after(best_node, demand) + 0.02 < \
+                        policies.util_after(me, demand):
                     return pb.LeaseReply(granted=False,
                                          spillback_node_id=best,
                                          spillback_address=best_node.address)

@@ -185,6 +185,9 @@ class ClusterRuntime(CoreRuntime):
         self.memory = MemoryStore()
         self._pulls = _PullManager(int(os.environ.get(
             "RAY_TPU_PULL_BUDGET_BYTES", 512 << 20)))
+        self._spread_idx = 0
+        self._spread_lock = threading.Lock()
+        self._node_addr_cache = None
         # The pool carries every background work item (task submits,
         # actor pushes, prefetches, stream polls): it stays WIDE so slow
         # tasks can't head-of-line block gets and actor calls. Raw submit
@@ -1067,10 +1070,22 @@ class ClusterRuntime(CoreRuntime):
     def _node_address(self, node_id: str) -> Optional[str]:
         return self._node_addresses().get(node_id)
 
+    NODE_ADDR_TTL_S = 1.0
+
     def _node_addresses(self) -> Dict[str, str]:
-        return {n.node_id: n.address
-                for n in self.gcs.GetNodes(pb.GetNodesRequest()).nodes
-                if n.alive}
+        # Cached briefly: SPREAD round-robin consults this per submission,
+        # and a per-task GetNodes would make the GCS the throughput
+        # bottleneck for exactly the short-task fan-outs SPREAD serves.
+        # Staleness is tolerated by the spillback/retry paths.
+        now = time.monotonic()
+        cached = self._node_addr_cache
+        if cached is not None and now - cached[0] < self.NODE_ADDR_TTL_S:
+            return cached[1]
+        addrs = {n.node_id: n.address
+                 for n in self.gcs.GetNodes(pb.GetNodesRequest()).nodes
+                 if n.alive}
+        self._node_addr_cache = (now, addrs)
+        return addrs
 
     def _pg_lease_targets(self, spec: pb.TaskSpec) -> List[Any]:
         """Node stubs hosting the target bundle(s), waiting for placement
@@ -1195,6 +1210,18 @@ class ClusterRuntime(CoreRuntime):
             self._apply_push_result(result, return_ids, spec.name)
         return True
 
+    def _next_spread_target(self):
+        try:
+            addrs = sorted(self._node_addresses().values())
+        except Exception:  # noqa: BLE001
+            return self.node
+        if not addrs:
+            return self.node
+        with self._spread_lock:
+            self._spread_idx = (self._spread_idx + 1) % len(addrs)
+            addr = addrs[self._spread_idx]
+        return rpc.get_stub("NodeService", addr)
+
     def _has_cached_lease(self, sig) -> bool:
         with self._lease_cache_lock:
             return bool(self._lease_cache.get(sig))
@@ -1216,6 +1243,12 @@ class ClusterRuntime(CoreRuntime):
                 target = pg_targets[0]
             elif spec.affinity_node_id:
                 target = self._affinity_target(spec)
+            elif spec.strategy == "SPREAD":
+                # Round-robin the initial lease target (reference:
+                # spread_scheduling_policy iterates nodes round-robin):
+                # utilization alone cannot spread short tasks — each one
+                # releases its resources before the next lease looks.
+                target = self._next_spread_target()
             else:
                 target = self.node
             deadline = time.monotonic() + 300.0

@@ -58,13 +58,29 @@ def pick_node_hybrid(
     return min(alive, key=_utilization).node_id
 
 
+def util_after(node: pb.NodeInfo, demand: Dict[str, float]) -> float:
+    """Critical-resource utilization AFTER charging ``demand`` — the
+    quantity SPREAD placement must compare (pre-charge utilization lets an
+    idle-but-small node swallow a whole fan-out serially)."""
+    utils = []
+    for k, total in node.resources.items():
+        if total <= 0:
+            continue
+        used = total - node.available.get(k, 0.0) + demand.get(k, 0.0)
+        utils.append(used / total)
+    return max(utils) if utils else 0.0
+
+
 def pick_node_spread(
     nodes: Sequence[pb.NodeInfo], demand: Dict[str, float]
 ) -> Optional[str]:
     alive = [n for n in nodes if n.alive and _fits(n, demand)]
     if not alive:
         return None
-    return min(alive, key=_utilization).node_id
+    # Rank by POST-charge utilization (what the node would look like with
+    # this task on it): pre-charge ranking prefers idle-but-tiny nodes
+    # that the demand would instantly saturate.
+    return min(alive, key=lambda n: util_after(n, demand)).node_id
 
 
 def pick_node_affinity(

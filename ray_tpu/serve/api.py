@@ -195,21 +195,45 @@ class ServeController:
             cfg = dict(DEFAULT_AUTOSCALING)
             cfg.update(autoscaling_config or {})
             num_replicas = cfg["min_replicas"]
-        prev = self.deployments.get(name) or {}
-        self.deployments[name] = {
-            "cls": cls_or_fn, "args": init_args, "kwargs": init_kwargs,
-            "num_replicas": num_replicas, "is_function": is_function,
-            "max_concurrency": max_concurrency, "autoscaling": cfg,
-            # Deployment scheduler (reference: deployment_scheduler.py
-            # compact placement): COMPACT gangs replicas onto as few
-            # nodes as possible via a PACK placement group; SPREAD
-            # spreads them with the min-utilization policy.
-            "placement": placement_strategy,
-            "actor_options": dict(ray_actor_options or {}),
-            # A redeploy must inherit the existing group or its
-            # reservation would leak unreachable.
-            "_pg": prev.get("_pg"),
-        }
+        with self._reconcile_lock:
+            # The swap must not race a reconcile in flight (the loop
+            # thread would write its group into an orphaned spec dict).
+            prev = self.deployments.get(name) or {}
+            keep_group = prev.get("placement") == placement_strategy == \
+                "COMPACT" and \
+                prev.get("actor_options") == dict(ray_actor_options or {})
+            self.deployments[name] = {
+                "cls": cls_or_fn, "args": init_args, "kwargs": init_kwargs,
+                "num_replicas": num_replicas, "is_function": is_function,
+                "max_concurrency": max_concurrency, "autoscaling": cfg,
+                # Deployment scheduler (reference: deployment_scheduler.py
+                # compact placement): COMPACT gangs replicas onto as few
+                # nodes as possible via a PACK placement group; SPREAD
+                # spreads them with the min-utilization policy.
+                "placement": placement_strategy,
+                "actor_options": dict(ray_actor_options or {}),
+                # A same-shape COMPACT redeploy inherits the group (its
+                # reservation would otherwise leak unreachable); any
+                # placement/resource change starts clean.
+                "_pg": prev.get("_pg") if keep_group else None,
+            }
+            if prev.get("_pg") is not None and not keep_group:
+                old_pg = prev["_pg"]
+                # Old gang + group are torn down: replicas would otherwise
+                # keep double-charging the cluster alongside the new ones.
+                for r in self.replicas.get(name, []):
+                    self._replica_birth.pop(id(r), None)
+                    try:
+                        ray_tpu.kill(r)
+                    except Exception:  # noqa: BLE001
+                        pass
+                self.replicas[name] = []
+                try:
+                    from ray_tpu.util import remove_placement_group
+
+                    remove_placement_group(old_pg)
+                except Exception:  # noqa: BLE001
+                    pass
         self._reconcile_once(name)
         return True
 
@@ -248,7 +272,10 @@ class ServeController:
                  else cfg["downscale_delay_s"])
         if now - intent[1] < delay:
             return
-        spec["num_replicas"] = desired
+        with self._reconcile_lock:
+            live = self.deployments.get(name)
+            if live is not None:
+                live["num_replicas"] = desired
         self._scale_intent.pop(name, None)
         self._reconcile_once(name)
 
@@ -284,11 +311,73 @@ class ServeController:
                 for name, spec in self.deployments.items()}
 
     def _reconcile_once(self, name: str):
+        # Slow placement-group creation happens OUTSIDE the lock (a 30s
+        # wait under it would freeze every deployment's maintenance);
+        # the lock then only covers fast state transitions.
+        self._maybe_prepare_compact_group(name)
         # One reconcile at a time: the deploy RPC thread and the loop
         # thread would otherwise race group creation / replica lists
         # (last-write-wins leaks the loser's group and replicas).
         with self._reconcile_lock:
             self._reconcile_locked(name)
+
+    def _compact_needs_grow(self, spec) -> bool:
+        pg = spec.get("_pg")
+        if time.monotonic() < spec.get("_pg_backoff", 0.0):
+            return False
+        if pg is None:
+            return True
+        if len(pg.bundle_specs) < spec["num_replicas"]:
+            return True
+        # Bundle SHAPE changes (bigger replicas) need a regrow too — the
+        # old bundles could never admit the new demand.
+        want = self._replica_bundle(spec.get("actor_options"))
+        return spec.get("_pg_bundle") != want
+
+    def _maybe_prepare_compact_group(self, name: str) -> None:
+        from ray_tpu.util import placement_group, remove_placement_group
+
+        with self._reconcile_lock:
+            spec = self.deployments.get(name)
+            if spec is None or spec.get("placement") != "COMPACT" or \
+                    not self._compact_needs_grow(spec):
+                return
+            per_replica = self._replica_bundle(spec.get("actor_options"))
+            want_replicas = spec["num_replicas"]
+        new_pg = placement_group([dict(per_replica)] * want_replicas,
+                                 strategy="PACK")
+        placed = new_pg.wait(30)
+        with self._reconcile_lock:
+            spec = self.deployments.get(name)
+            still_needed = (
+                spec is not None and spec.get("placement") == "COMPACT"
+                and self._compact_needs_grow(spec)
+                and spec["num_replicas"] <= want_replicas
+                and self._replica_bundle(
+                    spec.get("actor_options")) == per_replica)
+            if not placed or not still_needed:
+                try:
+                    remove_placement_group(new_pg)
+                except Exception:  # noqa: BLE001
+                    pass
+                if spec is not None and not placed:
+                    # Infeasible now: keep serving on the old group (if
+                    # any) and retry later instead of thrashing.
+                    spec["_pg_backoff"] = time.monotonic() + 30.0
+                return
+            old = spec.get("_pg")
+            if old is not None:
+                spec["_migrate"] = True
+
+                def _cleanup(old=old):
+                    try:
+                        remove_placement_group(old)
+                    except Exception:  # noqa: BLE001
+                        pass
+
+                self._pg_cleanups.setdefault(name, []).append(_cleanup)
+            spec["_pg"] = new_pg
+            spec["_pg_bundle"] = per_replica
 
     def _reconcile_locked(self, name: str):
         spec = self.deployments.get(name)
@@ -326,8 +415,15 @@ class ServeController:
         if placement == "COMPACT":
             strategy, regrown = self._compact_group_strategy(name, spec)
             if strategy is None:
-                # No feasible group yet: keep whatever runs, retry later.
+                # No feasible group yet: keep whatever runs (but still
+                # push routing if the live set shrank), retry later.
+                changed = [id(r) for r in current] != \
+                    [id(r) for r in self.replicas.get(name, [])]
                 self.replicas[name] = current
+                if changed:
+                    self._route_version[name] = \
+                        self._route_version.get(name, 0) + 1
+                    _publish_route_event(name)
                 return
             opts["scheduling_strategy"] = strategy
             if regrown:
@@ -388,50 +484,17 @@ class ServeController:
         return bundle
 
     def _compact_group_strategy(self, name: str, spec):
-        """PACK placement group sized to the deployment; regrown (new
-        group, replicas recreated into it) when scale-up outgrows it —
-        scale-down keeps the group and simply leaves bundles idle. An
-        infeasible regrow keeps the OLD (working) group and backs off,
-        never trading a live gang for an unplaceable one."""
-        from ray_tpu.util import (PlacementGroupSchedulingStrategy,
-                                  placement_group, remove_placement_group)
+        """Hand back the deployment's group strategy (the group itself is
+        prepared outside the lock by _maybe_prepare_compact_group); the
+        regrown flag is a one-shot migration marker."""
+        from ray_tpu.util import PlacementGroupSchedulingStrategy
 
-        per_replica = self._replica_bundle(spec.get("actor_options"))
         pg = spec.get("_pg")
-        regrown = False
-        needs_grow = pg is None or \
-            len(pg.bundle_specs) < spec["num_replicas"]
-        if needs_grow and time.monotonic() < spec.get("_pg_backoff", 0.0):
-            needs_grow = False  # recent infeasible regrow: don't thrash
-        if needs_grow:
-            new_pg = placement_group(
-                [dict(per_replica)] * spec["num_replicas"],
-                strategy="PACK")
-            if not new_pg.wait(30):
-                # Couldn't place: discard the new group, keep serving on
-                # the old one (if any), and retry later.
-                try:
-                    remove_placement_group(new_pg)
-                except Exception:  # noqa: BLE001
-                    pass
-                spec["_pg_backoff"] = time.monotonic() + 30.0
-            else:
-                if pg is not None:
-                    regrown = True
-                    old = pg
-
-                    def _cleanup(old=old):
-                        try:
-                            remove_placement_group(old)
-                        except Exception:  # noqa: BLE001
-                            pass
-
-                    self._pg_cleanups.setdefault(name, []).append(_cleanup)
-                spec["_pg"] = pg = new_pg
         if pg is None:
             return None, False  # nowhere to place yet; retry next tick
         return PlacementGroupSchedulingStrategy(
-            placement_group=pg, placement_group_bundle_index=-1), regrown
+            placement_group=pg, placement_group_bundle_index=-1), \
+            spec.pop("_migrate", False)
 
     def _reconcile_loop(self):
         while not self._stop:

@@ -223,8 +223,24 @@ def test_node_affinity_dead_node_raises(pg_cluster):
 
 
 def test_spread_strategy_string(pg_cluster):
-    nodes = ray_tpu.get([my_node.options(
-        scheduling_strategy="SPREAD", num_cpus=1).remote()
+    # Settle: prior tests' leases/actors release asynchronously; SPREAD
+    # can only use nodes that actually have capacity at submit time.
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    gcs = rpc.get_stub("GcsService", pg_cluster.address)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        nodes_free = [n for n in gcs.GetNodes(pb.GetNodesRequest()).nodes
+                      if n.alive and n.available.get("CPU", 0) >= 1]
+        if len(nodes_free) >= 2:
+            break
+        time.sleep(0.2)
+    # Busy tasks: SPREAD distributes CONCURRENT load; instant tasks can
+    # legitimately run anywhere since each releases its CPU before the
+    # next lease looks.
+    nodes = ray_tpu.get([sleeper.options(
+        scheduling_strategy="SPREAD", num_cpus=1).remote(1.0)
         for _ in range(4)], timeout=60)
     assert len(set(nodes)) >= 2
 
