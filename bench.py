@@ -24,6 +24,8 @@ import json
 import sys
 import time
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -58,8 +60,10 @@ def main() -> None:
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_layers=16, num_heads=16, num_kv_heads=16, head_dim=128,
             max_seq_len=2048, remat=True,
+            remat_policy=os.environ.get("RAY_TPU_BENCH_REMAT", "full"),
         )
-        batch_size, seq_len = 4, 2048
+        batch_size = int(os.environ.get("RAY_TPU_BENCH_BATCH", 4))
+        seq_len = 2048
         rounds, steps_per_round = 3, 5
     else:  # CI fallback so the bench always emits a line
         config = llama.LlamaConfig.tiny()
