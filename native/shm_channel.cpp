@@ -25,14 +25,18 @@
 
 #include <atomic>
 #include <cerrno>
+#include <climits>
+#include <cstddef>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <ctime>
 
 #include <fcntl.h>
+#include <linux/futex.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <sys/syscall.h>
 #include <unistd.h>
 
 namespace {
@@ -47,8 +51,15 @@ struct alignas(64) Header {
   std::atomic<uint64_t> version;
   std::atomic<uint64_t> size;
   std::atomic<uint64_t> closed;  // set once; never clobbers a pending value
+  // Cross-process wake word: bumped + FUTEX_WAKEd after every state
+  // change. nanosleep-based backoff had a ~50us floor (default kernel
+  // timer slack), which put a 100-200us tax on every DAG hop; futex
+  // wakes land in single-digit microseconds.
+  std::atomic<uint32_t> futex_word;
   alignas(64) std::atomic<uint64_t> acks[kMaxReaders];
 };
+static_assert(offsetof(Header, acks) == 64, "python fallback expects acks@64");
+static_assert(sizeof(Header) == 192, "python fallback expects data@192");
 
 struct Handle {
   Header* hdr;
@@ -65,23 +76,37 @@ double now_s() {
   return ts.tv_sec + ts.tv_nsec * 1e-9;
 }
 
-// Spin ~4k iterations, then sleep in escalating steps capped at 20us —
-// the cap bounds wake latency (a hop's critical path is one wake) while
-// still yielding the core to the peer process on small machines. Returns
-// false on timeout (timeout_s < 0 means wait forever).
+void futex_bump_wake(Header* hdr) {
+  hdr->futex_word.fetch_add(1, std::memory_order_release);
+  syscall(SYS_futex, reinterpret_cast<uint32_t*>(&hdr->futex_word),
+          FUTEX_WAKE, INT_MAX, nullptr, nullptr, 0);
+}
+
+// Spin briefly (the ping-pong fast path), then futex-wait on the shared
+// wake word. The wait is bounded (50ms) as defense in depth against a
+// peer that mutates state without waking (e.g. a crashed process's
+// partially-applied write). Returns false on timeout (timeout_s < 0 means
+// wait forever).
 template <typename Pred>
-bool wait_until(Pred pred, double timeout_s) {
-  for (int i = 0; i < 4000; ++i) {
+bool wait_until(Header* hdr, Pred pred, double timeout_s) {
+  for (int i = 0; i < 2000; ++i) {
     if (pred()) return true;
   }
   double deadline = timeout_s < 0 ? -1.0 : now_s() + timeout_s;
-  long ns = 1000;
   while (true) {
+    uint32_t seq = hdr->futex_word.load(std::memory_order_acquire);
     if (pred()) return true;
-    if (deadline > 0 && now_s() > deadline) return pred();
-    struct timespec ts{0, ns};
-    nanosleep(&ts, nullptr);
-    if (ns < 20000) ns *= 2;
+    double remain = 0.05;
+    if (deadline > 0) {
+      remain = deadline - now_s();
+      if (remain <= 0) return pred();
+      if (remain > 0.05) remain = 0.05;
+    }
+    struct timespec ts;
+    ts.tv_sec = (time_t)remain;
+    ts.tv_nsec = (long)((remain - (double)ts.tv_sec) * 1e9);
+    syscall(SYS_futex, reinterpret_cast<uint32_t*>(&hdr->futex_word),
+            FUTEX_WAIT, seq, &ts, nullptr, 0);
   }
 }
 
@@ -163,12 +188,13 @@ int chan_write(void* handle, const char* buf, uint64_t len, double timeout_s) {
     }
     return true;
   };
-  if (!wait_until(all_acked, timeout_s)) return -1;
+  if (!wait_until(hdr, all_acked, timeout_s)) return -1;
   if (hdr->closed.load(std::memory_order_acquire)) return -3;
   hdr->version.store(v + 1, std::memory_order_release);  // odd: mutating
   std::memcpy(h->data, buf, len);
   hdr->size.store(len, std::memory_order_release);
   hdr->version.store(v + 2, std::memory_order_release);  // even: stable
+  futex_bump_wake(hdr);
   return 0;
 }
 
@@ -182,7 +208,7 @@ int64_t chan_read(void* handle, char* out, uint64_t out_cap, double timeout_s) {
     return (v % 2 == 0 && v != h->last_seen) ||
            hdr->closed.load(std::memory_order_acquire);
   };
-  if (!wait_until(fresh, timeout_s)) return -1;
+  if (!wait_until(hdr, fresh, timeout_s)) return -1;
   while (true) {
     uint64_t v = hdr->version.load(std::memory_order_acquire);
     if (v % 2 != 0) continue;  // writer mid-mutation; stable soon
@@ -199,6 +225,7 @@ int64_t chan_read(void* handle, char* out, uint64_t out_cap, double timeout_s) {
       h->last_seen = v;
       if (h->reader_idx >= 0) {
         hdr->acks[h->reader_idx].store(v, std::memory_order_release);
+        futex_bump_wake(hdr);  // unblock a writer waiting on acks
       }
       return (int64_t)len;
     }
@@ -208,8 +235,9 @@ int64_t chan_read(void* handle, char* out, uint64_t out_cap, double timeout_s) {
 // Publish the closed flag. A value written before close is still readable;
 // reads past it return -3.
 void chan_close(void* handle) {
-  static_cast<Handle*>(handle)->hdr->closed.store(
-      1, std::memory_order_release);
+  Header* hdr = static_cast<Handle*>(handle)->hdr;
+  hdr->closed.store(1, std::memory_order_release);
+  futex_bump_wake(hdr);
 }
 
 void chan_detach(void* handle) {

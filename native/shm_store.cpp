@@ -253,6 +253,10 @@ void shm_client_unmap(void* ptr, uint64_t size) {
 // Worker-side create+write+seal in one call (the client writes the data
 // plane itself; only metadata goes to the store — reference: plasma clients
 // Create/Seal over shared memory, store.h:55).
+// Drop a client-created segment that was never registered with a store
+// (e.g. the object was freed before its put flush landed).
+int shm_client_unlink(const char* name) { return shm_unlink(name); }
+
 int shm_client_create(const char* name, const void* data, uint64_t size) {
   int fd = shm_open(name, O_CREAT | O_RDWR | O_EXCL, 0600);
   if (fd < 0 && errno == EEXIST) {

@@ -1,0 +1,284 @@
+"""Binary task plane: length-framed protobuf over raw TCP.
+
+Reference rationale: the reference's hot path is a C++ gRPC stack whose
+per-call overhead is tens of microseconds (``core_worker.cc:2485`` task
+submission, ``direct_task_transport``); Python gRPC's per-unary-call cost
+(channel dispatch, completion queue hops, HTTP/2 framing) is 300-500 us —
+an order of magnitude of pure overhead on a no-op task. This module is the
+redesign: one persistent TCP connection per (caller, worker) pair carrying
+length-framed protobuf messages with request-id multiplexing, so many
+in-flight tasks pipeline on one socket. The protobuf *messages* stay
+identical to the gRPC ones (``PushTaskRequest``/``PushTaskResult``); only
+the transport changes. gRPC remains for everything that is not
+latency-critical (control plane, streaming pulls) and as the fallback when
+the fastpath listener is unreachable.
+
+Frame layout (little-endian):
+    [u32 req_id][u8 kind][u32 len][len bytes payload]
+Replies echo ``req_id``; ``kind`` distinguishes request types so one
+connection can carry several RPCs (task push, object put).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_HDR = struct.Struct("<IBI")
+
+# Frame kinds. A reply's kind is the request's kind | 0x80; KIND_ERR
+# replies carry a utf-8 error message (handler raised server-side).
+KIND_PUSH_TASK = 1
+KIND_ERR = 0x7F
+KIND_REPLY_BIT = 0x80
+
+_MAX_FRAME = 1 << 31
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly n bytes or return None on EOF."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            return None
+        got += r
+    return bytes(buf)
+
+
+class FastClient:
+    """One multiplexed connection to a FastServer.
+
+    ``call()`` is thread-safe: concurrent callers pipeline frames on the
+    single socket; a dedicated reader thread resolves replies to futures
+    by request id. A broken connection fails every pending call with
+    ``ConnectionError`` and marks the client dead (callers fall back to
+    gRPC and drop the client from their cache).
+    """
+
+    CONNECT_TIMEOUT_S = 5.0
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=self.CONNECT_TIMEOUT_S)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self._pending: Dict[int, Future] = {}
+        self._pending_lock = threading.Lock()
+        self._next_id = 0
+        self._dead = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"fastpath-read-{address}")
+        self._reader.start()
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    def call(self, kind: int, payload: bytes,
+             timeout: Optional[float] = None) -> bytes:
+        if self._dead:
+            raise ConnectionError("fastpath connection is closed")
+        fut: Future = Future()
+        with self._pending_lock:
+            self._next_id = (self._next_id + 1) & 0xFFFFFFFF
+            req_id = self._next_id
+            self._pending[req_id] = fut
+        frame = _HDR.pack(req_id, kind, len(payload))
+        try:
+            with self._send_lock:
+                self._sock.sendall(frame)
+                self._sock.sendall(payload)
+        except OSError as e:
+            self._fail(e)
+            raise ConnectionError(f"fastpath send failed: {e}") from None
+        try:
+            return fut.result(timeout=timeout)
+        finally:
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+
+    def _read_loop(self):
+        try:
+            while True:
+                hdr = _recv_exact(self._sock, _HDR.size)
+                if hdr is None:
+                    raise ConnectionError("fastpath peer closed")
+                req_id, kind, length = _HDR.unpack(hdr)
+                if length > _MAX_FRAME:
+                    raise ConnectionError(f"oversized frame ({length})")
+                payload = _recv_exact(self._sock, length)
+                if payload is None:
+                    raise ConnectionError("fastpath peer closed mid-frame")
+                with self._pending_lock:
+                    fut = self._pending.pop(req_id, None)
+                if fut is not None and not fut.done():
+                    if kind == (KIND_ERR | KIND_REPLY_BIT):
+                        fut.set_exception(RuntimeError(
+                            f"fastpath handler error: "
+                            f"{payload.decode('utf-8', 'replace')}"))
+                    else:
+                        fut.set_result(payload)
+        except Exception as e:  # noqa: BLE001 — any break kills the client
+            self._fail(e)
+
+    def _fail(self, exc: BaseException):
+        self._dead = True
+        with self._pending_lock:
+            pending, self._pending = dict(self._pending), {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    ConnectionError(f"fastpath connection lost: {exc}"))
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def close(self):
+        self._fail(ConnectionError("closed"))
+
+
+class FastServer:
+    """Accepts fastpath connections and dispatches frames to a handler.
+
+    ``handler(kind, payload) -> reply_bytes`` runs on a shared thread pool
+    — a slow request must not block other pipelined requests on the same
+    connection (ordered actor pushes park until their sequence turn, the
+    same reason the gRPC server ran a wide pool).
+    """
+
+    def __init__(self, handler: Callable[[int, bytes], bytes],
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 128):
+        self._handler = handler
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.port = self._listener.getsockname()[1]
+        self.address = f"{host}:{self.port}"
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="fastpath-srv")
+        self._conns: list = []
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name=f"fastpath-accept-{self.port}").start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            threading.Thread(target=self._conn_loop, args=(conn,),
+                             daemon=True, name="fastpath-conn").start()
+
+    def _conn_loop(self, conn: socket.socket):
+        send_lock = threading.Lock()
+        try:
+            while True:
+                hdr = _recv_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                req_id, kind, length = _HDR.unpack(hdr)
+                if length > _MAX_FRAME:
+                    return
+                payload = _recv_exact(conn, length)
+                if payload is None:
+                    return
+                self._pool.submit(self._dispatch, conn, send_lock, req_id,
+                                  kind, payload)
+        except OSError:
+            return
+        finally:
+            try:
+                self._conns.remove(conn)
+            except ValueError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, conn, send_lock, req_id: int, kind: int,
+                  payload: bytes):
+        try:
+            reply = self._handler(kind, payload)
+            reply_kind = kind | KIND_REPLY_BIT
+        except Exception as e:  # noqa: BLE001 — handler bug: the caller
+            # must fail fast, not wait out its (potentially huge) push
+            # timeout on a frame that will never be answered.
+            logger.exception("fastpath handler failed (kind=%d)", kind)
+            reply = f"{type(e).__name__}: {e}".encode()
+            reply_kind = KIND_ERR | KIND_REPLY_BIT
+        frame = _HDR.pack(req_id, reply_kind, len(reply))
+        try:
+            with send_lock:
+                conn.sendall(frame)
+                conn.sendall(reply)
+        except OSError:
+            pass
+
+    def close(self):
+        self._closed = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._pool.shutdown(wait=False)
+
+
+_clients: Dict[str, FastClient] = {}
+_clients_lock = threading.Lock()
+
+
+def get_client(address: str) -> Optional[FastClient]:
+    """Cached client for a fastpath address, or None when unreachable.
+
+    Dead clients are dropped and re-dialed once; a connect failure returns
+    None so callers fall back to gRPC (and retry the fastpath on the next
+    call — the worker may still be starting its listener).
+    """
+    if not address:
+        return None
+    with _clients_lock:
+        client = _clients.get(address)
+        if client is not None and not client.dead:
+            return client
+        _clients.pop(address, None)
+    try:
+        client = FastClient(address)
+    except OSError:
+        return None
+    with _clients_lock:
+        existing = _clients.get(address)
+        if existing is not None and not existing.dead:
+            client.close()
+            return existing
+        _clients[address] = client
+    return client
+
+
+def drop_client(address: str) -> None:
+    with _clients_lock:
+        client = _clients.pop(address, None)
+    if client is not None:
+        client.close()

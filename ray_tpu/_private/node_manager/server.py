@@ -41,6 +41,7 @@ class _Worker:
         self.worker_id = worker_id
         self.proc = proc
         self.address: Optional[str] = None
+        self.fast_address: str = ""  # framed-TCP task plane (fastpath.py)
         self.ready = threading.Event()
         self.leased_for: Optional[bytes] = None  # lease id
         self.is_actor_worker = False
@@ -677,6 +678,7 @@ class NodeManager:
                                request.worker_id[:8])
                 return pb.Empty()
             w.address = request.address
+            w.fast_address = request.fast_address
             w.ready.set()
         return pb.Empty()
 
@@ -710,6 +712,7 @@ class NodeManager:
             self._leases[lease_id] = (worker.worker_id, demand)
             return pb.LeaseReply(granted=True,
                                  worker_address=worker.address,
+                                 worker_fast_address=worker.fast_address,
                                  worker_id=worker.worker_id,
                                  tpu_chips=self._chips_for(lease_id))
         selector = policies.parse_label_selector(spec.label_selector)
@@ -825,6 +828,7 @@ class NodeManager:
         self._leases[lease_id] = (worker.worker_id, demand)
         return pb.LeaseReply(granted=True,
                              worker_address=worker.address,
+                             worker_fast_address=worker.fast_address,
                              worker_id=worker.worker_id,
                              tpu_chips=self._chips_for(lease_id))
 
@@ -912,6 +916,7 @@ class NodeManager:
         stub = rpc.get_stub("WorkerService", worker.address)
         info.node_id = self.node_id
         info.address = worker.address
+        info.fast_address = worker.fast_address
         env = {}
         chips = self._chips_for(bytes(info.actor_id))
         if chips:
@@ -933,7 +938,8 @@ class NodeManager:
                 self._release(demand, holder=bytes(info.actor_id))
             return pb.CreateActorOnNodeReply(ok=False, error=reply.error)
         return pb.CreateActorOnNodeReply(ok=True,
-                                         worker_address=worker.address)
+                                         worker_address=worker.address,
+                                         fast_address=worker.fast_address)
 
     # ------------------------------------------------------------ bundles
     def PrepareBundle(self, request, context):
@@ -1121,7 +1127,8 @@ class NodeManager:
         return True
 
     # ------------------------------------------------------------ objects
-    def PutObject(self, request, context):
+    def _store_object(self, request) -> int:
+        """Seat one object in the local store; returns its size."""
         size = request.size or len(request.data)
         if request.shm_name and self._shm is not None:
             # Zero-copy put: the client already created+sealed the segment;
@@ -1133,10 +1140,31 @@ class NodeManager:
         else:
             with self._obj_lock:
                 self._objects[request.object_id] = request.data
+        return size
+
+    def PutObject(self, request, context):
+        size = self._store_object(request)
         try:
             self.gcs.UpdateObjectLocation(pb.ObjectLocationUpdate(
                 object_id=request.object_id, node_id=self.node_id,
                 added=True, size=size))
+        except Exception:  # noqa: BLE001
+            pass
+        self._maybe_spill()
+        return pb.Empty()
+
+    def PutObjectBatch(self, request, context):
+        """Amortized small-object puts (the driver's put flusher batches
+        inline payloads into one RPC instead of an RPC per object; the
+        directory registration rides one batched GCS RPC too)."""
+        batch = pb.ObjectLocationBatch()
+        for item in request.items:
+            size = self._store_object(item)
+            batch.updates.append(pb.ObjectLocationUpdate(
+                object_id=item.object_id, node_id=self.node_id,
+                added=True, size=size))
+        try:
+            self.gcs.UpdateObjectLocationsBatch(batch)
         except Exception:  # noqa: BLE001
             pass
         self._maybe_spill()
@@ -1234,6 +1262,7 @@ class NodeManager:
         with self._obj_lock:
             for oid in request.object_ids:
                 self._objects.pop(oid, None)
+        batch = pb.ObjectLocationBatch()
         for oid in request.object_ids:
             if self._shm is not None:
                 self._shm.delete(oid.hex())
@@ -1244,11 +1273,12 @@ class NodeManager:
                     os.unlink(meta[0])
                 except OSError:
                     pass
-            try:
-                self.gcs.UpdateObjectLocation(pb.ObjectLocationUpdate(
-                    object_id=oid, node_id=self.node_id, added=False))
-            except Exception:  # noqa: BLE001
-                pass
+            batch.updates.append(pb.ObjectLocationUpdate(
+                object_id=oid, node_id=self.node_id, added=False))
+        try:
+            self.gcs.UpdateObjectLocationsBatch(batch)
+        except Exception:  # noqa: BLE001
+            pass
         return pb.Empty()
 
     # ------------------------------------------------------------ lifecycle

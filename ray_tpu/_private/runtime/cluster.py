@@ -52,6 +52,22 @@ def loads(data) -> Any:
     return cloudpickle.loads(data)
 
 
+# Store-object wire formats: plain cloudpickle (legacy writers) or the
+# magic-prefixed Serializer format whose out-of-band buffers let large
+# values be written into shm with a single copy (put hot path).
+STORE_MAGIC = b"RTS1"
+
+
+def loads_store(data) -> Any:
+    mv = memoryview(data)
+    if mv.nbytes >= 4 and bytes(mv[:4]) == STORE_MAGIC:
+        from ray_tpu._private.serialization import (SerializedObject,
+                                                    Serializer)
+
+        return Serializer().deserialize(SerializedObject.parse(mv[4:]))
+    return cloudpickle.loads(data)
+
+
 def dumps_payload(value: Any) -> Tuple[bytes, List[bytes]]:
     """Serialize a task payload, returning (wire bytes, contained ref ids).
 
@@ -109,8 +125,8 @@ def read_object_reply(reply) -> Any:
         data = ShmClient.read_segment(reply.shm_name, reply.size)
         if data is None:
             return None
-        return loads(data)
-    return loads(reply.data)
+        return loads_store(data)
+    return loads_store(reply.data)
 
 
 class _PullManager:
@@ -198,6 +214,22 @@ class ClusterRuntime(CoreRuntime):
         # tasks/s). Slots are NOT held during task execution.
         self._pool = ThreadPoolExecutor(max_workers=64,
                                         thread_name_prefix="submit")
+        # Results of locally-submitted in-flight tasks arrive via the push
+        # reply — getters wait on these events instead of probing the
+        # store/directory (3 RPCs per spin, the r3 roundtrip bottleneck).
+        self._pending_results: Dict[bytes, threading.Event] = {}
+        self._pending_res_lock = threading.Lock()
+        # Small-put flusher: puts enqueue here; one thread batches them
+        # into PutObjectBatch RPCs (an RPC per 1KB put made put() RPC-bound).
+        from collections import deque
+
+        self._put_q = deque()
+        self._put_cv = threading.Condition()
+        self._put_flusher_started = False
+        # Per-lease-signature task queues drained by lease-holding runner
+        # threads (see _dispatch_task).
+        self._sig_queues: Dict[Any, dict] = {}
+        self._sig_lock = threading.Lock()
         self._submit_slots = threading.BoundedSemaphore(
             int(os.environ.get("RAY_TPU_SUBMIT_RPC_SLOTS", 8)))
         # Completion processing uses its OWN slots: if tails shared the
@@ -410,43 +442,116 @@ class ClusterRuntime(CoreRuntime):
         if not hasattr(self, "_put_task_id"):
             self._put_task_id = TaskID.for_normal_task(self.job_id)
         oid = ObjectID.from_task(self._put_task_id, self._next_put_index())
-        data = dumps(value)
+        from ray_tpu._private.serialization import Serializer
+
+        s = Serializer().serialize(value)
         # Owner semantics (reference: small objects live in the owner's
         # in-process store): the value is immediately visible to this
         # process; the node-store copy + directory registration that remote
-        # readers need flush asynchronously. Remote fetches racing the
-        # flush retry through the directory until it lands. Flushes get
-        # their own small pool — the shared submit pool blocks for whole
-        # task lifetimes, which could starve the flush behind the very
-        # tasks consuming the object.
+        # readers need flush asynchronously (batched — see _put_flush_loop).
+        # Remote fetches racing the flush retry through the directory.
         self.memory.put(oid, value)
-        if not hasattr(self, "_put_pool"):
-            self._put_pool = ThreadPoolExecutor(max_workers=4,
-                                                thread_name_prefix="put-flush")
-        self._put_pool.submit(self._flush_put, oid, data)
+        if s.total_bytes() > INLINE_RESULT_MAX:
+            # Large value: serialize straight into a client-created shm
+            # segment on the caller thread (single copy; deferring would
+            # let the caller mutate buffers before a snapshot). Only the
+            # metadata registration rides the async batch.
+            self._put_large(oid, s)
+        else:
+            self._enqueue_put(("data", oid, STORE_MAGIC + s.to_bytes()))
         return ObjectRef(oid, owner_address=self.node_address)
 
-    def _flush_put(self, oid: ObjectID, data: bytes) -> None:
-        deadline = time.monotonic() + 60.0
+    def _put_large(self, oid: ObjectID, s) -> None:
+        from ray_tpu._private.shm import ShmClient
+
+        wire = s.wire_size()
+        seg = f"/rtpu.{oid.binary().hex()}"
+        if ShmClient.available() and ShmClient.create_segment_vectored(
+                seg, s.to_parts(STORE_MAGIC)):
+            self._enqueue_put(("shm", oid, seg, 4 + wire))
+            return
+        # No shm: legacy inline/bytes path.
+        self._enqueue_put(("data", oid, STORE_MAGIC + s.to_bytes()))
+
+    def _enqueue_put(self, item: tuple) -> None:
+        with self._put_cv:
+            self._put_q.append(item + (time.monotonic() + 60.0,))
+            if not self._put_flusher_started:
+                self._put_flusher_started = True
+                threading.Thread(target=self._put_flush_loop, daemon=True,
+                                 name="put-flush").start()
+            # Notify only on the empty->nonempty edge: a notify per put
+            # woke the flusher thousands of times per second, and that GIL
+            # churn was visible in the put() caller's own latency.
+            if len(self._put_q) == 1:
+                self._put_cv.notify()
+
+    def _put_flush_loop(self) -> None:
         while not self._shutdown:
-            # Freed before the flush landed (local zero deletes the memory
-            # copy): registering a location now would resurrect a freed
-            # object and leak its store copy.
-            if not self.memory.contains(oid):
-                return
+            with self._put_cv:
+                while not self._put_q and not self._shutdown:
+                    self._put_cv.wait(0.5)
+            # Brief coalesce window: puts arrive in bursts; one batched
+            # RPC for hundreds beats many for a few.
+            time.sleep(0.001)
+            with self._put_cv:
+                # Cap by count AND bytes: the no-shm fallback carries full
+                # payloads inline, and an unbounded batch could exceed the
+                # gRPC message limit and fail deterministically forever.
+                items, n, nbytes = [], 0, 0
+                while self._put_q and n < 1024 and nbytes < (64 << 20):
+                    it = self._put_q.popleft()
+                    items.append(it)
+                    n += 1
+                    if it[0] == "data":
+                        nbytes += len(it[2])
+            if not items:
+                continue
+            batch = pb.PutObjectBatchRequest()
+            now = time.monotonic()
+            retry = []
+            for it in items:
+                oid = it[1]
+                # Freed before the flush landed (local zero deletes the
+                # memory copy): registering a location now would resurrect
+                # a freed object and leak its store copy.
+                if not self.memory.contains(oid):
+                    if it[0] == "shm":
+                        from ray_tpu._private.shm import ShmClient
+
+                        ShmClient.unlink_segment(it[2])
+                    continue
+                if it[0] == "shm":
+                    batch.items.append(pb.PutObjectRequest(
+                        object_id=oid.binary(), shm_name=it[2], size=it[3],
+                        owner=self.worker_id))
+                else:
+                    batch.items.append(pb.PutObjectRequest(
+                        object_id=oid.binary(), data=it[2],
+                        owner=self.worker_id))
+                retry.append(it)
+            if not batch.items:
+                continue
             try:
-                put_bytes_to_node(self.node, oid.binary(), data,
-                                  self.worker_id)
-                return
+                self.node.PutObjectBatch(batch)
             except Exception:  # noqa: BLE001
                 self._refresh_local_node()
-            if time.monotonic() > deadline:
-                logger.error(
-                    "put flush for %s failed for 60s; the object exists "
-                    "only in this process and remote readers cannot fetch "
-                    "it", oid.hex()[:12])
-                return
-            time.sleep(0.2)
+                kept = [it for it in retry if it[-1] > now]
+                if len(kept) < len(retry):
+                    logger.error(
+                        "put flush failed for 60s for %d objects; they "
+                        "exist only in this process and remote readers "
+                        "cannot fetch them", len(retry) - len(kept))
+                    for it in retry:
+                        # Expired shm segments were never registered with
+                        # any store: unlink or they leak in /dev/shm.
+                        if it[-1] <= now and it[0] == "shm":
+                            from ray_tpu._private.shm import ShmClient
+
+                            ShmClient.unlink_segment(it[2])
+                with self._put_cv:
+                    self._put_q.extendleft(reversed(kept))
+                time.sleep(0.2)
 
     def _next_put_index(self) -> int:
         with self._put_lock:
@@ -528,7 +633,7 @@ class ClusterRuntime(CoreRuntime):
                         if chunk.eof:
                             break
                     if found:
-                        value = loads(bytes(buf))
+                        value = loads_store(bytes(buf))
                         self.memory.put(oid, value)
                         try:  # cache on this node for future consumers
                             put_bytes_to_node(self.node, oid.binary(),
@@ -563,6 +668,21 @@ class ClusterRuntime(CoreRuntime):
                 return self.memory.get_if_ready(oid)
             except KeyError:
                 pass
+            # In-flight local task: its result lands via the push reply —
+            # wait on the completion event instead of probing the store
+            # and directory (3 RPCs per spin; the r3 roundtrip bottleneck).
+            with self._pending_res_lock:
+                ev = self._pending_results.get(oid.binary())
+            if ev is not None:
+                if deadline is None:
+                    ev.wait(5.0)
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise exceptions.GetTimeoutError(
+                            f"Timed out getting object {oid.hex()}")
+                    ev.wait(min(remaining, 5.0))
+                continue
             found, value, freed, pending = self._fetch_object(ref, deadline)
             if found:
                 return value
@@ -728,7 +848,19 @@ class ClusterRuntime(CoreRuntime):
         fetching = set()
         while True:
             pending = [r for r in refs if r.id() not in ready_ids]
-            for ref in self._batch_ready(pending):
+            # Locally in-flight tasks complete via push replies into the
+            # memory store: checking them there (no RPC) keeps a 1k-task
+            # fan-in wait from probing the node/GCS for every ref per tick.
+            local_ready = []
+            probe = []
+            with self._pending_res_lock:
+                for r in pending:
+                    if r.id().binary() in self._pending_results:
+                        if self.memory.contains(r.id()):
+                            local_ready.append(r)
+                    else:
+                        probe.append(r)
+            for ref in local_ready + self._batch_ready(probe):
                 if len(ready_ids) >= num_returns:
                     break  # caller asked for N: don't fetch the surplus
                 ready_ids.add(ref.id())
@@ -810,10 +942,28 @@ class ClusterRuntime(CoreRuntime):
                 self._task_lineage_count.get(task_id.binary(), 0) + nreturns
             if payload_oid is not None:
                 self._lineage_payload_bytes[task_id.binary()] = payload
-        self._pool.submit(self._lease_and_push, spec, return_ids,
-                          options.max_retries or 0, pinned)
+        self._register_pending(return_ids)
+        self._dispatch_task(spec, return_ids, options.max_retries or 0,
+                            pinned)
         return [ObjectRef(oid, owner_address=self.node_address)
                 for oid in return_ids]
+
+    def _register_pending(self, return_ids: List[ObjectID]) -> None:
+        """Mark a local task's returns as in-flight: getters/waiters block
+        on the completion event instead of probing the store/directory."""
+        ev = threading.Event()
+        with self._pending_res_lock:
+            for oid in return_ids:
+                self._pending_results[oid.binary()] = ev
+
+    def _complete_pending(self, return_ids) -> None:
+        with self._pending_res_lock:
+            evs = {self._pending_results.pop(
+                oid.binary() if hasattr(oid, "binary") else oid, None)
+                for oid in return_ids}
+        for ev in evs:
+            if ev is not None:
+                ev.set()
 
     PAYLOAD_PROMOTE_BYTES = 100 * 1024  # reference: >100KB args to plasma
     PAYLOAD_INDEX = (1 << 30) - 1       # object index reserved for payloads
@@ -1042,6 +1192,93 @@ class ClusterRuntime(CoreRuntime):
         for lease in leases:
             self._return_lease(lease)
 
+    # ------------------------------------------------- lease-runner queues
+    # Reference: the NormalTaskSubmitter pipelines same-shaped tasks onto
+    # held worker leases (``normal_task_submitter.cc:88-145``). One queue
+    # per lease signature; a bounded set of runner threads each hold one
+    # lease and drain the queue — a 1,000-task fan-out pays a handful of
+    # lease negotiations, not 1,000 (the r3 tasks/s bottleneck: every task
+    # paid lease RPCs because independent submitter threads camped at the
+    # node manager and starved the lease cache).
+    MAX_SIG_RUNNERS = int(os.environ.get("RAY_TPU_SIG_RUNNERS", 16))
+
+    def _dispatch_task(self, spec: pb.TaskSpec, return_ids: List[ObjectID],
+                       retries: int, pinned: Optional[List[bytes]] = None):
+        sig = self._lease_signature(spec)
+        if sig is None:
+            # Placement-specific lease (PG/affinity/SPREAD): dedicated
+            # negotiation per task, off the shared queue.
+            self._pool.submit(self._lease_and_push, spec, return_ids,
+                              retries, pinned)
+            return
+        item = [spec, return_ids, retries, pinned, 0]
+        with self._sig_lock:
+            st = self._sig_queues.get(sig)
+            if st is None:
+                st = self._sig_queues[sig] = {"items": [], "runners": 0}
+            st["items"].append(item)
+            spawn = st["runners"] < self.MAX_SIG_RUNNERS
+            if spawn:
+                st["runners"] += 1
+        if spawn:
+            self._pool.submit(self._sig_runner_loop, sig, st)
+
+    def _sig_runner_loop(self, sig, st: dict) -> None:
+        lease = None
+        lease_cached = False  # a stale cached lease must not burn attempts
+        while True:
+            with self._sig_lock:
+                if self._shutdown or not st["items"]:
+                    # Exit check and runner decrement are atomic with the
+                    # enqueue path: an item appended before this point is
+                    # visible; one appended after sees runners already
+                    # decremented and spawns a fresh runner.
+                    st["runners"] -= 1
+                    break
+                item = st["items"].pop(0)
+            spec, return_ids, retries, pinned, _ = item
+            try:
+                if lease is None:
+                    lease = self._take_cached_lease(sig)
+                    lease_cached = lease is not None
+                if lease is None:
+                    lease = self._negotiate_lease(spec, sig)
+                    lease_cached = False
+                    if lease is None:  # aborted: a cached lease appeared
+                        with self._sig_lock:
+                            st["items"].insert(0, item)
+                        continue
+                if self._push_on_lease(spec, return_ids, lease):
+                    self._finish_item(item)
+                    continue
+                # Worker died mid-push (or stale cached lease).
+                self._return_lease(lease)
+                lease = None
+                if not lease_cached:
+                    item[4] += 1
+                if item[4] <= max(retries, 3):
+                    with self._sig_lock:
+                        st["items"].insert(0, item)
+                    continue
+                self._store_error(
+                    exceptions.RayTaskError(
+                        spec.name, f"Worker executing {spec.name} died"),
+                    return_ids)
+                self._finish_item(item)
+            except BaseException as e:  # noqa: BLE001
+                self._store_error(
+                    exceptions.RayTaskError.from_exception(e, spec.name),
+                    return_ids)
+                self._finish_item(item)
+        if lease is not None and not self._cache_lease(sig, lease):
+            self._return_lease(lease)
+
+    def _finish_item(self, item) -> None:
+        """Release an item's flight-time pins exactly once."""
+        pinned, item[3] = item[3], None
+        for oid in pinned or ():
+            self.refs.decr(oid)
+
     def _lease_and_push(self, spec: pb.TaskSpec, return_ids: List[ObjectID],
                         retries: int, pinned: Optional[List[bytes]] = None):
         try:
@@ -1167,48 +1404,89 @@ class ClusterRuntime(CoreRuntime):
             self._push_with_lease(spec, return_ids, sig, lease, fresh=True)
             return
 
+    def _push_on_lease(self, spec: pb.TaskSpec, return_ids: List[ObjectID],
+                       lease: dict) -> bool:
+        """Push one task to a leased worker and apply the result. Returns
+        False when the worker died (the lease is unusable; the task may or
+        may not have run — callers apply the system-failure retry policy).
+        The lease itself is NOT disposed here: runners keep it for the
+        next queued task."""
+        del spec.tpu_chips[:]
+        spec.tpu_chips.extend(lease["tpu_chips"])
+        result = self._push_fast(lease.get("fast_address", ""), spec)
+        if result is False:
+            return False
+        if result is None:
+            stub = rpc.get_stub("WorkerService", lease["worker_address"])
+            attempts = 0
+            while True:
+                try:
+                    fut = stub.PushTask(pb.PushTaskRequest(spec=spec),
+                                        timeout=PUSH_TIMEOUT_S, wait=False)
+                    result = fut.result(timeout=PUSH_TIMEOUT_S + 5)
+                    break
+                except Exception as e:  # noqa: BLE001
+                    # wait=False bypasses the stub's retry wrapper;
+                    # re-dispatch UNAVAILABLE blips here (the call never
+                    # reached the worker, so the retry is safe even for
+                    # non-idempotent pushes) instead of burning a
+                    # task-level attempt.
+                    import grpc as _grpc
+
+                    code = e.code() if hasattr(e, "code") else None
+                    if code == _grpc.StatusCode.UNAVAILABLE and attempts < 2:
+                        attempts += 1
+                        time.sleep(0.05 * attempts)
+                        continue
+                    return False
+        with self._completion_slots:
+            self._apply_push_result(result, return_ids, spec.name)
+        return True
+
     def _push_with_lease(self, spec: pb.TaskSpec,
                          return_ids: List[ObjectID], sig, lease: dict,
                          fresh: bool) -> bool:
-        """Dispatch the push (cheap, unslotted), wait for the result
-        (GIL-free), then process it under a completion slot. Returns False
-        for a stale cached lease so the caller falls back to a fresh one;
-        a fresh lease's worker dying mid-task raises WorkerCrashedError
-        (the retry machinery above decides whether to re-run)."""
-        del spec.tpu_chips[:]
-        spec.tpu_chips.extend(lease["tpu_chips"])
-        stub = rpc.get_stub("WorkerService", lease["worker_address"])
-        attempts = 0
-        while True:
-            try:
-                fut = stub.PushTask(pb.PushTaskRequest(spec=spec),
-                                    timeout=PUSH_TIMEOUT_S, wait=False)
-                result = fut.result(timeout=PUSH_TIMEOUT_S + 5)
-                break
-            except Exception as e:  # noqa: BLE001
-                # wait=False bypasses the stub's retry wrapper; re-dispatch
-                # UNAVAILABLE blips here (the call never reached the
-                # worker, so the retry is safe even for non-idempotent
-                # pushes) instead of burning a task-level attempt.
-                import grpc as _grpc
-
-                code = e.code() if hasattr(e, "code") else None
-                if code == _grpc.StatusCode.UNAVAILABLE and attempts < 2:
-                    attempts += 1
-                    time.sleep(0.05 * attempts)
-                    continue
-                self._return_lease(lease)
-                if fresh:
-                    raise exceptions.WorkerCrashedError(
-                        f"Worker executing {spec.name} died: {e}")                         from None
-                return False
-        with self._completion_slots:
+        """One-shot push for the non-queued path: disposes the lease
+        (cache or return). Returns False for a stale cached lease so the
+        caller falls back to a fresh one; a fresh lease's worker dying
+        raises WorkerCrashedError (the retry machinery decides)."""
+        if self._push_on_lease(spec, return_ids, lease):
             # Keep the lease for the reuse window instead of returning it
             # (the reaper returns it after LEASE_CACHE_TTL_S idle).
             if sig is None or not self._cache_lease(sig, lease):
                 self._return_lease(lease)
-            self._apply_push_result(result, return_ids, spec.name)
-        return True
+            return True
+        self._return_lease(lease)
+        if fresh:
+            raise exceptions.WorkerCrashedError(
+                f"Worker executing {spec.name} died")
+        return False
+
+    def _push_fast(self, fast_address: str, spec: pb.TaskSpec):
+        """Push over the fastpath task plane (framed TCP, fastpath.py).
+
+        Returns a PushTaskResult, None when no fastpath is available
+        (caller uses gRPC), or False when the connection died mid-call
+        (worker gone: the task may or may not have run — same ambiguity
+        as a failed gRPC push, handled by the same retry policy)."""
+        if not fast_address:
+            return None
+        from ray_tpu._private import fastpath
+
+        fc = fastpath.get_client(fast_address)
+        if fc is None:
+            return None
+        try:
+            data = fc.call(fastpath.KIND_PUSH_TASK,
+                           pb.PushTaskRequest(spec=spec).SerializeToString(),
+                           timeout=PUSH_TIMEOUT_S + 5)
+        except (ConnectionError, TimeoutError):
+            return False
+        except Exception:  # noqa: BLE001 — Future timeout et al.
+            return False
+        result = pb.PushTaskResult()
+        result.ParseFromString(data)
+        return result
 
     def _next_spread_target(self):
         try:
@@ -1328,6 +1606,7 @@ class ClusterRuntime(CoreRuntime):
                 spec.tpu_chips.extend(reply.tpu_chips)
             return {"node": target, "worker_id": reply.worker_id,
                     "worker_address": reply.worker_address,
+                    "fast_address": reply.worker_fast_address,
                     "tpu_chips": list(reply.tpu_chips)}
         finally:
             self._submit_slots.release()
@@ -1347,15 +1626,17 @@ class ClusterRuntime(CoreRuntime):
         for i, oid in enumerate(return_ids):
             if i < len(result.in_store) and result.in_store[i]:
                 continue  # large result: fetched on demand via the directory
-            self.memory.put(oid, loads(result.inline_results[i]))
+            self.memory.put(oid, loads_store(result.inline_results[i]))
         if return_ids:
             self._task_done.add(return_ids[0].task_id().binary())
+        self._complete_pending(return_ids)
         with self._ready_cond:
             self._ready_cond.notify_all()
 
     def _store_error(self, err, return_ids):
         for oid in return_ids:
             self.memory.put(oid, err)
+        self._complete_pending(return_ids)
         with self._ready_cond:
             self._ready_cond.notify_all()
 
@@ -1492,6 +1773,7 @@ class ClusterRuntime(CoreRuntime):
             pinned.append(payload_oid)
         for oid in pinned:
             self.refs.incr(oid)
+        self._register_pending(return_ids)
         self._pool.submit(self._push_actor_task, actor_id, spec, return_ids,
                           options.max_task_retries, pinned)
         return [ObjectRef(oid, owner_address=self.node_address)
@@ -1547,9 +1829,21 @@ class ClusterRuntime(CoreRuntime):
             while True:
                 try:
                     info = self._resolve_actor(actor_id)
-                    stub = rpc.get_stub("WorkerService", info.address)
-                    result = stub.PushTask(pb.PushTaskRequest(spec=spec),
-                                           timeout=PUSH_TIMEOUT_S)
+                    result = self._push_fast(info.fast_address, spec)
+                    if result is False:
+                        # Connection died mid-call: the task MAY have
+                        # executed (the frame could have been delivered).
+                        # Re-pushing over gRPC here would double-execute
+                        # on a still-alive worker; route through the
+                        # normal retry gate instead (actor tasks default
+                        # to 0 retries for exactly this ambiguity).
+                        raise ConnectionError(
+                            f"fastpath connection to actor "
+                            f"{actor_id.hex()[:12]} lost mid-push")
+                    if result is None:
+                        stub = rpc.get_stub("WorkerService", info.address)
+                        result = stub.PushTask(pb.PushTaskRequest(spec=spec),
+                                               timeout=PUSH_TIMEOUT_S)
                     self._apply_push_result(result, return_ids, spec.name)
                     return
                 except exceptions.ActorDiedError as e:

@@ -561,6 +561,9 @@ class LocalRuntime(CoreRuntime):
 
     # ---------------------------------------------------------------- tasks
     def submit_task(self, function, function_name, args, kwargs, options):
+        from ray_tpu._private import fn_ref as fn_ref_mod
+
+        function = fn_ref_mod.resolve(function)
         task_id = TaskID.for_normal_task(self.job_id)
         nreturns = options.num_returns
         if opt_mod.is_streaming(nreturns):

@@ -216,6 +216,13 @@ class WorkerServer:
         self._server, self.port = rpc.serve("WorkerService", self,
                                             max_workers=128)
         self.address = f"127.0.0.1:{self.port}"
+        # Fastpath task plane: the latency-critical PushTask traffic rides
+        # framed TCP (fastpath.py) instead of per-call gRPC; gRPC stays as
+        # the fallback and for the rare control RPCs.
+        from ray_tpu._private import fastpath
+
+        self._fast = fastpath.FastServer(self._fast_handler)
+        self.fast_address = self._fast.address
         self.node = rpc.get_stub("NodeService", node_address)
         if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
             import sys
@@ -229,7 +236,17 @@ class WorkerServer:
             self.task_events = _TaskEventReporter(self.runtime.gcs,
                                                   worker_id, node_id)
         self.node.AnnounceWorker(pb.AnnounceWorkerRequest(
-            worker_id=worker_id, address=self.address, pid=os.getpid()))
+            worker_id=worker_id, address=self.address, pid=os.getpid(),
+            fast_address=self.fast_address))
+
+    def _fast_handler(self, kind: int, payload: bytes) -> bytes:
+        from ray_tpu._private import fastpath
+
+        if kind == fastpath.KIND_PUSH_TASK:
+            req = pb.PushTaskRequest()
+            req.ParseFromString(payload)
+            return self.PushTask(req, None).SerializeToString()
+        raise ValueError(f"unknown fastpath frame kind {kind}")
 
     # ------------------------------------------------------------- helpers
     def _payload_bytes(self, spec) -> bytes:
@@ -340,6 +357,9 @@ class WorkerServer:
                         pickle.loads(spec.runtime_env), self.runtime.gcs)
                 (fn, args, kwargs), n_borrows = \
                     loads_payload(self._payload_bytes(spec))
+                from ray_tpu._private import fn_ref as fn_ref_mod
+
+                fn = fn_ref_mod.resolve(fn)
                 if n_borrows:
                     # Flush the borrow (+1) registrations synchronously so
                     # the GCS observes them before the submitter's pin

@@ -32,8 +32,39 @@ _MAGIC = 0x52544348
 _VER_OFF = 16
 _SIZE_OFF = 24
 _CLOSED_OFF = 32
+_FUTEX_OFF = 40  # u32 wake word (native peers FUTEX_WAIT on it)
 _ACKS_OFF = 64
 _DATA_OFF = 192
+
+
+def _futex_setup():
+    try:
+        import platform
+
+        nr = {"x86_64": 202, "aarch64": 98}.get(platform.machine())
+        if nr is None:
+            return None, None
+        return ctypes.CDLL(None, use_errno=True), nr
+    except Exception:  # noqa: BLE001
+        return None, None
+
+
+_LIBC, _SYS_FUTEX = _futex_setup()
+
+
+def _futex_wake(mm: "mmap.mmap") -> None:
+    """Bump the shared wake word and FUTEX_WAKE native waiters. The
+    fallback itself polls, but a native peer blocked in futex_wait would
+    otherwise only notice fallback writes at its 50ms safety timeout."""
+    if _LIBC is None:
+        return
+    try:
+        word = ctypes.c_uint32.from_buffer(mm, _FUTEX_OFF)
+        word.value = (word.value + 1) & 0xFFFFFFFF
+        _LIBC.syscall(_SYS_FUTEX, ctypes.addressof(word), 1, 0x7FFFFFFF,
+                      None, None, 0)
+    except Exception:  # noqa: BLE001 — wake is best-effort
+        pass
 
 
 class ChannelClosed(Exception):
@@ -203,6 +234,7 @@ class Channel:
         self._mm[_DATA_OFF:_DATA_OFF + len(data)] = data
         struct.pack_into("<Q", self._mm, _SIZE_OFF, len(data))
         struct.pack_into("<Q", self._mm, _VER_OFF, v + 2)
+        _futex_wake(self._mm)
 
     def read(self, timeout: Optional[float] = None) -> Any:
         if self._closed_seen:
@@ -211,7 +243,9 @@ class Channel:
         if self._h is not None:
             n = _native().chan_read(self._h, self._buf, self.capacity, t)
             if n >= 0:
-                return pickle.loads(self._buf.raw[:n])
+                # string_at copies exactly n bytes; ``.raw[:n]`` would
+                # materialize the full capacity (1 MiB) per read.
+                return pickle.loads(ctypes.string_at(self._buf, n))
             if n == -1:
                 raise ChannelTimeout(f"read timed out on {self.name}")
             if n == -3:
@@ -232,6 +266,7 @@ class Channel:
                     if self.reader_idx >= 0:
                         struct.pack_into("<Q", self._mm,
                                          _ACKS_OFF + 8 * self.reader_idx, v)
+                        _futex_wake(self._mm)
                     return pickle.loads(data)
                 continue
             if self._fb_closed():
@@ -249,6 +284,7 @@ class Channel:
             return
         if self._mm is not None:
             struct.pack_into("<Q", self._mm, _CLOSED_OFF, 1)
+            _futex_wake(self._mm)
 
     def destroy(self) -> None:
         """Detach and unlink the backing segment (creator-side teardown)."""

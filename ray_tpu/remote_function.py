@@ -24,6 +24,7 @@ class RemoteFunction:
         self._options = options
         self._function_name = getattr(function, "__qualname__",
                                       getattr(function, "__name__", "anonymous"))
+        self._fn_ref = None  # lazily pickled-once form (hot-path cache)
         functools.update_wrapper(self, function)
 
     def __call__(self, *args, **kwargs):
@@ -39,12 +40,22 @@ class RemoteFunction:
         new._function = self._function
         new._function_name = self._function_name
         new._options = self._options.merged_with(option_overrides)
+        new._fn_ref = self._fn_ref  # same function: share the pickled form
         functools.update_wrapper(new, self._function)
         return new
 
     def _remote(self, args, kwargs, options: RemoteOptions):
+        # Pickle the function once per process, not once per task; workers
+        # unpickle once per digest (fn_ref.py — the function-table analog).
+        if self._fn_ref is None:
+            from ray_tpu._private.fn_ref import FnRef
+
+            try:
+                self._fn_ref = FnRef.of(self._function)
+            except Exception:  # noqa: BLE001 — unpicklable via FnRef path
+                self._fn_ref = self._function
         refs = _worker.global_worker().core.submit_task(
-            self._function, self._function_name, args, kwargs, options)
+            self._fn_ref, self._function_name, args, kwargs, options)
         if is_streaming(options.num_returns):
             # Generator task: refs[0] carries the final item count; items
             # stream out at deterministic ids (reference: ObjectRefStream).
