@@ -141,6 +141,13 @@ class FastClient:
                 fut.set_exception(
                     ConnectionError(f"fastpath connection lost: {exc}"))
         try:
+            # shutdown() before close(): the reader thread's in-flight
+            # recv holds the open file description, so a bare close()
+            # never sends FIN and the peer's connection lingers forever.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
@@ -238,7 +245,11 @@ class FastServer:
             self._listener.close()
         except OSError:
             pass
-        for conn in self._conns:
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)  # wake the reader thread
+            except OSError:
+                pass
             try:
                 conn.close()
             except OSError:

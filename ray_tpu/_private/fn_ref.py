@@ -17,8 +17,6 @@ import hashlib
 import threading
 from typing import Any, Callable, Dict
 
-import cloudpickle
-
 
 class FnRef:
     """A pre-pickled callable. The blob is embedded in the task payload;
@@ -34,8 +32,17 @@ class FnRef:
         return (FnRef, (self.blob, self.digest))
 
     @staticmethod
-    def of(fn: Callable) -> "FnRef":
-        blob = cloudpickle.dumps(fn)
+    def of(fn: Callable):
+        """Pickle ``fn`` once, or return None when its closure captures
+        ObjectRefs — those need the per-submit Serializer pass so each
+        task pins the contained refs for its flight time (a pre-pickled
+        blob would skip pinning and let the refs be freed mid-flight)."""
+        from ray_tpu._private.serialization import Serializer
+
+        s = Serializer().serialize(fn)
+        if s.contained_refs:
+            return None
+        blob = s.to_bytes()
         return FnRef(blob, hashlib.sha1(blob).digest())
 
 
@@ -52,7 +59,9 @@ def resolve(fn: Any) -> Any:
         cached = _cache.get(fn.digest)
     if cached is not None:
         return cached
-    loaded = cloudpickle.loads(fn.blob)
+    from ray_tpu._private.serialization import SerializedObject, Serializer
+
+    loaded = Serializer().deserialize(SerializedObject.parse(fn.blob))
     with _cache_lock:
         while len(_cache) >= _CACHE_CAP:
             _cache.pop(next(iter(_cache)))

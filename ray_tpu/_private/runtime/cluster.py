@@ -943,10 +943,63 @@ class ClusterRuntime(CoreRuntime):
             if payload_oid is not None:
                 self._lineage_payload_bytes[task_id.binary()] = payload
         self._register_pending(return_ids)
-        self._dispatch_task(spec, return_ids, options.max_retries or 0,
-                            pinned)
+        # Submitter-side dependency resolution (reference:
+        # ``dependency_resolver.h`` — a task is not dispatched until its
+        # direct ObjectRef args exist). Without this, dependent tasks
+        # occupy leased workers blocking on get(): a two-stage shuffle
+        # whose reduce tasks grab every worker before any map task runs
+        # deadlocks the pool.
+        direct_deps = [a for a in args if isinstance(a, ObjectRef)]
+        direct_deps += [v for v in kwargs.values()
+                        if isinstance(v, ObjectRef)]
+        unready = [r for r in direct_deps if not self._dep_ready_fast(r)]
+        if unready:
+            self._pool.submit(self._wait_deps_then_dispatch, unready, spec,
+                              return_ids, options.max_retries or 0, pinned)
+        else:
+            self._dispatch_task(spec, return_ids, options.max_retries or 0,
+                                pinned)
         return [ObjectRef(oid, owner_address=self.node_address)
                 for oid in return_ids]
+
+    def _dep_ready_fast(self, ref: ObjectRef) -> bool:
+        """RPC-free readiness check for the submit hot path: only an
+        in-process value is known-ready without an RPC; everything else
+        routes through the async dependency waiter (which batch-probes
+        the directory for refs owned elsewhere)."""
+        return self.memory.contains(ref.id())
+
+    def _wait_deps_then_dispatch(self, deps: List[ObjectRef],
+                                 spec: pb.TaskSpec,
+                                 return_ids: List[ObjectID], retries: int,
+                                 pinned: Optional[List[bytes]]) -> None:
+        """Block (off the lease path — no worker is held) until every
+        direct dependency exists somewhere, then dispatch. The deadline
+        matches the executor-side arg-fetch timeout: on expiry the task
+        dispatches anyway and surfaces the fetch error through the normal
+        path."""
+        deadline = time.monotonic() + 300.0
+        while not self._shutdown and time.monotonic() < deadline:
+            unready: List[ObjectRef] = []
+            probe: List[ObjectRef] = []
+            for ref in deps:
+                oid = ref.id()
+                if self.memory.contains(oid):
+                    continue
+                with self._pending_res_lock:
+                    if oid.binary() in self._pending_results:
+                        unready.append(ref)
+                        continue
+                probe.append(ref)
+            if probe:
+                ready = {r.id() for r in self._batch_ready(probe)}
+                unready.extend(r for r in probe if r.id() not in ready)
+            if not unready:
+                break
+            deps = unready
+            with self._ready_cond:
+                self._ready_cond.wait(0.05)
+        self._dispatch_task(spec, return_ids, retries, pinned)
 
     def _register_pending(self, return_ids: List[ObjectID]) -> None:
         """Mark a local task's returns as in-flight: getters/waiters block
@@ -1626,7 +1679,15 @@ class ClusterRuntime(CoreRuntime):
         for i, oid in enumerate(return_ids):
             if i < len(result.in_store) and result.in_store[i]:
                 continue  # large result: fetched on demand via the directory
-            self.memory.put(oid, loads_store(result.inline_results[i]))
+            data = result.inline_results[i]
+            self.memory.put(oid, loads_store(data))
+            # Inline results also flush (batched, async) to the node store
+            # + directory: a DIFFERENT worker consuming this return as a
+            # task arg fetches through the directory, and an object living
+            # only in this process's memory store would never resolve
+            # (reference: the owner serves its in-process objects;
+            # this runtime's data plane is the node store).
+            self._enqueue_put(("data", oid, data))
         if return_ids:
             self._task_done.add(return_ids[0].task_id().binary())
         self._complete_pending(return_ids)
@@ -1634,8 +1695,14 @@ class ClusterRuntime(CoreRuntime):
             self._ready_cond.notify_all()
 
     def _store_error(self, err, return_ids):
+        try:
+            blob = dumps(err)
+        except Exception:  # noqa: BLE001 — unpicklable error chain
+            blob = None
         for oid in return_ids:
             self.memory.put(oid, err)
+            if blob is not None:
+                self._enqueue_put(("data", oid, blob))
         self._complete_pending(return_ids)
         with self._ready_cond:
             self._ready_cond.notify_all()

@@ -441,37 +441,98 @@ class Dataset:
         return Dataset(self._execute())
 
     # ------------------------------------------------------------ all-to-all
+    # Two-stage task shuffle (reference: push-based shuffle —
+    # ``data/_internal/planner/exchange/shuffle_task_spec.py`` map tasks +
+    # reduce tasks streamed through the object store): each input block is
+    # split into per-partition parts by a map task; each output block is
+    # assembled by a reduce task. The driver holds only ObjectRefs and
+    # (for sort) a small boundary sample — a dataset larger than driver
+    # RAM shuffles fine.
+    def _two_stage_shuffle(self, refs: List[Any], num_parts: int,
+                           map_mode: str, map_arg, reduce_mode: str,
+                           reduce_arg) -> "Dataset":
+        parts = []
+        for i, r in enumerate(refs):
+            out = _shuffle_map.options(num_returns=num_parts).remote(
+                r, num_parts, map_mode,
+                map_arg(i) if callable(map_arg) else map_arg)
+            parts.append([out] if num_parts == 1 else out)
+        out_refs = [
+            _shuffle_reduce.remote(
+                reduce_mode,
+                reduce_arg(j) if callable(reduce_arg) else reduce_arg,
+                *[p[j] for p in parts])
+            for j in builtins.range(num_parts)]
+        ds = Dataset(out_refs)
+        ds._last_shuffle = {"mode": "distributed", "map_tasks": len(refs),
+                            "reduce_tasks": num_parts}
+        return ds
+
     def repartition(self, num_blocks: int) -> "Dataset":
-        tables = ray_tpu.get(self._execute())
-        combined = pa.concat_tables([t for t in tables if len(t)]) \
-            if any(len(t) for t in tables) else pa.table({})
-        n = len(combined)
-        sizes = [n // num_blocks + (1 if i < n % num_blocks else 0)
-                 for i in builtins.range(num_blocks)]
-        refs, off = [], 0
-        for s in sizes:
-            refs.append(ray_tpu.put(combined.slice(off, s)))
-            off += s
-        return Dataset(refs)
+        refs = self._execute()
+        if not refs:
+            return Dataset([ray_tpu.put(pa.table({}))
+                            for _ in builtins.range(num_blocks)])
+        # Order-preserving: fetch per-block row counts (scalars — the only
+        # driver-side data), cut the global row range into num_blocks
+        # contiguous spans, and have each map task zero-copy-slice its
+        # block by global offset. Reduce tasks concat parts in input
+        # order, so take_all() returns rows in the original order (the
+        # previous concat-then-slice implementation preserved it too).
+        sizes = ray_tpu.get([_block_len.remote(r) for r in refs],
+                            timeout=600)
+        total = sum(sizes)
+        cuts = [total * (j + 1) // num_blocks
+                for j in builtins.range(num_blocks - 1)]
+        starts = list(itertools.accumulate([0] + sizes[:-1]))
+        return self._two_stage_shuffle(
+            refs, num_blocks, "slice", lambda i: (starts[i], cuts),
+            "concat", None)
 
     def random_shuffle(self, seed: Optional[int] = None) -> "Dataset":
-        tables = ray_tpu.get(self._execute())
-        combined = pa.concat_tables([t for t in tables if len(t)]) \
-            if tables else pa.table({})
-        rng = np.random.default_rng(seed)
-        idx = rng.permutation(len(combined))
-        shuffled = combined.take(pa.array(idx))
-        k = max(len(tables), 1)
-        return Dataset([ray_tpu.put(b) for b in _split_table(shuffled, k)])
+        refs = self._execute()
+        if not refs:
+            return Dataset([])
+        k = len(refs)
+        map_arg = (lambda i: (seed, i)) if seed is not None else \
+            (lambda i: None)
+        reduce_arg = (lambda j: (seed, 1 << 20, j)) if seed is not None \
+            else (lambda j: None)
+        return self._two_stage_shuffle(refs, k, "random", map_arg,
+                                       "random", reduce_arg)
+
+    SORT_SAMPLES_PER_BLOCK = 64
 
     def sort(self, key: str, descending: bool = False) -> "Dataset":
-        tables = ray_tpu.get(self._execute())
-        combined = pa.concat_tables([t for t in tables if len(t)]) \
-            if tables else pa.table({})
+        refs = self._execute()
+        if not refs:
+            return Dataset([])
+        k = len(refs)
+        if k == 1:
+            order = "descending" if descending else "ascending"
+            return Dataset([_shuffle_reduce.remote(
+                "sort", (key, order), refs[0])])
+        # Range partitioning (TeraSort shape): sample keys per block (the
+        # ONLY driver-side materialization — dozens of scalars per block),
+        # cut boundaries at sample quantiles, then map-split by range and
+        # reduce-sort each range locally.
+        samples = ray_tpu.get(
+            [_sample_keys.remote(r, key, self.SORT_SAMPLES_PER_BLOCK)
+             for r in refs], timeout=600)
+        live = [s for s in samples if len(s)]
+        if not live:  # every block is empty: nothing to sort
+            return self.repartition(k)
+        allv = np.sort(np.concatenate(live))
+        bounds = [allv[min(int(j * len(allv) / k), len(allv) - 1)]
+                  for j in builtins.range(1, k)]
         order = "descending" if descending else "ascending"
-        out = combined.sort_by([(key, order)])
-        k = max(len(tables), 1)
-        return Dataset([ray_tpu.put(b) for b in _split_table(out, k)])
+        ds = self._two_stage_shuffle(
+            refs, k, "range", (key, bounds), "sort", (key, order))
+        if descending:
+            # Range partitions are ascending; a descending sort reads
+            # the partitions in reverse.
+            ds._block_refs = list(reversed(ds._block_refs))
+        return ds
 
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
@@ -585,17 +646,129 @@ def _split_table(t: pa.Table, k: int) -> List[pa.Table]:
     return out
 
 
+# ------------------------------------------------------- shuffle task bodies
+@ray_tpu.remote
+def _shuffle_map(table: pa.Table, num_parts: int, mode: str, arg):
+    """Map stage: split one block into ``num_parts`` partition tables.
+
+    Runs on workers; the driver only routes the returned refs to reduce
+    tasks (reference: shuffle map tasks,
+    ``data/_internal/planner/exchange/shuffle_task_spec.py``).
+    """
+    n = len(table)
+    if mode == "slice":
+        # Contiguous split by global row offset (order-preserving
+        # repartition): partition j covers global rows [cuts[j-1], cuts[j]).
+        start, cuts = arg
+        edges = [0] + [min(max(c - start, 0), n) for c in cuts] + [n]
+        parts = tuple(table.slice(edges[j], edges[j + 1] - edges[j])
+                      for j in builtins.range(num_parts))
+        return parts if num_parts > 1 else parts[0]
+    if mode == "roundrobin":
+        groups = [np.arange(j, n, num_parts)
+                  for j in builtins.range(num_parts)]
+    elif mode == "random":
+        assign = np.random.default_rng(arg).integers(0, num_parts, size=n)
+        groups = [np.nonzero(assign == j)[0]
+                  for j in builtins.range(num_parts)]
+    elif mode == "range":
+        key, bounds = arg
+        values = table.column(key).to_numpy(zero_copy_only=False) if n \
+            else np.array([])
+        part_ids = np.searchsorted(np.asarray(bounds), values,
+                                   side="right") if n else values
+        groups = [np.nonzero(part_ids == j)[0]
+                  for j in builtins.range(num_parts)]
+    else:
+        raise ValueError(f"unknown shuffle map mode {mode!r}")
+    parts = tuple(
+        table.take(pa.array(g)) if len(g) else table.slice(0, 0)
+        for g in groups)
+    return parts if num_parts > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _shuffle_reduce(mode: str, arg, *parts: pa.Table) -> pa.Table:
+    """Reduce stage: assemble one output block from its per-map parts."""
+    live = [t for t in parts if len(t)]
+    combined = pa.concat_tables(live) if live else \
+        (parts[0].slice(0, 0) if parts else pa.table({}))
+    if mode == "random" and len(combined):
+        idx = np.random.default_rng(arg).permutation(len(combined))
+        combined = combined.take(pa.array(idx))
+    elif mode == "sort" and len(combined):
+        key, order = arg
+        combined = combined.sort_by([(key, order)])
+    return combined
+
+
+@ray_tpu.remote
+def _block_len(table: pa.Table) -> int:
+    return len(table)
+
+
+@ray_tpu.remote
+def _agg_map(table: pa.Table, key: str, col: str, how: str) -> pa.Table:
+    """Per-block partial aggregate. ``mean`` ships (sum, count) partials
+    so the reduce can re-combine exactly."""
+    if not len(table):
+        return table.slice(0, 0)
+    if how == "mean":
+        return table.group_by(key).aggregate([(col, "sum"), (col, "count")])
+    return table.group_by(key).aggregate([(col, how)])
+
+
+@ray_tpu.remote
+def _agg_reduce(key: str, col: str, how: str, *parts: pa.Table) -> pa.Table:
+    """Re-aggregate partials into the final grouped table (column naming
+    matches a single-pass ``group_by(key).aggregate([(col, how)])``)."""
+    import pyarrow.compute as pc
+
+    live = [t for t in parts if len(t)]
+    if not live:
+        return pa.table({})
+    combined = pa.concat_tables(live)
+    if how == "mean":
+        g = combined.group_by(key).aggregate(
+            [(f"{col}_sum", "sum"), (f"{col}_count", "sum")])
+        mean = pc.divide(
+            pc.cast(g[f"{col}_sum_sum"], pa.float64()),
+            pc.cast(g[f"{col}_count_sum"], pa.float64()))
+        return pa.table({key: g[key], f"{col}_mean": mean})
+    recombine = "sum" if how in ("sum", "count") else how
+    g = combined.group_by(key).aggregate([(f"{col}_{how}", recombine)])
+    out = {key: g[key], f"{col}_{how}": g[f"{col}_{how}_{recombine}"]}
+    return pa.table(out)
+
+
+@ray_tpu.remote
+def _sample_keys(table: pa.Table, key: str, k: int):
+    """Sort-boundary sampling: at most ``k`` key values from one block."""
+    if key not in table.column_names:  # schema-less empty block
+        return np.array([])
+    values = table.column(key).to_numpy(zero_copy_only=False)
+    if len(values) <= k:
+        return values
+    idx = np.random.default_rng(len(values)).choice(len(values), size=k,
+                                                    replace=False)
+    return values[idx]
+
+
 class GroupedData:
     def __init__(self, ds: Dataset, key: str):
         self._ds = ds
         self._key = key
 
     def _agg(self, col: str, how: str) -> Dataset:
-        tables = ray_tpu.get(self._ds._execute())
-        live = [t for t in tables if len(t)]
-        combined = pa.concat_tables(live) if live else pa.table({})
-        agg = combined.group_by(self._key).aggregate([(col, how)])
-        return Dataset([ray_tpu.put(agg)])
+        # Distributed combine: per-block partial aggregates on workers,
+        # one reduce task re-aggregates the partials (reference:
+        # ``data/_internal/planner/exchange/aggregate_task_spec.py``).
+        # The driver never holds the dataset.
+        refs = self._ds._execute()
+        if not refs:
+            return Dataset([ray_tpu.put(pa.table({}))])
+        partials = [_agg_map.remote(r, self._key, col, how) for r in refs]
+        return Dataset([_agg_reduce.remote(self._key, col, how, *partials)])
 
     def sum(self, col: str) -> Dataset:
         return self._agg(col, "sum")

@@ -47,11 +47,14 @@ class RemoteFunction:
     def _remote(self, args, kwargs, options: RemoteOptions):
         # Pickle the function once per process, not once per task; workers
         # unpickle once per digest (fn_ref.py — the function-table analog).
+        # Functions whose closure captures ObjectRefs are NOT cached
+        # (FnRef.of returns None): each submit must re-serialize so the
+        # contained refs get their flight-time pins.
         if self._fn_ref is None:
             from ray_tpu._private.fn_ref import FnRef
 
             try:
-                self._fn_ref = FnRef.of(self._function)
+                self._fn_ref = FnRef.of(self._function) or self._function
             except Exception:  # noqa: BLE001 — unpicklable via FnRef path
                 self._fn_ref = self._function
         refs = _worker.global_worker().core.submit_task(

@@ -187,3 +187,51 @@ def test_memory_backpressure_env_drains_window(monkeypatch):
     ds = rdata.range(100, parallelism=8).map(lambda r: {"v": r["id"] * 2})
     got = sorted(r["v"] for r in ds.take_all())
     assert got == [i * 2 for i in __import__('builtins').range(100)]
+
+
+def test_shuffle_is_distributed_no_driver_concat(monkeypatch):
+    """The two-stage shuffle must never concatenate blocks on the driver
+    (r3 weak #4: repartition/random_shuffle/sort did get()+concat in the
+    driver process, capping datasets at driver RAM). Run against a real
+    cluster so a driver-side concat_tables poison can't leak into the
+    worker processes that legitimately concat their reduce parts."""
+    from ray_tpu.cluster_utils import Cluster
+
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 4})
+    try:
+        ray_tpu.init(address=c.address)
+
+        def poison(*a, **k):
+            raise AssertionError("driver-side concat_tables in shuffle")
+
+        monkeypatch.setattr(
+            "ray_tpu.data.dataset.pa.concat_tables", poison)
+        ds = rdata.range(300, parallelism=6)
+
+        out = ds.random_shuffle(seed=3)
+        assert out._last_shuffle == {"mode": "distributed", "map_tasks": 6,
+                                     "reduce_tasks": 6}
+        rows = sorted(r["id"] for r in out.take_all())
+        assert rows == list(range(300))
+
+        out = ds.sort("id", descending=True)
+        vals = [r["id"] for r in out.take_all()]
+        assert vals == list(range(299, -1, -1))
+
+        out = ds.repartition(10)
+        assert out.num_blocks() == 10
+        # Repartition preserves global row order (contiguous slicing).
+        assert [r["id"] for r in out.take_all()] == list(range(300))
+
+        agg = ds.groupby("id").count().take_all()
+        assert len(agg) == 300
+
+        # All-empty sort must not crash on boundary sampling.
+        empty = ds.filter(lambda r: False).sort("id")
+        assert empty.take_all() == []
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        ray_tpu.init(num_cpus=8)  # restore the module fixture's session
