@@ -161,6 +161,8 @@ class Autoscaler:
         self.idle_timeout_s = idle_timeout_s
         self.tick_interval_s = tick_interval_s
         self._idle_since: Dict[str, float] = {}
+        self._unregistered_since: Dict[str, float] = {}
+        self._warned_infeasible: set = set()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -171,65 +173,139 @@ class Autoscaler:
             return []
         return json.loads(reply.value)
 
+    # A provider node that never registers with the GCS within this window
+    # failed its bootstrap; reclaim it (reference: node launch failure
+    # handling in the v2 InstanceManager reconciler).
+    UNREGISTERED_GRACE_S = 300.0
+
+    def _provider_id_of(self, node) -> Optional[str]:
+        """GCS node -> provider inventory id. In-process providers register
+        under their own node_id; cloud nodes carry the provider-node-id
+        label their bootstrap was launched with. Several GCS nodes may map
+        to ONE provider id (a multi-host TPU slice is one provider node)."""
+        return dict(node.labels).get("provider-node-id") or node.node_id
+
+    @staticmethod
+    def _try_place(pools: List[Dict[str, float]],
+                   bundle: Dict[str, float]) -> bool:
+        """Place ``bundle`` onto the first pool that fits, mutating it."""
+        for a in pools:
+            if all(a.get(k, 0.0) >= v for k, v in bundle.items()):
+                for k, v in bundle.items():
+                    a[k] -= v
+                return True
+        return False
+
+    def _bundle_fits_shape(self, bundle: Dict[str, float]) -> bool:
+        shape = self.node_config.get("resources", {"CPU": 4.0})
+        return all(shape.get(k, 0.0) >= v for k, v in bundle.items())
+
+    def _pack_nodes_needed(self, bundles: List[Dict[str, float]]) -> int:
+        """FFD bin-packing: the FEWEST node_config-shaped nodes that cover
+        the unplaced demand (reference:
+        ``resource_demand_scheduler.get_nodes_for``). One-node-per-bundle
+        over-launched 8x for 8 single-chip asks on an 8-chip host."""
+        shape = dict(self.node_config.get("resources", {"CPU": 4.0}))
+        nodes: List[Dict[str, float]] = []
+        for bundle in sorted(bundles, key=lambda b: -sum(b.values())):
+            if not self._try_place(nodes, bundle):
+                fresh = dict(shape)
+                for k, v in bundle.items():
+                    fresh[k] -= v
+                nodes.append(fresh)
+        return len(nodes)
+
     def reconcile_once(self) -> Dict[str, int]:
         """One tick: returns {"launched": n, "terminated": m}."""
         nodes = [n for n in self.gcs.GetNodes(pb.GetNodesRequest()).nodes
                  if n.alive]
         managed = set(self.provider.non_terminated_nodes())
-        managed_nodes = [n for n in nodes if n.node_id in managed]
+        # pid -> every GCS node backing it (multi-host slices have many).
+        groups: Dict[str, List[Any]] = {}
+        for n in nodes:
+            pid = self._provider_id_of(n)
+            if pid in managed:
+                groups.setdefault(pid, []).append(n)
         launched = terminated = 0
 
-        # 1) explicit resource requests: bin-pack onto current capacity,
-        #    launch nodes for what does not fit.
-        unfit = 0
+        # 1) explicit resource requests: place onto current free capacity
+        #    first, then bin-pack the remainder onto the fewest new nodes.
+        #    Bundles no node shape can EVER satisfy are reported and
+        #    excluded — they must not wedge scale-down forever.
+        unfit: List[Dict[str, float]] = []
         avail = [dict(n.available) for n in nodes]
         for bundle in self._demand_bundles():
-            placed = False
-            for a in avail:
-                if all(a.get(k, 0.0) >= v for k, v in bundle.items()):
-                    for k, v in bundle.items():
-                        a[k] -= v
-                    placed = True
-                    break
-            if not placed:
-                unfit += 1
-        per_node = self.node_config.get("resources", {}).get("CPU", 4.0)
-        needed_for_demand = unfit  # conservatively one node per unfit bundle
+            if not self._try_place(avail, bundle):
+                if self._bundle_fits_shape(bundle):
+                    unfit.append(bundle)
+                else:
+                    key = frozenset(bundle.items())
+                    if key not in self._warned_infeasible:
+                        self._warned_infeasible.add(key)
+                        logger.warning(
+                            "demand bundle %s cannot fit the configured "
+                            "node shape %s; ignoring it", bundle,
+                            self.node_config.get("resources"))
+        # Nodes already launched but not yet registered count toward the
+        # demand (launch-in-flight; re-launching per tick would stampede).
+        in_flight = len(managed) - len(groups)
+        needed_for_demand = max(0, self._pack_nodes_needed(unfit) - in_flight)
 
-        # 2) utilization pressure.
+        # 2) utilization pressure. Suppressed while a launch is in flight:
+        #    a cloud node takes minutes to bootstrap and ticks are seconds —
+        #    without the gate, sustained pressure launches a node per tick.
         total = sum(n.resources.get("CPU", 0) for n in nodes)
         free = sum(n.available.get("CPU", 0) for n in nodes)
         util = 1.0 - (free / total) if total else 0.0
-        pressure = 1 if util > self.target_utilization else 0
+        pressure = 1 if util > self.target_utilization and in_flight == 0 \
+            else 0
 
         want = max(self.min_workers,
-                   len(managed_nodes) + needed_for_demand + pressure)
+                   len(managed) + needed_for_demand + pressure)
         want = min(want, self.max_workers)
 
         while len(self.provider.non_terminated_nodes()) < want:
             self.provider.create_node(self.node_config)
             launched += 1
 
-        # 3) scale down: managed nodes fully idle past the timeout.
         now = time.monotonic()
-        if needed_for_demand == 0 and pressure == 0:
+        # 3) reclaim provider nodes whose bootstrap never registered.
+        managed_now = set(self.provider.non_terminated_nodes())
+        for pid in list(self._unregistered_since):
+            if pid not in managed_now:  # vanished externally: don't leak
+                self._unregistered_since.pop(pid, None)
+        for pid in managed_now:
+            if pid in groups:
+                self._unregistered_since.pop(pid, None)
+                continue
+            first = self._unregistered_since.setdefault(pid, now)
+            if now - first > self.UNREGISTERED_GRACE_S:
+                logger.warning("provider node %s never registered; "
+                               "terminating", pid)
+                self.provider.terminate_node(pid)
+                self._unregistered_since.pop(pid, None)
+                terminated += 1
+
+        # 4) scale down: provider nodes whose EVERY host is fully idle
+        #    past the timeout (one busy host keeps the whole slice).
+        if not unfit and pressure == 0:
             over = len(self.provider.non_terminated_nodes()) - max(
                 self.min_workers, 0)
-            for n in managed_nodes:
+            for pid, hosts in groups.items():
                 if over <= 0:
                     break
                 fully_idle = all(
-                    abs(n.available.get(k, 0.0) - v) < 1e-6
-                    for k, v in n.resources.items())
+                    abs(h.available.get(k, 0.0) - v) < 1e-6
+                    for h in hosts for k, v in h.resources.items())
                 if fully_idle:
-                    first = self._idle_since.setdefault(n.node_id, now)
+                    first = self._idle_since.setdefault(pid, now)
                     if now - first > self.idle_timeout_s:
-                        self.provider.terminate_node(n.node_id)
-                        self._idle_since.pop(n.node_id, None)
+                        self.provider.terminate_node(pid)
+                        self._idle_since.pop(pid, None)
                         terminated += 1
                         over -= 1
                 else:
-                    self._idle_since.pop(n.node_id, None)
+                    self._idle_since.pop(pid, None)
         return {"launched": launched, "terminated": terminated}
 
     # ------------------------------------------------------------- lifecycle

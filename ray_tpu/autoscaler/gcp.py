@@ -1,0 +1,187 @@
+"""GCP TPU-VM node provider: provisions Cloud TPU VMs over the REST API.
+
+Reference: ``python/ray/autoscaler/_private/gcp/node_provider.py`` (+
+``gcp/node.py`` — the ``GCPTPUNode`` resource wrapper) redesigned
+TPU-first: the unit of provisioning is a *TPU pod slice* (one
+``nodes.create`` call may back several hosts), created nodes carry the
+cluster name as a label, and readiness is the TPU ``READY`` state plus the
+operation-done poll. The HTTP transport is a tiny injectable client so
+tests drive the provider against a recorded/mock endpoint
+(``tests/test_autoscaler.py``) with byte-identical request shapes.
+
+Bootstrap: each created TPU VM is expected to start a ray_tpu node that
+registers with the GCS carrying the label ``provider-node-id:<name>`` —
+the autoscaler joins provider inventory to GCS nodes through that label
+(the reference matches through instance metadata).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from ray_tpu.autoscaler import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+TPU_API = "https://tpu.googleapis.com/v2"
+CLUSTER_LABEL = "ray-tpu-cluster"
+
+
+class GceHttp:
+    """Minimal authenticated JSON-over-HTTP client for the TPU/GCE APIs.
+
+    ``token_provider`` returns a bearer token (the real path reads the GCE
+    metadata server; tests pass a constant). Injectable so unit tests run
+    against a local mock endpoint with zero cloud access.
+    """
+
+    def __init__(self, endpoint: str = TPU_API, token_provider=None,
+                 timeout_s: float = 30.0):
+        self.endpoint = endpoint.rstrip("/")
+        self._token_provider = token_provider or _metadata_token
+        self.timeout_s = timeout_s
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None) -> dict:
+        url = f"{self.endpoint}/{path.lstrip('/')}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Authorization", f"Bearer {self._token_provider()}")
+        req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                payload = r.read()
+        except urllib.error.HTTPError as e:
+            raise RuntimeError(
+                f"{method} {url} failed: {e.code} "
+                f"{e.read().decode(errors='replace')[:500]}") from None
+        return json.loads(payload) if payload else {}
+
+
+def _metadata_token() -> str:
+    """Bearer token from the GCE metadata server (only reachable on GCP)."""
+    req = urllib.request.Request(
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        "service-accounts/default/token",
+        headers={"Metadata-Flavor": "Google"})
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return json.loads(r.read())["access_token"]
+
+
+class TPUNodeProvider(NodeProvider):
+    """Provision/terminate TPU VM slices for one named cluster.
+
+    ``node_config`` keys (per create): ``accelerator_type`` (e.g.
+    "v5litepod-8"), ``runtime_version``, ``labels``, ``startup_script``.
+    Defaults come from the provider-level config.
+    """
+
+    OP_POLL_S = 2.0
+    OP_TIMEOUT_S = 600.0
+
+    def __init__(self, project: str, zone: str, cluster_name: str,
+                 config: Optional[Dict[str, Any]] = None,
+                 http: Optional[GceHttp] = None):
+        self.project = project
+        self.zone = zone
+        self.cluster_name = cluster_name
+        self.config = dict(config or {})
+        self.http = http or GceHttp()
+        self._counter = 0
+
+    # ------------------------------------------------------------- helpers
+    @property
+    def _parent(self) -> str:
+        return f"projects/{self.project}/locations/{self.zone}"
+
+    def _node_body(self, node_config: Dict[str, Any]) -> dict:
+        cfg = {**self.config, **(node_config or {})}
+        labels = {CLUSTER_LABEL: self.cluster_name,
+                  **cfg.get("labels", {})}
+        body = {
+            "acceleratorType": cfg.get("accelerator_type", "v5litepod-8"),
+            "runtimeVersion": cfg.get("runtime_version",
+                                      "tpu-ubuntu2204-base"),
+            "labels": labels,
+        }
+        if cfg.get("startup_script"):
+            body["metadata"] = {"startup-script": cfg["startup_script"]}
+        if cfg.get("network"):
+            body["networkConfig"] = {"network": cfg["network"]}
+        return body
+
+    def _wait_operation(self, op: dict) -> dict:
+        """Poll a long-running operation to completion (reference:
+        ``gcp/node.py`` wait_for_operation)."""
+        name = op.get("name")
+        if not name or op.get("done"):
+            return op
+        deadline = time.monotonic() + self.OP_TIMEOUT_S
+        while time.monotonic() < deadline:
+            op = self.http.request("GET", name)
+            if op.get("done"):
+                if op.get("error"):
+                    raise RuntimeError(
+                        f"TPU operation {name} failed: {op['error']}")
+                return op
+            time.sleep(self.OP_POLL_S)
+        raise TimeoutError(f"TPU operation {name} did not finish")
+
+    # ------------------------------------------------------------ interface
+    def create_node(self, node_config: Dict[str, Any]) -> str:
+        self._counter += 1
+        node_id = (f"{self.cluster_name}-worker-"
+                   f"{int(time.time())}-{self._counter}")
+        op = self.http.request(
+            "POST", f"{self._parent}/nodes?nodeId={node_id}",
+            self._node_body(node_config))
+        self._wait_operation(op)
+        logger.info("created TPU VM %s (%s)", node_id,
+                    self._node_body(node_config)["acceleratorType"])
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        try:
+            op = self.http.request(
+                "DELETE", f"{self._parent}/nodes/{node_id}")
+            self._wait_operation(op)
+        except RuntimeError as e:
+            if "404" in str(e):
+                return  # already gone
+            raise
+
+    def terminate_all(self) -> None:
+        """Tear down every VM of this cluster (``ray-tpu down``): leaving
+        provisioned TPU VMs running with no autoscaler to reclaim them
+        would bill forever."""
+        for node_id in self.non_terminated_nodes():
+            try:
+                self.terminate_node(node_id)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                logger.exception("failed to terminate %s", node_id)
+
+    def non_terminated_nodes(self) -> List[str]:
+        reply = self.http.request("GET", f"{self._parent}/nodes")
+        out = []
+        for node in reply.get("nodes", []):
+            labels = node.get("labels", {})
+            if labels.get(CLUSTER_LABEL) != self.cluster_name:
+                continue
+            if node.get("state") in ("READY", "CREATING", "STARTING"):
+                # name is fully qualified: projects/.../nodes/<id>
+                out.append(node.get("name", "").rsplit("/", 1)[-1])
+        return out
+
+    def node_ips(self, node_id: str) -> List[str]:
+        """Worker-host IPs of a slice (multi-host slices list every VM)."""
+        node = self.http.request("GET", f"{self._parent}/nodes/{node_id}")
+        return [ep.get("ipAddress", "")
+                for ep in node.get("networkEndpoints", [])]
+
+
+__all__ = ["TPUNodeProvider", "GceHttp", "CLUSTER_LABEL", "TPU_API"]

@@ -27,8 +27,36 @@ def main(argv=None):
 
     from ray_tpu.autoscaler import Autoscaler, LocalNodeProvider
 
-    provider = LocalNodeProvider(args.gcs_address,
-                                 defaults=cfg.get("worker", {}))
+    pcfg = cfg.get("provider", {})
+    if pcfg.get("type") == "gcp_tpu":
+        # Cloud provisioning: TPU VM slices via the Cloud TPU REST API.
+        from ray_tpu.autoscaler.gcp import GceHttp, TPUNodeProvider
+
+        # Auth, in preference order: token_file (re-read per call, so an
+        # external refresher can rotate it — OAuth bearer tokens expire
+        # hourly), static token (tests/short-lived runs), else the GCE
+        # metadata server (the on-GCP default, which self-refreshes).
+        token_file = pcfg.get("token_file")
+        token = pcfg.get("token")
+        if token_file:
+            def token_provider(path=token_file):
+                with open(path) as tf:
+                    return tf.read().strip()
+        elif token:
+            def token_provider(tok=token):
+                return tok
+        else:
+            token_provider = None
+        http = GceHttp(endpoint=pcfg.get("endpoint",
+                                         "https://tpu.googleapis.com/v2"),
+                       token_provider=token_provider)
+        provider = TPUNodeProvider(
+            pcfg["project"], pcfg["zone"],
+            pcfg.get("cluster_name", "ray-tpu"),
+            config=cfg.get("worker", {}), http=http)
+    else:
+        provider = LocalNodeProvider(args.gcs_address,
+                                     defaults=cfg.get("worker", {}))
     scaler = Autoscaler(
         args.gcs_address, provider,
         node_config=cfg.get("worker", {}),
@@ -42,7 +70,8 @@ def main(argv=None):
     import sys
 
     def _shutdown(*_):
-        provider.terminate_all()
+        if hasattr(provider, "terminate_all"):
+            provider.terminate_all()
         sys.exit(0)
 
     signal.signal(signal.SIGTERM, _shutdown)
@@ -52,7 +81,8 @@ def main(argv=None):
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
-        provider.terminate_all()
+        if hasattr(provider, "terminate_all"):
+            provider.terminate_all()
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -358,6 +358,7 @@ def cmd_up(args):
     if cfg.get("autoscaling"):
         monitor_cfg = json.dumps({
             "worker": cfg.get("worker", {}),
+            "provider": cfg.get("provider", {}),
             "min_workers": cfg.get("min_workers", 0),
             "max_workers": cfg.get("max_workers", 4),
             "idle_timeout_s": cfg.get("idle_timeout_s", 60.0),

@@ -126,3 +126,228 @@ def test_cli_up_and_down(tmp_path, monkeypatch, capsys):
         ray_tpu.shutdown()
         cli_mod.main(["down"])
         capsys.readouterr()
+
+
+# -------------------------------------------------- TPU-VM provider (mock GCE)
+
+class _MockTpuApi:
+    """In-memory mock of the Cloud TPU REST surface the provider speaks
+    (create/list/get/delete + operations). Serves the same URL/JSON shapes
+    as tpu.googleapis.com/v2 so the provider code under test is exactly
+    the production code."""
+
+    def __init__(self):
+        import http.server
+        import json as _json
+        import re
+        import threading
+
+        api = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, code, obj):
+                body = _json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                m = re.match(r".*/nodes/([^/?]+)$", self.path)
+                if m and not self.path.endswith("/nodes"):
+                    node = api.nodes.get(m.group(1))
+                    if node is None:
+                        return self._send(404, {"error": "not found"})
+                    return self._send(200, node)
+                if self.path.rstrip("/").endswith("/nodes"):
+                    return self._send(200, {"nodes": list(
+                        api.nodes.values())})
+                m = re.match(r".*/(operations/[^/?]+)$", self.path)
+                if m:
+                    return self._send(200, api.operations.get(
+                        m.group(1), {"done": True}))
+                self._send(404, {"error": self.path})
+
+            def do_POST(self):
+                import urllib.parse
+                length = int(self.headers.get("Content-Length", 0))
+                body = _json.loads(self.rfile.read(length) or b"{}")
+                q = urllib.parse.urlparse(self.path).query
+                node_id = urllib.parse.parse_qs(q)["nodeId"][0]
+                api.create_calls.append((node_id, body))
+                api.nodes[node_id] = {
+                    "name": f"projects/p/locations/z/nodes/{node_id}",
+                    "state": "READY",
+                    "labels": body.get("labels", {}),
+                    "acceleratorType": body.get("acceleratorType"),
+                    "networkEndpoints": [{"ipAddress": "10.0.0.9"}],
+                }
+                op = f"operations/op-{len(api.create_calls)}"
+                api.operations[op] = {"name": op, "done": True}
+                self._send(200, {"name": op, "done": False})
+
+            def do_DELETE(self):
+                m = re.match(r".*/nodes/([^/?]+)$", self.path)
+                node_id = m.group(1)
+                api.delete_calls.append(node_id)
+                if api.nodes.pop(node_id, None) is None:
+                    return self._send(404, {"error": "404 not found"})
+                self._send(200, {"name": "operations/del", "done": True})
+
+        self.nodes = {}
+        self.operations = {}
+        self.create_calls = []
+        self.delete_calls = []
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                                      Handler)
+        self.endpoint = f"http://127.0.0.1:{self.server.server_port}/v2"
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+
+
+@pytest.fixture
+def mock_tpu_api():
+    api = _MockTpuApi()
+    yield api
+    api.close()
+
+
+def _tpu_provider(api):
+    from ray_tpu.autoscaler.gcp import GceHttp, TPUNodeProvider
+
+    http = GceHttp(endpoint=api.endpoint, token_provider=lambda: "test-tok")
+    return TPUNodeProvider("proj", "us-central2-b", "testcluster",
+                           config={"accelerator_type": "v5litepod-8"},
+                           http=http)
+
+
+def test_tpu_provider_lifecycle(mock_tpu_api):
+    p = _tpu_provider(mock_tpu_api)
+    nid = p.create_node({"startup_script": "ray-tpu start"})
+    _, body = mock_tpu_api.create_calls[0]
+    assert body["acceleratorType"] == "v5litepod-8"
+    assert body["labels"]["ray-tpu-cluster"] == "testcluster"
+    assert body["metadata"]["startup-script"] == "ray-tpu start"
+    assert p.non_terminated_nodes() == [nid]
+    assert p.node_ips(nid) == ["10.0.0.9"]
+    p.terminate_node(nid)
+    assert p.non_terminated_nodes() == []
+    p.terminate_node(nid)  # idempotent: 404 swallowed
+
+
+def test_tpu_demand_binpacks_to_fewest_hosts(cluster, mock_tpu_api):
+    """8 single-chip asks on an 8-chip host shape -> exactly ONE TPU VM."""
+    p = _tpu_provider(mock_tpu_api)
+    scaler = Autoscaler(cluster.address, p,
+                        node_config={"resources": {"TPU": 8.0},
+                                     "accelerator_type": "v5litepod-8"},
+                        max_workers=8)
+    request_resources(cluster.address, [{"TPU": 1.0}] * 8)
+    out = scaler.reconcile_once()
+    assert out["launched"] == 1
+    assert len(mock_tpu_api.create_calls) == 1
+    # In-flight node (not yet registered) absorbs the demand: no stampede.
+    out = scaler.reconcile_once()
+    assert out["launched"] == 0
+
+    # Two 8-chip asks on top -> exactly two more hosts.
+    request_resources(cluster.address,
+                      [{"TPU": 8.0}, {"TPU": 8.0}, {"TPU": 1.0}])
+    out = scaler.reconcile_once()
+    assert out["launched"] == 2
+
+
+def test_tpu_scale_down_on_idle_and_bootstrap_failure(cluster, mock_tpu_api):
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    p = _tpu_provider(mock_tpu_api)
+    scaler = Autoscaler(cluster.address, p,
+                        node_config={"resources": {"TPU": 8.0}},
+                        max_workers=4, idle_timeout_s=0.2)
+    request_resources(cluster.address, [{"TPU": 8.0}])
+    assert scaler.reconcile_once()["launched"] == 1
+    vm_id = p.non_terminated_nodes()[0]
+
+    # Simulate the TPU VM's node registering with the GCS (the bootstrap
+    # labels it with its provider id), fully idle.
+    gcs = rpc.get_stub("GcsService", cluster.address)
+    info = pb.NodeInfo(node_id="fakevm" + "0" * 26,
+                       address="127.0.0.1:1", alive=True,
+                       labels={"provider-node-id": vm_id})
+    info.resources["TPU"] = 8.0
+    info.available["TPU"] = 8.0
+    gcs.RegisterNode(pb.RegisterNodeRequest(info=info))
+    request_resources(cluster.address, [])
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and p.non_terminated_nodes():
+        scaler.reconcile_once()
+        time.sleep(0.1)
+    assert vm_id in mock_tpu_api.delete_calls
+    gcs.DrainNode(pb.DrainNodeRequest(node_id=info.node_id))
+
+    # Bootstrap failure: a created VM that never registers is reclaimed
+    # after the grace window.
+    scaler2 = Autoscaler(cluster.address, p,
+                         node_config={"resources": {"TPU": 8.0}},
+                         max_workers=4)
+    scaler2.UNREGISTERED_GRACE_S = 0.2
+    request_resources(cluster.address, [{"TPU": 8.0}])
+    assert scaler2.reconcile_once()["launched"] == 1
+    request_resources(cluster.address, [])
+    time.sleep(0.3)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and p.non_terminated_nodes():
+        scaler2.reconcile_once()
+        time.sleep(0.1)
+    assert p.non_terminated_nodes() == []
+
+
+def test_multi_host_slice_not_reclaimed_while_any_host_busy(
+        cluster, mock_tpu_api):
+    """A v5litepod-16 slice registers 2 GCS hosts under ONE provider id;
+    idle scale-down must only fire when EVERY host is idle."""
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    p = _tpu_provider(mock_tpu_api)
+    scaler = Autoscaler(cluster.address, p,
+                        node_config={"resources": {"TPU": 8.0}},
+                        max_workers=4, idle_timeout_s=0.1)
+    request_resources(cluster.address, [{"TPU": 8.0}])
+    scaler.reconcile_once()
+    vm_id = p.non_terminated_nodes()[0]
+    gcs = rpc.get_stub("GcsService", cluster.address)
+    hosts = []
+    for i, free in enumerate([8.0, 0.0]):  # host 1 is busy
+        info = pb.NodeInfo(node_id=f"slicehost{i}" + "0" * 22,
+                           address=f"127.0.0.1:{i+1}", alive=True,
+                           labels={"provider-node-id": vm_id})
+        info.resources["TPU"] = 8.0
+        info.available["TPU"] = free
+        gcs.RegisterNode(pb.RegisterNodeRequest(info=info))
+        hosts.append(info)
+    request_resources(cluster.address, [])
+    for _ in range(5):
+        scaler.reconcile_once()
+        time.sleep(0.1)
+    assert vm_id in p.non_terminated_nodes()  # busy host pinned the slice
+
+    # Free the busy host: now the whole slice is idle -> reclaimed.
+    hosts[1].available["TPU"] = 8.0
+    gcs.RegisterNode(pb.RegisterNodeRequest(info=hosts[1]))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and vm_id in p.non_terminated_nodes():
+        scaler.reconcile_once()
+        time.sleep(0.1)
+    assert vm_id not in p.non_terminated_nodes()
+    for h in hosts:
+        gcs.DrainNode(pb.DrainNodeRequest(node_id=h.node_id))
