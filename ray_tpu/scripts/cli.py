@@ -408,6 +408,39 @@ def cmd_gateway(args):
         gw.stop()
 
 
+def cmd_serve(args):
+    """Declarative Serve control (reference: ``serve deploy/status``)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    _connect(args)
+    if args.action == "deploy":
+        if not args.config:
+            raise SystemExit("serve deploy requires a config file")
+        # Apps import relative to the config's directory and the cwd.
+        sys.path.insert(0, os.path.dirname(os.path.abspath(args.config)))
+        sys.path.insert(0, os.getcwd())
+        names = serve.deploy_config_file(args.config)
+        print(f"deployed: {', '.join(names)}")
+    elif args.action == "status":
+        from ray_tpu.serve.api import CONTROLLER_NAME
+
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            deployments = ray_tpu.get(
+                controller.list_deployments.remote(), timeout=10)
+        except ValueError:
+            print("serve is not running")
+            return
+        for name in deployments:
+            replicas = ray_tpu.get(
+                controller.get_replicas.remote(name), timeout=10)
+            print(f"{name}: {len(replicas)} replica(s)")
+    else:
+        serve.shutdown()
+        print("serve shut down")
+
+
 def cmd_resources(args):
     import ray_tpu
 
@@ -492,6 +525,13 @@ def main(argv=None):
     p = sub.add_parser("resources", help="cluster total/available resources")
     p.add_argument("--address")
     p.set_defaults(fn=cmd_resources)
+
+    p = sub.add_parser("serve",
+                       help="serve subcommands: deploy/status/shutdown")
+    p.add_argument("action", choices=["deploy", "status", "shutdown"])
+    p.add_argument("config", nargs="?", help="YAML config (deploy)")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("up", help="launch a cluster from a YAML config")
     p.add_argument("config")

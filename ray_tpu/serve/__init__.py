@@ -10,18 +10,24 @@ from ray_tpu.serve.api import (
     get_deployment_handle,
     run,
     shutdown,
+    start_grpc,
     start_http,
+    stop_grpc,
     stop_http,
 )
 from ray_tpu.serve.api import DeploymentResponseGenerator
 from ray_tpu.serve.batching import batch
+from ray_tpu.serve.config import (build_config, deploy_config_data,
+                                  deploy_config_dict, deploy_config_file)
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
 
 __all__ = [
     "Application", "Deployment", "DeploymentHandle", "DeploymentResponse",
-    "DeploymentResponseGenerator", "batch", "delete", "deployment",
-    "get_deployment_handle", "get_multiplexed_model_id", "multiplexed",
-    "run", "shutdown", "start_http", "stop_http",
+    "DeploymentResponseGenerator", "batch", "build_config", "delete",
+    "deploy_config_data", "deploy_config_dict", "deploy_config_file",
+    "deployment", "get_deployment_handle", "get_multiplexed_model_id",
+    "multiplexed", "run", "shutdown", "start_grpc", "start_http",
+    "stop_grpc", "stop_http",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

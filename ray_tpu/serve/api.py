@@ -849,112 +849,31 @@ def shutdown():
         pass
 
 
-# ---------------------------------------------------------------- HTTP proxy
-class _HttpProxy:
-    def __init__(self, host: str, port: int):
-        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-        handles: Dict[str, DeploymentHandle] = {}
-
-        class Handler(BaseHTTPRequestHandler):
-            protocol_version = "HTTP/1.1"
-
-            def do_POST(self):  # noqa: N802
-                parts = self.path.strip("/").split("/")
-                name = parts[0]
-                # /<deployment>/<method> targets a specific method;
-                # /<deployment>/stream/<method> streams its yields as
-                # chunked NDJSON (reference: Serve StreamingResponse).
-                stream = len(parts) >= 2 and parts[1] == "stream"
-                method = (parts[2] if stream and len(parts) > 2 else
-                          parts[1] if len(parts) > 1 else None)
-                if method and method.startswith("_"):
-                    # Only public methods are network-routable.
-                    data = json.dumps({"error": "method not found"}).encode()
-                    self.send_response(404)
-                    self.send_header("Content-Type", "application/json")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
-                    return
-                # Model multiplexing rides the reference's request header.
-                model_id = self.headers.get(
-                    "serve_multiplexed_model_id", "")
-                length = int(self.headers.get("Content-Length", 0))
-                body = self.rfile.read(length) if length else b"{}"
-                try:
-                    payload = json.loads(body) if body else {}
-                    handle = handles.get(name)
-                    if handle is None:
-                        handle = DeploymentHandle(name)
-                        handles[name] = handle
-                    h = handle.options(method, stream=stream,
-                                       multiplexed_model_id=model_id)
-                    if stream:
-                        gen = h.remote(payload)
-                        gen._timeout = 60.0  # per-item bound, like result()
-                        # Pull the first item BEFORE committing to 200 so
-                        # pre-stream failures (bad method, non-generator)
-                        # surface as errors, not empty successful streams.
-                        items = iter(gen)
-                        try:
-                            first = next(items)
-                            pending = [first]
-                        except StopIteration:
-                            pending = []
-                        self.send_response(200)
-                        self.send_header("Content-Type",
-                                         "application/x-ndjson")
-                        self.send_header("Transfer-Encoding", "chunked")
-                        self.end_headers()
-                        try:
-                            import itertools as _it
-
-                            for item in _it.chain(pending, items):
-                                chunk = json.dumps(item).encode() + b"\n"
-                                self.wfile.write(
-                                    f"{len(chunk):x}\r\n".encode()
-                                    + chunk + b"\r\n")
-                                self.wfile.flush()
-                            self.wfile.write(b"0\r\n\r\n")
-                        except Exception:  # noqa: BLE001
-                            # Mid-stream failure: abort the connection so
-                            # the client sees truncation, not completion.
-                            logger.exception(
-                                "streaming response for %s failed "
-                                "mid-stream", name)
-                            self.close_connection = True
-                        return
-                    result = h.remote(payload).result(timeout_s=60)
-                    data = json.dumps(result).encode()
-                    self.send_response(200)
-                except Exception as e:  # noqa: BLE001
-                    data = json.dumps({"error": str(e)}).encode()
-                    self.send_response(500)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-            def log_message(self, *a):  # silence
-                pass
-
-        self.server = ThreadingHTTPServer((host, port), Handler)
-        self.port = self.server.server_address[1]
-        threading.Thread(target=self.server.serve_forever, daemon=True).start()
-
-    def stop(self):
-        self.server.shutdown()
+# ------------------------------------------------------------- data plane
+# The ingress implementations (asyncio HTTP + gRPC over a shared router)
+# live in serve/proxy.py; these module-level helpers manage the default
+# instances (reference: serve.start(http_options=...)).
+_proxy = None
+_grpc_proxy = None
+_shared_router = None
 
 
-_proxy: Optional[_HttpProxy] = None
+def _router():
+    global _shared_router
+    if _shared_router is None:
+        from ray_tpu.serve.proxy import _Router
+
+        _shared_router = _Router()
+    return _shared_router
 
 
 def start_http(host: str = "127.0.0.1", port: int = 8000) -> int:
-    """Start the HTTP ingress; returns the bound port."""
+    """Start the asyncio HTTP ingress; returns the bound port."""
     global _proxy
     if _proxy is None:
-        _proxy = _HttpProxy(host, port)
+        from ray_tpu.serve.proxy import AsyncHttpProxy
+
+        _proxy = AsyncHttpProxy(host, port, router=_router())
     return _proxy.port
 
 
@@ -963,3 +882,20 @@ def stop_http():
     if _proxy is not None:
         _proxy.stop()
         _proxy = None
+
+
+def start_grpc(host: str = "127.0.0.1", port: int = 0) -> int:
+    """Start the gRPC ingress (ServeIngress service); returns the port."""
+    global _grpc_proxy
+    if _grpc_proxy is None:
+        from ray_tpu.serve.proxy import GrpcProxy
+
+        _grpc_proxy = GrpcProxy(host, port, router=_router())
+    return _grpc_proxy.port
+
+
+def stop_grpc():
+    global _grpc_proxy
+    if _grpc_proxy is not None:
+        _grpc_proxy.stop()
+        _grpc_proxy = None

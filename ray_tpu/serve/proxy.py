@@ -1,0 +1,371 @@
+"""Serve data plane: asyncio HTTP ingress + gRPC ingress over one router.
+
+Reference: ``python/ray/serve/_private/proxy.py`` — the reference runs a
+uvicorn/asyncio HTTP proxy (:752) and a gRPC proxy (:532) that share
+routing state. This build keeps that shape with stdlib asyncio streams:
+
+* keep-alive HTTP/1.1 with pipelined request loop per connection;
+* chunked NDJSON streaming whose writes apply real backpressure
+  (``await writer.drain()`` — a slow client throttles the generator pull
+  instead of buffering unboundedly);
+* a bounded executor bridging the blocking DeploymentHandle router calls,
+  whose size caps in-flight requests (the asyncio analog of the
+  reference's ``max_ongoing_requests`` admission);
+* control endpoints: ``GET /-/healthz``, ``GET /-/routes``, and
+  ``PUT /-/deploy`` (declarative config — reference ``serve deploy``).
+
+The gRPC ingress (``GrpcProxy``) serves the same deployments through
+``ServeIngress.Predict`` / ``PredictStream`` (reference grpc proxy).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+_MAX_BODY = 64 << 20
+_STREAM_END = object()
+
+
+class _Router:
+    """Shared deployment-handle cache for every ingress."""
+
+    def __init__(self):
+        self._handles: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def handle(self, name: str):
+        from ray_tpu.serve.api import DeploymentHandle
+
+        with self._lock:
+            h = self._handles.get(name)
+            if h is None:
+                h = self._handles[name] = DeploymentHandle(name)
+            return h
+
+    @staticmethod
+    def _check_public(method: Optional[str]) -> None:
+        # Only public methods are network-routable — enforced here so
+        # EVERY ingress (HTTP and gRPC) shares the guard.
+        if method and method.startswith("_"):
+            raise LookupError("method not found")
+
+    def call(self, name: str, method: Optional[str], payload,
+             model_id: str = "", timeout_s: float = 60.0):
+        self._check_public(method)
+        h = self.handle(name).options(method,
+                                      multiplexed_model_id=model_id)
+        return h.remote(payload).result(timeout_s=timeout_s)
+
+    def stream(self, name: str, method: Optional[str], payload,
+               model_id: str = ""):
+        self._check_public(method)
+        h = self.handle(name).options(method, stream=True,
+                                      multiplexed_model_id=model_id)
+        gen = h.remote(payload)
+        gen._timeout = 60.0  # per-item bound, like result()
+        return iter(gen)
+
+
+class AsyncHttpProxy:
+    """Asyncio HTTP/1.1 ingress (keep-alive, streaming, backpressure)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 max_concurrency: int = 64, router: Optional[_Router] = None):
+        self.router = router or _Router()
+        # The executor bounds concurrent blocking router calls: requests
+        # beyond it queue in asyncio (cheap futures), not in threads.
+        self._pool = ThreadPoolExecutor(max_workers=max_concurrency,
+                                        thread_name_prefix="serve-http")
+        self._loop = asyncio.new_event_loop()
+        self._started = threading.Event()
+        self._host, self._want_port = host, port
+        self.port: int = 0
+        self._server = None
+        self._boot_error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-http-loop")
+        self._thread.start()
+        if not self._started.wait(10) or self.port == 0:
+            if self._boot_error is not None:
+                raise RuntimeError(
+                    f"HTTP proxy failed to bind {host}:{port}: "
+                    f"{self._boot_error}") from self._boot_error
+            raise RuntimeError("HTTP proxy failed to start")
+
+    def _run(self):
+        asyncio.set_event_loop(self._loop)
+
+        async def boot():
+            self._server = await asyncio.start_server(
+                self._handle_conn, self._host, self._want_port)
+            self.port = self._server.sockets[0].getsockname()[1]
+
+        try:
+            self._loop.run_until_complete(boot())
+        except BaseException as e:  # noqa: BLE001 — surface bind errors
+            self._boot_error = e
+            self._started.set()
+            self._loop.close()
+            return
+        self._started.set()
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    # ------------------------------------------------------------- parsing
+    async def _read_request(self, reader):
+        """One request, or None on clean EOF, or (status, message) for a
+        protocol error the connection must answer-then-close."""
+        try:
+            line = await reader.readline()
+        except (asyncio.LimitOverrunError, ValueError):
+            return 431, "request line too long"
+        if not line or line in (b"\r\n", b"\n"):
+            return None
+        try:
+            method, path, version = line.decode("latin1").strip().split(" ", 2)
+        except ValueError:
+            return 400, "malformed request line"
+        headers: Dict[str, str] = {}
+        while True:
+            try:
+                h = await reader.readline()
+            except (asyncio.LimitOverrunError, ValueError):
+                return 431, "header too long"
+            if not h or h in (b"\r\n", b"\n"):
+                break
+            k, _, v = h.decode("latin1").partition(":")
+            headers[k.strip().lower()] = v.strip()
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            # Parsing chunked request bodies is unimplemented; accepting
+            # the request with an empty body would desync the keep-alive
+            # loop (the body bytes would parse as the next request line).
+            return 501, "chunked request bodies are not supported"
+        length = int(headers.get("content-length", 0) or 0)
+        if length > _MAX_BODY:
+            return 413, "request body too large"
+        body = await reader.readexactly(length) if length else b""
+        return method, path, version, headers, body
+
+    @staticmethod
+    def _response(status: int, body: bytes,
+                  content_type: str = "application/json",
+                  keep_alive: bool = True) -> bytes:
+        import http as _http
+
+        try:
+            reason = _http.HTTPStatus(status).phrase
+        except ValueError:
+            reason = "Unknown"
+        conn = "keep-alive" if keep_alive else "close"
+        return (f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Type: {content_type}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                f"Connection: {conn}\r\n\r\n").encode() + body
+
+    # ---------------------------------------------------------- connection
+    async def _handle_conn(self, reader, writer):
+        try:
+            while True:
+                try:
+                    req = await self._read_request(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return
+                if req is None:
+                    return
+                if len(req) == 2:  # protocol error: answer, then close
+                    status, msg = req
+                    writer.write(self._response(
+                        status, json.dumps({"error": msg}).encode(),
+                        keep_alive=False))
+                    await writer.drain()
+                    return
+                method, path, version, headers, body = req
+                close = (headers.get("connection", "").lower() == "close"
+                         or version == "HTTP/1.0")
+                try:
+                    done = await self._route(method, path, headers, body,
+                                             writer, keep_alive=not close)
+                except (ConnectionError, asyncio.CancelledError):
+                    return
+                except Exception as e:  # noqa: BLE001
+                    data = json.dumps({"error": str(e)}).encode()
+                    writer.write(self._response(500, data,
+                                                keep_alive=not close))
+                    await writer.drain()
+                    done = True
+                if not done or close:
+                    return
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _route(self, method: str, path: str, headers, body: bytes,
+                     writer, keep_alive: bool) -> bool:
+        """Handle one request; returns False to drop the connection."""
+        loop = asyncio.get_running_loop()
+        path = path.split("?", 1)[0]
+        if method == "GET" and path == "/-/healthz":
+            writer.write(self._response(200, b'{"status":"ok"}',
+                                        keep_alive=keep_alive))
+            await writer.drain()
+            return True
+        if method == "GET" and path == "/-/routes":
+            routes = await loop.run_in_executor(self._pool, _list_routes)
+            writer.write(self._response(
+                200, json.dumps(routes).encode(), keep_alive=keep_alive))
+            await writer.drain()
+            return True
+        if method in ("PUT", "POST") and path == "/-/deploy":
+            from ray_tpu.serve.config import deploy_config_data
+
+            cfg = await loop.run_in_executor(
+                self._pool, deploy_config_data, body.decode())
+            writer.write(self._response(
+                200, json.dumps({"deployed": cfg}).encode(),
+                keep_alive=keep_alive))
+            await writer.drain()
+            return True
+        if method != "POST":
+            writer.write(self._response(404, b'{"error":"not found"}',
+                                        keep_alive=keep_alive))
+            await writer.drain()
+            return True
+
+        parts = path.strip("/").split("/")
+        name = parts[0]
+        stream = len(parts) >= 2 and parts[1] == "stream"
+        call_method = (parts[2] if stream and len(parts) > 2 else
+                       parts[1] if len(parts) > 1 else None)
+        if not name or (call_method and call_method.startswith("_")):
+            writer.write(self._response(
+                404, json.dumps({"error": "method not found"}).encode(),
+                keep_alive=keep_alive))
+            await writer.drain()
+            return True
+        model_id = headers.get("serve_multiplexed_model_id", "")
+        payload = json.loads(body) if body else {}
+
+        if not stream:
+            result = await loop.run_in_executor(
+                self._pool, self.router.call, name, call_method, payload,
+                model_id)
+            writer.write(self._response(
+                200, json.dumps(result).encode(), keep_alive=keep_alive))
+            await writer.drain()
+            return True
+
+        # Streaming: pull the first item BEFORE committing to 200 so
+        # pre-stream failures surface as errors, not empty streams.
+        items = await loop.run_in_executor(
+            self._pool, self.router.stream, name, call_method, payload,
+            model_id)
+
+        def pull():
+            try:
+                return next(items)
+            except StopIteration:
+                return _STREAM_END
+
+        first = await loop.run_in_executor(self._pool, pull)
+        conn = "keep-alive" if keep_alive else "close"
+        writer.write((f"HTTP/1.1 200 OK\r\n"
+                      f"Content-Type: application/x-ndjson\r\n"
+                      f"Transfer-Encoding: chunked\r\n"
+                      f"Connection: {conn}\r\n\r\n").encode())
+        item = first
+        try:
+            while item is not _STREAM_END:
+                chunk = json.dumps(item).encode() + b"\n"
+                writer.write(f"{len(chunk):x}\r\n".encode() + chunk
+                             + b"\r\n")
+                await writer.drain()  # backpressure: slow client, slow pull
+                item = await loop.run_in_executor(self._pool, pull)
+            writer.write(b"0\r\n\r\n")
+            await writer.drain()
+            return True
+        except Exception:  # noqa: BLE001 — mid-stream failure: abort the
+            # connection so the client sees truncation, not completion.
+            logger.exception("streaming response for %s failed mid-stream",
+                             name)
+            return False
+
+    def stop(self):
+        def _shutdown():
+            if self._server is not None:
+                self._server.close()
+            self._loop.stop()
+
+        self._loop.call_soon_threadsafe(_shutdown)
+        self._thread.join(timeout=5)
+        self._pool.shutdown(wait=False)
+
+
+def _list_routes() -> Dict[str, str]:
+    import ray_tpu
+    from ray_tpu.serve.api import CONTROLLER_NAME
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        deployments = ray_tpu.get(controller.list_deployments.remote(),
+                                  timeout=10)
+        return {f"/{d}": d for d in deployments}
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+class GrpcProxy:
+    """gRPC ingress sharing the HTTP router (reference: grpc proxy,
+    ``serve/_private/proxy.py:532``). Payloads are JSON bytes; streaming
+    deployments map to a server-streaming RPC."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 router: Optional[_Router] = None):
+        from ray_tpu._private import rpc
+
+        self.router = router or _Router()
+        self._server, self.port = rpc.serve("ServeIngress", self, port=port,
+                                            host=host)
+
+    # ------------------------------------------------------------ handlers
+    def Predict(self, request, context):
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        try:
+            payload = json.loads(request.payload) if request.payload else {}
+            result = self.router.call(
+                request.deployment, request.method or None, payload,
+                request.multiplexed_model_id)
+            return pb.ServeReply(ok=True,
+                                 payload=json.dumps(result).encode())
+        except Exception as e:  # noqa: BLE001
+            return pb.ServeReply(ok=False, error=str(e))
+
+    def PredictStream(self, request, context):
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        try:
+            payload = json.loads(request.payload) if request.payload else {}
+            for item in self.router.stream(
+                    request.deployment, request.method or None, payload,
+                    request.multiplexed_model_id):
+                yield pb.ServeReply(ok=True,
+                                    payload=json.dumps(item).encode())
+        except Exception as e:  # noqa: BLE001
+            yield pb.ServeReply(ok=False, error=str(e))
+
+    def stop(self):
+        self._server.stop(grace=0.5)
+
+
+__all__ = ["AsyncHttpProxy", "GrpcProxy"]

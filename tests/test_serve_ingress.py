@@ -1,0 +1,201 @@
+"""Serve data-plane tests: asyncio HTTP ingress, gRPC ingress, declarative
+deploys (reference: serve/tests/test_proxy.py + test_config_files)."""
+
+import http.client
+import json
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_session():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(scope="module")
+def ingress():
+    @serve.deployment
+    class Echo:
+        def __call__(self, payload):
+            return {"echo": payload}
+
+        def double(self, payload):
+            return {"x2": payload.get("n", 0) * 2}
+
+        def counts(self, payload):
+            for i in range(payload.get("n", 3)):
+                yield {"i": i}
+
+    serve.run(Echo.bind(), name="Echo")
+    http_port = serve.start_http(port=0)
+    grpc_port = serve.start_grpc(port=0)
+    yield http_port, grpc_port
+    serve.stop_http()
+    serve.stop_grpc()
+
+
+def _post(conn, path, payload):
+    body = json.dumps(payload)
+    conn.request("POST", path, body=body,
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    return resp, resp.read()
+
+
+def test_http_keep_alive_multiple_requests(ingress):
+    """Several requests must ride ONE TCP connection (HTTP/1.1
+    keep-alive — the stdlib thread-per-connection server couldn't)."""
+    http_port, _ = ingress
+    conn = http.client.HTTPConnection("127.0.0.1", http_port)
+    for i in range(5):
+        resp, body = _post(conn, "/Echo/double", {"n": i})
+        assert resp.status == 200
+        assert json.loads(body) == {"x2": i * 2}
+        assert resp.getheader("Connection") == "keep-alive"
+    conn.close()
+
+
+def test_http_healthz_and_routes(ingress):
+    http_port, _ = ingress
+    conn = http.client.HTTPConnection("127.0.0.1", http_port)
+    conn.request("GET", "/-/healthz")
+    assert json.loads(conn.getresponse().read()) == {"status": "ok"}
+    conn.request("GET", "/-/routes")
+    routes = json.loads(conn.getresponse().read())
+    assert "/Echo" in routes
+    conn.close()
+
+
+def test_http_streaming_ndjson(ingress):
+    http_port, _ = ingress
+    conn = http.client.HTTPConnection("127.0.0.1", http_port)
+    resp, body = _post(conn, "/Echo/stream/counts", {"n": 4})
+    assert resp.status == 200
+    assert resp.getheader("Content-Type") == "application/x-ndjson"
+    items = [json.loads(line) for line in body.splitlines() if line]
+    assert items == [{"i": i} for i in range(4)]
+    # Connection stays usable after a completed stream.
+    resp, body = _post(conn, "/Echo/double", {"n": 5})
+    assert json.loads(body) == {"x2": 10}
+    conn.close()
+
+
+def test_http_error_does_not_kill_connection(ingress):
+    http_port, _ = ingress
+    conn = http.client.HTTPConnection("127.0.0.1", http_port)
+    resp, body = _post(conn, "/Echo/_private", {})
+    assert resp.status == 404
+    resp, body = _post(conn, "/Echo/double", {"n": 1})
+    assert resp.status == 200
+    conn.close()
+
+
+def test_grpc_ingress_shares_deployment(ingress):
+    _, grpc_port = ingress
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    stub = rpc.get_stub("ServeIngress", f"127.0.0.1:{grpc_port}")
+    reply = stub.Predict(pb.ServeRequest(
+        deployment="Echo", method="double",
+        payload=json.dumps({"n": 21}).encode()))
+    assert reply.ok, reply.error
+    assert json.loads(reply.payload) == {"x2": 42}
+
+    items = [json.loads(r.payload) for r in stub.PredictStream(
+        pb.ServeRequest(deployment="Echo", method="counts",
+                        payload=json.dumps({"n": 3}).encode())) if r.ok]
+    assert items == [{"i": i} for i in range(3)]
+
+    bad = stub.Predict(pb.ServeRequest(deployment="nope"))
+    assert not bad.ok and bad.error
+
+
+def test_declarative_deploy_from_yaml(tmp_path, ingress):
+    http_port, _ = ingress
+    app_py = tmp_path / "my_serve_app.py"
+    app_py.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "def adder(payload):\n"
+        "    return {'sum': payload.get('a', 0) + payload.get('b', 0)}\n")
+    cfg = tmp_path / "serve_config.yaml"
+    cfg.write_text(
+        "applications:\n"
+        "  - import_path: my_serve_app:adder\n"
+        "    deployments:\n"
+        "      - name: adder\n"
+        "        num_replicas: 2\n")
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        names = serve.deploy_config_file(str(cfg))
+        assert names == ["adder"]
+        conn = http.client.HTTPConnection("127.0.0.1", http_port)
+        resp, body = _post(conn, "/adder", {"a": 2, "b": 3})
+        assert json.loads(body) == {"sum": 5}
+        conn.close()
+        controller = ray_tpu.get_actor("__serve_controller__")
+        replicas = ray_tpu.get(controller.get_replicas.remote("adder"),
+                               timeout=10)
+        assert len(replicas) == 2  # override applied
+    finally:
+        sys.path.remove(str(tmp_path))
+
+
+def test_rest_deploy_endpoint(tmp_path, ingress):
+    """PUT /-/deploy with a YAML body deploys (reference: REST api)."""
+    http_port, _ = ingress
+    app_py = tmp_path / "rest_app.py"
+    app_py.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "def greeter(payload):\n"
+        "    return {'hi': payload.get('who', 'world')}\n")
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", http_port)
+        body = ("applications:\n"
+                "  - import_path: rest_app:greeter\n")
+        conn.request("PUT", "/-/deploy", body=body)
+        resp = conn.getresponse()
+        assert resp.status == 200, resp.read()
+        assert json.loads(resp.read()) == {"deployed": ["greeter"]}
+        resp, body = _post(conn, "/greeter", {"who": "tpu"})
+        assert json.loads(body) == {"hi": "tpu"}
+        conn.close()
+    finally:
+        sys.path.remove(str(tmp_path))
+
+
+def test_grpc_private_method_rejected(ingress):
+    _, grpc_port = ingress
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    stub = rpc.get_stub("ServeIngress", f"127.0.0.1:{grpc_port}")
+    reply = stub.Predict(pb.ServeRequest(deployment="Echo",
+                                         method="__init__"))
+    assert not reply.ok and "not found" in reply.error
+
+
+def test_http_chunked_request_rejected(ingress):
+    http_port, _ = ingress
+    import socket
+
+    s = socket.create_connection(("127.0.0.1", http_port))
+    s.sendall(b"POST /Echo HTTP/1.1\r\nTransfer-Encoding: chunked\r\n\r\n")
+    data = s.recv(4096)
+    assert b"501" in data.split(b"\r\n")[0]
+    s.close()
