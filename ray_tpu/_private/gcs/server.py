@@ -8,8 +8,9 @@ same responsibilities in one asyncio-free threaded gRPC process.
 Fault tolerance (reference: ``redis_store_client.h:107`` Redis-backed GCS
 restart): with ``persist_path`` set (or ``RAY_TPU_GCS_PERSIST_PATH``),
 durable tables (KV, actors, placement groups, object directory, refcounts)
-are snapshotted to disk on mutation (debounced, atomic rename) and reloaded
-on restart. Nodes are NOT persisted: a restarted GCS answers their next
+persist through a write-ahead log of idempotent delta records that compacts
+into a snapshot (gcs/wal.py); recovery loads the snapshot and replays the
+log. Nodes are NOT persisted: a restarted GCS answers their next
 heartbeat with ``ok=false``, which drives the node's re-register path;
 subscribers reconnect through their streaming-retry loops.
 """
@@ -36,7 +37,6 @@ logger = logging.getLogger(__name__)
 
 HEALTH_CHECK_PERIOD_S = 0.5
 HEALTH_FAILURE_THRESHOLD_S = 3.0
-PERSIST_DEBOUNCE_S = 0.1
 # A holder that stops flushing/pinging for this long is presumed crashed and
 # its refcounts reaped (reference ties refs to owner liveness,
 # reference_count.h:66). Every holder with live counts pings every
@@ -102,7 +102,6 @@ class GcsServer:
         self._freed: Dict[bytes, float] = {}
 
         self._lock = threading.RLock()
-        self._snapshot_write_lock = threading.Lock()
         self._stop = threading.Event()
         # Bounded pool for actor creation/restart and PG placement work
         # (the reference runs these on the GCS io_context, not a thread per
@@ -111,51 +110,35 @@ class GcsServer:
             max_workers=16, thread_name_prefix="gcs-work")
         self._persist_path = persist_path or os.environ.get(
             "RAY_TPU_GCS_PERSIST_PATH") or None
-        self._dirty = threading.Event()
+        self._wal = None
+        loaded = False
         if self._persist_path and os.path.exists(self._persist_path):
             self._load_snapshot()
+            loaded = True
+        if self._persist_path:
+            replayed = self._replay_wal()
+            if loaded or replayed:
+                self._finish_restore()
+            from ray_tpu._private.gcs.wal import WriteAheadLog
+
+            self._wal = WriteAheadLog(self._persist_path + ".wal",
+                                      self._state_blob, self._persist_path)
         self._server, self.port = rpc.serve("GcsService", self, port=port)
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="gcs-health")
         self._health_thread.start()
-        if self._persist_path:
-            self._persist_thread = threading.Thread(
-                target=self._persist_loop, daemon=True, name="gcs-persist")
-            self._persist_thread.start()
 
     # ------------------------------------------------------------ persistence
-    def _mark_dirty(self):
-        if self._persist_path:
-            self._dirty.set()
+    # Mutations append idempotent delta records to a write-ahead log
+    # (gcs/wal.py — O(delta) persistence; the earlier design re-pickled
+    # and fsynced the full state per debounce, burning a core machine-wide
+    # on busy clusters). The log compacts into the snapshot file; recovery
+    # loads the snapshot then replays the log.
+    def _wal_append(self, record) -> None:
+        if self._wal is not None:
+            self._wal.append(record)
 
-    def _persist_loop(self):
-        debounce = PERSIST_DEBOUNCE_S
-        while not self._stop.is_set():
-            if not self._dirty.wait(timeout=0.5):
-                continue
-            time.sleep(debounce)  # coalesce mutation bursts
-            self._dirty.clear()
-            try:
-                t0 = time.monotonic()
-                self._write_snapshot()
-                # Adaptive debounce: cap persistence at ~10% of the GCS's
-                # time — a busy cluster mutates object state continuously,
-                # and snapshotting (pickle + fsync) at a fixed 100ms
-                # interval burned a core machine-wide (visible as 3-4x
-                # latency on unrelated RPCs late in long runs).
-                debounce = min(max(PERSIST_DEBOUNCE_S,
-                                   10 * (time.monotonic() - t0)), 2.0)
-            except Exception:  # noqa: BLE001
-                logger.exception("GCS snapshot write failed")
-
-    def _write_snapshot(self):
-        # shutdown() and the persist loop can both write; serialize them so
-        # interleaved writes to the shared tmp file can't corrupt the
-        # snapshot os.replace installs (ADVICE r2 #5).
-        with self._snapshot_write_lock:
-            self._write_snapshot_locked()
-
-    def _write_snapshot_locked(self):
+    def _state_blob(self) -> bytes:
         with self._lock:
             state = {
                 "kv": dict(self._kv),
@@ -178,13 +161,7 @@ class GcsServer:
                             in self._holder_meta.items()},
                 "freed": list(self._freed),
             }
-        blob = pickle.dumps(state)
-        tmp = f"{self._persist_path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._persist_path)
+        return pickle.dumps(state)
 
     def _load_snapshot(self):
         try:
@@ -216,6 +193,90 @@ class GcsServer:
             self._holder_meta[h] = (nid, is_drv, now)
         for oid in state.get("freed", ()):
             self._freed[oid] = now
+
+    def _replay_wal(self) -> int:
+        """Apply log records over the loaded snapshot (recovery step 2)."""
+        from ray_tpu._private.gcs.wal import WriteAheadLog
+
+        n = 0
+        for rec in WriteAheadLog.replay(self._persist_path + ".wal"):
+            try:
+                self._apply_wal_record(rec)
+                n += 1
+            except Exception:  # noqa: BLE001 — one bad record must not
+                logger.exception("skipping unreplayable WAL record")
+        if n:
+            logger.info("replayed %d WAL records", n)
+        return n
+
+    def _apply_wal_record(self, rec) -> None:
+        kind = rec[0]
+        if kind == "kv":
+            _, ns, key, value = rec
+            if value is None:
+                self._kv.pop((ns, key), None)
+            else:
+                self._kv[(ns, key)] = value
+        elif kind == "actor":
+            info = pb.ActorInfo()
+            info.ParseFromString(rec[1])
+            self._actors[bytes(info.actor_id)] = info
+            if info.name:
+                key = (info.namespace or "default", info.name)
+                if info.state != "DEAD":
+                    self._actor_names[key] = bytes(info.actor_id)
+                elif self._actor_names.get(key) == bytes(info.actor_id):
+                    del self._actor_names[key]
+        elif kind == "pg":
+            info = pb.PlacementGroupInfo()
+            info.ParseFromString(rec[2])
+            self._pgroups[bytes(rec[1])] = info
+        elif kind == "loc":
+            _, oid, node_id, added, size = rec
+            if added:
+                self._locations[oid].add(node_id)
+                if size:
+                    self._object_sizes[oid] = size
+            else:
+                self._locations[oid].discard(node_id)
+        elif kind == "locs":
+            for sub in rec[1]:
+                self._apply_wal_record(("loc",) + tuple(sub))
+        elif kind == "refs":
+            for oid, holder, count in rec[1]:
+                holders = self._refcounts.get(oid)
+                if count <= 0:
+                    if holders is not None:
+                        holders.pop(holder, None)
+                        if not holders:
+                            del self._refcounts[oid]
+                else:
+                    if holders is None:
+                        holders = self._refcounts[oid] = {}
+                    holders[holder] = count
+        elif kind == "holder":
+            _, hid, nid, is_drv = rec
+            self._holder_meta[hid] = (nid, is_drv, time.monotonic())
+        elif kind == "rmholder":
+            for hid in rec[1]:
+                self._holder_meta.pop(hid, None)
+            hset = set(rec[1])
+            for oid in list(self._refcounts):
+                holders = self._refcounts[oid]
+                for hid in hset & holders.keys():
+                    del holders[hid]
+                if not holders:
+                    del self._refcounts[oid]
+        elif kind == "freed":
+            now = time.monotonic()
+            for oid in rec[1]:
+                self._freed[oid] = now
+                self._locations.pop(oid, None)
+                self._object_sizes.pop(oid, None)
+        else:
+            logger.warning("unknown WAL record kind %r", kind)
+
+    def _finish_restore(self):
         # Actors mid-creation at crash time (PENDING/RESTARTING) would hang
         # their clients forever: nothing re-submits them after a restart
         # (the reference GCS reconstructs and reschedules pending actors).
@@ -392,7 +453,9 @@ class GcsServer:
             if not request.overwrite and key in self._kv:
                 return pb.KvReply(ok=False)
             self._kv[key] = request.value
-        self._mark_dirty()
+            # Inside the lock: the log order must match the apply order,
+            # or replay can restore the losing value of a write race.
+            self._wal_append(("kv", request.ns, request.key, request.value))
         return pb.KvReply(ok=True)
 
     def KvGet(self, request, context):
@@ -434,7 +497,8 @@ class GcsServer:
     def KvDel(self, request, context):
         with self._lock:
             existed = self._kv.pop((request.ns, request.key), None) is not None
-        self._mark_dirty()
+            if existed:
+                self._wal_append(("kv", request.ns, request.key, None))
         return pb.KvReply(ok=existed)
 
     def KvKeys(self, request, context):
@@ -457,7 +521,7 @@ class GcsServer:
                         error=f"Actor name {info.name!r} already taken")
                 self._actor_names[key] = info.actor_id
             self._actors[info.actor_id] = info
-        self._mark_dirty()
+            self._wal_append(("actor", info.SerializeToString()))
         self._export_event("ACTOR_REGISTERED", actor_id=info.actor_id.hex(),
                            class_name=info.class_name, name=info.name)
         self._publish("ACTOR", info.SerializeToString())
@@ -485,7 +549,7 @@ class GcsServer:
                 key = (info.namespace or "default", info.name)
                 if self._actor_names.get(key) == info.actor_id:
                     del self._actor_names[key]
-        self._mark_dirty()
+            self._wal_append(("actor", info.SerializeToString()))
         self._export_event("ACTOR_STATE", actor_id=info.actor_id.hex(),
                            state=info.state, node_id=info.node_id,
                            num_restarts=info.num_restarts,
@@ -543,8 +607,9 @@ class GcsServer:
                     b.node_id = ""
                 info.state = "RESCHEDULING"
                 to_replace.append(info)
+                self._wal_append(("pg", bytes(info.group_id),
+                                  info.SerializeToString()))
         for info in to_replace:
-            self._mark_dirty()
             self._publish("PLACEMENT_GROUP", info.SerializeToString())
             self._submit_place(info)
         with self._lock:
@@ -554,6 +619,7 @@ class GcsServer:
             if info.num_restarts < info.max_restarts or info.max_restarts < 0:
                 info.num_restarts += 1
                 info.state = "RESTARTING"
+                self._wal_append(("actor", info.SerializeToString()))
                 self._publish("ACTOR", info.SerializeToString())
                 self._work_pool.submit(self._restart_actor, info)
             else:
@@ -754,7 +820,8 @@ class GcsServer:
             state="PENDING")
         with self._lock:
             self._pgroups[request.group_id] = info
-        self._mark_dirty()
+            self._wal_append(("pg", bytes(request.group_id),
+                              info.SerializeToString()))
         self._export_event("PLACEMENT_GROUP_CREATED",
                            group_id=request.group_id.hex(),
                            name=request.name, strategy=request.strategy,
@@ -894,7 +961,9 @@ class GcsServer:
             if len(committed) < len(by_node):
                 time.sleep(0.2)
                 continue
-            self._mark_dirty()
+            with self._lock:  # append ordered against RemovePlacementGroup
+                self._wal_append(("pg", bytes(info.group_id),
+                                  info.SerializeToString()))
             self._publish("PLACEMENT_GROUP", info.SerializeToString())
             return
         with self._lock:
@@ -902,7 +971,8 @@ class GcsServer:
                 return
             done = all(b.node_id for b in info.bundles)
             info.state = "CREATED" if done else "INFEASIBLE"
-        self._mark_dirty()
+            self._wal_append(("pg", bytes(info.group_id),
+                              info.SerializeToString()))
         self._publish("PLACEMENT_GROUP", info.SerializeToString())
 
     def GetPlacementGroup(self, request, context):
@@ -919,7 +989,8 @@ class GcsServer:
                 return pb.Empty()
             info.state = "REMOVED"
             nodes = {b.node_id for b in info.bundles if b.node_id}
-        self._mark_dirty()
+            self._wal_append(("pg", bytes(request.group_id),
+                              info.SerializeToString()))
         for node_id in nodes:
             stub = self._node_stub(node_id)
             if stub:
@@ -953,13 +1024,15 @@ class GcsServer:
     def UpdateObjectLocation(self, request, context):
         with self._lock:
             sweep_addr = self._apply_loc_update(request)
+            if sweep_addr is None:
+                self._wal_append(("loc", request.object_id, request.node_id,
+                                  request.added, request.size))
         if sweep_addr:
             oid = request.object_id
             self._work_pool.submit(
                 lambda: rpc.get_stub("NodeService", sweep_addr).FreeObjects(
                     pb.FreeObjectsRequest(object_ids=[oid])))
             return pb.Empty()
-        self._mark_dirty()
         if request.added:
             # Wake blocked get()/wait() callers (object-location pubsub,
             # reference: pubsub/publisher.h:297 object channel).
@@ -972,19 +1045,23 @@ class GcsServer:
         subscriber in every process per 1KB object)."""
         sweeps: Dict[str, List[bytes]] = {}
         added = False
+        applied = []
         with self._lock:
             for u in request.updates:
                 addr = self._apply_loc_update(u)
                 if addr:
                     sweeps.setdefault(addr, []).append(u.object_id)
-                elif u.added:
-                    added = True
+                else:
+                    applied.append((u.object_id, u.node_id, u.added, u.size))
+                    if u.added:
+                        added = True
+            if applied:
+                self._wal_append(("locs", applied))
         for addr, oids in sweeps.items():
             self._work_pool.submit(
                 lambda a=addr, o=oids: rpc.get_stub(
                     "NodeService", a).FreeObjects(
                     pb.FreeObjectsRequest(object_ids=o)))
-        self._mark_dirty()
         if added:
             self._publish("OBJECT_LOC", b"")
         return pb.Empty()
@@ -1009,6 +1086,7 @@ class GcsServer:
     def UpdateRefCounts(self, request, context):
         to_free: List[bytes] = []
         late_after_free: List[bytes] = []
+        changes: List[Tuple[bytes, str, int]] = []
         with self._lock:
             if request.holder_id:
                 self._holder_meta[request.holder_id] = (
@@ -1034,14 +1112,19 @@ class GcsServer:
                     holders.pop(request.holder_id, None)
                 else:
                     holders[request.holder_id] = n
+                # WAL records carry the ABSOLUTE count (idempotent upsert).
+                changes.append((d.object_id, request.holder_id, max(n, 0)))
                 if not holders:
                     del self._refcounts[d.object_id]
                     to_free.append(d.object_id)
-        if request.deltas:
             # Ping-only flushes (holder keep-alives every 2s) change no
-            # persisted state; marking dirty would rewrite the snapshot
-            # continuously on an idle cluster.
-            self._mark_dirty()
+            # persisted state and append nothing. Appends stay inside the
+            # lock so log order matches apply order.
+            if changes and request.holder_id:
+                self._wal_append(("holder", request.holder_id,
+                                  request.node_id, request.is_driver))
+            if changes:
+                self._wal_append(("refs", changes))
         self._schedule_free(to_free)
         for oid in late_after_free:
             self._publish("OBJECT_FREED", oid)
@@ -1066,6 +1149,7 @@ class GcsServer:
                 if not holders:
                     del self._refcounts[oid]
                     to_free.append(oid)
+            self._wal_append(("rmholder", list(holder_ids)))
         if to_free:
             logger.info("reaped %d holder(s): freeing %d orphaned objects",
                         len(holder_ids), len(to_free))
@@ -1103,9 +1187,10 @@ class GcsServer:
                 self._object_sizes.pop(oid, None)
             while len(self._freed) > MAX_FREED_REMEMBERED:
                 self._freed.pop(next(iter(self._freed)))
+            if survivors:
+                self._wal_append(("freed", survivors))
         if not survivors:
             return
-        self._mark_dirty()
         for node_id, node_oids in by_node.items():
             stub = self._node_stub(node_id)
             if stub is None:
@@ -1122,9 +1207,9 @@ class GcsServer:
     def shutdown(self):
         self._stop.set()
         self._work_pool.shutdown(wait=False)
-        if self._persist_path and self._dirty.is_set():
+        if self._wal is not None:
             try:
-                self._write_snapshot()
+                self._wal.close()  # flush + final compaction
             except Exception:  # noqa: BLE001
                 pass
         self._server.stop(grace=0.2)

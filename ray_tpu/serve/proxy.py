@@ -149,7 +149,12 @@ class AsyncHttpProxy:
             # the request with an empty body would desync the keep-alive
             # loop (the body bytes would parse as the next request line).
             return 501, "chunked request bodies are not supported"
-        length = int(headers.get("content-length", 0) or 0)
+        try:
+            length = int(headers.get("content-length", 0) or 0)
+        except ValueError:
+            return 400, "malformed Content-Length"
+        if length < 0:
+            return 400, "malformed Content-Length"
         if length > _MAX_BODY:
             return 413, "request body too large"
         body = await reader.readexactly(length) if length else b""
@@ -352,17 +357,24 @@ class GrpcProxy:
             return pb.ServeReply(ok=False, error=str(e))
 
     def PredictStream(self, request, context):
+        import grpc as _grpc
+
         from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
         try:
             payload = json.loads(request.payload) if request.payload else {}
-            for item in self.router.stream(
-                    request.deployment, request.method or None, payload,
-                    request.multiplexed_model_id):
+            items = self.router.stream(
+                request.deployment, request.method or None, payload,
+                request.multiplexed_model_id)
+            for item in items:
                 yield pb.ServeReply(ok=True,
                                     payload=json.dumps(item).encode())
         except Exception as e:  # noqa: BLE001
-            yield pb.ServeReply(ok=False, error=str(e))
+            # Terminate with an RPC error, NOT a trailing ok=False item:
+            # consumers filtering on ok would read a truncated stream as a
+            # successful short one (the HTTP plane aborts the connection
+            # for the same reason).
+            context.abort(_grpc.StatusCode.INTERNAL, str(e))
 
     def stop(self):
         self._server.stop(grace=0.5)

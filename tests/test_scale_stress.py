@@ -1,0 +1,200 @@
+"""Control-plane scale stress (reference: release/benchmarks — many_nodes,
+many_actors, many_tasks — shrunk to CI scale but exercising the same
+tables, schedulers, and persistence paths at 10-100x the rest of the
+suite's counts).
+
+Virtual nodes register directly with the GCS (no worker processes — the
+point is control-plane load, reference fake_multi_node); the task stress
+runs against a real node manager.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+
+def _start_gcs(tmp_path):
+    env = dict(os.environ, PYTHONPATH=os.pathsep.join(
+        [os.getcwd()] + sys.path),
+        RAY_TPU_GCS_PERSIST_PATH=str(tmp_path / "gcs.snap"))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.gcs.server", "--port", "0"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env,
+        text=True)
+    while True:
+        line = proc.stdout.readline().strip()
+        if line.startswith("GCS_PORT="):
+            return proc, f"127.0.0.1:{int(line.split('=', 1)[1])}"
+
+
+def _fresh_stub(address):
+    rpc.drop_stub("GcsService", address)
+    return rpc.get_stub("GcsService", address)
+
+
+NUM_VIRTUAL_NODES = 100
+NUM_ACTORS = 1000
+NUM_OBJECTS = 5000
+
+
+def test_control_plane_scale_and_wal_replay_under_load(tmp_path):
+    """100 virtual nodes + 1k actors + 5k objects of directory/refcount
+    state, then a hard GCS kill (no graceful compaction) and restart:
+    the WAL must replay everything."""
+    proc, address = _start_gcs(tmp_path)
+    gcs = _fresh_stub(address)
+    try:
+        t0 = time.monotonic()
+        for i in range(NUM_VIRTUAL_NODES):
+            info = pb.NodeInfo(node_id=f"{i:032x}",
+                               address=f"127.0.0.1:{20000 + i}", alive=True)
+            info.resources["CPU"] = 8.0
+            info.available["CPU"] = 8.0
+            gcs.RegisterNode(pb.RegisterNodeRequest(info=info))
+        nodes = gcs.GetNodes(pb.GetNodesRequest()).nodes
+        assert sum(1 for n in nodes if n.alive) == NUM_VIRTUAL_NODES
+        print(f"registered {NUM_VIRTUAL_NODES} nodes in "
+              f"{time.monotonic() - t0:.1f}s")
+
+        t0 = time.monotonic()
+        for i in range(NUM_ACTORS):
+            info = pb.ActorInfo(
+                actor_id=i.to_bytes(16, "big"), class_name="Stress",
+                name=f"actor-{i}" if i % 10 == 0 else "",
+                namespace="stress", state="ALIVE",
+                node_id=f"{i % NUM_VIRTUAL_NODES:032x}",
+                address="127.0.0.1:1")
+            gcs.UpdateActor(pb.UpdateActorRequest(info=info))
+        listed = gcs.ListActors(pb.ListActorsRequest(
+            namespace="stress")).actors
+        assert len(listed) == NUM_ACTORS
+        print(f"registered {NUM_ACTORS} actors in "
+              f"{time.monotonic() - t0:.1f}s")
+
+        t0 = time.monotonic()
+        batch = pb.ObjectLocationBatch()
+        for i in range(NUM_OBJECTS):
+            batch.updates.append(pb.ObjectLocationUpdate(
+                object_id=i.to_bytes(28, "big"),
+                node_id=f"{i % NUM_VIRTUAL_NODES:032x}",
+                added=True, size=1024))
+            if len(batch.updates) == 500:
+                gcs.UpdateObjectLocationsBatch(batch)
+                batch = pb.ObjectLocationBatch()
+        if batch.updates:
+            gcs.UpdateObjectLocationsBatch(batch)
+        req = pb.UpdateRefCountsRequest(holder_id="stress-driver",
+                                        node_id="", is_driver=True)
+        for i in range(NUM_OBJECTS):
+            req.deltas.append(pb.RefCountDelta(
+                object_id=i.to_bytes(28, "big"), delta=1))
+        gcs.UpdateRefCounts(req)
+        for i in range(200):  # kv churn
+            gcs.KvPut(pb.KvRequest(ns="stress", key=f"k{i}",
+                                   value=b"v" * 100, overwrite=True))
+        print(f"directory/refs/kv load in {time.monotonic() - t0:.1f}s")
+        time.sleep(1.0)  # let the WAL writer drain its queue
+
+        # Hard kill: no graceful shutdown, no final compaction — recovery
+        # must come from snapshot + WAL replay alone.
+        proc.kill()
+        proc.wait(timeout=10)
+
+        proc, address = _start_gcs(tmp_path)
+        gcs = _fresh_stub(address)
+        t0 = time.monotonic()
+        listed = gcs.ListActors(pb.ListActorsRequest(
+            namespace="stress")).actors
+        assert len(listed) == NUM_ACTORS, \
+            f"only {len(listed)} actors survived restart"
+        found = gcs.GetActor(pb.GetActorRequest(
+            name="actor-500", namespace="stress"))
+        assert found.found and found.info.state == "ALIVE"
+        locs = gcs.GetObjectLocations(pb.GetObjectLocationsRequest(
+            object_id=(42).to_bytes(28, "big")))
+        assert list(locs.node_ids) == [f"{42 % NUM_VIRTUAL_NODES:032x}"]
+        kv = gcs.KvGet(pb.KvRequest(ns="stress", key="k7"))
+        assert kv.found and kv.value == b"v" * 100
+        mem = gcs.KvGet(pb.KvRequest(ns="__memory__", key=""))
+        import pickle
+
+        report = pickle.loads(mem.value)
+        assert report["num_tracked"] == NUM_OBJECTS
+        print(f"restart + verify in {time.monotonic() - t0:.1f}s")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+def test_many_queued_tasks(tmp_path):
+    """10k no-op tasks queued at once drain correctly (reference:
+    many_tasks benchmark — 10k+ simultaneous tasks)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(head_node_args={"num_cpus": 4})
+    try:
+        ray_tpu.init(address=c.address)
+
+        @ray_tpu.remote(num_cpus=0)
+        def nop(i):
+            return i
+
+        n = 10_000
+        t0 = time.monotonic()
+        refs = [nop.remote(i) for i in range(n)]
+        submit_s = time.monotonic() - t0
+        out = ray_tpu.get(refs, timeout=600)
+        total_s = time.monotonic() - t0
+        assert out == list(range(n))
+        print(f"submitted {n} in {submit_s:.1f}s; drained in {total_s:.1f}s "
+              f"({n / total_s:.0f} tasks/s)")
+        assert total_s < 120, "10k tasks took too long"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_many_placement_groups(tmp_path):
+    """Hundreds of placement groups create, place, and remove cleanly
+    (reference: placement_group stress in release/nightly_tests)."""
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(head_node_args={"num_cpus": 300})
+    try:
+        ray_tpu.init(address=c.address)
+        from ray_tpu.util.placement_group import (placement_group,
+                                                  remove_placement_group)
+
+        n = 300
+        t0 = time.monotonic()
+        pgs = [placement_group([{"CPU": 1}]) for _ in range(n)]
+        for pg in pgs:
+            ray_tpu.get(pg.ready(), timeout=300)
+        create_s = time.monotonic() - t0
+        avail = ray_tpu.available_resources().get("CPU", 0)
+        assert avail == 0.0, f"expected all CPU reserved, {avail} free"
+        t0 = time.monotonic()
+        for pg in pgs:
+            remove_placement_group(pg)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if ray_tpu.available_resources().get("CPU", 0) == 300.0:
+                break
+            time.sleep(0.5)
+        assert ray_tpu.available_resources().get("CPU", 0) == 300.0
+        print(f"created {n} PGs in {create_s:.1f}s; removed in "
+              f"{time.monotonic() - t0:.1f}s")
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
