@@ -1004,8 +1004,12 @@ class GcsServer:
 
     # ------------------------------------------------------ object directory
     def _apply_loc_update(self, request):
-        """Apply one location update (caller holds ``self._lock``). Returns
-        the address to sweep when the object was already freed."""
+        """Apply one location update (caller holds ``self._lock``).
+        Returns ``(applied, sweep_addr)``: ``applied`` False means the
+        state was deliberately untouched (freed object — WAL-logging the
+        update would resurrect the location on replay), and
+        ``sweep_addr`` names the node whose late-stored copy needs
+        sweeping (when known)."""
         if request.added:
             if request.object_id in self._freed:
                 # A late registration (e.g. an async put flush) for an
@@ -1013,25 +1017,31 @@ class GcsServer:
                 # just-stored copy needs sweeping, since the free
                 # broadcast preceded it.
                 node = self._nodes.get(request.node_id)
-                return getattr(node, "address", None) if node else None
+                return False, (getattr(node, "address", None)
+                               if node else None)
             self._locations[request.object_id].add(request.node_id)
             if request.size:
                 self._object_sizes[request.object_id] = request.size
         else:
             self._locations[request.object_id].discard(request.node_id)
-        return None
+        return True, None
 
     def UpdateObjectLocation(self, request, context):
         with self._lock:
-            sweep_addr = self._apply_loc_update(request)
-            if sweep_addr is None:
+            applied, sweep_addr = self._apply_loc_update(request)
+            if applied:
                 self._wal_append(("loc", request.object_id, request.node_id,
                                   request.added, request.size))
-        if sweep_addr:
-            oid = request.object_id
-            self._work_pool.submit(
-                lambda: rpc.get_stub("NodeService", sweep_addr).FreeObjects(
-                    pb.FreeObjectsRequest(object_ids=[oid])))
+        if not applied:
+            # Freed object: state untouched (and NOT WAL-logged — a
+            # replayed loc-add would resurrect the freed location);
+            # sweep the late-stored copy when its node is known.
+            if sweep_addr:
+                oid = request.object_id
+                self._work_pool.submit(
+                    lambda: rpc.get_stub(
+                        "NodeService", sweep_addr).FreeObjects(
+                        pb.FreeObjectsRequest(object_ids=[oid])))
             return pb.Empty()
         if request.added:
             # Wake blocked get()/wait() callers (object-location pubsub,
@@ -1048,13 +1058,13 @@ class GcsServer:
         applied = []
         with self._lock:
             for u in request.updates:
-                addr = self._apply_loc_update(u)
-                if addr:
-                    sweeps.setdefault(addr, []).append(u.object_id)
-                else:
+                ok, addr = self._apply_loc_update(u)
+                if ok:
                     applied.append((u.object_id, u.node_id, u.added, u.size))
                     if u.added:
                         added = True
+                elif addr:
+                    sweeps.setdefault(addr, []).append(u.object_id)
             if applied:
                 self._wal_append(("locs", applied))
         for addr, oids in sweeps.items():

@@ -19,18 +19,27 @@ from ray_tpu.rllib.env_runner import (
     TrajectoryEnvRunner,
     TransitionEnvRunner,
 )
+from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.learner_group import LearnerGroup
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentEnv,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.sac import SAC, SACConfig
 from ray_tpu.rllib.ppo import PPO, PPOConfig
 
 __all__ = [
     "ContinuousEnvRunner", "DQN", "DQNConfig", "DQNLearner", "DQNModule",
-    "EnvRunnerGroup", "IMPALA", "IMPALAConfig", "ImpalaLearner",
-    "LearnerGroup", "PPO", "PPOConfig", "PPOLearner", "PPOModule",
-    "ReplayBuffer", "SAC", "SACConfig", "SACLearner", "SACModule",
-    "SampleBatch", "SingleAgentEnvRunner", "TrajectoryEnvRunner",
-    "Transition", "TransitionEnvRunner", "compute_gae", "vtrace",
+    "EnvRunnerGroup", "FaultTolerantActorManager", "IMPALA", "IMPALAConfig",
+    "ImpalaLearner", "LearnerGroup", "MultiAgentEnv", "MultiAgentEnvRunner",
+    "MultiAgentPPO", "MultiAgentPPOConfig", "PPO", "PPOConfig", "PPOLearner",
+    "PPOModule", "ReplayBuffer", "SAC", "SACConfig", "SACLearner",
+    "SACModule", "SampleBatch", "SingleAgentEnvRunner",
+    "TrajectoryEnvRunner", "Transition", "TransitionEnvRunner",
+    "compute_gae", "vtrace",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

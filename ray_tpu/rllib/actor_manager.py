@@ -1,0 +1,101 @@
+"""Fault-tolerant actor manager for RLlib worker fleets.
+
+Reference: ``rllib/utils/actor_manager.py:198`` (FaultTolerantActorManager)
+— async ``foreach`` over a fleet of actors where failures mark the actor
+unhealthy, the fleet restarts it, and (optionally) the failed call is
+retried on the replacement so an iteration keeps its full shard count
+instead of silently shrinking.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, List, Optional, Tuple
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+class FaultTolerantActorManager:
+    """Owns a fleet of same-class actors created by ``factory(index)``.
+
+    ``foreach`` fans a method call across the fleet and gathers results;
+    an actor whose call fails is replaced via the factory, and with
+    ``retry_on_replacement`` the call re-runs on the replacement (after
+    ``on_replace`` re-initializes it, e.g. re-syncing weights) so the
+    caller still receives one result per slot.
+    """
+
+    def __init__(self, factory: Callable[[int], Any], num_actors: int,
+                 on_replace: Optional[Callable[[Any], None]] = None):
+        self._factory = factory
+        self._on_replace = on_replace
+        self._next_index = num_actors
+        self.actors: List[Any] = [factory(i) for i in range(num_actors)]
+        self.num_replacements = 0
+
+    def __len__(self) -> int:
+        return len(self.actors)
+
+    def _replace(self, slot: int):
+        self._next_index += 1
+        self.num_replacements += 1
+        actor = self._factory(self._next_index)
+        self.actors[slot] = actor
+        if self._on_replace is not None:
+            try:
+                self._on_replace(actor)
+            except Exception:  # noqa: BLE001
+                logger.exception("on_replace failed for slot %d", slot)
+        return actor
+
+    def foreach(self, method: str, *args, timeout_s: float = 300.0,
+                retry_on_replacement: bool = True,
+                **kwargs) -> List[Tuple[int, Any]]:
+        """Call ``method(*args, **kwargs)`` on every actor concurrently.
+
+        Returns ``[(slot, result), ...]`` for every slot that produced a
+        result. A failed call replaces the actor; with retry the call
+        re-runs ONCE on the replacement (a second failure drops the slot
+        from this round — deterministic failures must not loop forever).
+        """
+        refs = [(slot, getattr(a, method).remote(*args, **kwargs))
+                for slot, a in enumerate(self.actors)]
+        results: List[Tuple[int, Any]] = []
+        retry: List[int] = []
+        for slot, ref in refs:
+            try:
+                results.append((slot, ray_tpu.get(ref, timeout=timeout_s)))
+            except Exception as e:  # noqa: BLE001
+                logger.warning("actor slot %d failed %s: %s; replacing",
+                               slot, method, e)
+                self._replace(slot)
+                if retry_on_replacement:
+                    retry.append(slot)
+        for slot in retry:
+            try:
+                ref = getattr(self.actors[slot], method).remote(*args,
+                                                                **kwargs)
+                results.append((slot, ray_tpu.get(ref, timeout=timeout_s)))
+            except Exception as e:  # noqa: BLE001
+                logger.warning("replacement for slot %d also failed %s: %s",
+                               slot, method, e)
+                self._replace(slot)
+        results.sort(key=lambda t: t[0])
+        return results
+
+    def healthy_count(self, timeout_s: float = 10.0) -> int:
+        alive = 0
+        probes = [(slot, a.ping.remote()) for slot, a in
+                  enumerate(self.actors)]
+        for slot, ref in probes:
+            try:
+                ray_tpu.get(ref, timeout=timeout_s)
+                alive += 1
+            except Exception:  # noqa: BLE001
+                self._replace(slot)
+        return alive
+
+
+__all__ = ["FaultTolerantActorManager"]

@@ -95,35 +95,45 @@ class SingleAgentEnvRunner:
 
 
 class EnvRunnerGroup:
-    """Fault-tolerant group of env-runner actors."""
+    """Fault-tolerant group of env-runner actors, built on the shared
+    FaultTolerantActorManager (reference: EnvRunnerGroup over
+    ``utils/actor_manager.py:198``): a runner dying mid-iteration is
+    replaced, re-synced with the last broadcast weights, and re-sampled —
+    the iteration keeps its full shard count."""
 
     def __init__(self, env_creator, module_spec, num_runners: int,
                  num_envs_per_runner: int, gamma: float, lam: float):
-        self._make = lambda seed: ray_tpu.remote(
-            SingleAgentEnvRunner).remote(
-            env_creator, module_spec, num_envs_per_runner, seed, gamma, lam)
-        self.runners = [self._make(i) for i in range(num_runners)]
-        self._seed = num_runners
+        from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+
+        self._weights = None
+
+        def factory(seed: int):
+            return ray_tpu.remote(SingleAgentEnvRunner).remote(
+                env_creator, module_spec, num_envs_per_runner, seed,
+                gamma, lam)
+
+        def on_replace(actor):
+            if self._weights is not None:
+                ray_tpu.get(actor.set_weights.remote(self._weights),
+                            timeout=120)
+
+        self._mgr = FaultTolerantActorManager(factory, num_runners,
+                                              on_replace=on_replace)
+
+    @property
+    def runners(self):
+        return self._mgr.actors
 
     def sync_weights(self, weights):
-        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners],
-                    timeout=120)
+        self._weights = weights
+        self._mgr.foreach("set_weights", weights, timeout_s=120)
 
     def sample(self, num_steps: int):
-        """Gather from all runners; drop+respawn dead ones (reference:
-        FaultTolerantActorManager.foreach with restarts)."""
-        refs = [(r, r.sample.remote(num_steps)) for r in self.runners]
-        batches, episode_returns, alive = [], [], []
-        for runner, ref in refs:
-            try:
-                batch, finished = ray_tpu.get(ref, timeout=300)
-                batches.append(batch)
-                episode_returns.extend(finished)
-                alive.append(runner)
-            except Exception:  # noqa: BLE001
-                self._seed += 1
-                alive.append(self._make(self._seed))
-        self.runners = alive
+        results = self._mgr.foreach("sample", num_steps)
+        batches, episode_returns = [], []
+        for _, (batch, finished) in results:
+            batches.append(batch)
+            episode_returns.extend(finished)
         return batches, episode_returns
 
 

@@ -32,6 +32,9 @@ def _start_gcs(tmp_path):
         line = proc.stdout.readline().strip()
         if line.startswith("GCS_PORT="):
             return proc, f"127.0.0.1:{int(line.split('=', 1)[1])}"
+        if not line and proc.poll() is not None:
+            raise RuntimeError(
+                f"GCS subprocess died at startup (rc={proc.returncode})")
 
 
 def _fresh_stub(address):
