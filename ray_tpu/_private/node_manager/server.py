@@ -434,8 +434,13 @@ class NodeManager:
             renv = pickle.loads(bytes(runtime_env_blob))
         except Exception:  # noqa: BLE001
             return
-        if not (renv.get("pip") or renv.get("working_dir")
-                or renv.get("py_modules")):
+        # Ask the plugin registry which fields need building rather than
+        # hardcoding them — a new plugin (conda's long builds most of all)
+        # must be prewarmable without touching this gate.
+        from ray_tpu._private.runtime_env import plugin as plugin_mod
+
+        if not any(p.prewarmable and renv.get(p.name)
+                   for p in plugin_mod.plugins_for(renv)):
             return  # env_vars-only: nothing to build, no thread to spawn
         if not self._agent_port:
             if len(self._pending_prewarm) < 16:

@@ -32,25 +32,14 @@ logger = logging.getLogger(__name__)
 
 
 def prepare(renv: Dict[str, Any], kv_stub) -> Dict[str, Any]:
-    """Upload local directories referenced by ``renv`` and return a copy
-    whose ``working_dir``/``py_modules`` entries are ``pkg://`` URIs any
-    node can materialize. Non-directory entries pass through untouched."""
+    """Driver-side prepare: each field's plugin uploads/validates its
+    value (e.g. local directories become ``pkg://`` URIs any node can
+    materialize). Fields without a plugin pass through with a warning."""
+    from ray_tpu._private.runtime_env import plugin as plugin_mod
+
     out = dict(renv)
-    wd = out.get("working_dir")
-    if wd and not packaging.is_uri(wd) and os.path.isdir(wd):
-        out["working_dir"] = packaging.upload_directory(wd, kv_stub)
-    mods = out.get("py_modules")
-    if mods:
-        # A py_modules entry is itself the importable module/package, so it
-        # nests under its own name in the zip (reference py_modules
-        # semantics: ``import <basename>`` works on the worker).
-        out["py_modules"] = [
-            packaging.upload_directory(
-                m, kv_stub,
-                prefix=os.path.basename(os.path.normpath(m)))
-            if not packaging.is_uri(m) and os.path.isdir(m) else m
-            for m in mods
-        ]
+    for p in plugin_mod.plugins_for(renv):
+        out[p.name] = p.prepare(renv[p.name], kv_stub)
     return out
 
 
@@ -76,40 +65,33 @@ def _purge_shadowed_modules(path: str) -> None:
 
 
 def apply(renv: Dict[str, Any], kv_stub):
-    """Activate a prepared runtime_env in the current process: set env
-    vars, chdir into the working_dir, put py_modules and the pip env's
-    site-packages on ``sys.path``. Returns a zero-arg restore callable
-    that undoes the process-level mutations (cwd, sys.path, env vars) —
-    task workers call it after the task so a reused worker doesn't leak
-    one task's environment into the next (the reference instead dedicates
+    """Activate a prepared runtime_env in the current process: each
+    field's plugin materializes into an :class:`EnvContext` (paths to
+    prepend, env vars, cwd), which is then applied. Returns a zero-arg
+    restore callable that undoes the process-level mutations — task
+    workers call it after the task so a reused worker doesn't leak one
+    task's environment into the next (the reference instead dedicates
     workers per env; actors here keep their env for life and skip
     restore)."""
+    from ray_tpu._private.runtime_env import plugin as plugin_mod
+
+    ctx = plugin_mod.EnvContext()
+    for p in plugin_mod.plugins_for(renv):
+        p.apply(renv[p.name], kv_stub, ctx)
+
     saved_env: Dict[str, Any] = {}
     added_paths: list = []
     old_cwd = os.getcwd()
-
-    def _add_path(p: str) -> None:
+    for k, v in ctx.env_vars.items():
+        saved_env[k] = os.environ.get(k)
+        os.environ[k] = str(v)
+    if ctx.cwd:
+        os.chdir(ctx.cwd)
+    for p in ctx.paths:
         if p not in sys.path:
             sys.path.insert(0, p)
             added_paths.append(p)
         _purge_shadowed_modules(p)
-
-    for k, v in (renv.get("env_vars") or {}).items():
-        saved_env[k] = os.environ.get(k)
-        os.environ[k] = str(v)
-    wd = renv.get("working_dir")
-    if wd:
-        if packaging.is_uri(wd):
-            wd = packaging.ensure_local(wd, kv_stub)
-        os.chdir(wd)
-        _add_path(wd)
-    for mod in renv.get("py_modules") or []:
-        path = packaging.ensure_local(mod, kv_stub) \
-            if packaging.is_uri(mod) else mod
-        _add_path(path)
-    pip_specs = renv.get("pip")
-    if pip_specs:
-        _add_path(pip_env.ensure_pip_env(list(pip_specs)))
 
     def restore() -> None:
         try:

@@ -1,0 +1,134 @@
+"""Conda environment materialization for runtime_env["conda"].
+
+Reference: ``python/ray/_private/runtime_env/conda.py`` — environments
+build once per content hash into a shared per-node cache and are reused
+across workers; a spec may be inline YAML content (dict), a path to an
+environment.yml, or the name of a pre-built env (resolved through
+``conda env list``).
+
+``RAY_TPU_CONDA_EXE`` overrides the conda binary (also how tests inject
+a stub builder without a real conda installation).
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import logging
+import os
+import shutil
+import subprocess
+import threading
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+
+
+def _cache_root() -> str:
+    return os.environ.get(
+        "RAY_TPU_CONDA_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "ray_tpu", "conda"))
+
+
+def _conda_exe() -> str:
+    exe = os.environ.get("RAY_TPU_CONDA_EXE") or shutil.which("conda") \
+        or shutil.which("mamba") or shutil.which("micromamba")
+    if not exe:
+        raise RuntimeError(
+            "runtime_env['conda'] requires a conda/mamba binary on PATH "
+            "(or RAY_TPU_CONDA_EXE)")
+    return exe
+
+
+def ensure_conda_env(spec: Any) -> str:
+    """Materialize the env for ``spec``; returns the env prefix path."""
+    if isinstance(spec, str) and not spec.endswith((".yml", ".yaml")):
+        return _named_env_prefix(spec)
+    if isinstance(spec, str):
+        with open(spec) as f:
+            content = f.read()
+    else:
+        content = json.dumps(spec, sort_keys=True)
+    digest = hashlib.sha1(content.encode()).hexdigest()[:16]
+    prefix = os.path.join(_cache_root(), digest)
+    marker = os.path.join(prefix, ".ray_tpu_ready")
+    os.makedirs(_cache_root(), exist_ok=True)
+    # The cache is shared ACROSS worker processes on a node: an OS file
+    # lock (not just the in-process lock) serializes builders, or two
+    # workers would `conda env create` into the same prefix (reference:
+    # conda.py uses file locks for the same reason).
+    import fcntl
+
+    with _lock, open(os.path.join(_cache_root(),
+                                  f"{digest}.lock"), "w") as lockf:
+        fcntl.flock(lockf, fcntl.LOCK_EX)
+        if os.path.exists(marker):
+            return prefix
+        if os.path.exists(prefix):
+            # A crashed/failed earlier build left a partial prefix with no
+            # marker: clear it or every retry fails on the existing dir.
+            shutil.rmtree(prefix, ignore_errors=True)
+        yml = os.path.join(_cache_root(), f"{digest}.yml")
+        if isinstance(spec, str):
+            shutil.copyfile(spec, yml)
+        else:
+            _write_env_yaml(spec, yml)
+        exe = _conda_exe()
+        logger.info("building conda env %s (this happens once per spec)",
+                    digest)
+        try:
+            subprocess.run([exe, "env", "create", "--yes", "-p", prefix,
+                            "-f", yml],
+                           check=True, capture_output=True, timeout=1800)
+        except BaseException:
+            shutil.rmtree(prefix, ignore_errors=True)
+            raise
+        with open(marker, "w") as f:
+            f.write("ok")
+        return prefix
+
+
+def _write_env_yaml(spec: dict, path: str) -> None:
+    """Minimal YAML emitter for the environment.yml subset conda reads
+    (name/channels/dependencies with one level of pip nesting)."""
+    lines = []
+    for key in ("name", "channels", "dependencies"):
+        value = spec.get(key)
+        if value is None:
+            continue
+        if isinstance(value, str):
+            lines.append(f"{key}: {value}")
+            continue
+        lines.append(f"{key}:")
+        for item in value:
+            if isinstance(item, dict):  # {"pip": [...]}
+                for sub_key, sub_items in item.items():
+                    lines.append(f"  - {sub_key}:")
+                    lines.extend(f"    - {s}" for s in sub_items)
+            else:
+                lines.append(f"  - {item}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def _named_env_prefix(name: str) -> str:
+    """Resolve a pre-existing named env through ``conda env list``."""
+    exe = _conda_exe()
+    out = subprocess.run([exe, "env", "list", "--json"], check=True,
+                         capture_output=True, timeout=60, text=True)
+    for prefix in json.loads(out.stdout).get("envs", []):
+        if os.path.basename(prefix) == name:
+            return prefix
+    raise RuntimeError(f"conda env {name!r} not found")
+
+
+def site_packages_of(prefix: str) -> Optional[str]:
+    hits = glob.glob(os.path.join(prefix, "lib", "python*",
+                                  "site-packages"))
+    return hits[0] if hits else None
+
+
+__all__ = ["ensure_conda_env", "site_packages_of"]

@@ -1,0 +1,285 @@
+"""Runtime-env plugin framework.
+
+Reference: ``python/ray/_private/runtime_env/plugin.py`` — every
+runtime_env field is handled by a plugin keyed on that field's name, with
+a driver-side prepare step (URI-ify / upload / validate) and a
+worker-side apply step, ordered by priority. The built-in fields
+(env_vars, working_dir, py_modules, pip, conda, container) are themselves
+plugins registered here; user plugins register through
+:func:`register_plugin` (worker processes import the module named in
+``RAY_TPU_RUNTIME_ENV_PLUGINS`` so registration happens in every process
+that applies environments).
+"""
+
+from __future__ import annotations
+
+import abc
+import importlib
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class EnvContext:
+    """Mutation collector for one apply(): plugins record process-level
+    changes here; the framework performs them and builds the restore
+    closure (reused task workers must not leak one task's env)."""
+
+    def __init__(self):
+        self.paths: List[str] = []       # prepended to sys.path
+        self.env_vars: Dict[str, str] = {}
+        self.cwd: Optional[str] = None
+
+    def add_path(self, path: str) -> None:
+        self.paths.append(path)
+
+    def set_env(self, key: str, value: str) -> None:
+        self.env_vars[key] = str(value)
+
+    def set_cwd(self, path: str) -> None:
+        self.cwd = path
+
+
+class RuntimeEnvPlugin(abc.ABC):
+    """One runtime_env field's lifecycle. ``name`` is the dict key the
+    plugin owns; lower ``priority`` applies first (reference: plugin
+    priority ordering)."""
+
+    name: str = ""
+    priority: int = 10
+    # True when apply() may run a slow build (venv, conda, download):
+    # the node manager prewarms such fields while placement is in flight.
+    prewarmable: bool = False
+
+    def prepare(self, value: Any, kv_stub) -> Any:
+        """Driver-side: validate/upload; the return value replaces the
+        field in the prepared runtime_env shipped with the task."""
+        return value
+
+    @abc.abstractmethod
+    def apply(self, value: Any, kv_stub, ctx: EnvContext) -> None:
+        """Worker-side: materialize the field, recording process changes
+        on ``ctx``."""
+
+
+_plugins: Dict[str, RuntimeEnvPlugin] = {}
+_lock = threading.Lock()
+_env_plugins_loaded = False
+
+
+def register_plugin(plugin: RuntimeEnvPlugin) -> None:
+    if not plugin.name:
+        raise ValueError("plugin needs a non-empty name")
+    with _lock:
+        _plugins[plugin.name] = plugin
+
+
+def get_plugin(name: str) -> Optional[RuntimeEnvPlugin]:
+    _load_env_plugins()
+    with _lock:
+        return _plugins.get(name)
+
+
+def plugins_for(renv: Dict[str, Any]) -> List[RuntimeEnvPlugin]:
+    """The registered plugins relevant to ``renv``, priority-ordered.
+    Unknown fields are tolerated (forward compatibility), with a one-time
+    warning."""
+    _load_env_plugins()
+    with _lock:
+        found = [p for name, p in _plugins.items() if name in renv]
+        unknown = [k for k in renv if k not in _plugins]
+    for k in unknown:
+        if k not in _warned_unknown:
+            _warned_unknown.add(k)
+            logger.warning("no runtime_env plugin for field %r; ignoring",
+                           k)
+    return sorted(found, key=lambda p: p.priority)
+
+
+_warned_unknown: set = set()
+
+
+def _load_env_plugins() -> None:
+    """Import plugin modules named in RAY_TPU_RUNTIME_ENV_PLUGINS
+    (comma-separated import paths) once per process — workers apply
+    environments in their own processes, so registration must re-run
+    there (reference: RAY_RUNTIME_ENV_PLUGINS)."""
+    global _env_plugins_loaded
+    if _env_plugins_loaded:
+        return
+    _env_plugins_loaded = True
+    for mod in filter(None, os.environ.get(
+            "RAY_TPU_RUNTIME_ENV_PLUGINS", "").split(",")):
+        try:
+            importlib.import_module(mod.strip())
+        except Exception:  # noqa: BLE001
+            logger.exception("failed to import runtime_env plugin module "
+                             "%r", mod)
+
+
+# ------------------------------------------------------------ built-ins
+class EnvVarsPlugin(RuntimeEnvPlugin):
+    name = "env_vars"
+    priority = 0
+
+    def apply(self, value, kv_stub, ctx: EnvContext) -> None:
+        for k, v in (value or {}).items():
+            ctx.set_env(k, v)
+
+
+class WorkingDirPlugin(RuntimeEnvPlugin):
+    name = "working_dir"
+    priority = 1
+    prewarmable = True
+
+    def prepare(self, value, kv_stub):
+        from ray_tpu._private.runtime_env import packaging
+
+        if value and not packaging.is_uri(value) and os.path.isdir(value):
+            return packaging.upload_directory(value, kv_stub)
+        return value
+
+    def apply(self, value, kv_stub, ctx: EnvContext) -> None:
+        from ray_tpu._private.runtime_env import packaging
+
+        if not value:
+            return
+        path = packaging.ensure_local(value, kv_stub) \
+            if packaging.is_uri(value) else value
+        ctx.set_cwd(path)
+        ctx.add_path(path)
+
+
+class PyModulesPlugin(RuntimeEnvPlugin):
+    name = "py_modules"
+    priority = 2
+    prewarmable = True
+
+    def prepare(self, value, kv_stub):
+        from ray_tpu._private.runtime_env import packaging
+
+        # A py_modules entry is itself the importable module/package, so
+        # it nests under its own name in the zip (reference py_modules
+        # semantics: ``import <basename>`` works on the worker).
+        return [
+            packaging.upload_directory(
+                m, kv_stub,
+                prefix=os.path.basename(os.path.normpath(m)))
+            if not packaging.is_uri(m) and os.path.isdir(m) else m
+            for m in (value or [])
+        ]
+
+    def apply(self, value, kv_stub, ctx: EnvContext) -> None:
+        from ray_tpu._private.runtime_env import packaging
+
+        for mod in value or []:
+            path = packaging.ensure_local(mod, kv_stub) \
+                if packaging.is_uri(mod) else mod
+            ctx.add_path(path)
+
+
+class PipPlugin(RuntimeEnvPlugin):
+    name = "pip"
+    priority = 3
+    prewarmable = True
+
+    def apply(self, value, kv_stub, ctx: EnvContext) -> None:
+        from ray_tpu._private.runtime_env import pip_env
+
+        if value:
+            ctx.add_path(pip_env.ensure_pip_env(list(value)))
+
+
+class CondaPlugin(RuntimeEnvPlugin):
+    """Conda environments (reference: ``_private/runtime_env/conda.py``).
+
+    ``conda`` may be a dict (environment.yml content), a path to an
+    environment.yml, or the name of a pre-existing conda env. The env is
+    built once per content hash into a shared cache; activation puts its
+    site-packages (and bin on PATH) into the worker process.
+    """
+
+    name = "conda"
+    priority = 3
+    prewarmable = True
+
+    def apply(self, value, kv_stub, ctx: EnvContext) -> None:
+        from ray_tpu._private.runtime_env import conda_env
+
+        if not value:
+            return
+        env_path = conda_env.ensure_conda_env(value)
+        site = conda_env.site_packages_of(env_path)
+        if site:
+            ctx.add_path(site)
+        bin_dir = os.path.join(env_path, "bin")
+        if os.path.isdir(bin_dir):
+            # Compose with a PATH the env_vars plugin may already have
+            # recorded (overwriting it would drop the user's entries).
+            base = ctx.env_vars.get("PATH", os.environ.get("PATH", ""))
+            ctx.set_env("PATH", bin_dir + os.pathsep + base)
+
+
+class ContainerPlugin(RuntimeEnvPlugin):
+    """Container image environments (reference:
+    ``_private/runtime_env/image_uri.py``). Containers wrap WORKER LAUNCH
+    (the process must start inside the image), which this agentless
+    runtime applies at node-manager worker spawn via
+    :func:`container_command`; in-process apply only validates and
+    exports the image for tooling."""
+
+    name = "container"
+    priority = 0
+
+    def prepare(self, value, kv_stub):
+        if isinstance(value, str):
+            value = {"image": value}
+        if not isinstance(value, dict) or not value.get("image"):
+            raise ValueError(
+                "runtime_env['container'] needs {'image': <uri>, "
+                "'run_options': [...]}")
+        return value
+
+    def apply(self, value, kv_stub, ctx: EnvContext) -> None:
+        if not value:
+            return
+        ctx.set_env("RAY_TPU_CONTAINER_IMAGE", value["image"])
+        if os.environ.get("RAY_TPU_CONTAINER_IMAGE") != value["image"]:
+            # This worker was NOT launched inside the image: in-process
+            # activation cannot retrofit container isolation. Be loud —
+            # silently running on the host with the wrong dependencies is
+            # worse than failing.
+            logger.warning(
+                "runtime_env['container'] image %r requested, but this "
+                "worker is not running inside it; the task executes on "
+                "the host. Launch container workers via "
+                "plugin.container_command (e.g. in the cluster config's "
+                "worker startup) for real isolation.", value["image"])
+
+
+def container_command(container: Dict[str, Any],
+                      worker_cmd: List[str]) -> List[str]:
+    """Wrap a worker launch command to run inside the declared image
+    (podman/docker, host networking so the worker can reach the node
+    manager). Used by the node manager when a lease carries a container
+    runtime_env."""
+    engine = container.get("engine") or os.environ.get(
+        "RAY_TPU_CONTAINER_ENGINE", "podman")
+    cmd = [engine, "run", "--rm", "--network=host",
+           "-v", f"{os.getcwd()}:{os.getcwd()}"]
+    cmd += [str(o) for o in container.get("run_options", [])]
+    cmd += [container["image"]]
+    cmd += worker_cmd
+    return cmd
+
+
+for _p in (EnvVarsPlugin(), WorkingDirPlugin(), PyModulesPlugin(),
+           PipPlugin(), CondaPlugin(), ContainerPlugin()):
+    register_plugin(_p)
+
+
+__all__ = ["RuntimeEnvPlugin", "EnvContext", "register_plugin",
+           "get_plugin", "plugins_for", "container_command"]

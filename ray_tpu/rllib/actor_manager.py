@@ -39,6 +39,13 @@ class FaultTolerantActorManager:
         return len(self.actors)
 
     def _replace(self, slot: int):
+        old = self.actors[slot]
+        try:
+            # This runtime has no handle-refcount actor GC: dropping the
+            # handle would leak a possibly-still-running actor process.
+            ray_tpu.kill(old)
+        except Exception:  # noqa: BLE001
+            pass
         self._next_index += 1
         self.num_replacements += 1
         actor = self._factory(self._next_index)
@@ -86,6 +93,12 @@ class FaultTolerantActorManager:
         return results
 
     def healthy_count(self, timeout_s: float = 10.0) -> int:
+        """Count responsive actors. A ping TIMEOUT counts as healthy-but
+        -busy (these actors are serial: a ping queues behind a long
+        sample(), and replacing a busy actor would discard its work);
+        only a dead actor is replaced."""
+        from ray_tpu.exceptions import GetTimeoutError
+
         alive = 0
         probes = [(slot, a.ping.remote()) for slot, a in
                   enumerate(self.actors)]
@@ -93,6 +106,8 @@ class FaultTolerantActorManager:
             try:
                 ray_tpu.get(ref, timeout=timeout_s)
                 alive += 1
+            except GetTimeoutError:
+                alive += 1  # busy, not dead
             except Exception:  # noqa: BLE001
                 self._replace(slot)
         return alive

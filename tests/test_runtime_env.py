@@ -4,6 +4,9 @@ Reference: ``python/ray/_private/runtime_env/`` (packaging.py content-
 addressed URIs, pip.py per-spec venvs, the agent's CreateRuntimeEnv flow).
 """
 
+import os
+import sys
+
 import pytest
 
 import ray_tpu
@@ -113,3 +116,117 @@ def test_pip_env_installs_local_package(renv_cluster, tmp_path):
         return localpkg.MAGIC
 
     assert ray_tpu.get(use.remote(), timeout=180) == "pip-ok"
+
+
+# ------------------------------------------------------------- plugin ABC
+def test_custom_plugin_registration_and_apply(tmp_path):
+    from ray_tpu._private import runtime_env as renv_mod
+    from ray_tpu._private.runtime_env import plugin as plugin_mod
+
+    calls = []
+
+    class TokenPlugin(plugin_mod.RuntimeEnvPlugin):
+        name = "token"
+        priority = 5
+
+        def prepare(self, value, kv_stub):
+            calls.append(("prepare", value))
+            return value.upper()
+
+        def apply(self, value, kv_stub, ctx):
+            calls.append(("apply", value))
+            ctx.set_env("TOKEN_VALUE", value)
+
+    plugin_mod.register_plugin(TokenPlugin())
+    prepared = renv_mod.prepare({"token": "abc"}, kv_stub=None)
+    assert prepared == {"token": "ABC"}
+    restore = renv_mod.apply(prepared, kv_stub=None)
+    try:
+        assert os.environ["TOKEN_VALUE"] == "ABC"
+    finally:
+        restore()
+    assert "TOKEN_VALUE" not in os.environ
+    assert calls == [("prepare", "abc"), ("apply", "ABC")]
+
+
+def _stub_conda(tmp_path):
+    """A fake conda binary: `conda env create -p <prefix> -f <yml>` makes
+    the prefix with a site-packages holding a marker module."""
+    stub = tmp_path / "conda"
+    stub.write_text(
+        "#!/bin/sh\n"
+        "# args: env create --yes -p <prefix> -f <yml>\n"
+        "while [ $# -gt 0 ]; do\n"
+        "  if [ \"$1\" = \"-p\" ]; then prefix=$2; fi\n"
+        "  shift\n"
+        "done\n"
+        "sp=\"$prefix/lib/python3.12/site-packages\"\n"
+        "mkdir -p \"$sp\" \"$prefix/bin\"\n"
+        "echo 'CONDA_MARKER = \"made-by-stub\"' > \"$sp/conda_marker.py\"\n")
+    stub.chmod(0o755)
+    return str(stub)
+
+
+def test_conda_plugin_builds_and_activates(tmp_path, monkeypatch):
+    from ray_tpu._private import runtime_env as renv_mod
+
+    monkeypatch.setenv("RAY_TPU_CONDA_EXE", _stub_conda(tmp_path))
+    monkeypatch.setenv("RAY_TPU_CONDA_CACHE", str(tmp_path / "cache"))
+    spec = {"dependencies": ["python=3.12", {"pip": ["tinypkg"]}]}
+    restore = renv_mod.apply({"conda": spec}, kv_stub=None)
+    try:
+        import conda_marker
+
+        assert conda_marker.CONDA_MARKER == "made-by-stub"
+    finally:
+        restore()
+        sys.modules.pop("conda_marker", None)
+    # Second apply reuses the cached env (stub would fail on existing -p?
+    # no: the ready-marker short-circuits before any subprocess runs).
+    cache_envs = list((tmp_path / "cache").glob("*/.ray_tpu_ready"))
+    assert len(cache_envs) == 1
+    restore = renv_mod.apply({"conda": spec}, kv_stub=None)
+    restore()
+    assert len(list((tmp_path / "cache").glob("*/.ray_tpu_ready"))) == 1
+
+
+def test_conda_task_end_to_end(tmp_path, monkeypatch):
+    """A task declaring a conda env imports a module only that env
+    provides (the reference 'Done' bar for the conda plugin)."""
+    monkeypatch.setenv("RAY_TPU_CONDA_EXE", _stub_conda(tmp_path))
+    monkeypatch.setenv("RAY_TPU_CONDA_CACHE", str(tmp_path / "cache"))
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    from ray_tpu.cluster_utils import Cluster
+
+    c = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=c.address)
+
+        @ray_tpu.remote(runtime_env={
+            "conda": {"dependencies": ["python=3.12"]}})
+        def probe():
+            import conda_marker
+
+            return conda_marker.CONDA_MARKER
+
+        assert ray_tpu.get(probe.remote(), timeout=120) == "made-by-stub"
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+
+
+def test_container_plugin_command_and_validation():
+    from ray_tpu._private.runtime_env import plugin as plugin_mod
+
+    p = plugin_mod.get_plugin("container")
+    assert p.prepare("myimage:1", None) == {"image": "myimage:1"}
+    with pytest.raises(ValueError):
+        p.prepare({}, None)
+    cmd = plugin_mod.container_command(
+        {"image": "myimage:1", "run_options": ["--gpus=all"],
+         "engine": "docker"},
+        ["python", "-m", "worker"])
+    assert cmd[:4] == ["docker", "run", "--rm", "--network=host"]
+    assert "--gpus=all" in cmd and "myimage:1" in cmd
+    assert cmd[-3:] == ["python", "-m", "worker"]
