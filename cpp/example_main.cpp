@@ -78,6 +78,18 @@ int main(int argc, char** argv) {
   if (client.Get(oid, &out, &err)) return 1;  // freed -> unknown id
   std::printf("CHECK free ok\n");
 
+  // With --call-cpp: a C++-registered task (served by a TaskExecutor
+  // worker process) reached through the same gateway Submit path.
+  if (argc > 2 && std::string(argv[2]) == "--call-cpp") {
+    ref = client.Submit("cpp_mul", {ray_tpu::V(static_cast<int64_t>(6)),
+                                    ray_tpu::V(static_cast<int64_t>(9))});
+    if (ref.empty() || !client.Get(ref, &out, &err) || out.i() != 54) {
+      std::fprintf(stderr, "cpp_worker call failed: %s\n", err.c_str());
+      return 1;
+    }
+    std::printf("CHECK cpp_worker mul=54 ok\n");
+  }
+
   std::printf("ALL CHECKS PASSED\n");
   return 0;
 }

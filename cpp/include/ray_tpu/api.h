@@ -16,9 +16,13 @@
 
 #pragma once
 
+#include <atomic>
 #include <cstdint>
+#include <functional>
 #include <map>
+#include <memory>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "ray_tpu/protobuf/ray_tpu.pb.h"
@@ -71,6 +75,11 @@ class Client {
 
   const std::string& last_error() const { return last_error_; }
 
+  // The local IP this client's socket uses to reach the gateway — the
+  // address OTHER cluster nodes can reach this host at (TaskExecutor
+  // advertises it; loopback would break cross-node calls).
+  std::string LocalAddress() const;
+
  private:
   bool Call(uint8_t op, const std::string& body, std::string* reply);
   bool SendAll(const char* data, size_t n);
@@ -78,6 +87,62 @@ class Client {
 
   int fd_;
   std::string last_error_;
+};
+
+// ---------------------------------------------------------------- worker
+// C++ worker mode (reference: cpp/src/ray/runtime/task/task_executor.cc —
+// C++-defined tasks executed in C++ processes). A TaskExecutor registers
+// named functions, serves execution requests over a framed-protobuf
+// socket (request [u32 len][u8 op=1][XLangCall], reply
+// [u32 len][u8 ok][XLangResult]), and announces each function's address
+// in the cluster KV (namespace "__cpp_executors__") through a gateway
+// Client — Python callers reach it via cross_language.cpp_function(name),
+// and C++ clients via the normal gateway Submit (the gateway routes names
+// it finds in that namespace back to this process).
+//
+// Usage:
+//   ray_tpu::TaskExecutor exec;
+//   exec.Register("cpp_mul", [](const auto& args) {
+//     return ray_tpu::V(args[0].i() * args[1].i());
+//   });
+//   exec.Serve(gateway_client);    // announce + serve in background
+using CppTaskFn = std::function<rpc::XLangValue(
+    const std::vector<rpc::XLangValue>&)>;
+
+class TaskExecutor {
+ public:
+  TaskExecutor() : listen_fd_(-1), port_(0), stopping_(false) {}
+  ~TaskExecutor();
+
+  void Register(const std::string& name, CppTaskFn fn);
+
+  // Bind (ephemeral port when 0), announce every registered function via
+  // `gateway`, and serve on a background thread. Returns the bound port
+  // (0 on failure). An empty advertise_host announces the address this
+  // host reaches the gateway from (routable cross-node, unlike loopback).
+  int Serve(Client& gateway, const std::string& advertise_host = "",
+            int port = 0);
+
+  void Stop();
+
+  int port() const { return port_; }
+
+ private:
+  struct Conn {
+    std::thread thread;
+    int fd;
+    std::shared_ptr<std::atomic<bool>> done;
+  };
+
+  void AcceptLoop();
+  void ServeConn(int fd, std::shared_ptr<std::atomic<bool>> done);
+
+  std::map<std::string, CppTaskFn> fns_;
+  int listen_fd_;
+  int port_;
+  std::atomic<bool> stopping_;
+  std::thread accept_thread_;
+  std::vector<Conn> conns_;  // touched only by accept thread + Stop()
 };
 
 }  // namespace ray_tpu

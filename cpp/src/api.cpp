@@ -37,6 +37,26 @@ uint32_t GetU32LE(const char* in) {
          (static_cast<uint32_t>(static_cast<unsigned char>(in[2])) << 16) |
          (static_cast<uint32_t>(static_cast<unsigned char>(in[3])) << 24);
 }
+
+bool SendAllFd(int fd, const char* data, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t w = ::send(fd, data + off, n - off, 0);
+    if (w <= 0) return false;
+    off += static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool RecvAllFd(int fd, char* data, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    ssize_t r = ::recv(fd, data + off, n - off, 0);
+    if (r <= 0) return false;
+    off += static_cast<size_t>(r);
+  }
+  return true;
+}
 }  // namespace
 
 rpc::XLangValue V(double d) {
@@ -99,24 +119,24 @@ bool Client::Connect(const std::string& host, int port) {
   return true;
 }
 
+std::string Client::LocalAddress() const {
+  if (fd_ < 0) return "127.0.0.1";
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (::getsockname(fd_, reinterpret_cast<sockaddr*>(&addr), &len) != 0)
+    return "127.0.0.1";
+  char buf[INET_ADDRSTRLEN];
+  if (!::inet_ntop(AF_INET, &addr.sin_addr, buf, sizeof(buf)))
+    return "127.0.0.1";
+  return buf;
+}
+
 bool Client::SendAll(const char* data, size_t n) {
-  size_t sent = 0;
-  while (sent < n) {
-    ssize_t r = ::send(fd_, data + sent, n - sent, 0);
-    if (r <= 0) return false;
-    sent += static_cast<size_t>(r);
-  }
-  return true;
+  return SendAllFd(fd_, data, n);
 }
 
 bool Client::RecvAll(char* data, size_t n) {
-  size_t got = 0;
-  while (got < n) {
-    ssize_t r = ::recv(fd_, data + got, n - got, 0);
-    if (r <= 0) return false;
-    got += static_cast<size_t>(r);
-  }
-  return true;
+  return RecvAllFd(fd_, data, n);
 }
 
 bool Client::Call(uint8_t op, const std::string& body, std::string* reply) {
@@ -249,4 +269,142 @@ bool Client::KvGet(const std::string& ns, const std::string& key,
   return true;
 }
 
+// ------------------------------------------------------------- TaskExecutor
+
+TaskExecutor::~TaskExecutor() { Stop(); }
+
+void TaskExecutor::Register(const std::string& name, CppTaskFn fn) {
+  fns_[name] = std::move(fn);
+}
+
+int TaskExecutor::Serve(Client& gateway, const std::string& advertise_host,
+                        int port) {
+  listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return 0;
+  int one = 1;
+  ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(listen_fd_, 16) != 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return 0;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+  port_ = ntohs(addr.sin_port);
+  // Announce every function: KV "__cpp_executors__"/<name> -> host:port.
+  // Empty advertise_host: use the address this host reaches the gateway
+  // from — routable by other nodes, unlike loopback.
+  const std::string host =
+      advertise_host.empty() ? gateway.LocalAddress() : advertise_host;
+  const std::string address = host + ":" + std::to_string(port_);
+  for (const auto& kv : fns_) {
+    if (!gateway.KvPut("__cpp_executors__", kv.first, address)) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return 0;
+    }
+  }
+  accept_thread_ = std::thread([this] { AcceptLoop(); });
+  return port_;
+}
+
+void TaskExecutor::Stop() {
+  if (stopping_.exchange(true)) return;
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  // Wake threads blocked in recv() on idle keep-alive connections —
+  // without the shutdown, join() below would hang forever.
+  for (auto& c : conns_) {
+    ::shutdown(c.fd, SHUT_RDWR);
+  }
+  for (auto& c : conns_) {
+    if (c.thread.joinable()) c.thread.join();
+  }
+  conns_.clear();
+}
+
+void TaskExecutor::AcceptLoop() {
+  while (!stopping_.load()) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;
+    // Reap finished connection threads (per-call clients would otherwise
+    // accumulate one unjoined thread per connection forever).
+    for (auto it = conns_.begin(); it != conns_.end();) {
+      if (it->done->load()) {
+        if (it->thread.joinable()) it->thread.join();
+        it = conns_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto done = std::make_shared<std::atomic<bool>>(false);
+    Conn c;
+    c.fd = fd;
+    c.done = done;
+    c.thread = std::thread([this, fd, done] { ServeConn(fd, done); });
+    conns_.push_back(std::move(c));
+  }
+}
+
+void TaskExecutor::ServeConn(int fd,
+                             std::shared_ptr<std::atomic<bool>> done) {
+  // Per-request: [u32 len][u8 op][XLangCall] -> [u32 len][u8 ok][XLangResult]
+  while (!stopping_.load()) {
+    char header[5];
+    if (!RecvAllFd(fd, header, 5)) break;
+    const uint32_t length = GetU32LE(header);
+    std::string body(length, '\0');
+    if (length > 0 && !RecvAllFd(fd, &body[0], length)) break;
+    rpc::XLangResult result;
+    rpc::XLangCall call;
+    if (header[4] != 1 || !call.ParseFromString(body)) {
+      result.set_ok(false);
+      result.set_error("malformed executor request");
+    } else {
+      auto it = fns_.find(call.function());
+      if (it == fns_.end()) {
+        result.set_ok(false);
+        result.set_error("unknown C++ function: " + call.function());
+      } else {
+        std::vector<rpc::XLangValue> args(call.args().begin(),
+                                          call.args().end());
+        try {
+          *result.mutable_value() = it->second(args);
+          result.set_ok(true);
+        } catch (const std::exception& e) {
+          result.set_ok(false);
+          result.set_error(std::string("C++ task raised: ") + e.what());
+        } catch (...) {
+          // A non-std exception escaping would std::terminate the whole
+          // worker, killing every other registered function with it.
+          result.set_ok(false);
+          result.set_error("C++ task raised a non-standard exception");
+        }
+      }
+    }
+    const std::string out = result.SerializeAsString();
+    char reply_header[5];
+    PutU32LE(static_cast<uint32_t>(out.size()), reply_header);
+    reply_header[4] = result.ok() ? 1 : 0;
+    if (!SendAllFd(fd, reply_header, 5) ||
+        !SendAllFd(fd, out.data(), out.size()))
+      break;
+  }
+  ::close(fd);
+  done->store(true);
+}
+
 }  // namespace ray_tpu
+

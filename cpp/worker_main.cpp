@@ -1,0 +1,52 @@
+// C++ worker example (driven by tests/test_cpp_api.py).
+//
+// Registers C++-defined tasks with a TaskExecutor, announces them through
+// the gateway, and serves until stdin closes. Python callers reach these
+// via cross_language.cpp_function("cpp_mul"); C++ clients via the normal
+// gateway Submit.
+
+#include <cstdio>
+#include <cstdlib>
+#include <stdexcept>
+#include <string>
+
+#include "ray_tpu/api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <gateway_port>\n", argv[0]);
+    return 2;
+  }
+  ray_tpu::Client gateway;
+  if (!gateway.Connect("127.0.0.1", std::atoi(argv[1]))) {
+    std::fprintf(stderr, "connect failed: %s\n",
+                 gateway.last_error().c_str());
+    return 1;
+  }
+
+  ray_tpu::TaskExecutor exec;
+  exec.Register("cpp_mul", [](const std::vector<ray_tpu::rpc::XLangValue>&
+                                  args) {
+    return ray_tpu::V(args.at(0).i() * args.at(1).i());
+  });
+  exec.Register("cpp_concat",
+                [](const std::vector<ray_tpu::rpc::XLangValue>& args) {
+                  return ray_tpu::V(args.at(0).s() + args.at(1).s());
+                });
+  exec.Register("cpp_fail",
+                [](const std::vector<ray_tpu::rpc::XLangValue>&)
+                    -> ray_tpu::rpc::XLangValue {
+                  throw std::runtime_error("intentional c++ failure");
+                });
+  int port = exec.Serve(gateway);
+  if (port == 0) {
+    std::fprintf(stderr, "executor serve failed\n");
+    return 1;
+  }
+  std::printf("EXECUTOR_PORT=%d\n", port);
+  std::fflush(stdout);
+  // Serve until the harness closes stdin (worker-lifetime control).
+  std::getchar();
+  exec.Stop();
+  return 0;
+}

@@ -19,12 +19,10 @@ import logging
 import os
 import shutil
 import subprocess
-import threading
 from typing import Any, Optional
 
 logger = logging.getLogger(__name__)
 
-_lock = threading.Lock()
 
 
 def _cache_root() -> str:
@@ -57,13 +55,16 @@ def ensure_conda_env(spec: Any) -> str:
     marker = os.path.join(prefix, ".ray_tpu_ready")
     os.makedirs(_cache_root(), exist_ok=True)
     # The cache is shared ACROSS worker processes on a node: an OS file
-    # lock (not just the in-process lock) serializes builders, or two
-    # workers would `conda env create` into the same prefix (reference:
-    # conda.py uses file locks for the same reason).
+    # lock serializes builders per digest, or two workers would
+    # `conda env create` into the same prefix (reference: conda.py uses
+    # file locks for the same reason). flock also excludes threads within
+    # one process (distinct fds of one file contend), so no process-wide
+    # lock is held across a build — unrelated envs materialize in
+    # parallel and cache hits never wait behind a 20-minute create.
     import fcntl
 
-    with _lock, open(os.path.join(_cache_root(),
-                                  f"{digest}.lock"), "w") as lockf:
+    with open(os.path.join(_cache_root(),
+                           f"{digest}.lock"), "w") as lockf:
         fcntl.flock(lockf, fcntl.LOCK_EX)
         if os.path.exists(marker):
             return prefix
@@ -93,7 +94,16 @@ def ensure_conda_env(spec: Any) -> str:
 
 def _write_env_yaml(spec: dict, path: str) -> None:
     """Minimal YAML emitter for the environment.yml subset conda reads
-    (name/channels/dependencies with one level of pip nesting)."""
+    (name/channels/dependencies with one level of pip nesting). Unknown
+    keys raise: silently dropping them would cache a wrong env under the
+    full spec's hash forever."""
+    supported = ("name", "channels", "dependencies")
+    unknown = [k for k in spec if k not in supported]
+    if unknown:
+        raise ValueError(
+            f"unsupported environment.yml keys {unknown} (supported: "
+            f"{supported}); write the spec to a file and pass its path "
+            f"for full YAML support")
     lines = []
     for key in ("name", "channels", "dependencies"):
         value = spec.get(key)

@@ -59,6 +59,60 @@ def register_function(name: str, fn=None):
     return do if fn is None else do(fn)
 
 
+_CPP_EXEC_NS = "__cpp_executors__"
+
+
+def _call_cpp_executor(address: str, function: str, args) -> Any:
+    """Dial a C++ TaskExecutor (cpp/include/ray_tpu/api.h) and run one
+    registered function: [u32 len][u8 op=1][XLangCall] ->
+    [u32 len][u8 ok][XLangResult]."""
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    call = pb.XLangCall(function=function)
+    for a in args:
+        call.args.append(to_xlang_value(a))
+    body = call.SerializeToString()
+    host, port = address.rsplit(":", 1)
+    with socket.create_connection((host, int(port)), timeout=30) as conn:
+        conn.sendall(struct.pack("<IB", len(body), 1) + body)
+        header = ClientGateway._recv_exact(conn, 5)
+        if header is None:
+            raise ConnectionError(f"C++ executor at {address} hung up")
+        (length,) = struct.unpack("<I", header[:4])
+        reply = ClientGateway._recv_exact(conn, length)
+        if reply is None:
+            raise ConnectionError(f"C++ executor at {address} hung up")
+    result = pb.XLangResult.FromString(reply)
+    if not result.ok:
+        raise RuntimeError(result.error or f"C++ task {function!r} failed")
+    return from_xlang_value(result.value)
+
+
+def _invoke_cpp(function: str, *args) -> Any:
+    """Task body bridging to a C++ worker: resolve the executor address
+    from the KV (re-read per call so a restarted C++ worker re-resolves)
+    and forward the call. Runs inside a normal Python worker; the actual
+    computation happens in the C++ process that registered ``function``."""
+    from ray_tpu.experimental.internal_kv import internal_kv_get
+
+    addr = internal_kv_get(function, namespace=_CPP_EXEC_NS)
+    if addr is None:
+        raise KeyError(f"no C++ executor registered for {function!r}")
+    return _call_cpp_executor(addr.decode(), function, args)
+
+
+def cpp_function(name: str):
+    """Remote-callable handle to a C++-registered task (reference:
+    ``ray.cross_language.cpp_function``). ``cpp_function("f").remote(x)``
+    schedules a normal task whose body forwards to the C++ worker that
+    registered ``f`` via ``TaskExecutor::Serve``."""
+    import functools
+
+    import ray_tpu
+
+    return ray_tpu.remote(functools.partial(_invoke_cpp, name))
+
+
 def to_xlang_value(v) -> "Any":
     from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
@@ -246,6 +300,21 @@ class ClientGateway:
         # cached keyed on the blob bytes.
         blob = internal_kv_get(name, namespace=_KV_NS)
         if blob is None:
+            # Not a Python-registered function: a C++ TaskExecutor may own
+            # the name — route the call to it (C++ client -> gateway ->
+            # C++ worker completes the cross-language loop). Cached like
+            # the Python path, keyed on the executor's address.
+            addr = internal_kv_get(name, namespace=_CPP_EXEC_NS)
+            if addr is not None:
+                key = b"cpp:" + addr
+                with self._lock:
+                    cached = self._fns.get(name)
+                    if cached is not None and cached[0] == key:
+                        return cached[1]
+                fn = cpp_function(name)
+                with self._lock:
+                    self._fns[name] = (key, fn)
+                return fn
             raise KeyError(f"no cross-language function registered as "
                            f"{name!r}")
         with self._lock:

@@ -100,3 +100,45 @@ def test_python_side_registry_and_gateway_reuse(gateway):
     result = pb.XLangResult.FromString(data)
     assert result.ok and result.value.i == 42
     s.close()
+
+
+# --------------------------------------------------------- C++ worker mode
+WORKER = os.path.join(CPP_DIR, "build", "worker")
+
+
+@pytest.fixture()
+def cpp_worker(gateway):
+    """A real C++ worker process: registers cpp_mul/cpp_concat/cpp_fail
+    via TaskExecutor and serves them (reference: C++-defined tasks run by
+    C++ workers, cpp/src/ray/runtime/task/task_executor.cc)."""
+    proc = subprocess.Popen([WORKER, str(gateway.port)],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            text=True)
+    line = proc.stdout.readline().strip()
+    assert line.startswith("EXECUTOR_PORT="), line
+    yield proc
+    proc.stdin.close()
+    proc.wait(timeout=10)
+
+
+def test_cpp_worker_tasks_from_python(cpp_worker):
+    """Python drives C++-defined tasks end to end: the computation runs in
+    the C++ worker process."""
+    mul = cross_language.cpp_function("cpp_mul")
+    assert ray_tpu.get(mul.remote(6, 7), timeout=60) == 42
+
+    concat = cross_language.cpp_function("cpp_concat")
+    assert ray_tpu.get(concat.remote("tpu", "!"), timeout=60) == "tpu!"
+
+    fail = cross_language.cpp_function("cpp_fail")
+    with pytest.raises(Exception, match="intentional c\\+\\+ failure"):
+        ray_tpu.get(fail.remote(), timeout=60)
+
+
+def test_cpp_worker_tasks_from_cpp_client(cpp_worker, gateway):
+    """C++ client -> gateway -> C++ worker: the gateway routes names owned
+    by C++ executors back to the registering process."""
+    r = subprocess.run([EXAMPLE, str(gateway.port), "--call-cpp"],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
+    assert "CHECK cpp_worker mul=54 ok" in r.stdout
