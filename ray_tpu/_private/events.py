@@ -20,6 +20,53 @@ _enabled = True
 MAX_EVENTS = 200_000
 
 
+class BufferedPublisher:
+    """Lock-guarded buffer + daemon flush thread that Publishes pickled
+    batches to one GCS pubsub channel. Shared by the worker task-event
+    reporter and the tracing span reporter (one flush pattern to keep
+    correct, not two)."""
+
+    def __init__(self, channel: str, gcs_getter, period_s: float = 0.2,
+                 cap: int = 4000):
+        self._channel = channel
+        # Returns the GCS stub or None. A getter that auto-initializes a
+        # runtime would resurrect a global worker from this daemon thread
+        # after shutdown — callers must pass a non-initializing one.
+        self._gcs_getter = gcs_getter
+        self._period = period_s
+        self._cap = cap
+        self._buf: List[Any] = []
+        self._buf_lock = threading.Lock()
+        threading.Thread(target=self._flush_loop, daemon=True,
+                         name=f"pub-{channel}").start()
+
+    def add(self, record: Any) -> None:
+        with self._buf_lock:
+            self._buf.append(record)
+            if len(self._buf) > self._cap:
+                del self._buf[:self._cap // 2]
+
+    def _flush_loop(self) -> None:
+        import pickle
+
+        while True:
+            time.sleep(self._period)
+            with self._buf_lock:
+                buf, self._buf = self._buf, []
+            if not buf:
+                continue
+            try:
+                gcs = self._gcs_getter()
+                if gcs is None:
+                    continue  # no runtime (e.g. after shutdown): drop
+                from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+                gcs.Publish(pb.PublishRequest(
+                    channel=self._channel, data=pickle.dumps(buf)))
+            except Exception:  # noqa: BLE001 — events are best-effort
+                pass
+
+
 def record(name: str, category: str, start_s: float, end_s: float,
            tid: Optional[int] = None, **extra) -> None:
     if not _enabled:

@@ -921,6 +921,10 @@ class ClusterRuntime(CoreRuntime):
             spec.strategy = pf.strategy
         if pf.label_selector:
             spec.label_selector = pf.label_selector
+        from ray_tpu.util import tracing
+
+        if tracing.enabled():
+            tracing.inject_context(spec)
         # Pin every contained ObjectRef (top-level AND nested in containers)
         # for the task's flight time so its refcount can't hit zero between
         # submit and the worker's borrow flush. A promoted payload gets the
@@ -1830,6 +1834,10 @@ class ClusterRuntime(CoreRuntime):
             caller_address=f"{self.worker_id}:{session}".encode(),
             returns_stream=streaming,
         )
+        from ray_tpu.util import tracing
+
+        if tracing.enabled():
+            tracing.inject_context(spec)
         payload_oid = self._maybe_promote_payload(task_id, payload, spec)
         # Same flight-time pinning as submit_task: actor resolution can take
         # tens of seconds, during which the caller may drop its handles. A

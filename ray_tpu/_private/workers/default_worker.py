@@ -83,41 +83,23 @@ class _LogTee:
 class _TaskEventReporter:
     """Batch task state transitions to the GCS task-event sink
     (reference C32: ``gcs_task_manager.h`` — workers buffer task events
-    and flush them periodically to the GCS)."""
-
-    FLUSH_PERIOD_S = 0.2
+    and flush them periodically to the GCS). The buffering/flush loop is
+    the shared BufferedPublisher (one flush pattern for task events and
+    tracing spans)."""
 
     def __init__(self, gcs, worker_id: str, node_id: str):
-        self._gcs = gcs
+        from ray_tpu._private.events import BufferedPublisher
+
         self._worker_id = worker_id
         self._node_id = node_id
-        self._buf: List[dict] = []
-        self._lock = threading.Lock()
-        threading.Thread(target=self._flush_loop, daemon=True,
-                         name="task-events").start()
+        self._pub = BufferedPublisher("TASK_EVENT", lambda: gcs, cap=2000)
 
     def report(self, task_id_hex: str, name: str, state: str,
                **extra) -> None:
-        with self._lock:
-            self._buf.append({
-                "task_id": task_id_hex, "name": name, "state": state,
-                "ts": time.time(), "worker_id": self._worker_id[:12],
-                "node_id": self._node_id[:12], **extra})
-            if len(self._buf) > 2000:
-                del self._buf[:1000]
-
-    def _flush_loop(self):
-        while True:
-            time.sleep(self.FLUSH_PERIOD_S)
-            with self._lock:
-                buf, self._buf = self._buf, []
-            if not buf:
-                continue
-            try:
-                self._gcs.Publish(pb.PublishRequest(
-                    channel="TASK_EVENT", data=pickle.dumps(buf)))
-            except Exception:  # noqa: BLE001
-                pass
+        self._pub.add({
+            "task_id": task_id_hex, "name": name, "state": state,
+            "ts": time.time(), "worker_id": self._worker_id[:12],
+            "node_id": self._node_id[:12], **extra})
 
 
 class _LogPublisher:
@@ -373,16 +355,22 @@ class WorkerServer:
                     pg_context.set(bytes(spec.placement_group_id),
                                    spec.pg_bundle_index,
                                    spec.pg_capture_child_tasks)
-                try:
-                    result = fn(*args, **kwargs)
-                finally:
-                    if spec.placement_group_id:
-                        pg_context.clear()
-                if spec.returns_stream:
-                    result = self._stream_generator(result, spec)
-                elif hasattr(result, "__next__"):  # legacy generator tasks
-                    result = tuple(result) if len(spec.return_ids) > 1 \
-                        else list(result)
+                from ray_tpu.util import tracing
+
+                # The span covers generator DRAIN too: a streaming task's
+                # real work happens consuming the generator, and children
+                # submitted from its body must inherit the trace context.
+                with tracing.execute_span(spec):
+                    try:
+                        result = fn(*args, **kwargs)
+                    finally:
+                        if spec.placement_group_id:
+                            pg_context.clear()
+                    if spec.returns_stream:
+                        result = self._stream_generator(result, spec)
+                    elif hasattr(result, "__next__"):  # legacy generators
+                        result = tuple(result) \
+                            if len(spec.return_ids) > 1 else list(result)
                 out = self._package_results(result, spec.return_ids)
                 self._report_task(spec, "FINISHED")
                 return out
@@ -433,13 +421,16 @@ class WorkerServer:
             method = getattr(runner.instance, spec.method_name)
             if runner.pg_ctx is not None:
                 pg_context.set(*runner.pg_ctx)
-            try:
-                result = method(*args, **kwargs)
-            finally:
-                if runner.pg_ctx is not None:
-                    pg_context.clear()
-            if spec.returns_stream:
-                result = self._stream_generator(result, spec)
+            from ray_tpu.util import tracing
+
+            with tracing.execute_span(spec, kind="actor_task"):
+                try:
+                    result = method(*args, **kwargs)
+                finally:
+                    if runner.pg_ctx is not None:
+                        pg_context.clear()
+                if spec.returns_stream:
+                    result = self._stream_generator(result, spec)
             out = self._package_results(result, spec.return_ids)
             self._report_task(spec, "FINISHED")
             return out

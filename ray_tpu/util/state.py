@@ -79,11 +79,13 @@ def list_objects(limit: int = 1000) -> List[Dict[str, Any]]:
 
 
 def list_tasks(limit: int = 1000,
-               filters: Optional[Dict[str, Any]] = None
-               ) -> List[Dict[str, Any]]:
+               filters: Optional[Dict[str, Any]] = None,
+               include_spans: bool = False) -> List[Dict[str, Any]]:
     """Recent task state transitions from the GCS task-event sink
     (reference C32: ``ray.util.state.list_tasks`` over the GCS task
-    manager). Cluster mode only; local mode returns []."""
+    manager). Cluster mode only; local mode returns []. Tracing SPAN
+    records ride the same sink; they are excluded unless
+    ``include_spans`` (the timeline asks for them)."""
     core = _core()
     gcs = getattr(core, "gcs", None)
     if gcs is None:
@@ -94,6 +96,8 @@ def list_tasks(limit: int = 1000,
 
     reply = gcs.KvGet(pb.KvRequest(ns="__task_events__", key="recent"))
     events = pickle.loads(reply.value) if reply.found else []
+    if not include_spans:
+        events = [e for e in events if e.get("state") != "SPAN"]
     if filters:
         events = [e for e in events
                   if all(e.get(k) == v for k, v in filters.items())]
@@ -101,11 +105,19 @@ def list_tasks(limit: int = 1000,
 
 
 def task_timeline() -> List[Dict[str, Any]]:
-    """Chrome-trace events built from the cluster task-event sink
-    (reference: ``ray timeline`` merging task events)."""
+    """Chrome-trace events built from the cluster task-event sink,
+    merged with tracing spans when RAY_TPU_TRACING is on (reference:
+    ``ray timeline`` merging task events; spans add cross-process
+    parent->child flow arrows)."""
+    from ray_tpu.util.tracing import spans_to_chrome_events
+
     spans: Dict[str, Dict[str, Any]] = {}
+    span_records: List[Dict[str, Any]] = []
     out: List[Dict[str, Any]] = []
-    for e in list_tasks(limit=100000):
+    for e in list_tasks(limit=100000, include_spans=True):
+        if e["state"] == "SPAN":
+            span_records.append(e)
+            continue
         tid = e["task_id"]
         if e["state"] == "RUNNING":
             spans[tid] = e
@@ -119,6 +131,7 @@ def task_timeline() -> List[Dict[str, Any]]:
                 "args": {"state": e["state"], "task_id": tid,
                          **({"error": e["error"]} if "error" in e else {})},
             })
+    out.extend(spans_to_chrome_events(span_records))
     return out
 
 

@@ -1,0 +1,185 @@
+"""Distributed tracing: span propagation across task submissions.
+
+Reference: ``python/ray/util/tracing/tracing_helper.py:326,446`` — the
+reference wraps every task/actor submission and execution in
+OpenTelemetry spans, propagating the trace context inside the TaskSpec so
+a nested task graph yields one cross-process trace. This redesign keeps
+the propagation protocol (trace_id + parent_span_id ride the TaskSpec)
+but exports spans through the existing GCS task-event sink instead of an
+OTel collector: ``ray-tpu timeline`` merges them into the chrome trace
+with flow arrows linking parent and child spans across processes.
+
+Off by default (``RAY_TPU_TRACING=1`` enables): the hot path pays only
+one env check when disabled.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+_local = threading.local()
+_reporter = None
+_reporter_lock = threading.Lock()
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_TRACING", "0") == "1"
+
+
+def current() -> Optional[Tuple[str, str]]:
+    """(trace_id, span_id) of the active span in this thread, if any."""
+    return getattr(_local, "ctx", None)
+
+
+def set_context(trace_id: str, span_id: str) -> None:
+    _local.ctx = (trace_id, span_id)
+
+
+def _live_core():
+    """The current runtime, WITHOUT auto-initializing one (a flush thread
+    must never resurrect a global worker after shutdown)."""
+    from ray_tpu._private import worker as worker_mod
+
+    w = getattr(worker_mod, "_global_worker", None)
+    return None if w is None else w.core
+
+
+def _get_reporter():
+    global _reporter
+    with _reporter_lock:
+        if _reporter is None:
+            from ray_tpu._private.events import BufferedPublisher
+
+            def gcs_getter():
+                core = _live_core()
+                return getattr(core, "gcs", None) if core else None
+
+            _reporter = BufferedPublisher("TASK_EVENT", gcs_getter)
+        return _reporter
+
+
+def _ids() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+@contextmanager
+def span(name: str, kind: str = "task",
+         trace_id: Optional[str] = None,
+         parent_span_id: Optional[str] = None, **attrs):
+    """Run a span: sets the thread-local context (children submitted
+    inside inherit it) and records a SPAN task-event on exit. With no
+    explicit trace context, continues the current one or starts fresh."""
+    if not enabled():
+        yield None
+        return
+    with _span_impl(name, kind=kind, trace_id=trace_id,
+                    parent_span_id=parent_span_id, **attrs) as s:
+        yield s
+
+
+@contextmanager
+def _span_impl(name: str, kind: str = "task",
+               trace_id: Optional[str] = None,
+               parent_span_id: Optional[str] = None, **attrs):
+    prev = current()
+    if trace_id is None:
+        if prev is not None:
+            trace_id, parent_span_id = prev
+        else:
+            trace_id = _ids()
+    span_id = _ids()
+    set_context(trace_id, span_id)
+    t0 = time.time()
+    try:
+        yield span_id
+    finally:
+        _local.ctx = prev
+        ids = _process_ids()
+        _get_reporter().add({
+            "state": "SPAN", "name": name, "kind": kind,
+            "task_id": span_id,
+            "trace_id": trace_id, "span_id": span_id,
+            "parent_span_id": parent_span_id or "",
+            "ts": t0, "dur": time.time() - t0, **ids, **attrs})
+
+
+def _process_ids() -> Dict[str, str]:
+    core = _live_core()
+    if core is None:
+        return {"worker_id": "driver", "node_id": ""}
+    return {"worker_id": getattr(core, "worker_id", "driver")[:12],
+            "node_id": str(getattr(core, "node_id", ""))[:12]}
+
+
+def inject_context(spec) -> None:
+    """Stamp the active trace context into a TaskSpec before submission
+    (reference: _inject_tracing_into_function). Creates a submit span so
+    the executor-side span parents to this submission."""
+    if not (enabled() or current() is not None):
+        return
+    ctx = current()
+    if ctx is None:
+        trace_id, parent = _ids(), ""
+    else:
+        trace_id, parent = ctx
+    submit_span = _ids()
+    ids = _process_ids()
+    _get_reporter().add({
+        "state": "SPAN", "name": f"submit:{spec.name}", "kind": "submit",
+        "task_id": submit_span,
+        "trace_id": trace_id, "span_id": submit_span,
+        "parent_span_id": parent, "ts": time.time(), "dur": 0.0, **ids})
+    spec.trace_id = trace_id
+    spec.parent_span_id = submit_span
+
+
+@contextmanager
+def execute_span(spec, kind: str = "task"):
+    """Executor-side span for a pushed task, parented to the submitter's
+    span carried in the spec (the cross-process edge)."""
+    if not getattr(spec, "trace_id", ""):
+        yield None
+        return
+    with _span_impl(spec.name, kind=kind, trace_id=spec.trace_id,
+                    parent_span_id=spec.parent_span_id) as s:
+        yield s
+
+
+def spans_to_chrome_events(records: List[Dict[str, Any]]) \
+        -> List[Dict[str, Any]]:
+    """SPAN task-events -> chrome trace X events + flow arrows linking
+    parent to child (visible as arrows across process rows in
+    chrome://tracing / perfetto)."""
+    by_id = {r["span_id"]: r for r in records}
+    out: List[Dict[str, Any]] = []
+    for r in records:
+        out.append({
+            "name": r["name"], "cat": f"span:{r.get('kind', 'task')}",
+            "ph": "X", "ts": r["ts"] * 1e6,
+            "dur": max(r.get("dur", 0.0), 1e-5) * 1e6,
+            "pid": r.get("node_id", ""), "tid": r.get("worker_id", ""),
+            "args": {"trace_id": r["trace_id"], "span_id": r["span_id"],
+                     "parent_span_id": r.get("parent_span_id", "")},
+        })
+        parent = by_id.get(r.get("parent_span_id", ""))
+        if parent is not None:
+            mid = parent["ts"] + max(parent.get("dur", 0.0), 0.0) / 2
+            out.append({"name": "trace", "cat": "flow", "ph": "s",
+                        "id": r["span_id"], "ts": mid * 1e6,
+                        "pid": parent.get("node_id", ""),
+                        "tid": parent.get("worker_id", "")})
+            out.append({"name": "trace", "cat": "flow", "ph": "f",
+                        "bp": "e", "id": r["span_id"],
+                        "ts": r["ts"] * 1e6,
+                        "pid": r.get("node_id", ""),
+                        "tid": r.get("worker_id", "")})
+    return out
+
+
+__all__ = ["enabled", "span", "execute_span", "inject_context",
+           "current", "set_context", "spans_to_chrome_events"]

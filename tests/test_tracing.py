@@ -1,0 +1,127 @@
+"""Distributed tracing tests (reference: test_tracing.py over
+tracing_helper.py — spans propagate through the TaskSpec so a nested
+task graph forms one cross-process trace)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.util import state, tracing
+
+
+@pytest.fixture()
+def traced_cluster(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 4})
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def _span_events(timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        events = [e for e in state.list_tasks(limit=100000,
+                                              include_spans=True)
+                  if e["state"] == "SPAN"]
+        if events:
+            return events
+        time.sleep(0.3)
+    return []
+
+
+def test_nested_task_graph_forms_one_cross_process_trace(traced_cluster):
+    @ray_tpu.remote
+    def child(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def parent(x):
+        # Submitted INSIDE the parent's execute span: the child's trace
+        # context chains through this worker's thread-local.
+        return ray_tpu.get(child.remote(x), timeout=60) + 1
+
+    assert ray_tpu.get(parent.remote(10), timeout=120) == 21
+    time.sleep(1.0)  # span reporters flush every 0.2s
+
+    events = _span_events()
+    by_name = {}
+    for e in events:
+        # Task names are qualnames (module.<locals>.fn): key by leaf name.
+        key = e["name"].rsplit(".", 1)[-1].rsplit(":", 1)[-1]
+        kind = "submit:" if e["name"].startswith("submit:") else ""
+        by_name.setdefault(kind + key, []).append(e)
+    assert "parent" in by_name and "child" in by_name, sorted(by_name)
+    p = by_name["parent"][0]
+    ch = by_name["child"][0]
+
+    # One trace spans the whole graph.
+    assert ch["trace_id"] == p["trace_id"]
+    # The child executes in a DIFFERENT process than the parent.
+    assert ch["worker_id"] != p["worker_id"]
+    # Parent-child linkage: child's parent is the submit span created
+    # inside the parent's execute span, whose parent is the parent span.
+    submits = {e["span_id"]: e for e in by_name.get("submit:child", [])}
+    assert submits, sorted(by_name)
+    assert ch["parent_span_id"] in submits
+    assert submits[ch["parent_span_id"]]["parent_span_id"] == p["span_id"]
+    # And the parent chains up to the driver's submit span — a third
+    # process (the driver), distinct from both workers.
+    drv = {e["span_id"]: e for e in by_name.get("submit:parent", [])}
+    assert p["parent_span_id"] in drv
+    assert drv[p["parent_span_id"]]["worker_id"] != p["worker_id"]
+
+
+def test_timeline_merges_spans_with_flow_arrows(traced_cluster):
+    @ray_tpu.remote
+    def leaf():
+        return 1
+
+    @ray_tpu.remote
+    def root():
+        return ray_tpu.get(leaf.remote(), timeout=60)
+
+    assert ray_tpu.get(root.remote(), timeout=120) == 1
+    time.sleep(1.0)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        events = state.task_timeline()
+        span_events = [e for e in events
+                       if str(e.get("cat", "")).startswith("span:")]
+        flows = [e for e in events if e.get("cat") == "flow"]
+        if any(e["name"] == "leaf" for e in span_events) and flows:
+            break
+        time.sleep(0.3)
+    names = {e["name"].rsplit(".", 1)[-1] for e in span_events}
+    assert {"root", "leaf"} <= names, names
+    # Flow arrows come in start/finish pairs linking parent to child.
+    starts = {e["id"] for e in flows if e["ph"] == "s"}
+    finishes = {e["id"] for e in flows if e["ph"] == "f"}
+    assert starts & finishes
+
+
+def test_tracing_disabled_adds_no_spans():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 2})
+    try:
+        ray_tpu.init(address=c.address)
+
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        assert ray_tpu.get(f.remote(), timeout=60) == 1
+        time.sleep(0.5)
+        assert not [e for e in state.list_tasks(limit=10000,
+                                                include_spans=True)
+                    if e["state"] == "SPAN"]
+        assert tracing.current() is None
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
