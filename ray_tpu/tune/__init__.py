@@ -1,12 +1,14 @@
 """ray_tpu.tune: hyperparameter tuning (reference: ``python/ray/tune``)."""
 
 from ray_tpu.tune.schedulers import (
+    PB2,
     AsyncHyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
+    Searcher,
     TPESearch,
     choice,
     grid_search,
@@ -27,9 +29,10 @@ from ray_tpu.tune.tuner import (
 
 __all__ = [
     "AsyncHyperBandScheduler", "FIFOScheduler", "MedianStoppingRule",
-    "PopulationBasedTraining", "ResultGrid", "TPESearch", "TrialResult",
-    "TuneConfig", "Tuner", "choice", "get_checkpoint", "grid_search",
-    "loguniform", "randint", "report", "run", "sample_from", "uniform",
+    "PB2", "PopulationBasedTraining", "ResultGrid", "Searcher",
+    "TPESearch", "TrialResult", "TuneConfig", "Tuner", "choice",
+    "get_checkpoint", "grid_search", "loguniform", "randint", "report",
+    "run", "sample_from", "uniform",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -179,3 +179,108 @@ class PopulationBasedTraining:
             elif resampled is not None:
                 config[key] = resampled
         return config
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference: ``tune/schedulers/pb2.py`` —
+    PBT whose explore step is a GP-bandit over continuous hyperparameters
+    instead of random perturbation). Exploited trials pick their next
+    hyperparameters by maximizing a GP-UCB acquisition fit on the
+    population's observed (hyperparams -> score improvement) history, so
+    the population steers toward productive regions with far fewer trials
+    than random perturbation.
+
+    ``hyperparam_bounds`` maps each tuned key to ``(low, high)``; values
+    are modeled in normalized [0, 1] with an RBF-kernel GP (numpy-native —
+    population histories are tiny, so exact GP inference is cheap).
+    """
+
+    def __init__(self, metric: str = "score", mode: str = "max",
+                 perturbation_interval: int = 2,
+                 hyperparam_bounds: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 ucb_kappa: float = 1.0,
+                 n_candidates: int = 64,
+                 seed: Optional[int] = None):
+        super().__init__(metric=metric, mode=mode,
+                         perturbation_interval=perturbation_interval,
+                         hyperparam_mutations={},
+                         quantile_fraction=quantile_fraction, seed=seed)
+        if not hyperparam_bounds:
+            raise ValueError("PB2 needs hyperparam_bounds={key: (lo, hi)}")
+        self.bounds = {k: (float(lo), float(hi))
+                       for k, (lo, hi) in hyperparam_bounds.items()}
+        self.ucb_kappa = ucb_kappa
+        self.n_candidates = n_candidates
+        self._prev_value: Dict[str, float] = {}
+        # (normalized hyperparam vector, oriented score delta)
+        self._observations: list = []
+        self.MAX_OBS = 256
+
+    # ------------------------------------------------------------ tracking
+    def _normalize(self, config: Dict) -> Optional[list]:
+        x = []
+        for k, (lo, hi) in self.bounds.items():
+            v = config.get(k)
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                return None
+            x.append((float(v) - lo) / (hi - lo) if hi > lo else 0.0)
+        return x
+
+    def on_result(self, trial_id: str, step: int, value: float) -> str:
+        prev = self._prev_value.get(trial_id)
+        self._prev_value[trial_id] = value
+        if prev is not None:
+            x = self._normalize(self._configs.get(trial_id, {}))
+            if x is not None:
+                delta = value - prev
+                if self.mode == "min":
+                    delta = -delta
+                self._observations.append((x, delta))
+                del self._observations[:-self.MAX_OBS]
+        return super().on_result(trial_id, step, value)
+
+    def commit_exploit(self, trial_id: str, new_config: Dict) -> None:
+        super().commit_exploit(trial_id, new_config)
+        # The forked trial resumes from the DONOR's checkpointed score:
+        # comparing its next report against the pre-fork value would
+        # credit the checkpoint jump to the new hyperparameters and
+        # poison the GP with a phantom improvement.
+        self._prev_value.pop(trial_id, None)
+
+    # ------------------------------------------------------------- explore
+    def _explore(self, config: Dict) -> Dict:
+        """GP-UCB selection over the bounded hyperparameters (replaces
+        PBT's random perturbation)."""
+        import numpy as np
+
+        keys = list(self.bounds)
+        if len(self._observations) < 4:
+            # Cold start: uniform draw inside the bounds.
+            for k in keys:
+                lo, hi = self.bounds[k]
+                config[k] = lo + (hi - lo) * float(self._rng.random())
+            return config
+        X = np.asarray([x for x, _ in self._observations])
+        y = np.asarray([d for _, d in self._observations])
+        y = (y - y.mean()) / (y.std() + 1e-9)
+
+        def rbf(a, b, ls=0.2):
+            d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+            return np.exp(-0.5 * d2 / (ls * ls))
+
+        K = rbf(X, X) + 1e-2 * np.eye(len(X))
+        try:
+            K_inv = np.linalg.inv(K)
+        except np.linalg.LinAlgError:
+            K_inv = np.linalg.pinv(K)
+        cands = self._rng.random((self.n_candidates, len(keys)))
+        Ks = rbf(cands, X)
+        mu = Ks @ K_inv @ y
+        var = np.clip(1.0 - np.einsum("ij,jk,ik->i", Ks, K_inv, Ks),
+                      1e-9, None)
+        best = cands[int(np.argmax(mu + self.ucb_kappa * np.sqrt(var)))]
+        for k, u in zip(keys, best):
+            lo, hi = self.bounds[k]
+            config[k] = lo + (hi - lo) * float(u)
+        return config

@@ -91,7 +91,34 @@ class _SampleFrom(Domain):
         return self.fn  # resolved after the rest of the config
 
 
-class TPESearch:
+class Searcher:
+    """Pluggable search-algorithm interface (reference:
+    ``python/ray/tune/search/searcher.py`` Searcher ABC — BayesOpt /
+    HyperOpt / Optuna all plug in through it). Implement these three
+    methods and pass an instance as ``TuneConfig.search_alg``; the
+    controller calls ``configure`` once with the resolved space, then
+    alternates ``suggest`` / ``on_trial_complete``. Instances must be
+    picklable: experiment restore resurrects the searcher WITH its
+    observation history."""
+
+    def configure(self, param_space: Dict[str, Any],
+                  metric: Optional[str], mode: str,
+                  seed: Optional[int] = None) -> None:
+        raise NotImplementedError
+
+    def suggest(self) -> Dict[str, Any]:
+        """The next trial's config."""
+        raise NotImplementedError
+
+    def on_trial_complete(self, config: Dict[str, Any],
+                          score: float) -> None:
+        """Feed a finished trial's final RAW metric value back. The
+        controller does NOT orient it: apply the ``mode`` received in
+        :meth:`configure` yourself (min => lower is better)."""
+        raise NotImplementedError
+
+
+class TPESearch(Searcher):
     """Tree-structured Parzen Estimator search (model-based BayesOpt-class
     searcher; reference: ``python/ray/tune/search/`` hosts HyperOpt — whose
     core algorithm is TPE — plus BayesOpt/Optuna integrations. This build

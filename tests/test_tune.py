@@ -205,3 +205,65 @@ def test_tpe_mixed_space_types(ray8):
     # The categorical model should discover the gelu bonus.
     last = [r.config["act"] for r in list(results)[-8:]]
     assert last.count("gelu") >= 4
+
+
+def test_searcher_abc_custom_plugin(ray8):
+    """A user-defined Searcher plugs into TuneConfig.search_alg."""
+
+    class FixedSearcher(tune.Searcher):
+        def __init__(self):
+            self.completed = []
+            self._i = 0
+
+        def configure(self, param_space, metric, mode, seed=None):
+            self.space = param_space
+
+        def suggest(self):
+            self._i += 1
+            return {"x": self._i}
+
+        def on_trial_complete(self, config, score):
+            self.completed.append((config["x"], score))
+
+    searcher = FixedSearcher()
+    grid = tune.Tuner(
+        lambda cfg: tune.report({"score": cfg["x"] * 10}),
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    num_samples=4, search_alg=searcher),
+    ).fit()
+    assert len(grid) == 4 and not grid.errors
+    assert sorted(x for x, _ in searcher.completed) == [1, 2, 3, 4]
+    assert grid.get_best_result().metrics["score"] == 40
+
+
+def test_pb2_steers_population_within_bounds(ray8):
+    """PB2's GP-bandit explore must keep chosen hyperparams inside the
+    declared bounds and move the population toward the productive region
+    (higher lr -> strictly faster progress here)."""
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint() or {"score": 0.0, "step": 0}
+        score, step = ckpt["score"], ckpt["step"]
+        import time as _t
+
+        for _ in range(8 - step):
+            step += 1
+            score += config["lr"]
+            tune.report({"score": score, "lr": config["lr"]},
+                        checkpoint={"score": score, "step": step})
+            _t.sleep(0.15)
+
+    pb2 = tune.PB2(metric="score", mode="max", perturbation_interval=2,
+                   hyperparam_bounds={"lr": (0.01, 1.0)}, seed=0)
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.01, 0.02, 0.9, 0.9])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    scheduler=pb2),
+    ).fit()
+    assert len(grid) == 4 and not grid.errors
+    assert pb2.exploit_count >= 1, "PB2 never exploited"
+    final_lrs = [r.metrics["lr"] for r in grid if r.metrics]
+    assert all(0.01 <= lr <= 1.0 for lr in final_lrs)
+    assert max(final_lrs) >= 0.5  # a high-lr lineage survived/was chosen
