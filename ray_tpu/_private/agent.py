@@ -85,6 +85,17 @@ class NodeAgent:
                 if self.path == "/healthz":
                     self._send(200, {"ok": True,
                                      "node_id": agent.node_id})
+                elif self.path == "/metrics":
+                    # Per-node Prometheus series (reference: the metrics
+                    # agent each node runs, _private/metrics_agent.py);
+                    # the dashboard scrapes and aggregates these.
+                    body = agent.prometheus_metrics().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 elif self.path == "/stats":
                     self._send(200, read_proc_stats(agent.spill_dir))
                 elif self.path.startswith("/runtime_env/status"):
@@ -115,6 +126,29 @@ class NodeAgent:
                                         daemon=True, name="node-agent")
         self._thread.start()
         self._register()
+
+    def prometheus_metrics(self) -> str:
+        """This node's series: the agent process's metric registry plus
+        /proc-derived node gauges (memory, load, spill disk)."""
+        from ray_tpu.util.metrics import prometheus_text
+
+        registry = prometheus_text().rstrip()
+        lines = [registry] if registry else []
+        stats = read_proc_stats(self.spill_dir)
+        gauges = {
+            "ray_tpu_node_mem_total_bytes": stats.get("mem_total_bytes"),
+            "ray_tpu_node_mem_available_bytes":
+                stats.get("mem_available_bytes"),
+            "ray_tpu_node_loadavg_1m": stats.get("loadavg_1m"),
+            "ray_tpu_node_num_cpus": stats.get("num_cpus"),
+            "ray_tpu_node_disk_free_bytes": stats.get("disk_free_bytes"),
+        }
+        for name, value in gauges.items():
+            if value is None:
+                continue
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {float(value)}")
+        return "\n".join(line for line in lines if line) + "\n"
 
     # ------------------------------------------------------------ pre-warm
     def start_prewarm(self, renv: Dict[str, Any]) -> str:

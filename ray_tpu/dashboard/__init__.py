@@ -16,6 +16,53 @@ from collections import deque
 from typing import Optional
 
 
+def _label_series(text: str, key: str, value: str) -> str:
+    """Inject ``key="value"`` into every sample line of a Prometheus text
+    exposition (comment/TYPE lines pass through) so aggregated scrapes
+    stay distinguishable per node. Labeled lines split at the CLOSING
+    brace (label values may contain spaces); bare names split at the
+    first space (metric names cannot)."""
+    out = []
+    for line in text.splitlines():
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            out.append(line)
+            continue
+        if "{" in stripped:
+            close = stripped.rfind("}")
+            if close < 0:
+                out.append(line)  # malformed: pass through untouched
+                continue
+            name, _, labels = stripped[:close].partition("{")
+            rest = stripped[close + 1:].strip()
+            sep = "," if labels else ""
+            out.append(f'{name}{{{labels}{sep}{key}="{value}"}} {rest}')
+        else:
+            name_part, _, rest = stripped.partition(" ")
+            out.append(f'{name_part}{{{key}="{value}"}} {rest}')
+    return "\n".join(out)
+
+
+def _merge_expositions(parts) -> str:
+    """Concatenate Prometheus expositions keeping only the FIRST
+    ``# TYPE``/``# HELP`` line per metric name — the text parser rejects
+    a second TYPE line for the same name, and every process emits the
+    same registry metadata."""
+    seen = set()
+    out = []
+    for part in parts:
+        for line in part.splitlines():
+            stripped = line.strip()
+            if stripped.startswith(("# TYPE ", "# HELP ")):
+                words = stripped.split()  # ["#", "TYPE", name, ...]
+                key = (words[1], words[2] if len(words) > 2 else "")
+                if key in seen:
+                    continue
+                seen.add(key)
+            out.append(line)
+    return "\n".join(line for line in out if line.strip()) + "\n"
+
+
 # Single-file frontend (reference: dashboard/client React app, condensed to
 # a dependency-free page over the same JSON API).
 _INDEX_HTML = """<!doctype html>
@@ -149,35 +196,35 @@ class Dashboard:
                                            key="recent"))
             return pickle.loads(reply.value) if reply.found else []
 
-        agents_cache = {"ts": 0.0, "value": []}
-        agents_lock = threading.Lock()
+        # Per-path cached fan-out over the node agents (reference:
+        # dashboard agents): resolve addresses from the __agents__ KV
+        # registry, probe CONCURRENTLY (dead agents cost one shared 2s
+        # timeout, not 2s each), and cache briefly so the frontend's poll
+        # loop can't pile requests behind unreachable agents. Shared by
+        # /api/agents (stats) and /metrics (Prometheus rollup).
+        probe_cache: dict = {}
+        probe_lock = threading.Lock()
 
-        def agents():
-            # Per-node agent stats (reference: dashboard agents): resolve
-            # agent addresses from the __agents__ KV registry, probe them
-            # CONCURRENTLY (dead agents cost one shared 2s timeout, not 2s
-            # each), and cache briefly so the frontend's poll loop can't
-            # pile requests behind unreachable agents.
+        def probe_agents(path, transform, ttl_s=2.0):
             import urllib.request
             from concurrent.futures import ThreadPoolExecutor
 
-            with agents_lock:
-                if time.monotonic() - agents_cache["ts"] < 2.0:
-                    return agents_cache["value"]
+            with probe_lock:
+                cached = probe_cache.get(path)
+                if cached and time.monotonic() - cached[0] < ttl_s:
+                    return cached[1]
 
             def probe(node_id):
                 r = gcs.KvGet(pb.KvRequest(ns="__agents__", key=node_id))
                 if not r.found:
                     return None
                 addr = r.value.decode()
-                entry = {"node_id": node_id, "agent_address": addr}
                 try:
                     with urllib.request.urlopen(
-                            f"http://{addr}/stats", timeout=2) as resp:
-                        entry["stats"] = json.loads(resp.read())
+                            f"http://{addr}{path}", timeout=2) as resp:
+                        return transform(node_id, addr, resp.read(), None)
                 except Exception as e:  # noqa: BLE001
-                    entry["error"] = str(e)
-                return entry
+                    return transform(node_id, addr, None, e)
 
             keys = list(gcs.KvKeys(pb.KvRequest(ns="__agents__",
                                                 prefix="")).keys)
@@ -185,11 +232,40 @@ class Dashboard:
             if keys:
                 with ThreadPoolExecutor(max_workers=min(16,
                                                         len(keys))) as ex:
-                    out = [e for e in ex.map(probe, keys) if e is not None]
-            with agents_lock:
-                agents_cache["ts"] = time.monotonic()
-                agents_cache["value"] = out
+                    out = [e for e in ex.map(probe, keys)
+                           if e is not None]
+            with probe_lock:
+                probe_cache[path] = (time.monotonic(), out)
             return out
+
+        def agents():
+            def transform(node_id, addr, body, err):
+                entry = {"node_id": node_id, "agent_address": addr}
+                if err is not None:
+                    entry["error"] = str(err)
+                else:
+                    entry["stats"] = json.loads(body)
+                return entry
+
+            return probe_agents("/stats", transform)
+
+        def cluster_metrics() -> str:
+            """Cluster-wide Prometheus rollup (reference: per-node metrics
+            agents scraped into one Prometheus view): head-process series
+            plus every node agent's /metrics, each series labeled with its
+            FULL node_id (truncation could collide nodes into duplicate
+            samples, which Prometheus rejects). TYPE/HELP metadata is
+            deduplicated across parts for the same reason."""
+            from ray_tpu.util.metrics import prometheus_text
+
+            def transform(node_id, addr, body, err):
+                if err is not None:
+                    return ""
+                return _label_series(body.decode(), "node_id", node_id)
+
+            parts = [_label_series(prometheus_text(), "node_id", "head")]
+            parts.extend(probe_agents("/metrics", transform))
+            return _merge_expositions(parts)
 
         def cluster_status():
             ns = nodes()
@@ -209,9 +285,7 @@ class Dashboard:
             def do_GET(self):  # noqa: N802
                 try:
                     if self.path == "/metrics":
-                        from ray_tpu.util.metrics import prometheus_text
-
-                        body = prometheus_text().encode()
+                        body = cluster_metrics().encode()
                         ctype = "text/plain; version=0.0.4"
                     elif self.path in ("/", "/index.html"):
                         body = _INDEX_HTML.encode()

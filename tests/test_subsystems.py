@@ -481,3 +481,39 @@ def test_workflow_event_timeout(ray_local, tmp_path):
     with pytest.raises(Exception, match="not received"):
         workflow.run(dag, workflow_id="wf-timeout")
     workflow.delete("wf-timeout")
+
+
+def test_dashboard_cluster_metric_rollup(cluster, monkeypatch):
+    """/metrics aggregates per-node agent series labeled by node_id
+    (reference: per-node metrics agents scraped into one Prometheus
+    view). Runs a real in-process NodeAgent and registers it."""
+    from ray_tpu._private.agent import NodeAgent
+    from ray_tpu.dashboard import Dashboard, _label_series
+
+    # Label injection handles labeled and bare series, passes comments,
+    # and survives label values containing spaces.
+    text = ('# TYPE m counter\nm{a="us east"} 3\nplain 1\n')
+    labeled = _label_series(text, "node_id", "n1")
+    assert 'm{a="us east",node_id="n1"} 3' in labeled
+    assert 'plain{node_id="n1"} 1' in labeled
+    assert "# TYPE m counter" in labeled
+    # Merging dedupes repeated TYPE/HELP metadata (Prometheus rejects a
+    # second TYPE line for the same metric).
+    from ray_tpu.dashboard import _merge_expositions
+
+    merged = _merge_expositions(["# TYPE m counter\nm 1\n",
+                                 "# TYPE m counter\nm{n=\"2\"} 2\n"])
+    assert merged.count("# TYPE m counter") == 1
+
+    agent = NodeAgent(cluster.address, node_id="rollupnode", port=0)
+    dash = Dashboard(cluster.address, port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/metrics", timeout=15) as r:
+            body = r.read().decode()
+        assert 'node_id="head"' in body
+        assert 'node_id="rollupnode"' in body
+        assert "ray_tpu_node_mem_available_bytes" in body
+    finally:
+        dash.stop()
+        agent.stop()
