@@ -78,6 +78,29 @@ int main(int argc, char** argv) {
   if (client.Get(oid, &out, &err)) return 1;  // freed -> unknown id
   std::printf("CHECK free ok\n");
 
+  // Actor lifecycle through the gateway: create a registered Python
+  // class, call methods (stateful), kill it.
+  std::string actor = client.CreateActor(
+      "Counter", {ray_tpu::V(static_cast<int64_t>(100))});
+  if (actor.empty()) {
+    std::fprintf(stderr, "CreateActor failed: %s\n",
+                 client.last_error().c_str());
+    return 1;
+  }
+  for (int i = 0; i < 3; ++i) {
+    ref = client.ActorCall(actor, "add", {ray_tpu::V(int64_t(7))});
+    if (ref.empty() || !client.Get(ref, &out, &err)) return 1;
+  }
+  if (out.i() != 121) {  // 100 + 3*7: state persisted across calls
+    std::fprintf(stderr, "actor state wrong: %lld\n",
+                 static_cast<long long>(out.i()));
+    return 1;
+  }
+  if (!client.KillActor(actor)) return 1;
+  if (!client.ActorCall(actor, "add", {ray_tpu::V(int64_t(1))}).empty())
+    return 1;  // killed actor: unknown id
+  std::printf("CHECK actor ok\n");
+
   // With --call-cpp: a C++-registered task (served by a TaskExecutor
   // worker process) reached through the same gateway Submit path.
   if (argc > 2 && std::string(argv[2]) == "--call-cpp") {

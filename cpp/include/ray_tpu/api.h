@@ -67,6 +67,17 @@ class Client {
   // the gateway also caps held refs with oldest-first eviction).
   bool Free(const std::string& object_id);
 
+  // Actors (reference: the Ray Client proxies actor lifecycle for thin
+  // clients): create an instance of a registered class, call its
+  // methods (returns a result object id), and kill it.
+  std::string CreateActor(const std::string& class_name,
+                          const std::vector<rpc::XLangValue>& args,
+                          const std::map<std::string, double>& resources = {});
+  std::string ActorCall(const std::string& actor_id,
+                        const std::string& method,
+                        const std::vector<rpc::XLangValue>& args);
+  bool KillActor(const std::string& actor_id);
+
   // Cluster KV (reference: ray internal KV).
   bool KvPut(const std::string& ns, const std::string& key,
              const std::string& value);

@@ -21,6 +21,9 @@ constexpr uint8_t kOpGet = 4;
 constexpr uint8_t kOpSubmit = 5;
 constexpr uint8_t kOpWait = 6;
 constexpr uint8_t kOpFree = 7;
+constexpr uint8_t kOpCreateActor = 8;
+constexpr uint8_t kOpActorCall = 9;
+constexpr uint8_t kOpKillActor = 10;
 
 // The wire protocol is explicitly little-endian; encode/decode byte-wise
 // so the client also works on big-endian hosts.
@@ -239,6 +242,51 @@ bool Client::Free(const std::string& object_id) {
   ref.set_object_id(object_id);
   std::string reply;
   if (!Call(kOpFree, ref.SerializeAsString(), &reply)) return false;
+  rpc::XLangResult result;
+  return result.ParseFromString(reply) && result.ok();
+}
+
+std::string Client::CreateActor(
+    const std::string& class_name,
+    const std::vector<rpc::XLangValue>& args,
+    const std::map<std::string, double>& resources) {
+  rpc::XLangCall call;
+  call.set_function(class_name);
+  for (const auto& a : args) *call.add_args() = a;
+  for (const auto& kv : resources)
+    (*call.mutable_resources())[kv.first] = kv.second;
+  std::string reply;
+  if (!Call(kOpCreateActor, call.SerializeAsString(), &reply)) return "";
+  rpc::GatewayRef ref;
+  if (!ref.ParseFromString(reply)) {
+    last_error_ = "bad GatewayRef reply";
+    return "";
+  }
+  return ref.object_id();
+}
+
+std::string Client::ActorCall(const std::string& actor_id,
+                              const std::string& method,
+                              const std::vector<rpc::XLangValue>& args) {
+  rpc::XLangActorCall call;
+  call.set_actor_id(actor_id);
+  call.set_method(method);
+  for (const auto& a : args) *call.add_args() = a;
+  std::string reply;
+  if (!Call(kOpActorCall, call.SerializeAsString(), &reply)) return "";
+  rpc::GatewayRef ref;
+  if (!ref.ParseFromString(reply)) {
+    last_error_ = "bad GatewayRef reply";
+    return "";
+  }
+  return ref.object_id();
+}
+
+bool Client::KillActor(const std::string& actor_id) {
+  rpc::GatewayRef ref;
+  ref.set_object_id(actor_id);
+  std::string reply;
+  if (!Call(kOpKillActor, ref.SerializeAsString(), &reply)) return false;
   rpc::XLangResult result;
   return result.ParseFromString(reply) && result.ok();
 }

@@ -15,7 +15,8 @@ tasks, objects, and the KV without needing a gRPC or pickle stack.
 
 Wire protocol (little-endian): request ``[u32 len][u8 op][protobuf]``,
 reply ``[u32 len][u8 ok][protobuf]``. Ops: 1 KvPut, 2 KvGet, 3 Put,
-4 Get, 5 Submit, 6 Wait, 7 Free (release a gateway-held ref).
+4 Get, 5 Submit, 6 Wait, 7 Free (release a gateway-held ref),
+8 CreateActor, 9 ActorCall, 10 KillActor.
 """
 
 from __future__ import annotations
@@ -39,6 +40,9 @@ OP_GET = 4
 OP_SUBMIT = 5
 OP_WAIT = 6
 OP_FREE = 7
+OP_CREATE_ACTOR = 8
+OP_ACTOR_CALL = 9
+OP_KILL_ACTOR = 10
 
 # Backstop for clients that never Free: the gateway pins at most this many
 # refs, evicting oldest-first (an evicted ref just loses its pin; the
@@ -113,6 +117,20 @@ def cpp_function(name: str):
     return ray_tpu.remote(functools.partial(_invoke_cpp, name))
 
 
+def _resource_opts(resources) -> Dict[str, Any]:
+    """XLangCall.resources -> remote() options (shared by task submit and
+    actor creation)."""
+    opts: Dict[str, Any] = {}
+    res = dict(resources)
+    if "CPU" in res:
+        opts["num_cpus"] = res.pop("CPU")
+    if "TPU" in res:
+        opts["num_tpus"] = res.pop("TPU")
+    if res:
+        opts["resources"] = res
+    return opts
+
+
 def to_xlang_value(v) -> "Any":
     from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
@@ -152,6 +170,12 @@ class ClientGateway:
             ray_tpu.init(address=gcs_address, ignore_reinit_error=True)
         self._ray = ray_tpu
         self._fns: Dict[str, Any] = {}   # name -> (kv blob, remote function)
+        self._actor_classes: Dict[str, Any] = {}  # name -> (blob, ActorClass)
+        # actor id -> handle, held for the client's lifetime (killed via
+        # OP_KILL_ACTOR). Bounded: evicted handles are KILLED — unlike an
+        # evicted ref (which only loses its pin), a dropped ActorHandle
+        # has no GC and would leak the running actor forever.
+        self._actors: Dict[bytes, Any] = {}
         # object id -> ObjectRef, insertion-ordered for MAX_HELD_REFS
         # eviction; clients release explicitly with OP_FREE.
         self._refs: Dict[bytes, Any] = {}
@@ -255,14 +279,7 @@ class ClientGateway:
             call = pb.XLangCall.FromString(body)
             fn = self._resolve(call.function)
             args = [from_xlang_value(a) for a in call.args]
-            opts = {}
-            res = dict(call.resources)
-            if "CPU" in res:
-                opts["num_cpus"] = res.pop("CPU")
-            if "TPU" in res:
-                opts["num_tpus"] = res.pop("TPU")
-            if res:
-                opts["resources"] = res
+            opts = _resource_opts(call.resources)
             remote = fn.options(**opts) if opts else fn
             ref = remote.remote(*args)
             self._hold(ref)
@@ -283,7 +300,77 @@ class ClientGateway:
                 found = self._refs.pop(bytes(ref_msg.object_id),
                                        None) is not None
             return True, pb.XLangResult(ok=found).SerializeToString()
+        # Actor ops (reference: the Ray Client proxies actor lifecycle +
+        # method calls for thin clients, util/client/server/server.py:96).
+        if op == OP_CREATE_ACTOR:
+            call = pb.XLangCall.FromString(body)
+            actor_cls = self._resolve_actor_class(call.function)
+            args = [from_xlang_value(a) for a in call.args]
+            opts = _resource_opts(call.resources)
+            remote_cls = actor_cls.options(**opts) if opts else actor_cls
+            handle = remote_cls.remote(*args)
+            aid = handle._actor_id.binary()
+            evicted = []
+            with self._lock:
+                self._actors[aid] = handle
+                while len(self._actors) > MAX_HELD_REFS:
+                    evicted.append(self._actors.pop(
+                        next(iter(self._actors))))
+            for old in evicted:
+                # Unlike an evicted ref (which only loses its pin), a
+                # dropped ActorHandle has no GC: kill or it leaks forever.
+                try:
+                    ray_tpu.kill(old)
+                except Exception:  # noqa: BLE001
+                    pass
+            return True, pb.GatewayRef(object_id=aid).SerializeToString()
+        if op == OP_ACTOR_CALL:
+            call = pb.XLangActorCall.FromString(body)
+            with self._lock:
+                handle = self._actors.get(bytes(call.actor_id))
+            if handle is None:
+                # ok=0 frame, like every other op's errors: the C++
+                # client parses a success frame as GatewayRef and would
+                # silently swallow an inline XLangResult error.
+                raise KeyError(
+                    "unknown actor id (gateway-held actors only)")
+            args = [from_xlang_value(a) for a in call.args]
+            ref = getattr(handle, call.method).remote(*args)
+            self._hold(ref)
+            return True, pb.GatewayRef(
+                object_id=ref.id().binary()).SerializeToString()
+        if op == OP_KILL_ACTOR:
+            ref_msg = pb.GatewayRef.FromString(body)
+            with self._lock:
+                handle = self._actors.pop(bytes(ref_msg.object_id), None)
+            if handle is not None:
+                ray_tpu.kill(handle)
+            return True, pb.XLangResult(
+                ok=handle is not None).SerializeToString()
         raise ValueError(f"unknown gateway op {op}")
+
+    def _resolve_actor_class(self, name: str):
+        """A registered class exported for cross-language actor creation
+        (register_function accepts classes too)."""
+        import ray_tpu
+        from ray_tpu.experimental.internal_kv import internal_kv_get
+
+        blob = internal_kv_get(name, namespace=_KV_NS)
+        if blob is None:
+            raise KeyError(f"no cross-language class registered as "
+                           f"{name!r}")
+        with self._lock:
+            cached = self._actor_classes.get(name)
+            if cached is not None and cached[0] == blob:
+                return cached[1]
+        cls = cloudpickle.loads(blob)
+        if not isinstance(cls, type):
+            raise TypeError(f"{name!r} is registered as a function, not a "
+                            f"class; use Submit for functions")
+        actor_cls = ray_tpu.remote(cls)
+        with self._lock:
+            self._actor_classes[name] = (blob, actor_cls)
+        return actor_cls
 
     def _hold(self, ref) -> None:
         with self._lock:

@@ -49,6 +49,16 @@ def gateway():
 
     cross_language.register_function("boom", boom)
 
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    cross_language.register_function("Counter", Counter)
+
     gw = cross_language.ClientGateway(c.address)
     yield gw
     gw.stop()
