@@ -93,6 +93,9 @@ class Client {
 
  private:
   bool Call(uint8_t op, const std::string& body, std::string* reply);
+  // Shared reply tails: ops returning a GatewayRef / an ok-flag result.
+  std::string CallReturningRef(uint8_t op, const std::string& body);
+  bool CallReturningOk(uint8_t op, const std::string& body);
   bool SendAll(const char* data, size_t n);
   bool RecvAll(char* data, size_t n);
 

@@ -187,22 +187,42 @@ std::string Client::Put(const rpc::XLangValue& value) {
   return ref.object_id();
 }
 
-std::string Client::Submit(const std::string& function,
-                           const std::vector<rpc::XLangValue>& args,
-                           const std::map<std::string, double>& resources) {
-  rpc::XLangCall call;
-  call.set_function(function);
-  for (const auto& a : args) *call.add_args() = a;
-  for (const auto& kv : resources)
-    (*call.mutable_resources())[kv.first] = kv.second;
+std::string Client::CallReturningRef(uint8_t op, const std::string& body) {
   std::string reply;
-  if (!Call(kOpSubmit, call.SerializeAsString(), &reply)) return "";
+  if (!Call(op, body, &reply)) return "";
   rpc::GatewayRef ref;
   if (!ref.ParseFromString(reply)) {
     last_error_ = "bad GatewayRef reply";
     return "";
   }
   return ref.object_id();
+}
+
+bool Client::CallReturningOk(uint8_t op, const std::string& body) {
+  std::string reply;
+  if (!Call(op, body, &reply)) return false;
+  rpc::XLangResult result;
+  return result.ParseFromString(reply) && result.ok();
+}
+
+namespace {
+rpc::XLangCall BuildCall(const std::string& function,
+                         const std::vector<rpc::XLangValue>& args,
+                         const std::map<std::string, double>& resources) {
+  rpc::XLangCall call;
+  call.set_function(function);
+  for (const auto& a : args) *call.add_args() = a;
+  for (const auto& kv : resources)
+    (*call.mutable_resources())[kv.first] = kv.second;
+  return call;
+}
+}  // namespace
+
+std::string Client::Submit(const std::string& function,
+                           const std::vector<rpc::XLangValue>& args,
+                           const std::map<std::string, double>& resources) {
+  return CallReturningRef(
+      kOpSubmit, BuildCall(function, args, resources).SerializeAsString());
 }
 
 bool Client::Get(const std::string& object_id, rpc::XLangValue* out,
@@ -231,38 +251,22 @@ bool Client::Get(const std::string& object_id, rpc::XLangValue* out,
 bool Client::Wait(const std::string& object_id) {
   rpc::GatewayRef ref;
   ref.set_object_id(object_id);
-  std::string reply;
-  if (!Call(kOpWait, ref.SerializeAsString(), &reply)) return false;
-  rpc::XLangResult result;
-  return result.ParseFromString(reply) && result.ok();
+  return CallReturningOk(kOpWait, ref.SerializeAsString());
 }
 
 bool Client::Free(const std::string& object_id) {
   rpc::GatewayRef ref;
   ref.set_object_id(object_id);
-  std::string reply;
-  if (!Call(kOpFree, ref.SerializeAsString(), &reply)) return false;
-  rpc::XLangResult result;
-  return result.ParseFromString(reply) && result.ok();
+  return CallReturningOk(kOpFree, ref.SerializeAsString());
 }
 
 std::string Client::CreateActor(
     const std::string& class_name,
     const std::vector<rpc::XLangValue>& args,
     const std::map<std::string, double>& resources) {
-  rpc::XLangCall call;
-  call.set_function(class_name);
-  for (const auto& a : args) *call.add_args() = a;
-  for (const auto& kv : resources)
-    (*call.mutable_resources())[kv.first] = kv.second;
-  std::string reply;
-  if (!Call(kOpCreateActor, call.SerializeAsString(), &reply)) return "";
-  rpc::GatewayRef ref;
-  if (!ref.ParseFromString(reply)) {
-    last_error_ = "bad GatewayRef reply";
-    return "";
-  }
-  return ref.object_id();
+  return CallReturningRef(
+      kOpCreateActor,
+      BuildCall(class_name, args, resources).SerializeAsString());
 }
 
 std::string Client::ActorCall(const std::string& actor_id,
@@ -272,23 +276,13 @@ std::string Client::ActorCall(const std::string& actor_id,
   call.set_actor_id(actor_id);
   call.set_method(method);
   for (const auto& a : args) *call.add_args() = a;
-  std::string reply;
-  if (!Call(kOpActorCall, call.SerializeAsString(), &reply)) return "";
-  rpc::GatewayRef ref;
-  if (!ref.ParseFromString(reply)) {
-    last_error_ = "bad GatewayRef reply";
-    return "";
-  }
-  return ref.object_id();
+  return CallReturningRef(kOpActorCall, call.SerializeAsString());
 }
 
 bool Client::KillActor(const std::string& actor_id) {
   rpc::GatewayRef ref;
   ref.set_object_id(actor_id);
-  std::string reply;
-  if (!Call(kOpKillActor, ref.SerializeAsString(), &reply)) return false;
-  rpc::XLangResult result;
-  return result.ParseFromString(reply) && result.ok();
+  return CallReturningOk(kOpKillActor, ref.SerializeAsString());
 }
 
 bool Client::KvPut(const std::string& ns, const std::string& key,

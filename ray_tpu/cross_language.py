@@ -408,7 +408,14 @@ class ClientGateway:
             cached = self._fns.get(name)
             if cached is not None and cached[0] == blob:
                 return cached[1]
-        fn = ray_tpu.remote(cloudpickle.loads(blob))
+        target = cloudpickle.loads(blob)
+        if isinstance(target, type):
+            # Mirror of _resolve_actor_class's guard: Submit on a class
+            # would instantiate an actor and then crash holding its
+            # result ref — leaking a running actor nothing tracks.
+            raise TypeError(f"{name!r} is registered as a class; use "
+                            f"CreateActor for classes")
+        fn = ray_tpu.remote(target)
         with self._lock:
             self._fns[name] = (blob, fn)
         return fn

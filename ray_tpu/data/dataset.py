@@ -602,6 +602,31 @@ class Dataset:
         live = [t for t in tables if len(t)]
         return (pa.concat_tables(live) if live else pa.table({})).to_pandas()
 
+    # ------------------------------------------------------------- writers
+    # Distributed writes (reference: ``Dataset.write_parquet`` etc. —
+    # one output file per block, written by the task that holds the
+    # block; the driver only collects the written paths).
+    def _write(self, dir_path: str, fmt: str, ext: str) -> List[str]:
+        os.makedirs(dir_path, exist_ok=True)
+        refs = self._execute()
+        out = [
+            _write_block.remote(
+                r, os.path.join(dir_path, f"block_{i:05d}.{ext}"), fmt)
+            for i, r in enumerate(refs)]
+        return [p for p in ray_tpu.get(out, timeout=600) if p]
+
+    def write_parquet(self, dir_path: str) -> List[str]:
+        """Write one parquet file per block into ``dir_path``; returns
+        the written paths (empty blocks are skipped)."""
+        return self._write(dir_path, "parquet", "parquet")
+
+    def write_csv(self, dir_path: str) -> List[str]:
+        return self._write(dir_path, "csv", "csv")
+
+    def write_json(self, dir_path: str) -> List[str]:
+        """Newline-delimited JSON, one file per block."""
+        return self._write(dir_path, "json", "jsonl")
+
     def schema(self):
         for ref in self._execute():
             t = ray_tpu.get(ref)
@@ -705,6 +730,31 @@ def _shuffle_reduce(mode: str, arg, *parts: pa.Table) -> pa.Table:
 @ray_tpu.remote
 def _block_len(table: pa.Table) -> int:
     return len(table)
+
+
+@ray_tpu.remote
+def _write_block(table: pa.Table, path: str, fmt: str) -> str:
+    """Write one block to one file (runs on the worker holding it).
+    Returns the path, or "" for an empty block (no file emitted)."""
+    if not len(table):
+        return ""
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(table, path)
+    elif fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(table, path)
+    elif fmt == "json":
+        import json as _json
+
+        with open(path, "w") as f:
+            for row in table.to_pylist():
+                f.write(_json.dumps(row) + "\n")
+    else:
+        raise ValueError(f"unknown write format {fmt!r}")
+    return path
 
 
 @ray_tpu.remote

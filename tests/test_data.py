@@ -235,3 +235,23 @@ def test_shuffle_is_distributed_no_driver_concat(monkeypatch):
         ray_tpu.shutdown()
         c.shutdown()
         ray_tpu.init(num_cpus=8)  # restore the module fixture's session
+
+
+def test_distributed_writers_roundtrip(tmp_path):
+    ds = rdata.range(100, parallelism=4)
+    paths = ds.write_parquet(str(tmp_path / "pq"))
+    assert len(paths) == 4 and all(p.endswith(".parquet") for p in paths)
+    back = rdata.read_parquet(str(tmp_path / "pq"))
+    assert sorted(r["id"] for r in back.take_all()) == list(range(100))
+
+    ds.write_csv(str(tmp_path / "csv"))
+    back = rdata.read_csv(str(tmp_path / "csv"))
+    assert back.count() == 100
+
+    ds.write_json(str(tmp_path / "nj"))
+    back = rdata.read_json(str(tmp_path / "nj"))
+    assert sorted(r["id"] for r in back.take_all()) == list(range(100))
+
+    # Empty blocks are skipped, not written as corrupt files.
+    empty = ds.filter(lambda r: False)
+    assert empty.write_parquet(str(tmp_path / "empty")) == []
