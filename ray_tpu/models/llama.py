@@ -49,10 +49,11 @@ class LlamaConfig:
     rms_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    # remat_policy: "full" recomputes the whole layer body in the backward;
-    # "mlp_only" saves per-layer attention/projection outputs and recomputes
-    # only the MLP gate/up intermediates (the dominant activation memory) —
-    # ~25% less recompute FLOPs when HBM allows.
+    # remat_policy: "full" recomputes the whole layer body in the backward
+    # (the measured-best default at the bench shape); "attn_out" saves the
+    # attention outputs only; "mlp_only" additionally saves q/k/v (the
+    # least recompute, the most memory). See forward() for the exact
+    # save-lists and measured tradeoffs.
     remat_policy: str = "full"
     # attention: "auto" | "flash" | "ring" | "reference"
     attention: str = "auto"
@@ -255,12 +256,22 @@ def forward(
             policy = jax.checkpoint_policies.save_only_these_names(
                 "q", "k", "v", "attn_out"
             )
+        elif c.remat_policy == "attn_out":
+            # Save ONLY the attention outputs (~33MB/layer at the bench
+            # shape). NOTE: flash_attention is a custom_vjp whose bwd
+            # needs (q, k, v, out, lse) residuals, so the remat backward
+            # STILL replays the flash forward — this only spares the
+            # wo-projection backward's input recompute. Measured slightly
+            # WORSE than "full" on v5e at the bench shape; kept as a
+            # tuning point for other shapes.
+            policy = jax.checkpoint_policies.save_only_these_names(
+                "attn_out")
         elif c.remat_policy == "full":
             policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
         else:
             raise ValueError(
                 f"unknown remat_policy {c.remat_policy!r}; "
-                "expected 'full' or 'mlp_only'"
+                "expected 'full', 'attn_out', or 'mlp_only'"
             )
         body = jax.checkpoint(layer_fn, policy=policy)
     (x, aux_total), _ = jax.lax.scan(
@@ -270,8 +281,13 @@ def forward(
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     if return_hidden:
         return x, aux_total
+    # bf16 operands with fp32 accumulation: the params are STORED bf16, so
+    # upcasting inputs to fp32 buys no precision on the products — it only
+    # runs the MXU at its fp32 rate (~4x slower on v5e). fp32 accumulate +
+    # fp32 logits keep the softmax math exact.
     logits = jnp.einsum(
-        "bse,ev->bsv", x.astype(jnp.float32), params["lm_head"].astype(jnp.float32)
+        "bse,ev->bsv", x, params["lm_head"].astype(c.dtype),
+        preferred_element_type=jnp.float32,
     )
     if mesh is not None:
         logits = constrain(logits, mesh, "batch", "seq", "act_vocab")
@@ -318,7 +334,9 @@ def loss_fn(
     x = x[:, :-1]
     m = (mask[:, 1:] if mask is not None else
          jnp.ones_like(targets)).astype(jnp.float32)
-    head = params["lm_head"].astype(jnp.float32)
+    # Keep the head in the params' storage dtype: the chunk matmul runs
+    # bf16 x bf16 -> fp32-accumulated logits (see forward()).
+    head = params["lm_head"].astype(config.dtype)
 
     s = x.shape[1]
     n_chunks = vocab_chunks
@@ -330,7 +348,8 @@ def loss_fn(
 
     @functools.partial(jax.checkpoint, policy=jax.checkpoint_policies.nothing_saveable)
     def chunk_stats(xc, tc, mc):
-        logits = jnp.einsum("bse,ev->bsv", xc.astype(jnp.float32), head)
+        logits = jnp.einsum("bse,ev->bsv", xc, head,
+                            preferred_element_type=jnp.float32)
         lse = jax.nn.logsumexp(logits, axis=-1)
         picked = jnp.take_along_axis(logits, tc[..., None], axis=-1)[..., 0]
         nll = (lse - picked) * mc

@@ -91,13 +91,24 @@ def default_optimizer(
     grad_clip: float = 1.0,
     warmup_steps: int = 100,
     total_steps: int = 10000,
+    mu_dtype=None,
 ) -> optax.GradientTransformation:
+    """AdamW with warmup-cosine.
+
+    Moment dtypes: optax inits BOTH moments in the params' dtype — with
+    bf16 params (this framework's default) the default optimizer state is
+    already bf16 mu AND bf16 nu. ``mu_dtype`` can RAISE the first
+    moment's precision (e.g. ``jnp.float32`` for bf16 params) at
+    +4 bytes/param; note the second moment has no such knob in optax and
+    stays in the params' dtype.
+    """
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, learning_rate, warmup_steps, max(total_steps, warmup_steps + 1)
     )
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay),
+        optax.adamw(schedule, b1=b1, b2=b2, weight_decay=weight_decay,
+                    mu_dtype=mu_dtype),
     )
 
 
