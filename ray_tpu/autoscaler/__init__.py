@@ -152,8 +152,15 @@ class Autoscaler:
                  target_utilization: float = 0.8,
                  idle_timeout_s: float = 30.0,
                  tick_interval_s: float = 1.0):
+        from ray_tpu.autoscaler.instance_manager import InstanceManager
+
         self.gcs = rpc.get_stub("GcsService", gcs_address)
         self.provider = provider
+        # Instance lifecycle bookkeeping (reference: the v2
+        # InstanceManager): every launch/terminate this reconciler makes
+        # runs through the state machine, and reconcile ticks fold the
+        # provider + GCS views back into it.
+        self.im = InstanceManager(provider)
         self.node_config = node_config or {"resources": {"CPU": 4.0}}
         self.min_workers = min_workers
         self.max_workers = max_workers
@@ -215,8 +222,9 @@ class Autoscaler:
                 nodes.append(fresh)
         return len(nodes)
 
-    def reconcile_once(self) -> Dict[str, int]:
-        """One tick: returns {"launched": n, "terminated": m}."""
+    def reconcile_once(self) -> Dict[str, Any]:
+        """One tick: returns {"launched": n, "terminated": m,
+        "instances": {status: count}} (the instance-table summary)."""
         nodes = [n for n in self.gcs.GetNodes(pb.GetNodesRequest()).nodes
                  if n.alive]
         managed = set(self.provider.non_terminated_nodes())
@@ -226,6 +234,9 @@ class Autoscaler:
             pid = self._provider_id_of(n)
             if pid in managed:
                 groups.setdefault(pid, []).append(n)
+        # Fold observed state into the instance table (ALLOCATED nodes
+        # that registered become RAY_RUNNING; vanished ones TERMINATED).
+        self.im.sync_from(managed, set(groups))
         launched = terminated = 0
 
         # 1) explicit resource requests: place onto current free capacity
@@ -265,8 +276,10 @@ class Autoscaler:
         want = min(want, self.max_workers)
 
         while len(self.provider.non_terminated_nodes()) < want:
-            self.provider.create_node(self.node_config)
-            launched += 1
+            if self.im.launch_instances(1, self.node_config):
+                launched += 1
+            else:
+                break  # allocation failed: don't tight-loop the provider
 
         now = time.monotonic()
         # 3) reclaim provider nodes whose bootstrap never registered.
@@ -282,7 +295,7 @@ class Autoscaler:
             if now - first > self.UNREGISTERED_GRACE_S:
                 logger.warning("provider node %s never registered; "
                                "terminating", pid)
-                self.provider.terminate_node(pid)
+                self._terminate_pid(pid, "bootstrap never registered")
                 self._unregistered_since.pop(pid, None)
                 terminated += 1
 
@@ -300,13 +313,24 @@ class Autoscaler:
                 if fully_idle:
                     first = self._idle_since.setdefault(pid, now)
                     if now - first > self.idle_timeout_s:
-                        self.provider.terminate_node(pid)
+                        self._terminate_pid(pid, "idle past timeout")
                         self._idle_since.pop(pid, None)
                         terminated += 1
                         over -= 1
                 else:
                     self._idle_since.pop(pid, None)
-        return {"launched": launched, "terminated": terminated}
+        return {"launched": launched, "terminated": terminated,
+                "instances": self.im.summary()}
+
+    def _terminate_pid(self, provider_id: str, detail: str) -> None:
+        """Terminate through the instance table when this reconciler
+        launched the node; directly otherwise (e.g. a pre-existing
+        provider node carrying our cluster label)."""
+        inst = self.im.get_by_provider_id(provider_id)
+        if inst is not None:
+            self.im.terminate_instance(inst.instance_id, detail)
+        else:
+            self.provider.terminate_node(provider_id)
 
     # ------------------------------------------------------------- lifecycle
     def start(self):

@@ -1,0 +1,217 @@
+"""Instance lifecycle state machine for autoscaled nodes.
+
+Reference: ``python/ray/autoscaler/v2/instance_manager/instance_manager.py:29``
+— the v2 autoscaler tracks every cloud instance through an explicit status
+machine (QUEUED → REQUESTED → ALLOCATED → RAY_RUNNING → TERMINATING →
+TERMINATED, with failure branches), keeping a per-instance transition
+history so scaling decisions and debugging read from recorded state
+instead of re-deriving it from provider list calls.
+
+This build's reconciler (:class:`~ray_tpu.autoscaler.Autoscaler`) drives
+the same transitions against the provider + GCS views; the
+InstanceManager is the bookkeeping layer: it owns the instance table,
+validates transitions, and records history. Providers stay the simple
+three-method ABC.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# Statuses (reference: instance_manager.proto Instance.Status).
+QUEUED = "QUEUED"                    # asked for, not yet requested
+REQUESTED = "REQUESTED"              # provider.create_node in flight
+ALLOCATED = "ALLOCATED"              # cloud says the instance exists
+RAY_RUNNING = "RAY_RUNNING"          # node registered with the GCS
+RAY_STOPPING = "RAY_STOPPING"        # drain requested
+TERMINATING = "TERMINATING"          # provider.terminate_node in flight
+TERMINATED = "TERMINATED"            # gone (terminal)
+ALLOCATION_FAILED = "ALLOCATION_FAILED"  # provider create failed (terminal)
+
+# Legal transitions (anything else is a bug worth failing loudly on).
+_TRANSITIONS = {
+    QUEUED: {REQUESTED, TERMINATED},
+    REQUESTED: {ALLOCATED, ALLOCATION_FAILED},
+    ALLOCATED: {RAY_RUNNING, TERMINATING, TERMINATED},
+    RAY_RUNNING: {RAY_STOPPING, TERMINATING, TERMINATED},
+    RAY_STOPPING: {RAY_RUNNING, TERMINATING, TERMINATED},
+    TERMINATING: {TERMINATED},
+    TERMINATED: set(),
+    ALLOCATION_FAILED: set(),
+}
+
+
+@dataclass
+class Instance:
+    instance_id: str
+    status: str = QUEUED
+    node_config: Dict[str, Any] = field(default_factory=dict)
+    provider_id: str = ""            # cloud/provider node id once requested
+    node_id: str = ""                # GCS node id once registered
+    created_at: float = field(default_factory=time.monotonic)
+    updated_at: float = field(default_factory=time.monotonic)
+    # [(status, monotonic ts, detail)] — full transition history.
+    history: List[tuple] = field(default_factory=list)
+
+
+class InvalidTransition(RuntimeError):
+    pass
+
+
+class InstanceManager:
+    """Instance table + transition validation + provider actions.
+
+    ``launch_instances`` / ``terminate_instance`` perform the provider
+    side effects AND record the state transitions; ``sync_from`` folds in
+    the externally-observed views (provider inventory, GCS nodes) each
+    reconcile tick.
+    """
+
+    def __init__(self, provider):
+        self.provider = provider
+        self._instances: Dict[str, Instance] = {}
+        self._lock = threading.Lock()
+
+    # ----------------------------------------------------------- accessors
+    def instances(self, statuses: Optional[set] = None) -> List[Instance]:
+        with self._lock:
+            out = list(self._instances.values())
+        if statuses is not None:
+            out = [i for i in out if i.status in statuses]
+        return out
+
+    TERMINAL = frozenset({TERMINATED, ALLOCATION_FAILED})
+
+    def get_by_provider_id(self, provider_id: str) -> Optional[Instance]:
+        """The LIVE instance for a provider id. Terminal instances are
+        skipped: a TERMINATED record must not shadow the id, or a node
+        whose terminate call failed transiently could never be
+        re-terminated through the manager."""
+        with self._lock:
+            for inst in self._instances.values():
+                if inst.provider_id == provider_id and \
+                        inst.status not in self.TERMINAL:
+                    return inst
+        return None
+
+    # --------------------------------------------------------- transitions
+    def _set_status(self, inst: Instance, status: str,
+                    detail: str = "") -> None:
+        if status not in _TRANSITIONS.get(inst.status, set()):
+            raise InvalidTransition(
+                f"instance {inst.instance_id}: {inst.status} -> {status}")
+        inst.status = status
+        inst.updated_at = time.monotonic()
+        inst.history.append((status, inst.updated_at, detail))
+
+    # -------------------------------------------------------------- actions
+    def launch_instances(self, count: int,
+                         node_config: Dict[str, Any]) -> List[Instance]:
+        """QUEUED → REQUESTED → ALLOCATED/ALLOCATION_FAILED for ``count``
+        new instances (our provider ABC's create_node is synchronous, so
+        REQUESTED exists in the history rather than as a resting state)."""
+        self._prune()
+        launched = []
+        for _ in range(count):
+            inst = Instance(instance_id=f"inst-{uuid.uuid4().hex[:12]}",
+                            node_config=dict(node_config))
+            inst.history.append((QUEUED, inst.created_at, ""))
+            with self._lock:
+                self._instances[inst.instance_id] = inst
+            self._set_status(inst, REQUESTED)
+            try:
+                inst.provider_id = self.provider.create_node(node_config)
+                self._set_status(inst, ALLOCATED, inst.provider_id)
+            except Exception as e:  # noqa: BLE001
+                self._set_status(inst, ALLOCATION_FAILED, str(e))
+                logger.warning("instance %s allocation failed: %s",
+                               inst.instance_id, e)
+                continue
+            launched.append(inst)
+        return launched
+
+    def terminate_instance(self, instance_id: str,
+                           detail: str = "") -> bool:
+        with self._lock:
+            inst = self._instances.get(instance_id)
+        if inst is None or inst.status in (TERMINATED, ALLOCATION_FAILED):
+            return False
+        if inst.status == QUEUED:
+            self._set_status(inst, TERMINATED, detail or "cancelled")
+            return True
+        if inst.status != TERMINATING:
+            self._set_status(inst, TERMINATING, detail)
+        try:
+            self.provider.terminate_node(inst.provider_id)
+        except Exception as e:  # noqa: BLE001
+            # Stay TERMINATING: the next reconcile tick retries (marking
+            # TERMINATED on a failed call would leak the cloud node).
+            logger.warning("terminate of %s failed (will retry): %s",
+                           inst.provider_id, e)
+            return False
+        self._set_status(inst, TERMINATED, detail)
+        self._prune()
+        return True
+
+    MAX_TERMINAL_KEPT = 512
+
+    def _prune(self) -> None:
+        """Bound the table: keep only the newest terminal records (a
+        long-lived reconciler retrying against a quota-exhausted provider
+        would otherwise grow one ALLOCATION_FAILED instance per tick)."""
+        with self._lock:
+            terminal = [i for i in self._instances.values()
+                        if i.status in self.TERMINAL]
+            excess = len(terminal) - self.MAX_TERMINAL_KEPT
+            if excess > 0:
+                terminal.sort(key=lambda i: i.updated_at)
+                for inst in terminal[:excess]:
+                    del self._instances[inst.instance_id]
+
+    # ---------------------------------------------------------------- sync
+    def sync_from(self, provider_ids: set, gcs_provider_ids: set) -> None:
+        """Fold in observed state: provider inventory (which instances
+        still exist) and the GCS view (which registered as nodes).
+
+        ALLOCATED + seen in GCS → RAY_RUNNING; any non-terminal instance
+        that vanished from the provider → TERMINATED (preempted/deleted
+        externally)."""
+        with self._lock:
+            insts = list(self._instances.values())
+        for inst in insts:
+            if inst.status in (TERMINATED, ALLOCATION_FAILED, QUEUED):
+                continue
+            if inst.provider_id not in provider_ids:
+                self._set_status(inst, TERMINATED, "vanished from provider")
+                continue
+            if inst.status == ALLOCATED and \
+                    inst.provider_id in gcs_provider_ids:
+                self._set_status(inst, RAY_RUNNING)
+            elif inst.status == RAY_RUNNING and \
+                    inst.provider_id not in gcs_provider_ids:
+                # Registered once, gone from the GCS now: draining/dead
+                # ray-side while the VM lives on.
+                self._set_status(inst, RAY_STOPPING, "left the GCS")
+            elif inst.status == RAY_STOPPING and \
+                    inst.provider_id in gcs_provider_ids:
+                # Back in the GCS (heartbeat blip / cancelled drain).
+                self._set_status(inst, RAY_RUNNING, "re-registered")
+
+    def summary(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for inst in self.instances():
+            counts[inst.status] = counts.get(inst.status, 0) + 1
+        return counts
+
+
+__all__ = ["Instance", "InstanceManager", "InvalidTransition",
+           "QUEUED", "REQUESTED", "ALLOCATED", "RAY_RUNNING",
+           "RAY_STOPPING", "TERMINATING", "TERMINATED",
+           "ALLOCATION_FAILED"]

@@ -351,3 +351,83 @@ def test_multi_host_slice_not_reclaimed_while_any_host_busy(
     assert vm_id not in p.non_terminated_nodes()
     for h in hosts:
         gcs.DrainNode(pb.DrainNodeRequest(node_id=h.node_id))
+
+
+# ------------------------------------------------- instance state machine
+
+def test_instance_manager_lifecycle(cluster, mock_tpu_api):
+    """Launch -> ALLOCATED -> RAY_RUNNING -> TERMINATED with full history
+    (reference: v2 instance_manager.py status machine)."""
+    from ray_tpu.autoscaler import instance_manager as im_mod
+
+    p = _tpu_provider(mock_tpu_api)
+    im = im_mod.InstanceManager(p)
+    (inst,) = im.launch_instances(1, {"accelerator_type": "v5litepod-8"})
+    assert inst.status == im_mod.ALLOCATED
+    assert [s for s, _, _ in inst.history] == [
+        im_mod.QUEUED, im_mod.REQUESTED, im_mod.ALLOCATED]
+    assert inst.provider_id in p.non_terminated_nodes()
+
+    # GCS registration observed -> RAY_RUNNING.
+    im.sync_from(set(p.non_terminated_nodes()), {inst.provider_id})
+    assert inst.status == im_mod.RAY_RUNNING
+
+    # Left the GCS while the VM lives -> RAY_STOPPING.
+    im.sync_from(set(p.non_terminated_nodes()), set())
+    assert inst.status == im_mod.RAY_STOPPING
+
+    assert im.terminate_instance(inst.instance_id, "test done")
+    assert inst.status == im_mod.TERMINATED
+    assert inst.provider_id not in p.non_terminated_nodes()
+    assert not im.terminate_instance(inst.instance_id)  # terminal: no-op
+    assert im.summary() == {im_mod.TERMINATED: 1}
+
+    # Invalid transitions fail loudly.
+    import pytest as _pytest
+
+    with _pytest.raises(im_mod.InvalidTransition):
+        im._set_status(inst, im_mod.RAY_RUNNING)
+
+
+def test_instance_manager_external_vanish_and_alloc_failure(
+        cluster, mock_tpu_api):
+    from ray_tpu.autoscaler import instance_manager as im_mod
+
+    p = _tpu_provider(mock_tpu_api)
+    im = im_mod.InstanceManager(p)
+    (inst,) = im.launch_instances(1, {})
+    # Preempted/deleted outside our control: provider no longer lists it.
+    mock_tpu_api.nodes.clear()
+    im.sync_from(set(p.non_terminated_nodes()), set())
+    assert inst.status == im_mod.TERMINATED
+    assert inst.history[-1][2] == "vanished from provider"
+
+    class FailingProvider:
+        def create_node(self, cfg):
+            raise RuntimeError("quota exceeded")
+
+        def terminate_node(self, nid):
+            pass
+
+        def non_terminated_nodes(self):
+            return []
+
+    im2 = im_mod.InstanceManager(FailingProvider())
+    assert im2.launch_instances(2, {}) == []
+    assert im2.summary() == {im_mod.ALLOCATION_FAILED: 2}
+    failed = im2.instances({im_mod.ALLOCATION_FAILED})[0]
+    assert "quota exceeded" in failed.history[-1][2]
+
+
+def test_autoscaler_reports_instance_summary(cluster, mock_tpu_api):
+    from ray_tpu.autoscaler import instance_manager as im_mod
+
+    p = _tpu_provider(mock_tpu_api)
+    scaler = Autoscaler(cluster.address, p,
+                        node_config={"resources": {"TPU": 8.0}},
+                        max_workers=4)
+    request_resources(cluster.address, [{"TPU": 8.0}])
+    out = scaler.reconcile_once()
+    assert out["launched"] == 1
+    assert out["instances"].get(im_mod.ALLOCATED) == 1
+    request_resources(cluster.address, [])
