@@ -1015,11 +1015,15 @@ class NodeManager:
             self._spill_event.clear()
             self._drain_to_low_water()
 
-    def _drain_to_low_water(self):
-        """Spill LRU-cold objects until usage falls to the low watermark.
-        The lock is taken per victim so concurrent restores/pulls interleave
-        with the drain instead of blocking for its whole duration."""
-        target = self._store_capacity * self.SPILL_LOW
+    def _drain_to_low_water(self, min_free_bytes: int = 0):
+        """Spill LRU-cold objects until usage falls to the low watermark
+        (or low enough that ``min_free_bytes`` fits — an object larger
+        than the watermark slack must still be admittable; reference:
+        plasma SpillObjectsOfSize takes the needed size). The lock is
+        taken per victim so concurrent restores/pulls interleave with the
+        drain instead of blocking for its whole duration."""
+        target = min(self._store_capacity * self.SPILL_LOW,
+                     max(self._store_capacity - min_free_bytes, 0))
         try:
             os.makedirs(self._spill_dir, exist_ok=True)
         except OSError:
@@ -1132,39 +1136,81 @@ class NodeManager:
         return True
 
     # ------------------------------------------------------------ objects
-    def _store_object(self, request) -> int:
-        """Seat one object in the local store; returns its size."""
+    def _store_object(self, request) -> Optional[int]:
+        """Seat one object in the local store; returns its size, or None
+        when it could not be stored (capacity even after spilling) — the
+        caller must NOT register a directory location for a dropped
+        object, or readers would spin fetching something that isn't there.
+
+        Backpressure (reference: plasma's create-request queue): a
+        capacity failure spills down to the low watermark synchronously
+        and retries once before giving up.
+        """
         size = request.size or len(request.data)
+        oid_hex = request.object_id.hex()
         if request.shm_name and self._shm is not None:
             # Zero-copy put: the client already created+sealed the segment;
             # only the metadata is registered (plasma Create/Seal protocol).
-            self._shm.register(request.object_id.hex(), request.shm_name,
-                               request.size)
+            if not self._seat_with_backpressure(
+                    lambda: self._shm.register(oid_hex, request.shm_name,
+                                               request.size), size):
+                logger.warning("store full: rejecting register of %s "
+                               "(%d bytes)", oid_hex[:12], size)
+                # Nothing indexes the client-created segment now: unlink
+                # it or it leaks in /dev/shm forever.
+                from ray_tpu._private.shm import ShmClient
+
+                ShmClient.unlink_segment(request.shm_name)
+                return None
         elif self._shm is not None and request.data:
-            self._shm.put(request.object_id.hex(), request.data)
+            if not self._seat_with_backpressure(
+                    lambda: self._shm.put(oid_hex,
+                                          request.data) is not None, size):
+                logger.warning("store full: rejecting put of %s "
+                               "(%d bytes)", oid_hex[:12], size)
+                return None
         else:
             with self._obj_lock:
                 self._objects[request.object_id] = request.data
         return size
 
+    def _seat_with_backpressure(self, attempt, size: int,
+                                retries: int = 5) -> bool:
+        """Run ``attempt()`` with spill-down retries: concurrent writers
+        can consume freed space between a drain and the retry, so one
+        retry is not enough under sustained pressure (plasma queues
+        create requests; this bounded loop is the collapsed analog)."""
+        if attempt():
+            return True
+        for _ in range(retries):
+            self._drain_to_low_water(min_free_bytes=size)
+            if attempt():
+                return True
+        return False
+
     def PutObject(self, request, context):
         size = self._store_object(request)
-        try:
-            self.gcs.UpdateObjectLocation(pb.ObjectLocationUpdate(
-                object_id=request.object_id, node_id=self.node_id,
-                added=True, size=size))
-        except Exception:  # noqa: BLE001
-            pass
+        if size is not None:
+            try:
+                self.gcs.UpdateObjectLocation(pb.ObjectLocationUpdate(
+                    object_id=request.object_id, node_id=self.node_id,
+                    added=True, size=size))
+            except Exception:  # noqa: BLE001
+                pass
         self._maybe_spill()
-        return pb.Empty()
+        return pb.PutObjectReply(rejected=size is None)
 
     def PutObjectBatch(self, request, context):
         """Amortized small-object puts (the driver's put flusher batches
         inline payloads into one RPC instead of an RPC per object; the
         directory registration rides one batched GCS RPC too)."""
         batch = pb.ObjectLocationBatch()
+        rejected = []
         for item in request.items:
             size = self._store_object(item)
+            rejected.append(size is None)
+            if size is None:
+                continue  # rejected at capacity: no location to register
             batch.updates.append(pb.ObjectLocationUpdate(
                 object_id=item.object_id, node_id=self.node_id,
                 added=True, size=size))
@@ -1173,7 +1219,7 @@ class NodeManager:
         except Exception:  # noqa: BLE001
             pass
         self._maybe_spill()
-        return pb.Empty()
+        return pb.PutObjectBatchReply(rejected=rejected)
 
     def GetObject(self, request, context):
         oid_hex = request.object_id.hex()

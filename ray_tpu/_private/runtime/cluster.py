@@ -473,6 +473,23 @@ class ClusterRuntime(CoreRuntime):
         # No shm: legacy inline/bytes path.
         self._enqueue_put(("data", oid, STORE_MAGIC + s.to_bytes()))
 
+    def _requeue_rejected_shm(self, item: tuple) -> None:
+        """Rebuild a rejected zero-copy put's segment from the live value
+        (the node unlinked the original) and queue it again, preserving
+        the item's original deadline."""
+        from ray_tpu._private.serialization import Serializer
+        from ray_tpu._private.shm import ShmClient
+
+        oid, seg, deadline = item[1], item[2], item[-1]
+        value = self.memory.get_if_ready(oid, default=None)
+        if value is None:
+            return  # freed meanwhile: nothing to re-ship
+        s = Serializer().serialize(value)
+        if ShmClient.create_segment_vectored(seg, s.to_parts(STORE_MAGIC)):
+            with self._put_cv:
+                self._put_q.append(("shm", oid, seg,
+                                    4 + s.wire_size(), deadline))
+
     def _enqueue_put(self, item: tuple) -> None:
         with self._put_cv:
             self._put_q.append(item + (time.monotonic() + 60.0,))
@@ -533,7 +550,26 @@ class ClusterRuntime(CoreRuntime):
             if not batch.items:
                 continue
             try:
-                self.node.PutObjectBatch(batch)
+                reply = self.node.PutObjectBatch(batch)
+                # Items the store REJECTED (full even after spilling) have
+                # no location and — for shm items — no segment anymore
+                # (the node unlinks what it can't index). Re-enqueue from
+                # the live in-process value so the flush retries once the
+                # spiller catches up; the 60s deadline still bounds it.
+                for it, rej in zip(retry, list(reply.rejected)):
+                    if not rej:
+                        continue
+                    if it[-1] <= time.monotonic():
+                        logger.error(
+                            "store rejected put of %s repeatedly; the "
+                            "object exists only in this process",
+                            it[1].hex()[:12])
+                        continue
+                    if it[0] == "shm":
+                        self._requeue_rejected_shm(it)
+                    else:
+                        with self._put_cv:
+                            self._put_q.append(it)
             except Exception:  # noqa: BLE001
                 self._refresh_local_node()
                 kept = [it for it in retry if it[-1] > now]

@@ -282,6 +282,15 @@ class Autoscaler:
                 break  # allocation failed: don't tight-loop the provider
 
         now = time.monotonic()
+        # Retry instances stuck TERMINATING (an earlier provider
+        # terminate call failed transiently).
+        from ray_tpu.autoscaler import instance_manager as im_mod
+
+        for inst in self.im.instances({im_mod.TERMINATING}):
+            if self.im.terminate_instance(inst.instance_id,
+                                          "retry terminate"):
+                terminated += 1
+
         # 3) reclaim provider nodes whose bootstrap never registered.
         managed_now = set(self.provider.non_terminated_nodes())
         for pid in list(self._unregistered_since):
@@ -295,9 +304,9 @@ class Autoscaler:
             if now - first > self.UNREGISTERED_GRACE_S:
                 logger.warning("provider node %s never registered; "
                                "terminating", pid)
-                self._terminate_pid(pid, "bootstrap never registered")
-                self._unregistered_since.pop(pid, None)
-                terminated += 1
+                if self._terminate_pid(pid, "bootstrap never registered"):
+                    self._unregistered_since.pop(pid, None)
+                    terminated += 1
 
         # 4) scale down: provider nodes whose EVERY host is fully idle
         #    past the timeout (one busy host keeps the whole slice).
@@ -312,8 +321,8 @@ class Autoscaler:
                     for h in hosts for k, v in h.resources.items())
                 if fully_idle:
                     first = self._idle_since.setdefault(pid, now)
-                    if now - first > self.idle_timeout_s:
-                        self._terminate_pid(pid, "idle past timeout")
+                    if now - first > self.idle_timeout_s and \
+                            self._terminate_pid(pid, "idle past timeout"):
                         self._idle_since.pop(pid, None)
                         terminated += 1
                         over -= 1
@@ -322,15 +331,22 @@ class Autoscaler:
         return {"launched": launched, "terminated": terminated,
                 "instances": self.im.summary()}
 
-    def _terminate_pid(self, provider_id: str, detail: str) -> None:
+    def _terminate_pid(self, provider_id: str, detail: str) -> bool:
         """Terminate through the instance table when this reconciler
         launched the node; directly otherwise (e.g. a pre-existing
-        provider node carrying our cluster label)."""
+        provider node carrying our cluster label). Returns success — a
+        failed provider call leaves the instance TERMINATING and the
+        caller must NOT count it terminated or drop its trackers."""
         inst = self.im.get_by_provider_id(provider_id)
         if inst is not None:
-            self.im.terminate_instance(inst.instance_id, detail)
-        else:
+            return self.im.terminate_instance(inst.instance_id, detail)
+        try:
             self.provider.terminate_node(provider_id)
+            return True
+        except Exception as e:  # noqa: BLE001
+            logger.warning("terminate of unmanaged %s failed: %s",
+                           provider_id, e)
+            return False
 
     # ------------------------------------------------------------- lifecycle
     def start(self):

@@ -186,7 +186,10 @@ class InstanceManager:
         with self._lock:
             insts = list(self._instances.values())
         for inst in insts:
-            if inst.status in (TERMINATED, ALLOCATION_FAILED, QUEUED):
+            # REQUESTED skipped too: an instance observed mid-launch has
+            # no provider_id yet and must not take the vanished branch.
+            if inst.status in (TERMINATED, ALLOCATION_FAILED, QUEUED,
+                               REQUESTED):
                 continue
             if inst.provider_id not in provider_ids:
                 self._set_status(inst, TERMINATED, "vanished from provider")
