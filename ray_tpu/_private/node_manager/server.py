@@ -1182,6 +1182,10 @@ class NodeManager:
         create requests; this bounded loop is the collapsed analog)."""
         if attempt():
             return True
+        if size > self._store_capacity:
+            # Can NEVER fit: draining would evict the entire store to
+            # disk on every retry without ever succeeding.
+            return False
         for _ in range(retries):
             self._drain_to_low_water(min_free_bytes=size)
             if attempt():

@@ -98,10 +98,12 @@ def loads_payload(data) -> Tuple[Any, int]:
 
 
 def put_bytes_to_node(node_stub, oid_binary: bytes, data: bytes,
-                      owner: str) -> None:
+                      owner: str) -> bool:
     """Store serialized bytes on a node: large payloads go through a
     client-created shm segment (zero-copy data plane, metadata-only RPC);
-    small ones ride inline in the RPC."""
+    small ones ride inline in the RPC. Returns False when the store
+    REJECTED the object (full even after spilling) — callers must not
+    assume the object is fetchable."""
     from ray_tpu._private.shm import ShmClient
 
     if len(data) > INLINE_RESULT_MAX and ShmClient.available():
@@ -109,12 +111,13 @@ def put_bytes_to_node(node_stub, oid_binary: bytes, data: bytes,
         # (they differ only in the trailing 4-byte index).
         seg = f"/rtpu.{oid_binary.hex()}"
         if ShmClient.create_segment(seg, data):
-            node_stub.PutObject(pb.PutObjectRequest(
+            reply = node_stub.PutObject(pb.PutObjectRequest(
                 object_id=oid_binary, shm_name=seg, size=len(data),
                 owner=owner))
-            return
-    node_stub.PutObject(pb.PutObjectRequest(
+            return not reply.rejected
+    reply = node_stub.PutObject(pb.PutObjectRequest(
         object_id=oid_binary, data=data, owner=owner))
+    return not reply.rejected
 
 
 def read_object_reply(reply) -> Any:
@@ -556,9 +559,11 @@ class ClusterRuntime(CoreRuntime):
                 # (the node unlinks what it can't index). Re-enqueue from
                 # the live in-process value so the flush retries once the
                 # spiller catches up; the 60s deadline still bounds it.
+                any_rejected = False
                 for it, rej in zip(retry, list(reply.rejected)):
                     if not rej:
                         continue
+                    any_rejected = True
                     if it[-1] <= time.monotonic():
                         logger.error(
                             "store rejected put of %s repeatedly; the "
@@ -570,6 +575,11 @@ class ClusterRuntime(CoreRuntime):
                     else:
                         with self._put_cv:
                             self._put_q.append(it)
+                if any_rejected:
+                    # Back off before re-sending: without it the requeue
+                    # spins at the coalesce interval, re-serializing and
+                    # re-creating segments the node promptly rejects.
+                    time.sleep(0.2)
             except Exception:  # noqa: BLE001
                 self._refresh_local_node()
                 kept = [it for it in retry if it[-1] > now]
@@ -1073,14 +1083,19 @@ class ClusterRuntime(CoreRuntime):
             return None
         oid = ObjectID.from_task(task_id, self.PAYLOAD_INDEX)
         try:
-            put_bytes_to_node(self.node, oid.binary(), payload,
-                              self.worker_id)
+            stored = put_bytes_to_node(self.node, oid.binary(), payload,
+                                       self.worker_id)
         except Exception:  # noqa: BLE001
             if not self._refresh_local_node():
                 spec.payload = payload
                 return None
-            put_bytes_to_node(self.node, oid.binary(), payload,
-                              self.worker_id)
+            stored = put_bytes_to_node(self.node, oid.binary(), payload,
+                                       self.worker_id)
+        if not stored:
+            # Store rejected the promotion (full): ship the payload inline
+            # — heavier on the wire, but the task still runs.
+            spec.payload = payload
+            return None
         spec.payload_ref = oid.binary()
         return oid.binary()
 

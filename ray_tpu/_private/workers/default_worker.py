@@ -258,10 +258,17 @@ class WorkerServer:
                 f"num_returns='streaming' requires a generator "
                 f"{'method' if spec.actor_id else 'function'}, but "
                 f"{spec.name!r} returned {type(gen).__name__}")
-        return drain_stream(
-            gen, TaskID(bytes(spec.task_id)),
-            lambda oid, item: put_bytes_to_node(
-                self.node, oid.binary(), dumps(item), self.worker_id))
+        def store_item(oid, item):
+            if not put_bytes_to_node(self.node, oid.binary(), dumps(item),
+                                     self.worker_id):
+                # A stream item MUST live in the store (the consumer
+                # fetches it by id); rejection fails the task rather than
+                # silently dropping items mid-stream.
+                raise exceptions.RayTpuError(
+                    f"object store rejected stream item {oid.hex()[:12]} "
+                    f"(store full even after spilling)")
+
+        return drain_stream(gen, TaskID(bytes(spec.task_id)), store_item)
 
     def _resolve_args(self, args, kwargs):
         """Top-level ObjectRef resolution (nested refs pass through)."""
@@ -290,11 +297,15 @@ class WorkerServer:
         out = pb.PushTaskResult(ok=True)
         for oid, value in zip(return_ids, values):
             data = dumps(value)
-            if len(data) <= INLINE_RESULT_MAX:
+            if len(data) <= INLINE_RESULT_MAX or not put_bytes_to_node(
+                    self.node, bytes(oid), data, self.worker_id):
+                # Small result — or the store REJECTED a large one (full
+                # even after spilling): degrade to inline so the result
+                # still reaches the owner (whose flusher re-seats it in
+                # the store once pressure clears) instead of vanishing.
                 out.inline_results.append(data)
                 out.in_store.append(False)
             else:
-                put_bytes_to_node(self.node, bytes(oid), data, self.worker_id)
                 out.inline_results.append(b"")
                 out.in_store.append(True)
         return out

@@ -131,8 +131,10 @@ def test_restored_pending_actor_rescheduled(persistent_cluster):
     c.gcs = GcsServer(port=port, persist_path=c.gcs_persist_path)
 
     # The restored PENDING actor must come back ALIVE (rescheduled onto the
-    # re-registered node) and serve calls again.
-    deadline = time.monotonic() + 30
+    # re-registered node) and serve calls again. Generous deadline: late
+    # in the full suite hundreds of accumulated daemon threads from prior
+    # modules contend for the CPU and stretch the restart path.
+    deadline = time.monotonic() + 60
     gcs = rpc.get_stub("GcsService", c.address)
     aid = next(iter(infos))
     state_seen = ""

@@ -150,6 +150,7 @@ def test_concurrent_store_pressure_stress(small_store_cluster):
         t.start()
     for t in threads:
         t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "stress thread hung"
     assert not errors, errors[:3]
 
     # Everything written survives the churn — fetched from shm or spill.
