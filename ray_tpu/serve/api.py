@@ -19,8 +19,10 @@ Condensed re-design of SURVEY.md §3.5's architecture:
   deployment's replica set changes; handles refresh on the event instead of
   polling on a TTL, and a call that lands on a dead replica refreshes and
   retries immediately.
-* HTTP ingress: an aiohttp proxy thread mapping ``POST /<deployment>`` to
-  handle calls (``proxy.py:752``).
+* Data plane: an asyncio HTTP/1.1 ingress (keep-alive, chunked streaming,
+  bounded-executor admission) plus a gRPC ingress over one shared router,
+  and declarative YAML/REST deploys — see :mod:`ray_tpu.serve.proxy` and
+  :mod:`ray_tpu.serve.config` (reference ``proxy.py:532,752``).
 """
 
 from __future__ import annotations
