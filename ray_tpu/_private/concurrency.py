@@ -1,0 +1,51 @@
+"""Shared actor-concurrency helpers.
+
+One source of truth for "is this class an async actor?" and "which
+concurrency group does this method belong to?", used by both the cluster
+worker (``workers/default_worker.py``) and the in-process runtime
+(``runtime/local.py``) so the two executors can't silently diverge
+(reference: ``src/ray/core_worker/transport/concurrency_group_manager.h``
+— one manager shared by every transport).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Dict, Optional
+
+
+def class_is_async(cls: type) -> bool:
+    """True when any (possibly inherited) method is a coroutine or
+    async-generator function — the class runs as an async actor on a
+    dedicated event loop (reference: async actors, ``fiber.h``)."""
+    return any(
+        inspect.iscoroutinefunction(getattr(cls, name, None))
+        or inspect.isasyncgenfunction(getattr(cls, name, None))
+        for name in dir(cls))
+
+
+def effective_max_concurrency(is_async: bool, max_concurrency: int) -> int:
+    """Resolve the user's ``max_concurrency`` option: async actors left at
+    the default (1) run highly concurrent (reference: async actors default
+    to max_concurrency=1000). Shared by the submitter window sizing and
+    both executors so they can't desynchronize."""
+    mc = max(1, int(max_concurrency or 1))
+    if is_async and mc == 1:
+        return 1000
+    return mc
+
+
+def group_of(method, groups: Optional[Dict[str, int]]) -> str:
+    """Concurrency-group name for a bound method ("" = default group).
+
+    The group rides the ``@ray_tpu.method(concurrency_group=...)``
+    decorator attribute, which pickles with the class — executors read it
+    straight off the instance. Unknown group names raise ``ValueError``.
+    """
+    opts = getattr(method, "__ray_tpu_method_options__", None) or {}
+    group = opts.get("concurrency_group", "")
+    if group and group not in (groups or {}):
+        raise ValueError(
+            f"method declares concurrency_group={group!r} but the "
+            f"actor class only defines groups {sorted(groups or {})}")
+    return group

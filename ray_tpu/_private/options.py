@@ -58,6 +58,10 @@ class RemoteOptions:
 
     # Internal.
     _is_actor: bool = False
+    # Set at ActorClass._remote time (the client sees the class): async
+    # actors get a wider submitter send window so max_concurrency isn't
+    # silently capped by the in-flight push limit.
+    _is_async_actor: bool = False
 
     def merged_with(self, overrides: Dict[str, Any]) -> "RemoteOptions":
         known = {f.name for f in dataclasses.fields(self)}

@@ -8,19 +8,24 @@ submit paths read it when no explicit placement option is given.
 
 from __future__ import annotations
 
-import threading
+import contextvars
 from typing import Optional, Tuple
 
-_local = threading.local()
+# ContextVar, not threading.local: plain worker threads each get their own
+# context (same semantics as before), and on an async actor's event loop
+# every asyncio task carries its own copy, so interleaved coroutines don't
+# race on set/clear.
+_ctx: contextvars.ContextVar[Optional[Tuple[bytes, int, bool]]] = \
+    contextvars.ContextVar("ray_tpu_pg_context", default=None)
 
 
 def set(group_id: bytes, bundle_index: int, capture: bool) -> None:  # noqa: A001
-    _local.ctx = (group_id, bundle_index, capture)
+    _ctx.set((group_id, bundle_index, capture))
 
 
 def clear() -> None:
-    _local.ctx = None
+    _ctx.set(None)
 
 
 def get() -> Optional[Tuple[bytes, int, bool]]:
-    return getattr(_local, "ctx", None)
+    return _ctx.get()

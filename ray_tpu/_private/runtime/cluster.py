@@ -1874,6 +1874,13 @@ class ClusterRuntime(CoreRuntime):
             session = self._actor_session.get(actor_id.binary(), 0)
             seq = self._actor_seq.get(actor_id.binary(), 0)
             self._actor_seq[actor_id.binary()] = seq + 1
+        if getattr(options, "_is_async_actor", False):
+            from ray_tpu._private.concurrency import effective_max_concurrency
+
+            eff = effective_max_concurrency(True, options.max_concurrency)
+            st = self._actor_window_state(actor_id.binary())
+            st["window"] = max(self.ACTOR_SEND_WINDOW,
+                               min(eff, self.ASYNC_ACTOR_SEND_WINDOW_MAX))
         payload, contained = dumps_payload((None, args, kwargs))
         spec = pb.TaskSpec(
             task_id=task_id.binary(),
@@ -1928,13 +1935,19 @@ class ClusterRuntime(CoreRuntime):
     # thread; this runtime's unary RPCs can't, so the submitter bounds the
     # in-flight window instead).
     ACTOR_SEND_WINDOW = 16
+    # Async actors hold a push open for the whole await, so the window IS
+    # the concurrency cap seen by one caller — widen it (bounded by the
+    # submitter pool of 64 and the worker server pool of 128, shared with
+    # gets/prefetches).
+    ASYNC_ACTOR_SEND_WINDOW_MAX = 48
 
     def _actor_window_state(self, aid: bytes) -> dict:
         with self._actor_lock:
             st = self._actor_window.get(aid)
             if st is None:
                 st = self._actor_window[aid] = {
-                    "cond": threading.Condition(), "done": 0}
+                    "cond": threading.Condition(), "done": 0,
+                    "window": self.ACTOR_SEND_WINDOW}
             return st
 
     def _push_actor_task(self, actor_id: ActorID, spec: pb.TaskSpec,
@@ -1948,7 +1961,7 @@ class ClusterRuntime(CoreRuntime):
         # deadline it proceeds and fails fast server-side instead.
         gate_deadline = time.monotonic() + 120.0
         with st["cond"]:
-            while seq >= st["done"] + self.ACTOR_SEND_WINDOW and \
+            while seq >= st["done"] + st["window"] and \
                     not self._shutdown and time.monotonic() < gate_deadline:
                 st["cond"].wait(1.0)
         try:

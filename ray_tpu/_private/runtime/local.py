@@ -129,13 +129,16 @@ class _LocalActor:
         self.instance = None
         self.dead = False
         self.death_cause: Optional[BaseException] = None
-        # Inherited coroutine methods count too.
-        self.is_async = any(
-            inspect.iscoroutinefunction(getattr(cls, name, None))
-            for name in dir(cls))
-        self.max_concurrency = options.max_concurrency
-        if self.is_async and options.max_concurrency == 1:
-            self.max_concurrency = 1000  # async actors default to high concurrency
+        from ray_tpu._private import concurrency as _conc
+
+        # Inherited coroutine (and async-generator) methods count too.
+        self.is_async = _conc.class_is_async(cls)
+        self.max_concurrency = _conc.effective_max_concurrency(
+            self.is_async, options.max_concurrency)
+        # Concurrency groups (reference: concurrency_group_manager.h):
+        # per-group caps; declaring groups on a sync actor switches it to
+        # threaded execution (same rule as the cluster worker).
+        self.groups: Dict[str, int] = dict(options.concurrency_groups or {})
         self._inbox: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()
         self._pool: Optional[ThreadPoolExecutor] = None
@@ -164,7 +167,7 @@ class _LocalActor:
         self.runtime._actor_started(self.actor_id)
         if self.is_async:
             self._run_async_loop()
-        elif self.max_concurrency > 1:
+        elif self.max_concurrency > 1 or self.groups:
             self._run_concurrent()
         else:
             self._run_ordered()
@@ -176,34 +179,68 @@ class _LocalActor:
                 return
             self._execute(*item)
 
+    def _group_of(self, method_name: str) -> str:
+        from ray_tpu._private import concurrency as _conc
+
+        return _conc.group_of(getattr(self.instance, method_name, None),
+                              self.groups)
+
     def _run_concurrent(self):
-        self._pool = ThreadPoolExecutor(
+        # One pool PER concurrency group, sized to the group's cap (the
+        # default group gets max_concurrency) — the pool itself is the
+        # gate, so a backlogged group queues in its own executor and can
+        # never occupy another group's threads (reference:
+        # concurrency_group_manager.h: one BoundedExecutor per group).
+        self._group_pools = {
+            name: ThreadPoolExecutor(
+                max_workers=int(cap),
+                thread_name_prefix=f"actor-{self.actor_id.hex()[:6]}-{name}")
+            for name, cap in self.groups.items()}
+        self._group_pools[""] = self._pool = ThreadPoolExecutor(
             max_workers=self.max_concurrency,
             thread_name_prefix=f"actor-{self.actor_id.hex()[:6]}")
         while True:
             item = self._inbox.get()
             if item is None:
-                self._pool.shutdown(wait=False)
+                for pool in self._group_pools.values():
+                    pool.shutdown(wait=False)
                 return
-            self._pool.submit(self._execute, *item)
+            try:
+                pool = self._group_pools[self._group_of(item[0])]
+            except ValueError as e:
+                self.runtime._store_error(
+                    exceptions.RayTaskError.from_exception(
+                        e, f"{self.cls.__name__}.{item[0]}"), item[3])
+                continue
+            pool.submit(self._execute, *item)
 
     def _run_async_loop(self):
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
-        sem = asyncio.Semaphore(self.max_concurrency)
+        sems = {"": asyncio.Semaphore(self.max_concurrency)}
+        for name, cap in self.groups.items():
+            sems[name] = asyncio.Semaphore(int(cap))
 
         async def pump():
             while True:
                 item = await loop.run_in_executor(None, self._inbox.get)
                 if item is None:
                     return
-                await sem.acquire()
+                try:
+                    sem = sems[self._group_of(item[0])]
+                except ValueError as e:
+                    self.runtime._store_error(
+                        exceptions.RayTaskError.from_exception(
+                            e, f"{self.cls.__name__}.{item[0]}"), item[3])
+                    continue
 
-                async def run(item=item):
-                    try:
+                # Acquire INSIDE the task: a saturated group must not
+                # head-of-line block the pump (other groups keep flowing)
+                # — same placement as the cluster worker's
+                # _run_async_actor_method.
+                async def run(item=item, sem=sem):
+                    async with sem:
                         await self._execute_async(*item)
-                    finally:
-                        sem.release()
 
                 loop.create_task(run())
 

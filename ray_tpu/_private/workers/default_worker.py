@@ -143,22 +143,94 @@ class _LogPublisher:
 
 
 class _ActorRunner:
-    """Per-caller sequence ordering + single-slot execution for one actor.
+    """Execution modes for one hosted actor instance.
 
-    ``max_concurrency > 1`` switches to threaded-actor semantics
-    (reference: threaded actors, ``core_worker.cc`` BoundedExecutor):
-    calls run concurrently on RPC threads gated by a semaphore, and
-    per-caller ordering is deliberately NOT enforced.
+    * **ordered** (default, ``max_concurrency == 1``, no coroutine methods,
+      no concurrency groups): per-caller sequence ordering + single-slot
+      execution (reference: ``actor_scheduling_queue.h``).
+    * **threaded** (``max_concurrency > 1`` or ``concurrency_groups``
+      declared on a sync class): calls run concurrently on RPC threads
+      gated by semaphores — one per concurrency group plus a default
+      (reference: threaded actors, ``core_worker.cc`` BoundedExecutor +
+      ``concurrency_group_manager.h``). Per-caller ordering is
+      deliberately NOT enforced.
+    * **async** (any ``async def`` method on the class): a dedicated
+      asyncio event loop thread runs every call (reference: async actors,
+      ``src/ray/core_worker/fiber.h`` — fibers there, one loop here
+      because Python coroutines ARE the fiber). Calls *start* in
+      per-caller submission order, then interleave at await points;
+      ``max_concurrency`` (default 1000) caps concurrent awaits via
+      asyncio semaphores, per concurrency group.
+
+    Concurrency groups are declared at the class level
+    (``@ray_tpu.remote(concurrency_groups={"io": 2})``) and picked per
+    method with ``@ray_tpu.method(concurrency_group="io")`` — the group
+    name travels with the pickled method attribute, so the worker reads
+    it straight off the instance.
     """
 
-    def __init__(self, instance: Any, max_concurrency: int = 1):
+    def __init__(self, instance: Any, max_concurrency: int = 1,
+                 concurrency_groups: Optional[Dict[str, int]] = None):
+        from ray_tpu._private import concurrency
+
         self.instance = instance
         self.cond = threading.Condition()
         self.next_seq: Dict[bytes, int] = {}
         self.dead = False
         self.pg_ctx: Optional[tuple] = None  # (group_id, bundle_idx, capture)
-        self.max_concurrency = max(1, int(max_concurrency))
-        self.sem = threading.Semaphore(self.max_concurrency)
+        self.is_async = concurrency.class_is_async(type(instance))
+        mc = concurrency.effective_max_concurrency(self.is_async,
+                                                   max_concurrency)
+        self.max_concurrency = mc
+        self.groups: Dict[str, int] = dict(concurrency_groups or {})
+        self.ordered = (not self.is_async and mc == 1 and not self.groups)
+        self.loop: Optional[Any] = None
+        if self.is_async:
+            import asyncio
+
+            self.loop = asyncio.new_event_loop()
+            self._async_sems: Dict[str, Any] = {}
+            ready = threading.Event()
+
+            def loop_body():
+                asyncio.set_event_loop(self.loop)
+                ready.set()
+                self.loop.run_forever()
+
+            threading.Thread(target=loop_body, daemon=True,
+                             name="actor-async-loop").start()
+            ready.wait(timeout=10.0)
+        else:
+            self.sem = threading.Semaphore(mc)
+            self._thread_sems = {name: threading.Semaphore(int(cap))
+                                 for name, cap in self.groups.items()}
+
+    # -- concurrency-group resolution -----------------------------------
+    def _group_of(self, method) -> str:
+        from ray_tpu._private import concurrency
+
+        return concurrency.group_of(method, self.groups)
+
+    def thread_sem_for(self, method) -> threading.Semaphore:
+        group = self._group_of(method)
+        return self._thread_sems[group] if group else self.sem
+
+    def async_sem_for(self, method):
+        import asyncio
+
+        group = self._group_of(method)
+        sem = self._async_sems.get(group)
+        if sem is None:
+            cap = self.groups.get(group, self.max_concurrency)
+            sem = self._async_sems[group] = asyncio.Semaphore(int(cap))
+        return sem
+
+    def stop_loop(self):
+        if self.loop is not None:
+            try:
+                self.loop.call_soon_threadsafe(self.loop.stop)
+            except Exception:  # noqa: BLE001 — already closed
+                pass
 
     def wait_turn(self, caller: bytes, seq: int) -> bool:
         deadline = time.monotonic() + 120.0
@@ -253,6 +325,12 @@ class WorkerServer:
 
         from ray_tpu._private.object_ref import drain_stream
 
+        if inspect.isasyncgen(gen):
+            # async-generator streaming task outside an async actor: drain
+            # on a private loop.
+            import asyncio
+
+            return asyncio.run(self._drain_stream_async(gen, spec))
         if not (inspect.isgenerator(gen) or hasattr(gen, "__next__")):
             raise TypeError(
                 f"num_returns='streaming' requires a generator "
@@ -374,6 +452,18 @@ class WorkerServer:
                 with tracing.execute_span(spec):
                     try:
                         result = fn(*args, **kwargs)
+                        import inspect as _inspect
+
+                        if _inspect.iscoroutine(result):
+                            # async def task: run to completion on a
+                            # private loop (reference: async remote
+                            # functions, async_compat.py). Must run while
+                            # pg_context is still set — children submitted
+                            # inside the coroutine body inherit the
+                            # capturing placement group.
+                            import asyncio as _asyncio
+
+                            result = _asyncio.run(result)
                     finally:
                         if spec.placement_group_id:
                             pg_context.clear()
@@ -399,17 +489,25 @@ class WorkerServer:
             err = exceptions.ActorDiedError(
                 ActorID(bytes(spec.actor_id)), "actor not hosted here")
             return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
+        if runner.is_async:
+            return self._push_async_actor_task(runner, spec)
         caller = bytes(spec.caller_address)
-        ordered = runner.max_concurrency <= 1
+        ordered = runner.ordered
+        sem: Optional[threading.Semaphore] = None
         if ordered:
             if not runner.wait_turn(caller, spec.sequence_no):
                 err = exceptions.ActorDiedError(
                     ActorID(bytes(spec.actor_id)), "actor died")
                 return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
         else:
-            runner.sem.acquire()
+            try:
+                sem = runner.thread_sem_for(
+                    getattr(runner.instance, spec.method_name, None))
+            except ValueError as e:  # unknown concurrency group
+                return self._error_result(e, spec.method_name)
+            sem.acquire()
             if runner.dead:
-                runner.sem.release()
+                sem.release()
                 err = exceptions.ActorDiedError(
                     ActorID(bytes(spec.actor_id)), "actor died")
                 return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
@@ -456,7 +554,107 @@ class WorkerServer:
             if ordered:
                 runner.complete(caller, spec.sequence_no)
             else:
-                runner.sem.release()
+                sem.release()
+
+    def _push_async_actor_task(self, runner: _ActorRunner,
+                               spec) -> pb.PushTaskResult:
+        """Async-actor execution (reference: ``core_worker/fiber.h`` +
+        async actor event loop, ``python/ray/_private/async_compat.py``).
+
+        The RPC thread admits the call in per-caller *submission* order
+        (sequence turn), schedules a coroutine on the actor's dedicated
+        event loop, releases the sequence immediately — so later calls
+        from the same caller start while this one awaits — and then
+        blocks for the result (the push reply carries it). Concurrency is
+        capped by per-group asyncio semaphores inside the coroutine.
+        """
+        import asyncio
+
+        caller = bytes(spec.caller_address)
+        fut = None
+        try:
+            try:
+                if not runner.wait_turn(caller, spec.sequence_no):
+                    err = exceptions.ActorDiedError(
+                        ActorID(bytes(spec.actor_id)), "actor died")
+                    return pb.PushTaskResult(ok=False,
+                                             error=pickle.dumps(err))
+                self._report_task(spec, "RUNNING",
+                                  actor_id=bytes(spec.actor_id).hex()[:12])
+                (_, args, kwargs), n_borrows = \
+                    loads_payload(self._payload_bytes(spec))
+                if n_borrows:
+                    self.runtime.refs.flush()  # borrow-before-pin-release
+                args, kwargs = self._resolve_args(args, kwargs)
+                fut = asyncio.run_coroutine_threadsafe(
+                    self._run_async_actor_method(runner, spec, args, kwargs),
+                    runner.loop)
+            finally:
+                # Sequence completes at SCHEDULE time, not completion —
+                # in-order starts, interleaved execution.
+                runner.complete(caller, spec.sequence_no)
+            result = fut.result()
+            out = self._package_results(result, spec.return_ids)
+            self._report_task(spec, "FINISHED")
+            return out
+        except exceptions.AsyncioActorExit:
+            self._terminate_actor(spec.actor_id, "exit_actor() called")
+            self._report_task(spec, "FINISHED")
+            return self._package_results(None, spec.return_ids)
+        except BaseException as e:  # noqa: BLE001
+            self._report_task(spec, "FAILED", error=repr(e)[:200])
+            return self._error_result(e, f"{spec.method_name}")
+
+    async def _run_async_actor_method(self, runner: _ActorRunner, spec,
+                                      args, kwargs):
+        import inspect
+
+        from ray_tpu.util import tracing
+
+        method = getattr(runner.instance, spec.method_name)
+        sem = runner.async_sem_for(method)
+        async with sem:
+            if runner.dead:
+                raise exceptions.ActorDiedError(
+                    ActorID(bytes(spec.actor_id)), "actor died")
+            # pg_context is a ContextVar: each asyncio task carries its own
+            # copy, so concurrent coroutines don't race on set/clear.
+            if runner.pg_ctx is not None:
+                pg_context.set(*runner.pg_ctx)
+            try:
+                with tracing.execute_span(spec, kind="actor_task"):
+                    result = method(*args, **kwargs)
+                    if inspect.iscoroutine(result):
+                        result = await result
+                    if spec.returns_stream:
+                        if inspect.isasyncgen(result):
+                            result = await self._drain_stream_async(result,
+                                                                    spec)
+                        else:
+                            result = self._stream_generator(result, spec)
+                    elif inspect.isasyncgen(result):
+                        result = [item async for item in result]
+                return result
+            finally:
+                if runner.pg_ctx is not None:
+                    pg_context.clear()
+
+    async def _drain_stream_async(self, agen, spec) -> int:
+        """Async-generator streaming drain. Each item's store put is a
+        blocking node RPC executed inline on the loop (sub-ms locally);
+        matches the reference, where sync work inside an async actor
+        blocks its loop."""
+        from ray_tpu._private.object_ref import drain_stream_async
+
+        def store_item(oid, item):
+            if not put_bytes_to_node(self.node, oid.binary(), dumps(item),
+                                     self.worker_id):
+                raise exceptions.RayTpuError(
+                    f"object store rejected stream item {oid.hex()[:12]} "
+                    f"(store full even after spilling)")
+
+        return await drain_stream_async(agen, TaskID(bytes(spec.task_id)),
+                                        store_item)
 
     def CreateActor(self, request, context):
         info = request.info
@@ -485,7 +683,9 @@ class WorkerServer:
                     pg_context.clear()
             runner = _ActorRunner(
                 instance,
-                max_concurrency=getattr(options, "max_concurrency", 1))
+                max_concurrency=getattr(options, "max_concurrency", 1),
+                concurrency_groups=getattr(options, "concurrency_groups",
+                                           None))
             runner.pg_ctx = pg_ctx
             self._actors[bytes(info.actor_id)] = runner
             return pb.CreateActorReply(ok=True)
@@ -504,6 +704,7 @@ class WorkerServer:
             runner.dead = True
             with runner.cond:
                 runner.cond.notify_all()
+            runner.stop_loop()
         # An actor worker is dedicated; exit so the pool reaps it.
         threading.Thread(target=self._delayed_exit, daemon=True).start()
 

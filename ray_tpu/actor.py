@@ -141,6 +141,12 @@ class ActorClass:
         return new
 
     def _remote(self, args, kwargs, options: RemoteOptions) -> ActorHandle:
+        import dataclasses
+
+        from ray_tpu._private.concurrency import class_is_async
+
+        options = dataclasses.replace(
+            options, _is_async_actor=class_is_async(self._cls))
         core = _worker.global_worker().core
         if options.name and options.get_if_exists:
             try:

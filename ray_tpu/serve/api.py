@@ -105,9 +105,22 @@ DEFAULT_AUTOSCALING = {
 
 
 class Replica:
-    """Hosts one copy of the user callable."""
+    """Hosts one copy of the user callable.
 
-    def __init__(self, cls_or_fn, init_args, init_kwargs, is_function: bool):
+    Async-native (reference: Serve replicas run user code on the replica
+    actor's event loop, ``serve/_private/replica.py``): ``handle_request``
+    is a coroutine, so the replica actor runs on a dedicated asyncio loop
+    and an async user ``__call__`` overlaps slow requests up to the
+    deployment's ``max_concurrency``. Sync user code runs in a thread
+    executor so it still overlaps (threaded-deployment behavior) instead
+    of blocking the loop.
+    """
+
+    def __init__(self, cls_or_fn, init_args, init_kwargs, is_function: bool,
+                 sync_workers: int = 8):
+        import inspect
+        from concurrent.futures import ThreadPoolExecutor as _TPE
+
         self.is_function = is_function
         if is_function:
             self.instance = cls_or_fn
@@ -116,9 +129,20 @@ class Replica:
         self._ongoing = 0
         self._total = 0
         self._m_lock = threading.Lock()
+        self._inspect = inspect
+        self._sync_pool = _TPE(max_workers=max(1, int(sync_workers)),
+                               thread_name_prefix="replica-sync")
 
-    def handle_request(self, method: str, args, kwargs,
-                       multiplexed_model_id: str = ""):
+    def _target(self, method: str):
+        if self.is_function:
+            return self.instance
+        return getattr(self.instance, method or "__call__")
+
+    async def handle_request(self, method: str, args, kwargs,
+                             multiplexed_model_id: str = ""):
+        import asyncio
+        import contextvars
+
         from ray_tpu.serve import multiplex
 
         with self._m_lock:
@@ -126,20 +150,25 @@ class Replica:
             self._total += 1
         token = multiplex._set_model_id(multiplexed_model_id)
         try:
-            if self.is_function:
-                return self.instance(*args, **kwargs)
-            target = getattr(self.instance, method or "__call__")
-            return target(*args, **kwargs)
+            target = self._target(method)
+            if self._inspect.iscoroutinefunction(target):
+                return await target(*args, **kwargs)
+            # Sync user code: off the loop so it can't stall concurrent
+            # requests. The context (multiplexed model id) rides along.
+            ctx = contextvars.copy_context()
+            return await asyncio.get_running_loop().run_in_executor(
+                self._sync_pool, lambda: ctx.run(target, *args, **kwargs))
         finally:
             multiplex._reset_model_id(token)
             with self._m_lock:
                 self._ongoing -= 1
 
-    def handle_request_streaming(self, method: str, args, kwargs,
-                                 multiplexed_model_id: str = ""):
-        """Generator variant: each yield of the user method becomes one
+    async def handle_request_streaming(self, method: str, args, kwargs,
+                                       multiplexed_model_id: str = ""):
+        """Streaming variant: each yield of the user method becomes one
         streamed item when called with num_returns="streaming" (reference:
-        DeploymentResponseGenerator / RayServeHandle stream=True)."""
+        DeploymentResponseGenerator / RayServeHandle stream=True). Accepts
+        sync and async generators."""
         from ray_tpu.serve import multiplex
 
         with self._m_lock:
@@ -147,15 +176,39 @@ class Replica:
             self._total += 1
         token = multiplex._set_model_id(multiplexed_model_id)
         try:
-            target = (self.instance if self.is_function
-                      else getattr(self.instance, method or "__call__"))
-            result = target(*args, **kwargs)
-            if not hasattr(result, "__next__"):
+            result = self._target(method)(*args, **kwargs)
+            if hasattr(result, "__aiter__"):
+                async for item in result:
+                    yield item
+            elif hasattr(result, "__next__"):
+                # Sync generator: pull each item off-loop so a slow
+                # producer (time.sleep between yields) can't stall the
+                # replica's other in-flight requests. The copied context
+                # carries the multiplexed-model-id ContextVar into the
+                # pool thread (same as the non-streaming sync path).
+                import asyncio
+                import contextvars
+
+                loop = asyncio.get_running_loop()
+                ctx = contextvars.copy_context()
+                sentinel = object()
+
+                def _next():
+                    try:
+                        return ctx.run(next, result)
+                    except StopIteration:
+                        return sentinel
+
+                while True:
+                    item = await loop.run_in_executor(self._sync_pool, _next)
+                    if item is sentinel:
+                        break
+                    yield item
+            else:
                 raise TypeError(
                     f"stream=True requires a generator; "
                     f"{method or '__call__'!r} returned "
                     f"{type(result).__name__}")
-            yield from result
         finally:
             multiplex._reset_model_id(token)
             with self._m_lock:
@@ -447,7 +500,8 @@ class ServeController:
         while len(current) < spec["num_replicas"]:
             replica = replica_cls.options(**opts).remote(
                 spec["cls"], spec["args"], spec["kwargs"],
-                spec["is_function"])
+                spec["is_function"],
+                sync_workers=spec["max_concurrency"])
             self._replica_birth[id(replica)] = time.monotonic()
             current.append(replica)
         while len(current) > spec["num_replicas"]:

@@ -111,3 +111,27 @@ def test_spread_placement_uses_multiple_nodes(serve_cluster):
     nodes = {h.remote(i).result(timeout_s=60) for i in range(12)}
     assert len(nodes) >= 2, nodes
     serve.delete("WhereSpread")
+
+
+def test_async_replica_overlaps_slow_requests(serve_cluster):
+    """A replica with an async __call__ runs on the worker's event loop and
+    overlaps slow awaits (reference: replicas execute user code on an
+    asyncio loop, serve/_private/replica.py)."""
+    import asyncio
+
+    @serve.deployment(name="SlowAsync", num_replicas=1,
+                      max_ongoing_requests=8)
+    class SlowAsync:
+        async def __call__(self, x):
+            await asyncio.sleep(0.5)
+            return x
+
+    h = serve.run(SlowAsync.bind(), name="slow_async_app")
+    h.remote(0).result(timeout_s=60)  # warm the replica
+    t0 = time.monotonic()
+    futs = [h.remote(i) for i in range(6)]
+    assert sorted(f.result(timeout_s=60) for f in futs) == list(range(6))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 6 * 0.5 * 0.7, \
+        f"async replica did not overlap requests: {elapsed:.2f}s"
+    serve.delete("SlowAsync")
