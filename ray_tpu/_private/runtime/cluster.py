@@ -233,6 +233,18 @@ class ClusterRuntime(CoreRuntime):
         # threads (see _dispatch_task).
         self._sig_queues: Dict[Any, dict] = {}
         self._sig_lock = threading.Lock()
+        # Cancellation (reference: CoreWorker::CancelTask,
+        # core_worker.h:961): cancelled task ids are observed by every
+        # dispatch stage (dep-wait, sig queue, lease negotiation, push);
+        # running tasks are interrupted via a CancelTask RPC to the worker
+        # recorded in _running_locs. _children maps a task executing ON
+        # THIS worker -> tasks it submitted, for recursive cancel.
+        self._cancel_lock = threading.Lock()
+        self._cancelled_tasks: set = set()
+        self._running_locs: Dict[bytes, str] = {}
+        self._children: Dict[bytes, list] = {}
+        # Locality-hint directory cache: oid -> (ts, size, node_ids).
+        self._loc_cache: Dict[bytes, tuple] = {}
         self._submit_slots = threading.BoundedSemaphore(
             int(os.environ.get("RAY_TPU_SUBMIT_RPC_SLOTS", 8)))
         # Completion processing uses its OWN slots: if tails shared the
@@ -993,6 +1005,16 @@ class ClusterRuntime(CoreRuntime):
             if payload_oid is not None:
                 self._lineage_payload_bytes[task_id.binary()] = payload
         self._register_pending(return_ids)
+        # Child registry for recursive cancellation: a task submitted
+        # while another task executes on this runtime is that task's
+        # child (reference: recursive CancelTask walks the task graph).
+        from ray_tpu._private.runtime.local import current_task_context
+
+        pctx = current_task_context()
+        if pctx is not None and pctx.task_id is not None:
+            with self._cancel_lock:
+                self._children.setdefault(pctx.task_id.binary(), []).append(
+                    (task_id.binary(), [o.binary() for o in return_ids]))
         # Submitter-side dependency resolution (reference:
         # ``dependency_resolver.h`` — a task is not dispatched until its
         # direct ObjectRef args exist). Without this, dependent tasks
@@ -1005,12 +1027,74 @@ class ClusterRuntime(CoreRuntime):
         unready = [r for r in direct_deps if not self._dep_ready_fast(r)]
         if unready:
             self._pool.submit(self._wait_deps_then_dispatch, unready, spec,
-                              return_ids, options.max_retries or 0, pinned)
+                              return_ids, options.max_retries or 0, pinned,
+                              direct_deps)
         else:
+            self._apply_locality_hint(spec, direct_deps)
             self._dispatch_task(spec, return_ids, options.max_retries or 0,
                                 pinned)
         return [ObjectRef(oid, owner_address=self.node_address)
                 for oid in return_ids]
+
+    # Locality-aware lease targeting (reference:
+    # ``LocalityAwareLeasePolicy``, ``core_worker/lease_policy.h:58``):
+    # only argument payloads at least this large steer the lease — below
+    # it the chunked pull costs less than giving up lease reuse.
+    LOCALITY_MIN_BYTES = 100 * 1024
+    LOCALITY_CACHE_TTL_S = 5.0
+
+    def _dep_locations(self, oid: ObjectID):
+        """(size, node_ids) via the GCS directory, TTL-cached: a fan-out
+        of N tasks sharing one big arg must not pay N directory RPCs
+        (same concern as the _node_addresses cache)."""
+        key = oid.binary()
+        now = time.monotonic()
+        hit = self._loc_cache.get(key)
+        if hit is not None and now - hit[0] < self.LOCALITY_CACHE_TTL_S:
+            return hit[1], hit[2]
+        try:
+            locs = self.gcs.GetObjectLocations(
+                pb.GetObjectLocationsRequest(object_id=key))
+        except Exception:  # noqa: BLE001 — directory miss: no hint
+            return 0, ()
+        size = 0 if locs.freed else locs.size
+        node_ids = tuple(locs.node_ids)
+        if len(self._loc_cache) > 4096:
+            self._loc_cache.clear()
+        self._loc_cache[key] = (now, size, node_ids)
+        return size, node_ids
+
+    def _apply_locality_hint(self, spec: pb.TaskSpec,
+                             deps: List[ObjectRef]) -> None:
+        """Prefer leasing on the node holding the most resident argument
+        bytes: a task whose 1GB arg lives on node B should run on node B
+        instead of paying a cross-node chunked pull (on a TPU pod: DCN
+        traffic vs none). Expressed as SOFT node affinity so the existing
+        spillback machinery handles a busy/full target."""
+        if (spec.placement_group_id or spec.affinity_node_id
+                or spec.strategy or spec.label_selector or not deps):
+            return
+        per_node: Dict[str, int] = {}
+        local_bytes = 0
+        for ref in deps[:4]:  # bounded directory cost per submit
+            oid = ref.id()
+            if self.memory.contains(oid):
+                continue  # value already in-process: no pull either way
+            size, node_ids = self._dep_locations(oid)
+            if not size:
+                continue
+            for nid in node_ids:
+                if nid == self.node_id:
+                    local_bytes += size
+                else:
+                    per_node[nid] = per_node.get(nid, 0) + size
+        if not per_node:
+            return
+        best, best_bytes = max(per_node.items(), key=lambda kv: kv[1])
+        if best_bytes >= self.LOCALITY_MIN_BYTES and \
+                best_bytes > local_bytes:
+            spec.affinity_node_id = best
+            spec.affinity_soft = True
 
     def _dep_ready_fast(self, ref: ObjectRef) -> bool:
         """RPC-free readiness check for the submit hot path: only an
@@ -1022,7 +1106,9 @@ class ClusterRuntime(CoreRuntime):
     def _wait_deps_then_dispatch(self, deps: List[ObjectRef],
                                  spec: pb.TaskSpec,
                                  return_ids: List[ObjectID], retries: int,
-                                 pinned: Optional[List[bytes]]) -> None:
+                                 pinned: Optional[List[bytes]],
+                                 all_deps: Optional[List[ObjectRef]] = None,
+                                 ) -> None:
         """Block (off the lease path — no worker is held) until every
         direct dependency exists somewhere, then dispatch. The deadline
         matches the executor-side arg-fetch timeout: on expiry the task
@@ -1030,6 +1116,11 @@ class ClusterRuntime(CoreRuntime):
         path."""
         deadline = time.monotonic() + 300.0
         while not self._shutdown and time.monotonic() < deadline:
+            if self._task_cancelled(bytes(spec.task_id)):
+                self._store_cancelled(spec, return_ids)
+                for oid in pinned or ():
+                    self.refs.decr(oid)
+                return
             unready: List[ObjectRef] = []
             probe: List[ObjectRef] = []
             for ref in deps:
@@ -1049,6 +1140,8 @@ class ClusterRuntime(CoreRuntime):
             deps = unready
             with self._ready_cond:
                 self._ready_cond.wait(0.05)
+        # Hint AFTER deps exist: locations are only known once produced.
+        self._apply_locality_hint(spec, all_deps or deps)
         self._dispatch_task(spec, return_ids, retries, pinned)
 
     def _register_pending(self, return_ids: List[ObjectID]) -> None:
@@ -1345,6 +1438,10 @@ class ClusterRuntime(CoreRuntime):
                     break
                 item = st["items"].pop(0)
             spec, return_ids, retries, pinned, _ = item
+            if self._task_cancelled(bytes(spec.task_id)):
+                self._store_cancelled(spec, return_ids)
+                self._finish_item(item)
+                continue
             try:
                 if lease is None:
                     lease = self._take_cached_lease(sig)
@@ -1373,6 +1470,9 @@ class ClusterRuntime(CoreRuntime):
                         spec.name, f"Worker executing {spec.name} died"),
                     return_ids)
                 self._finish_item(item)
+            except exceptions.TaskCancelledError as e:
+                self._store_error(e, return_ids)  # keep the typed error
+                self._finish_item(item)
             except BaseException as e:  # noqa: BLE001
                 self._store_error(
                     exceptions.RayTaskError.from_exception(e, spec.name),
@@ -1392,6 +1492,9 @@ class ClusterRuntime(CoreRuntime):
         try:
             attempt = 0
             while True:
+                if self._task_cancelled(bytes(spec.task_id)):
+                    self._store_cancelled(spec, return_ids)
+                    return
                 try:
                     self._lease_and_push_once(spec, return_ids)
                     return
@@ -1404,6 +1507,8 @@ class ClusterRuntime(CoreRuntime):
                     self._store_error(
                         exceptions.RayTaskError(spec.name, str(e)), return_ids)
                     return
+        except exceptions.TaskCancelledError as e:
+            self._store_error(e, return_ids)  # keep the typed error
         except BaseException as e:  # noqa: BLE001
             self._store_error(
                 exceptions.RayTaskError.from_exception(e, spec.name),
@@ -1519,36 +1624,52 @@ class ClusterRuntime(CoreRuntime):
         may not have run — callers apply the system-failure retry policy).
         The lease itself is NOT disposed here: runners keep it for the
         next queued task."""
+        tid = bytes(spec.task_id)
+        if self._task_cancelled(tid):
+            self._store_cancelled(spec, return_ids)
+            return True
         del spec.tpu_chips[:]
         spec.tpu_chips.extend(lease["tpu_chips"])
-        result = self._push_fast(lease.get("fast_address", ""), spec)
-        if result is False:
-            return False
-        if result is None:
-            stub = rpc.get_stub("WorkerService", lease["worker_address"])
-            attempts = 0
-            while True:
-                try:
-                    fut = stub.PushTask(pb.PushTaskRequest(spec=spec),
-                                        timeout=PUSH_TIMEOUT_S, wait=False)
-                    result = fut.result(timeout=PUSH_TIMEOUT_S + 5)
-                    break
-                except Exception as e:  # noqa: BLE001
-                    # wait=False bypasses the stub's retry wrapper;
-                    # re-dispatch UNAVAILABLE blips here (the call never
-                    # reached the worker, so the retry is safe even for
-                    # non-idempotent pushes) instead of burning a
-                    # task-level attempt.
-                    import grpc as _grpc
+        # Visible to cancel() for the duration of the push: a CancelTask
+        # RPC to this address interrupts the executor.
+        with self._cancel_lock:
+            self._running_locs[tid] = lease["worker_address"]
+        try:
+            result = self._push_fast(lease.get("fast_address", ""), spec)
+            if result is False:
+                return False
+            if result is None:
+                stub = rpc.get_stub("WorkerService", lease["worker_address"])
+                attempts = 0
+                while True:
+                    try:
+                        fut = stub.PushTask(pb.PushTaskRequest(spec=spec),
+                                            timeout=PUSH_TIMEOUT_S,
+                                            wait=False)
+                        result = fut.result(timeout=PUSH_TIMEOUT_S + 5)
+                        break
+                    except Exception as e:  # noqa: BLE001
+                        # wait=False bypasses the stub's retry wrapper;
+                        # re-dispatch UNAVAILABLE blips here (the call never
+                        # reached the worker, so the retry is safe even for
+                        # non-idempotent pushes) instead of burning a
+                        # task-level attempt.
+                        import grpc as _grpc
 
-                    code = e.code() if hasattr(e, "code") else None
-                    if code == _grpc.StatusCode.UNAVAILABLE and attempts < 2:
-                        attempts += 1
-                        time.sleep(0.05 * attempts)
-                        continue
-                    return False
+                        code = e.code() if hasattr(e, "code") else None
+                        if code == _grpc.StatusCode.UNAVAILABLE \
+                                and attempts < 2:
+                            attempts += 1
+                            time.sleep(0.05 * attempts)
+                            continue
+                        return False
+        finally:
+            with self._cancel_lock:
+                self._running_locs.pop(tid, None)
         with self._completion_slots:
             self._apply_push_result(result, return_ids, spec.name)
+        with self._cancel_lock:
+            self._cancelled_tasks.discard(tid)
         return True
 
     def _push_with_lease(self, spec: pb.TaskSpec,
@@ -1641,6 +1762,9 @@ class ClusterRuntime(CoreRuntime):
             backoff = 0.01
             spillbacks = 0
             while True:
+                if self._task_cancelled(bytes(spec.task_id)):
+                    raise exceptions.TaskCancelledError(
+                        TaskID(bytes(spec.task_id)))
                 if sig is not None and self._has_cached_lease(sig):
                     return None
                 # Fairness: a capacity-starved negotiation (lease waits can
@@ -1763,7 +1887,67 @@ class ClusterRuntime(CoreRuntime):
             self._ready_cond.notify_all()
 
     def cancel(self, ref, force, recursive):
-        logger.warning("cancel() is best-effort in the cluster runtime")
+        """Cancel a task (reference: ``CoreWorker::CancelTask``,
+        ``core_worker.h:961``): pending tasks are dropped at whichever
+        dispatch stage holds them (dep-wait, sig queue, lease
+        negotiation); running tasks get a CancelTask RPC to their worker
+        (async-exc / asyncio cancel; ``force`` kills the worker);
+        ``recursive`` propagates through the task's children on the
+        executing worker. Finished tasks are untouched (no-op)."""
+        self._cancel_task(ref.task_id().binary(), [ref.id().binary()],
+                          force, recursive)
+
+    def _task_cancelled(self, tid: bytes) -> bool:
+        with self._cancel_lock:
+            return bytes(tid) in self._cancelled_tasks
+
+    def _store_cancelled(self, spec, return_ids) -> None:
+        tid = bytes(spec.task_id)
+        self._store_error(
+            exceptions.TaskCancelledError(TaskID(tid)), return_ids)
+        # Terminal for this task: drop the flag (a long-lived driver
+        # cancelling queued tasks forever must not grow the set unboundedly).
+        with self._cancel_lock:
+            self._cancelled_tasks.discard(tid)
+
+    def _cancel_task(self, tid: bytes, oid_bins: List[bytes], force: bool,
+                     recursive: bool) -> None:
+        # Already finished (result locally visible)? Then it's a no-op —
+        # matching the reference: cancel never un-computes a result.
+        if all(self.memory.contains(ObjectID(o)) for o in oid_bins):
+            finished = True
+            with self._pending_res_lock:
+                if any(o in self._pending_results for o in oid_bins):
+                    finished = False
+            if finished:
+                return
+        with self._cancel_lock:
+            self._cancelled_tasks.add(tid)
+            loc = self._running_locs.get(tid)
+            children = list(self._children.get(tid, ())) if recursive \
+                else []
+        if loc:
+            try:
+                stub = rpc.get_stub("WorkerService", loc)
+                stub.CancelTask(pb.CancelTaskRequest(
+                    task_id=tid, force=force, recursive=recursive),
+                    timeout=10)
+            except Exception:  # noqa: BLE001 — worker already gone
+                pass
+        for ctid, coids in children:
+            self._cancel_task(ctid, coids, force, True)
+
+    def cancel_children(self, parent_tid: bytes, force: bool) -> None:
+        """Cancel every task the given (locally-executing) task submitted
+        — the executor side of a recursive cancel."""
+        with self._cancel_lock:
+            children = list(self._children.pop(parent_tid, ()))
+        for ctid, coids in children:
+            self._cancel_task(ctid, coids, force, True)
+
+    def drop_children(self, parent_tid: bytes) -> None:
+        with self._cancel_lock:
+            self._children.pop(parent_tid, None)
 
     # ---------------------------------------------------------------- actors
     def create_actor(self, cls, args, kwargs, options) -> ActorID:
@@ -1960,14 +2144,26 @@ class ClusterRuntime(CoreRuntime):
         # stale-session push could otherwise wait forever — after the
         # deadline it proceeds and fails fast server-side instead.
         gate_deadline = time.monotonic() + 120.0
+        tid = bytes(spec.task_id)
         with st["cond"]:
             while seq >= st["done"] + st["window"] and \
                     not self._shutdown and time.monotonic() < gate_deadline:
+                if self._task_cancelled(tid):
+                    break
                 st["cond"].wait(1.0)
         try:
+            if self._task_cancelled(tid):
+                # STILL push, as a tombstone: the worker must advance this
+                # caller's sequence number or every later task from this
+                # caller wedges in wait_turn (ordered actors). The
+                # executor sees spec.cancelled and fails the task without
+                # running user code.
+                spec.cancelled = True
             while True:
                 try:
                     info = self._resolve_actor(actor_id)
+                    with self._cancel_lock:
+                        self._running_locs[tid] = info.address
                     result = self._push_fast(info.fast_address, spec)
                     if result is False:
                         # Connection died mid-call: the task MAY have
@@ -2002,6 +2198,9 @@ class ClusterRuntime(CoreRuntime):
                         return_ids)
                     return
         finally:
+            with self._cancel_lock:
+                self._running_locs.pop(tid, None)
+                self._cancelled_tasks.discard(tid)
             with st["cond"]:
                 st["done"] = max(st["done"], seq + 1)
                 st["cond"].notify_all()

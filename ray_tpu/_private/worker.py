@@ -200,6 +200,13 @@ def kill(actor, *, no_restart: bool = True):
 
 
 def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    from ray_tpu._private.object_ref import ObjectRefGenerator
+
+    if isinstance(ref, ObjectRefGenerator):
+        # Cancelling a streaming generator cancels its producing task; the
+        # consumer surfaces the stored TaskCancelledError past the last
+        # produced item (reference: cancel accepts the stream handle).
+        ref = ref._length_ref
     if not isinstance(ref, ObjectRef):
         raise TypeError("cancel() expects an ObjectRef.")
     global_worker().core.cancel(ref, force, recursive)

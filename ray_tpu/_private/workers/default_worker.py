@@ -25,6 +25,7 @@ import pickle
 import threading
 import time
 import traceback
+from concurrent.futures import CancelledError as _FuturesCancelledError
 from typing import Any, Dict, List, Optional
 
 from ray_tpu import exceptions
@@ -190,6 +191,9 @@ class _ActorRunner:
 
             self.loop = asyncio.new_event_loop()
             self._async_sems: Dict[str, Any] = {}
+            # task_id -> asyncio.Task, for ray_tpu.cancel() (reference:
+            # async actor tasks are the cancellable kind).
+            self.async_tasks: Dict[bytes, Any] = {}
             ready = threading.Event()
 
             def loop_body():
@@ -260,6 +264,13 @@ class WorkerServer:
         worker_mod._global_worker = worker_mod.Worker(self.runtime, "worker")
         self._actors: Dict[bytes, _ActorRunner] = {}
         self._task_lock = threading.Lock()  # one normal task at a time
+        # Cancellation state (reference: CancelTaskOnExecutor,
+        # core_worker.h:1655): running normal tasks by id -> executing
+        # thread; cancels that arrive before their PushTask are remembered
+        # briefly so the push fails fast instead of racing.
+        self._cancel_lock = threading.Lock()
+        self._running: Dict[bytes, dict] = {}
+        self._precancelled: Dict[bytes, float] = {}
         self._exit = threading.Event()
         # Pool must exceed any single submitter's concurrency: ordered
         # actor pushes BLOCK a server thread until their sequence number's
@@ -413,9 +424,32 @@ class WorkerServer:
             self.task_events.report(bytes(spec.task_id).hex()[:16],
                                     spec.name, state, **extra)
 
+    def _prune_precancelled(self) -> None:
+        """Drop stale early-cancel records (caller holds _cancel_lock)."""
+        if len(self._precancelled) > 32:
+            cutoff = time.monotonic() - 60.0
+            for k, ts in list(self._precancelled.items()):
+                if ts < cutoff:
+                    del self._precancelled[k]
+
     def _push_normal_task(self, spec) -> pb.PushTaskResult:
+        tid = bytes(spec.task_id)
         with self._task_lock:
+            with self._cancel_lock:
+                self._prune_precancelled()
+                if spec.cancelled or \
+                        self._precancelled.pop(tid, None) is not None:
+                    return self._error_result(
+                        exceptions.TaskCancelledError(TaskID(tid)), spec.name)
+                # in_user gates the async-exc: CancelTask only raises into
+                # this thread while it executes USER code — never during
+                # cleanup/packaging, where a stray exception would corrupt
+                # worker state for the next task.
+                entry = {"thread": threading.get_ident(),
+                         "in_user": False, "cancelled": False}
+                self._running[tid] = entry
             renv_restore = None
+            ctx_token = None
             self._report_task(spec, "RUNNING")
             try:
                 if spec.tpu_chips:
@@ -445,11 +479,23 @@ class WorkerServer:
                                    spec.pg_bundle_index,
                                    spec.pg_capture_child_tasks)
                 from ray_tpu.util import tracing
+                from ray_tpu._private.runtime.local import _TaskCtx, _context
+
+                # Task context: get_runtime_context() works on cluster
+                # workers, and children submitted by this task are recorded
+                # under it for recursive cancellation.
+                ctx_token = _context.set(
+                    _TaskCtx(TaskID(tid), name=spec.name))
 
                 # The span covers generator DRAIN too: a streaming task's
                 # real work happens consuming the generator, and children
-                # submitted from its body must inherit the trace context.
+                # submitted from its body must inherit the trace context
+                # (and the capturing placement group).
                 with tracing.execute_span(spec):
+                    with self._cancel_lock:
+                        if entry["cancelled"]:
+                            raise exceptions.TaskCancelledError(TaskID(tid))
+                        entry["in_user"] = True
                     try:
                         result = fn(*args, **kwargs)
                         import inspect as _inspect
@@ -457,21 +503,20 @@ class WorkerServer:
                         if _inspect.iscoroutine(result):
                             # async def task: run to completion on a
                             # private loop (reference: async remote
-                            # functions, async_compat.py). Must run while
-                            # pg_context is still set — children submitted
-                            # inside the coroutine body inherit the
-                            # capturing placement group.
+                            # functions, async_compat.py).
                             import asyncio as _asyncio
 
                             result = _asyncio.run(result)
+                        if spec.returns_stream:
+                            result = self._stream_generator(result, spec)
+                        elif hasattr(result, "__next__"):  # legacy gens
+                            result = tuple(result) \
+                                if len(spec.return_ids) > 1 else list(result)
                     finally:
+                        with self._cancel_lock:
+                            entry["in_user"] = False
                         if spec.placement_group_id:
                             pg_context.clear()
-                    if spec.returns_stream:
-                        result = self._stream_generator(result, spec)
-                    elif hasattr(result, "__next__"):  # legacy generators
-                        result = tuple(result) \
-                            if len(spec.return_ids) > 1 else list(result)
                 out = self._package_results(result, spec.return_ids)
                 self._report_task(spec, "FINISHED")
                 return out
@@ -479,6 +524,17 @@ class WorkerServer:
                 self._report_task(spec, "FAILED", error=repr(e)[:200])
                 return self._error_result(e, spec.name)
             finally:
+                with self._cancel_lock:
+                    self._running.pop(tid, None)
+                self.runtime.drop_children(tid)
+                if spec.placement_group_id:
+                    # Idempotent re-clear: a cancel async-exc landing in
+                    # the inner finally can abort its pg clear.
+                    pg_context.clear()
+                if ctx_token is not None:
+                    from ray_tpu._private.runtime.local import _context
+
+                    _context.reset(ctx_token)
                 if renv_restore is not None:
                     # Reused worker: don't leak this task's cwd/env/path.
                     renv_restore()
@@ -512,6 +568,15 @@ class WorkerServer:
                     ActorID(bytes(spec.actor_id)), "actor died")
                 return pb.PushTaskResult(ok=False, error=pickle.dumps(err))
         try:
+            with self._cancel_lock:
+                # After wait_turn/sem (the finally advances the sequence /
+                # releases the slot): a cancelled actor task must not
+                # leave a per-caller ordering hole.
+                if spec.cancelled or \
+                        self._precancelled.pop(
+                            bytes(spec.task_id), None) is not None:
+                    raise exceptions.TaskCancelledError(
+                        TaskID(bytes(spec.task_id)))
             self._report_task(spec, "RUNNING",
                               actor_id=bytes(spec.actor_id).hex()[:12])
             (_, args, kwargs), n_borrows = \
@@ -571,6 +636,7 @@ class WorkerServer:
         import asyncio
 
         caller = bytes(spec.caller_address)
+        tid = bytes(spec.task_id)
         fut = None
         try:
             try:
@@ -579,6 +645,13 @@ class WorkerServer:
                         ActorID(bytes(spec.actor_id)), "actor died")
                     return pb.PushTaskResult(ok=False,
                                              error=pickle.dumps(err))
+                # Cancellation checks live AFTER wait_turn and inside the
+                # complete() finally: a cancelled task must still advance
+                # the caller's sequence or later tasks wedge.
+                with self._cancel_lock:
+                    if spec.cancelled or \
+                            self._precancelled.pop(tid, None) is not None:
+                        raise exceptions.TaskCancelledError(TaskID(tid))
                 self._report_task(spec, "RUNNING",
                                   actor_id=bytes(spec.actor_id).hex()[:12])
                 (_, args, kwargs), n_borrows = \
@@ -586,6 +659,11 @@ class WorkerServer:
                 if n_borrows:
                     self.runtime.refs.flush()  # borrow-before-pin-release
                 args, kwargs = self._resolve_args(args, kwargs)
+                with self._cancel_lock:
+                    # Re-check: a cancel that arrived during the (possibly
+                    # long) argument fetch would otherwise be lost.
+                    if self._precancelled.pop(tid, None) is not None:
+                        raise exceptions.TaskCancelledError(TaskID(tid))
                 fut = asyncio.run_coroutine_threadsafe(
                     self._run_async_actor_method(runner, spec, args, kwargs),
                     runner.loop)
@@ -601,12 +679,36 @@ class WorkerServer:
             self._terminate_actor(spec.actor_id, "exit_actor() called")
             self._report_task(spec, "FINISHED")
             return self._package_results(None, spec.return_ids)
+        except (asyncio.CancelledError, _FuturesCancelledError):
+            # ray_tpu.cancel() cancelled the coroutine mid-await (the
+            # thread-safe future re-raises it as the concurrent.futures
+            # flavor).
+            self._report_task(spec, "FAILED", error="cancelled")
+            return self._error_result(
+                exceptions.TaskCancelledError(TaskID(tid)), spec.method_name)
         except BaseException as e:  # noqa: BLE001
             self._report_task(spec, "FAILED", error=repr(e)[:200])
             return self._error_result(e, f"{spec.method_name}")
 
     async def _run_async_actor_method(self, runner: _ActorRunner, spec,
                                       args, kwargs):
+        import asyncio
+
+        tid = bytes(spec.task_id)
+        with self._cancel_lock:
+            # Last gap: a cancel between the schedule-time check and this
+            # task actually starting lands in _precancelled.
+            if self._precancelled.pop(tid, None) is not None:
+                raise exceptions.TaskCancelledError(TaskID(tid))
+        runner.async_tasks[tid] = asyncio.current_task()
+        try:
+            return await self._run_async_actor_body(runner, spec, args,
+                                                    kwargs)
+        finally:
+            runner.async_tasks.pop(tid, None)
+
+    async def _run_async_actor_body(self, runner: _ActorRunner, spec,
+                                    args, kwargs):
         import inspect
 
         from ray_tpu.util import tracing
@@ -697,6 +799,61 @@ class WorkerServer:
     def KillActor(self, request, context):
         self._terminate_actor(request.actor_id, "killed")
         return pb.Empty()
+
+    def CancelTask(self, request, context):
+        """Executor-side cancel (reference: ``CancelTaskOnExecutor``,
+        ``core_worker.h:1655``).
+
+        * running normal task → ``TaskCancelledError`` raised INTO the
+          executing thread (async-exc; takes effect at the next Python
+          bytecode — C-blocking calls finish first, same limitation as
+          the reference's interrupt path);
+        * running async-actor task → ``asyncio.Task.cancel()``;
+        * not here yet → remembered briefly so a racing PushTask fails
+          fast;
+        * ``force`` → the worker process exits (the owner observes the
+          death and stores the cancel error instead of retrying);
+        * ``recursive`` → this runtime also cancels every child the task
+          submitted.
+        """
+        tid = bytes(request.task_id)
+        found = False
+        with self._cancel_lock:
+            info = self._running.get(tid)
+            if info is not None:
+                found = True
+                if request.force:
+                    pass  # handled below: the whole worker dies
+                elif info.get("in_user"):
+                    import ctypes
+
+                    ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                        ctypes.c_ulong(info["thread"]),
+                        ctypes.py_object(exceptions.TaskCancelledError))
+                else:
+                    # Not in user code yet (arg fetch / setup): flag it —
+                    # the pre-execution check raises before fn() runs. If
+                    # the task is already past user code, the result
+                    # stands (cancel raced completion).
+                    info["cancelled"] = True
+            else:
+                for runner in self._actors.values():
+                    task = getattr(runner, "async_tasks", {}).get(tid) \
+                        if runner.is_async else None
+                    if task is not None:
+                        found = True
+                        runner.loop.call_soon_threadsafe(task.cancel)
+                        break
+                else:
+                    self._prune_precancelled()
+                    self._precancelled[tid] = time.monotonic()
+        if request.recursive:
+            self.runtime.cancel_children(tid, request.force)
+        if request.force and found:
+            # Reply first, then die: the owner's push fails with a
+            # connection error and the cancel flag suppresses the retry.
+            threading.Thread(target=self._delayed_exit, daemon=True).start()
+        return pb.CancelTaskReply(found=found)
 
     def _terminate_actor(self, actor_id: bytes, reason: str):
         runner = self._actors.pop(bytes(actor_id), None)
