@@ -24,15 +24,16 @@ def class_is_async(cls: type) -> bool:
         for name in dir(cls))
 
 
-def effective_max_concurrency(is_async: bool, max_concurrency: int) -> int:
-    """Resolve the user's ``max_concurrency`` option: async actors left at
-    the default (1) run highly concurrent (reference: async actors default
-    to max_concurrency=1000). Shared by the submitter window sizing and
-    both executors so they can't desynchronize."""
-    mc = max(1, int(max_concurrency or 1))
-    if is_async and mc == 1:
-        return 1000
-    return mc
+def effective_max_concurrency(is_async: bool,
+                              max_concurrency: Optional[int]) -> int:
+    """Resolve the ``max_concurrency`` option: UNSET (None) means ordered
+    execution for sync actors and 1000 concurrent awaits for async actors
+    (the reference default); an explicit value — including an explicit
+    1 on an async actor — is honored as-is. Shared by the submitter
+    window sizing and both executors so they can't desynchronize."""
+    if max_concurrency is None:
+        return 1000 if is_async else 1
+    return max(1, int(max_concurrency))
 
 
 def group_of(method, groups: Optional[Dict[str, int]]) -> str:

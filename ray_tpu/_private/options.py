@@ -38,7 +38,10 @@ class RemoteOptions:
     # Actor behavior.
     max_restarts: int = 0
     max_task_retries: int = 0
-    max_concurrency: int = 1
+    # None = unset: sync actors run ordered (1); async actors default to
+    # 1000 concurrent awaits. An EXPLICIT 1 stays 1 even on async actors
+    # (e.g. a serve deployment with max_ongoing_requests=1 must serialize).
+    max_concurrency: Optional[int] = None
     max_pending_calls: int = -1
     lifetime: Optional[str] = None  # None | "detached"
     namespace: Optional[str] = None

@@ -1470,8 +1470,8 @@ class ClusterRuntime(CoreRuntime):
                         spec.name, f"Worker executing {spec.name} died"),
                     return_ids)
                 self._finish_item(item)
-            except exceptions.TaskCancelledError as e:
-                self._store_error(e, return_ids)  # keep the typed error
+            except exceptions.TaskCancelledError:
+                self._store_cancelled(spec, return_ids)  # typed + flag drop
                 self._finish_item(item)
             except BaseException as e:  # noqa: BLE001
                 self._store_error(
@@ -1507,8 +1507,8 @@ class ClusterRuntime(CoreRuntime):
                     self._store_error(
                         exceptions.RayTaskError(spec.name, str(e)), return_ids)
                     return
-        except exceptions.TaskCancelledError as e:
-            self._store_error(e, return_ids)  # keep the typed error
+        except exceptions.TaskCancelledError:
+            self._store_cancelled(spec, return_ids)  # typed + flag drop
         except BaseException as e:  # noqa: BLE001
             self._store_error(
                 exceptions.RayTaskError.from_exception(e, spec.name),
@@ -1912,8 +1912,13 @@ class ClusterRuntime(CoreRuntime):
 
     def _cancel_task(self, tid: bytes, oid_bins: List[bytes], force: bool,
                      recursive: bool) -> None:
-        # Already finished (result locally visible)? Then it's a no-op —
-        # matching the reference: cancel never un-computes a result.
+        # Already finished? Then it's a no-op — matching the reference:
+        # cancel never un-computes a result. _task_done covers
+        # store-resident (in_store) results that never touch the local
+        # memory store; flagging those would poison a later lineage
+        # reconstruction of the same task id.
+        if tid in self._task_done:
+            return
         if all(self.memory.contains(ObjectID(o)) for o in oid_bins):
             finished = True
             with self._pending_res_lock:

@@ -170,7 +170,7 @@ class _ActorRunner:
     it straight off the instance.
     """
 
-    def __init__(self, instance: Any, max_concurrency: int = 1,
+    def __init__(self, instance: Any, max_concurrency: Optional[int] = None,
                  concurrency_groups: Optional[Dict[str, int]] = None):
         from ray_tpu._private import concurrency
 
@@ -785,7 +785,7 @@ class WorkerServer:
                     pg_context.clear()
             runner = _ActorRunner(
                 instance,
-                max_concurrency=getattr(options, "max_concurrency", 1),
+                max_concurrency=getattr(options, "max_concurrency", None),
                 concurrency_groups=getattr(options, "concurrency_groups",
                                            None))
             runner.pg_ctx = pg_ctx

@@ -231,6 +231,8 @@ class ServeController:
         self.deployments: Dict[str, Dict[str, Any]] = {}
         self.replicas: Dict[str, List[Any]] = {}
         self._route_version: Dict[str, int] = {}
+        # Shared router loads: name -> (ts, [ongoing per replica]).
+        self._loads_cache: Dict[str, Any] = {}
         # autoscaler intent: name -> (desired, first_seen_monotonic)
         self._scale_intent: Dict[str, Any] = {}
         self._pg_cleanups: Dict[str, list] = {}
@@ -360,6 +362,29 @@ class ServeController:
         """(version, replicas) — versioned routing table (long-poll analog)."""
         return self._route_version.get(name, 0), \
             list(self.replicas.get(name, []))
+
+    LOADS_TTL_S = 0.4
+
+    def get_replica_loads(self, name: str):
+        """Per-replica ongoing-request counts, aligned with get_routes
+        order and TTL-cached controller-side (reference: the pow-2
+        router's replica queue-length probes,
+        ``replica_scheduler/pow_2_scheduler.py:813`` — centralized here so
+        N ingress processes share ONE probe stream instead of N)."""
+        now = time.monotonic()
+        cached = self._loads_cache.get(name)
+        if cached is not None and now - cached[0] < self.LOADS_TTL_S:
+            return cached[1]
+        replicas = list(self.replicas.get(name, []))
+        refs = [r.metrics.remote() for r in replicas]
+        loads = []
+        for ref in refs:
+            try:
+                loads.append(ray_tpu.get(ref, timeout=1)["ongoing"])
+            except Exception:  # noqa: BLE001 — dying replica: avoid it
+                loads.append(1 << 20)
+        self._loads_cache[name] = (now, loads)
+        return loads
 
     def list_deployments(self):
         return {name: {"num_replicas": spec["num_replicas"]}
@@ -632,6 +657,10 @@ class _RouterState:
         self.inflight: Dict[int, int] = {}
         self.lock = threading.Lock()
         self.subscribed = False
+        # Cluster-wide per-replica load baseline from the controller
+        # (other callers' traffic); local inflight rides on top.
+        self.shared_loads: List[int] = []
+        self.loads_ts = 0.0
 
 
 class DeploymentHandle:
@@ -652,6 +681,14 @@ class DeploymentHandle:
         # SHARED across options()/method clones: one subscription per
         # logical handle, not per call.
         self._router = _router or _RouterState()
+
+    def __reduce__(self):
+        # Handles ship inside composed deployments' init args (reference:
+        # build_app injects handles for nested bound deployments); router
+        # state (locks, subscriptions, counts) is rebuilt per process,
+        # call options (stream/model-id) survive the trip.
+        return (_rebuild_handle,
+                (self._name, self._method, self._stream, self._model_id))
 
     def options(self, method_name: Optional[str] = None, *,
                 stream: Optional[bool] = None,
@@ -734,6 +771,9 @@ class DeploymentHandle:
                 self._refresh(force=True)
         if not self._replicas:
             raise RuntimeError(f"deployment {self._name!r} has no replicas")
+        shared: List[int] = []
+        if not model_id and len(self._replicas) > 1:
+            shared = self._fetch_shared_loads()
         with self._lock:
             if model_id:
                 import zlib
@@ -742,11 +782,41 @@ class DeploymentHandle:
             elif len(self._replicas) == 1:
                 idx = 0
             else:
+                # Pow-2 over shared (cluster-wide) + local in-flight: N
+                # independent ingress processes see each other's load
+                # through the controller baseline instead of each assuming
+                # idle replicas (reference: pow_2_scheduler.py:813).
+                loads = shared if len(shared) == len(self._replicas) \
+                    else None
                 a, b = random.sample(range(len(self._replicas)), 2)
-                idx = a if self._inflight.get(a, 0) <= \
-                    self._inflight.get(b, 0) else b
+
+                def cost(i):
+                    return (loads[i] if loads else 0) + \
+                        self._inflight.get(i, 0)
+
+                idx = a if cost(a) <= cost(b) else b
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
         return idx, self._replicas[idx]
+
+    LOADS_TTL_S = 0.5
+
+    def _fetch_shared_loads(self) -> List[int]:
+        """Controller-published per-replica queue depth, TTL-cached per
+        router (one fetch per 0.5s under load, amortized over calls)."""
+        st = self._router
+        now = time.monotonic()
+        if now - st.loads_ts < self.LOADS_TTL_S:
+            return st.shared_loads
+        st.loads_ts = now  # claim the slot first: no thundering herd
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            loads = list(ray_tpu.get(
+                controller.get_replica_loads.remote(self._name), timeout=5))
+        except Exception:  # noqa: BLE001 — fall back to local-only view
+            loads = []
+        with st.lock:
+            st.shared_loads = loads
+        return loads
 
     def remote(self, *args, **kwargs):
         idx, replica = self._choose(self._model_id)
@@ -779,6 +849,11 @@ class DeploymentHandle:
                 self._inflight[idx] = max(self._inflight.get(idx, 1) - 1, 0)
         return DeploymentResponse(ref, handle=self, call=(args, kwargs),
                                   replica=replica)
+
+
+def _rebuild_handle(name, method, stream, model_id) -> "DeploymentHandle":
+    return DeploymentHandle(name, method, _stream=stream,
+                            _model_id=model_id)
 
 
 class _HandleMethod:
@@ -869,19 +944,55 @@ def _get_or_start_controller():
             get_if_exists=True).remote()
 
 
-def run(app: Application, *, name: str = "default",
-        route_prefix: Optional[str] = None) -> DeploymentHandle:
-    controller = _get_or_start_controller()
+def _resolve_bound_args(controller, value, deployed: Dict[str, Any]):
+    """Replace nested bound ``Application``s (anywhere in args, including
+    inside lists/tuples/dicts) with handles to their freshly-deployed
+    deployments — depth-first, so leaves deploy before their consumers
+    (reference: ``build_app`` recursion, serve/_private/build_app.py:68)."""
+    if isinstance(value, Application):
+        return _deploy_application(controller, value, deployed)
+    if isinstance(value, (list, tuple)):
+        return type(value)(
+            _resolve_bound_args(controller, v, deployed) for v in value)
+    if isinstance(value, dict):
+        return {k: _resolve_bound_args(controller, v, deployed)
+                for k, v in value.items()}
+    return value
+
+
+def _deploy_application(controller, app: Application,
+                        deployed: Dict[str, Any]) -> DeploymentHandle:
     dep = app.deployment
+    if dep.name in deployed:
+        # Diamond graphs: one deployment bound into several consumers
+        # deploys once and shares its handle.
+        return deployed[dep.name]
     import inspect
 
+    args = tuple(_resolve_bound_args(controller, a, deployed)
+                 for a in app.args)
+    kwargs = {k: _resolve_bound_args(controller, v, deployed)
+              for k, v in app.kwargs.items()}
     is_function = not inspect.isclass(dep._cls_or_fn)
     ray_tpu.get(controller.deploy.remote(
-        dep.name, dep._cls_or_fn, app.args, app.kwargs, dep.num_replicas,
+        dep.name, dep._cls_or_fn, args, kwargs, dep.num_replicas,
         is_function, dep.max_ongoing_requests, dep.autoscaling_config,
         dep.placement_strategy, dep.ray_actor_options),
         timeout=120)
-    return DeploymentHandle(dep.name)
+    handle = DeploymentHandle(dep.name)
+    deployed[dep.name] = handle
+    return handle
+
+
+def run(app: Application, *, name: str = "default",
+        route_prefix: Optional[str] = None) -> DeploymentHandle:
+    """Deploy an application GRAPH: nested bound deployments (an
+    ``Application`` passed as an init arg) deploy recursively and the
+    consumer receives a ``DeploymentHandle`` in their place — multi-stage
+    pipelines (preprocess → LLM → postprocess) compose naturally
+    (reference: ``serve.run`` + ``build_app``)."""
+    controller = _get_or_start_controller()
+    return _deploy_application(controller, app, {})
 
 
 def get_deployment_handle(name: str, app_name: str = "default") -> DeploymentHandle:

@@ -135,3 +135,26 @@ def test_async_replica_overlaps_slow_requests(serve_cluster):
     assert elapsed < 6 * 0.5 * 0.7, \
         f"async replica did not overlap requests: {elapsed:.2f}s"
     serve.delete("SlowAsync")
+
+
+def test_cluster_composition_pipeline(serve_cluster):
+    """Nested bound deployments deploy recursively; the injected handle
+    pickles into the consumer replica's process and routes from there."""
+
+    @serve.deployment(name="Doubler")
+    class Doubler:
+        def __call__(self, x):
+            return x * 2
+
+    @serve.deployment(name="Chain")
+    class Chain:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def __call__(self, x):
+            return self.inner.remote(x).result(timeout_s=30) + 1
+
+    h = serve.run(Chain.bind(Doubler.bind()), name="chain_app")
+    assert h.remote(20).result(timeout_s=60) == 41
+    serve.delete("Chain")
+    serve.delete("Doubler")
