@@ -90,8 +90,14 @@ def bench_put_get_large_gbps(ray_tpu, n_mb=64, chunk_mb=16):
     put_dt = time.perf_counter() - t0
     t0 = time.perf_counter()
     outs = ray_tpu.get(refs, timeout=300)
+    # Touch the bytes: a page-strided checksum forces every page of the
+    # zero-copy shm mapping to actually fault in, so the metric measures
+    # data delivery, not mmap registration speed (r4 verdict weak #3).
+    sums = [int(o[::4096].sum()) for o in outs]
     get_dt = time.perf_counter() - t0
+    expected = int(arr[::4096].sum())
     assert all(o.nbytes == arr.nbytes for o in outs)
+    assert all(s == expected for s in sums), "corrupt bytes from get()"
     total_gb = reps * arr.nbytes / 1e9
     return total_gb / put_dt, total_gb / get_dt
 

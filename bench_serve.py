@@ -1,0 +1,119 @@
+"""Serving benchmark: continuous-batching decode throughput on one chip.
+
+Prints ONE JSON line and writes ``BENCH_SERVE_r{N}.json``.
+
+Metric: steady-state decode tokens/sec/chip of the ContinuousBatcher
+(``models/continuous_batching.py``) running the same ~1B-param Llama the
+training bench uses, all KV slots saturated.
+
+Criterion (v5e HBM roofline): every decode tick must read the full
+parameter set plus the active KV prefixes from HBM, so
+``roofline_tokens_per_s = num_slots * HBM_BW / (param_bytes + kv_bytes)``.
+The criterion is 10% of this roofline: XLA (non-pallas) decode with
+per-slot cache scatter plus a REMOTE-attached chip (every host fetch
+costs a ~90ms tunnel RTT; the engine's speculative buffered decode hides
+most but not all of it) lands 10-15%; vLLM-class stacks on local GPUs
+land ~15-30%. ``vs_baseline`` = achieved / (0.10 * roofline), and
+``hbm_efficiency`` reports the raw fraction transparently.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+HBM_GBPS = {
+    "TPU v5 lite": 819e9,   # v5e
+    "TPU v5": 2765e9,       # v5p
+    "TPU v4": 1228e9,
+    "TPU v6 lite": 1640e9,  # v6e
+}
+
+
+def _hbm_bw(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for name, bw in HBM_GBPS.items():
+        if kind.startswith(name):
+            return bw
+    return 819e9
+
+
+def main() -> None:
+    from ray_tpu.models import llama
+    from ray_tpu.models.continuous_batching import ContinuousBatcher
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        config = llama.LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=16, num_heads=16, num_kv_heads=16, head_dim=128,
+            max_seq_len=2048)
+        num_slots, max_len, prompt_len, ticks = 32, 512, 32, 120
+        sync_every = 32  # remote-attached chip: ~90ms per host fetch
+    else:  # CI fallback: always emit a line
+        config = llama.LlamaConfig.tiny()
+        num_slots, max_len, prompt_len, ticks = 4, 64, 8, 20
+        sync_every = 4
+
+    eng = ContinuousBatcher(config, num_slots=num_slots, max_len=max_len,
+                            sync_every=sync_every)
+    param_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(eng.params))
+
+    def top_up():
+        while len(eng._slots) + len(eng._waiting) < num_slots:
+            eng.submit(list(range(1, prompt_len + 1)),
+                       max_new_tokens=max_len - prompt_len - 1)
+
+    # Warm: compile prefill + tick, reach steady state.
+    top_up()
+    for _ in range(5):
+        eng.step()
+        top_up()
+
+    # Timed region at full occupancy. No per-tick device sync: the
+    # buffered engine's whole point is overlapping fetches with compute,
+    # so the wall clock over the window is the honest measure.
+    t0 = time.perf_counter()
+    for _ in range(ticks):
+        top_up()
+        eng.step()
+    jax.block_until_ready(eng.cache.k)
+    wall = time.perf_counter() - t0
+    med = wall / ticks
+    tokens_per_s = num_slots / med
+
+    # Roofline: params + average live KV prefix, read once per tick.
+    avg_pos = (prompt_len + max_len) / 2
+    kv_bytes = (num_slots * avg_pos * config.num_layers
+                * 2 * config.num_kv_heads * config.head_dim * 2)
+    bw = _hbm_bw(jax.devices()[0])
+    roofline = num_slots * bw / (param_bytes + kv_bytes)
+    criterion = 0.10 * roofline
+
+    out = {
+        "metric": "decode_tokens_per_s_per_chip",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_s / criterion, 3),
+        "roofline_tokens_per_s": round(roofline, 1),
+        "hbm_efficiency": round(tokens_per_s / roofline, 3),
+        "mean_tick_ms": round(med * 1e3, 2),
+        "num_slots": num_slots,
+        "sync_every": sync_every,
+        "param_bytes": param_bytes,
+        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+        "on_tpu": on_tpu,
+    }
+    print(json.dumps(out))
+    rnd = int(sys.argv[sys.argv.index("--round") + 1]) \
+        if "--round" in sys.argv else 5
+    with open(f"BENCH_SERVE_r{rnd:02d}.json", "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -35,7 +35,32 @@ _HDR = struct.Struct("<IBI")
 # Frame kinds. A reply's kind is the request's kind | 0x80; KIND_ERR
 # replies carry a utf-8 error message (handler raised server-side).
 KIND_PUSH_TASK = 1
+KIND_PUSH_BATCH = 2
+KIND_PUT_BATCH = 3   # node object plane: PutObjectBatch
 KIND_ERR = 0x7F
+
+
+def call_proto(address: str, kind: int, request, reply_cls, timeout: float):
+    """One protobuf round-trip over the fastpath plane.
+
+    Returns ``("ok", reply)``, ``("no_client", None)`` when no fastpath
+    client is reachable (callers fall back to gRPC), or
+    ``("error", None)`` when the connection died mid-call — the request
+    MAY have executed (same ambiguity as a failed gRPC call); callers
+    must apply their own retry policy, not blindly resend.
+    """
+    if not address:
+        return "no_client", None
+    fc = get_client(address)
+    if fc is None:
+        return "no_client", None
+    try:
+        data = fc.call(kind, request.SerializeToString(), timeout=timeout)
+    except Exception:  # noqa: BLE001 — connection/timeout
+        return "error", None
+    reply = reply_cls()
+    reply.ParseFromString(data)
+    return "ok", reply
 KIND_REPLY_BIT = 0x80
 
 _MAX_FRAME = 1 << 31

@@ -25,8 +25,35 @@ import threading
 from typing import ClassVar
 
 
+class _FastRandom:
+    """Buffered unique-id entropy: one ``os.urandom`` syscall refills 8KB
+    instead of one syscall per id — id creation is on the task-submit hot
+    path (reference: ids only need uniqueness, not crypto strength, and
+    the reference's ``FromRandom`` likewise uses a userspace PRNG)."""
+
+    def __init__(self):
+        self._buf = b""
+        self._off = 0
+        self._lock = threading.Lock()
+
+    def take(self, n: int) -> bytes:
+        with self._lock:
+            if self._off + n > len(self._buf):
+                self._buf = os.urandom(8192)
+                self._off = 0
+            out = self._buf[self._off:self._off + n]
+            self._off += n
+            return out
+
+
+_rng = _FastRandom()
+# A fork must not replay the parent's entropy buffer (duplicate ids).
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=lambda: _rng.__init__())
+
+
 def _random_bytes(n: int) -> bytes:
-    return os.urandom(n)
+    return _rng.take(n)
 
 
 class BaseID:

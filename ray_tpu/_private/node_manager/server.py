@@ -161,9 +161,16 @@ class NodeManager:
         self._server, self.port = rpc.serve("NodeService", self, port=port,
                                             max_workers=128)
         self.address = f"127.0.0.1:{self.port}"
+        # Binary object plane: owners flush put metadata / batches over
+        # framed TCP instead of per-batch gRPC (the gRPC stack's CPU was
+        # visible in the large-put path on small hosts).
+        from ray_tpu._private import fastpath as _fastpath
+
+        self._fast = _fastpath.FastServer(self._fast_handler)
+        self.fast_address = self._fast.address
 
         info = pb.NodeInfo(node_id=self.node_id, address=self.address,
-                           alive=True)
+                           alive=True, fast_address=self.fast_address)
         for k, v in self.total.items():
             info.resources[k] = v
             info.available[k] = v
@@ -303,6 +310,18 @@ class NodeManager:
                 avail[k] = avail.get(k, 0.0) + v
             return True
 
+    def _fast_handler(self, kind: int, payload: bytes) -> bytes:
+        """Binary object plane (fastpath.py): put-batch flushes (sync
+        large-put registration + the flusher's batches) skip the gRPC
+        stack — measurable CPU per call on small hosts."""
+        from ray_tpu._private import fastpath
+
+        if kind == fastpath.KIND_PUT_BATCH:
+            req = pb.PutObjectBatchRequest()
+            req.ParseFromString(payload)
+            return self.PutObjectBatch(req, None).SerializeToString()
+        raise ValueError(f"unknown fastpath frame kind {kind}")
+
     def _heartbeat_loop(self):
         seq = 0
         while not self._stop.wait(HEARTBEAT_PERIOD_S):
@@ -316,7 +335,8 @@ class NodeManager:
                 if not reply.ok:
                     # GCS restarted / lost us: re-register.
                     info = pb.NodeInfo(node_id=self.node_id,
-                                       address=self.address, alive=True)
+                                       address=self.address, alive=True,
+                                       fast_address=self.fast_address)
                     for k, v in self.total.items():
                         info.resources[k] = v
                     with self._res_lock:
@@ -1341,6 +1361,12 @@ class NodeManager:
         """Stop the node. ``graceful=False`` simulates a node crash: no drain
         notification, so the GCS health checker must discover the death."""
         self._stop.set()
+        try:
+            # Close the fastpath object plane first: a zombie listener
+            # would keep accepting put registrations for a dead node.
+            self._fast.close()
+        except Exception:  # noqa: BLE001
+            pass
         if graceful:
             try:
                 self.gcs.DrainNode(pb.DrainNodeRequest(node_id=self.node_id),

@@ -98,6 +98,21 @@ class ObjectRef:
 
     def __reduce__(self):
         # Plain pickling (outside the framework serializer) keeps id + owner.
+        # Serialization IS escape: if this process holds the object's bytes
+        # lazily (inline task result not yet flushed to the node store),
+        # flush now — whoever receives this ref resolves it through the
+        # directory. Covers every pickle path in one place: task results,
+        # stream items, gateway replies, user pickles.
+        try:
+            from ray_tpu._private import worker as _worker
+
+            w = _worker.global_worker_or_none()
+            if w is not None:
+                hook = getattr(w.core, "_flush_escaped", None)
+                if hook is not None:
+                    hook((self._id.binary(),))
+        except Exception:  # noqa: BLE001 — escape flush is best-effort
+            pass
         return (_rebuild_ref, (self._id.binary(), self._owner_address))
 
     def __del__(self):

@@ -245,6 +245,11 @@ class ClusterRuntime(CoreRuntime):
         self._children: Dict[bytes, list] = {}
         # Locality-hint directory cache: oid -> (ts, size, node_ids).
         self._loc_cache: Dict[bytes, tuple] = {}
+        # Inline results not yet flushed to the node store (flushed on
+        # ref escape — see _apply_push_result / _flush_escaped), plus the
+        # sticky set of ids whose refs have left this process.
+        self._lazy_results: Dict[bytes, bytes] = {}
+        self._escaped_ids: set = set()
         self._submit_slots = threading.BoundedSemaphore(
             int(os.environ.get("RAY_TPU_SUBMIT_RPC_SLOTS", 8)))
         # Completion processing uses its OWN slots: if tails shared the
@@ -431,6 +436,8 @@ class ClusterRuntime(CoreRuntime):
         from ray_tpu._private.ids import ObjectID
 
         self.memory.delete([ObjectID(oid)])
+        self._lazy_results.pop(oid, None)
+        self._escaped_ids.discard(oid)
         payload_oid = None
         with self._lineage_lock:
             spec = self._lineage.pop(oid, None)
@@ -460,6 +467,9 @@ class ClusterRuntime(CoreRuntime):
         from ray_tpu._private.serialization import Serializer
 
         s = Serializer().serialize(value)
+        # Refs nested inside a put value escape with it.
+        if s.contained_refs:
+            self._flush_escaped(list(s.contained_refs))
         # Owner semantics (reference: small objects live in the owner's
         # in-process store): the value is immediately visible to this
         # process; the node-store copy + directory registration that remote
@@ -483,10 +493,37 @@ class ClusterRuntime(CoreRuntime):
         seg = f"/rtpu.{oid.binary().hex()}"
         if ShmClient.available() and ShmClient.create_segment_vectored(
                 seg, s.to_parts(STORE_MAGIC)):
-            self._enqueue_put(("shm", oid, seg, 4 + wire))
+            size = 4 + wire
+            # Register synchronously over the node fastpath: the metadata
+            # frame is tiny, and skipping the flusher removes the
+            # cross-thread wakeups that contended with the NEXT put's
+            # writev on small hosts (plus the object is fetchable the
+            # moment put() returns). Flusher remains the fallback.
+            if not self._register_shm_sync(oid, seg, size):
+                self._enqueue_put(("shm", oid, seg, size))
             return
         # No shm: legacy inline/bytes path.
         self._enqueue_put(("data", oid, STORE_MAGIC + s.to_bytes()))
+
+    def _register_shm_sync(self, oid: ObjectID, seg: str,
+                           size: int) -> bool:
+        from ray_tpu._private import fastpath
+
+        batch = pb.PutObjectBatchRequest()
+        batch.items.append(pb.PutObjectRequest(
+            object_id=oid.binary(), shm_name=seg, size=size,
+            owner=self.worker_id))
+        status, reply = fastpath.call_proto(
+            self._node_fast_address(), fastpath.KIND_PUT_BATCH, batch,
+            pb.PutObjectBatchReply, timeout=30)
+        if status != "ok":
+            return False  # transport/no client: let the flusher handle it
+        if reply.rejected and reply.rejected[0]:
+            # Store full: the node unlinked the segment; rebuild from the
+            # live value and retry through the flusher's backoff path.
+            self._requeue_rejected_shm(("shm", oid, seg,
+                                        time.monotonic() + 60.0))
+        return True
 
     def _requeue_rejected_shm(self, item: tuple) -> None:
         """Rebuild a rejected zero-copy put's segment from the live value
@@ -504,6 +541,54 @@ class ClusterRuntime(CoreRuntime):
             with self._put_cv:
                 self._put_q.append(("shm", oid, seg,
                                     4 + s.wire_size(), deadline))
+
+    def _flush_escaped(self, oid_bins) -> None:
+        """Mark refs as ESCAPED (leaving this process inside a task
+        payload / actor args / put value / pickled result) and flush any
+        lazily-held bytes to the node store so remote consumers resolve
+        them through the directory. Escape is sticky: a ref can escape
+        BEFORE its task finishes (a reduce task submitted on a map
+        task's in-flight returns), so arrival checks the set too."""
+        for ob in oid_bins:
+            self._escaped_ids.add(ob)
+            data = self._lazy_results.pop(ob, None)
+            if data is not None:
+                self._enqueue_put(("data", ObjectID(ob), data))
+
+    NODE_FAST_REFRESH_S = 30.0
+
+    def _node_fast_address(self) -> str:
+        """The local node manager's binary object plane address, learned
+        lazily from the GCS node table ("" until known)."""
+        now = time.monotonic()
+        cached = getattr(self, "_node_fast_cache", None)
+        if cached is not None and now - cached[0] < self.NODE_FAST_REFRESH_S:
+            return cached[1]
+        addr = ""
+        try:
+            for n in self.gcs.GetNodes(pb.GetNodesRequest()).nodes:
+                if n.address == self.node_address and n.alive:
+                    addr = n.fast_address
+                    break
+        except Exception:  # noqa: BLE001 — fall back to gRPC
+            pass
+        self._node_fast_cache = (now, addr)
+        return addr
+
+    def _node_put_batch(self, batch: pb.PutObjectBatchRequest):
+        """Flush a put batch over the node's fastpath plane when
+        available (the gRPC stack's per-call CPU was visible in the
+        large-put path); gRPC remains the fallback. Puts are idempotent
+        (immutable content at a fixed id), so retrying an ambiguous
+        fastpath failure over gRPC is safe here."""
+        from ray_tpu._private import fastpath
+
+        status, reply = fastpath.call_proto(
+            self._node_fast_address(), fastpath.KIND_PUT_BATCH, batch,
+            pb.PutObjectBatchReply, timeout=60)
+        if status == "ok":
+            return reply
+        return self.node.PutObjectBatch(batch)
 
     def _enqueue_put(self, item: tuple) -> None:
         with self._put_cv:
@@ -565,7 +650,7 @@ class ClusterRuntime(CoreRuntime):
             if not batch.items:
                 continue
             try:
-                reply = self.node.PutObjectBatch(batch)
+                reply = self._node_put_batch(batch)
                 # Items the store REJECTED (full even after spilling) have
                 # no location and — for shm items — no segment anymore
                 # (the node unlinks what it can't index). Re-enqueue from
@@ -729,8 +814,7 @@ class ClusterRuntime(CoreRuntime):
             # In-flight local task: its result lands via the push reply —
             # wait on the completion event instead of probing the store
             # and directory (3 RPCs per spin; the r3 roundtrip bottleneck).
-            with self._pending_res_lock:
-                ev = self._pending_results.get(oid.binary())
+            ev = self._pending_event(oid.binary())
             if ev is not None:
                 if deadline is None:
                     ev.wait(5.0)
@@ -913,10 +997,13 @@ class ClusterRuntime(CoreRuntime):
             probe = []
             with self._pending_res_lock:
                 for r in pending:
-                    if r.id().binary() in self._pending_results:
-                        if self.memory.contains(r.id()):
-                            local_ready.append(r)
-                    else:
+                    oid = r.id()
+                    # Completed local tasks leave _pending_results but
+                    # their value IS in the memory store — check it first
+                    # or a fan-in wait pays a GCS probe per completed ref.
+                    if self.memory.contains(oid):
+                        local_ready.append(r)
+                    elif oid.binary() not in self._pending_results:
                         probe.append(r)
             for ref in local_ready + self._batch_ready(probe):
                 if len(ready_ids) >= num_returns:
@@ -937,6 +1024,8 @@ class ClusterRuntime(CoreRuntime):
     def free(self, refs):
         ids = [r.id().binary() for r in refs]
         self.memory.delete([r.id() for r in refs])
+        for ob in ids:
+            self._lazy_results.pop(ob, None)
         try:
             for n in self.gcs.GetNodes(pb.GetNodesRequest()).nodes:
                 if n.alive:
@@ -988,6 +1077,7 @@ class ClusterRuntime(CoreRuntime):
         # submit and the worker's borrow flush. A promoted payload gets the
         # same flight pin on top of its lineage pin below.
         pinned = list(contained)
+        self._flush_escaped(contained)
         if payload_oid is not None:
             pinned.append(payload_oid)
             self.refs.incr(payload_oid)  # lineage pin (see _on_ref_zero)
@@ -1146,11 +1236,25 @@ class ClusterRuntime(CoreRuntime):
 
     def _register_pending(self, return_ids: List[ObjectID]) -> None:
         """Mark a local task's returns as in-flight: getters/waiters block
-        on the completion event instead of probing the store/directory."""
-        ev = threading.Event()
+        on the completion event instead of probing the store/directory.
+        The Event is allocated LAZILY by the first getter that actually
+        waits (``_pending_event``) — most tasks complete before anyone
+        blocks, and an Event (condition + two locks) per task was
+        measurable on the submit hot path."""
         with self._pending_res_lock:
             for oid in return_ids:
-                self._pending_results[oid.binary()] = ev
+                self._pending_results[oid.binary()] = None
+
+    def _pending_event(self, oid_bin: bytes) -> Optional[threading.Event]:
+        """The in-flight completion event for an object, created on first
+        waiter; None when the task is not in flight locally."""
+        with self._pending_res_lock:
+            if oid_bin not in self._pending_results:
+                return None
+            ev = self._pending_results[oid_bin]
+            if ev is None:
+                ev = self._pending_results[oid_bin] = threading.Event()
+            return ev
 
     def _complete_pending(self, return_ids) -> None:
         with self._pending_res_lock:
@@ -1424,6 +1528,11 @@ class ClusterRuntime(CoreRuntime):
         if spawn:
             self._pool.submit(self._sig_runner_loop, sig, st)
 
+    # Tasks drained per lease iteration: one fastpath frame + one executor
+    # hop carries up to this many sub-millisecond tasks (per-push RPC and
+    # thread overhead dominated the r4 task-throughput profile).
+    SIG_PUSH_BATCH = 16
+
     def _sig_runner_loop(self, sig, st: dict) -> None:
         lease = None
         lease_cached = False  # a stale cached lease must not burn attempts
@@ -1436,12 +1545,18 @@ class ClusterRuntime(CoreRuntime):
                     # decremented and spawns a fresh runner.
                     st["runners"] -= 1
                     break
-                item = st["items"].pop(0)
-            spec, return_ids, retries, pinned, _ = item
-            if self._task_cancelled(bytes(spec.task_id)):
-                self._store_cancelled(spec, return_ids)
-                self._finish_item(item)
+                items = st["items"][:self.SIG_PUSH_BATCH]
+                del st["items"][:len(items)]
+            live = []
+            for item in items:
+                if self._task_cancelled(bytes(item[0].task_id)):
+                    self._store_cancelled(item[0], item[1])
+                    self._finish_item(item)
+                else:
+                    live.append(item)
+            if not live:
                 continue
+            spec, return_ids = live[0][0], live[0][1]
             try:
                 if lease is None:
                     lease = self._take_cached_lease(sig)
@@ -1451,35 +1566,93 @@ class ClusterRuntime(CoreRuntime):
                     lease_cached = False
                     if lease is None:  # aborted: a cached lease appeared
                         with self._sig_lock:
-                            st["items"].insert(0, item)
+                            st["items"][:0] = live
                         continue
-                if self._push_on_lease(spec, return_ids, lease):
-                    self._finish_item(item)
+                if self._push_batch_on_lease(live, lease):
                     continue
                 # Worker died mid-push (or stale cached lease).
                 self._return_lease(lease)
                 lease = None
-                if not lease_cached:
-                    item[4] += 1
-                if item[4] <= max(retries, 3):
+                requeue = []
+                for item in live:
+                    if not lease_cached:
+                        item[4] += 1
+                    if item[4] <= max(item[2], 3):
+                        requeue.append(item)
+                    else:
+                        self._store_error(
+                            exceptions.RayTaskError(
+                                item[0].name,
+                                f"Worker executing {item[0].name} died"),
+                            item[1])
+                        self._finish_item(item)
+                if requeue:
                     with self._sig_lock:
-                        st["items"].insert(0, item)
-                    continue
-                self._store_error(
-                    exceptions.RayTaskError(
-                        spec.name, f"Worker executing {spec.name} died"),
-                    return_ids)
-                self._finish_item(item)
+                        st["items"][:0] = requeue
             except exceptions.TaskCancelledError:
+                # Negotiation observed live[0]'s cancel; the rest requeue.
                 self._store_cancelled(spec, return_ids)  # typed + flag drop
-                self._finish_item(item)
+                self._finish_item(live[0])
+                if len(live) > 1:
+                    with self._sig_lock:
+                        st["items"][:0] = live[1:]
             except BaseException as e:  # noqa: BLE001
-                self._store_error(
-                    exceptions.RayTaskError.from_exception(e, spec.name),
-                    return_ids)
-                self._finish_item(item)
+                for item in live:
+                    self._store_error(
+                        exceptions.RayTaskError.from_exception(
+                            e, item[0].name), item[1])
+                    self._finish_item(item)
         if lease is not None and not self._cache_lease(sig, lease):
             self._return_lease(lease)
+
+    def _push_batch_on_lease(self, items: List[list], lease: dict) -> bool:
+        """Push a chunk of same-signature tasks to one leased worker.
+        Returns False when the worker died (callers apply the retry
+        policy to every item); on success every item's results are
+        applied and its pins released."""
+        if len(items) == 1:
+            item = items[0]
+            if self._push_on_lease(item[0], item[1], lease):
+                self._finish_item(item)
+                return True
+            return False
+        from ray_tpu._private import fastpath
+
+        breq = pb.PushTaskBatchRequest()
+        for item in items:
+            spec = item[0]
+            del spec.tpu_chips[:]
+            spec.tpu_chips.extend(lease["tpu_chips"])
+            breq.specs.append(spec)
+            self._running_locs[bytes(spec.task_id)] = \
+                lease["worker_address"]
+        try:
+            status, reply = fastpath.call_proto(
+                lease.get("fast_address", ""), fastpath.KIND_PUSH_BATCH,
+                breq, pb.PushTaskBatchReply, timeout=PUSH_TIMEOUT_S + 5)
+            if status == "error":
+                # Connection died mid-call: the batch MAY have executed;
+                # do NOT resend over gRPC — route through the retry gate.
+                return False
+            if status == "no_client":
+                stub = rpc.get_stub("WorkerService", lease["worker_address"])
+                try:
+                    reply = stub.PushTaskBatch(breq,
+                                               timeout=PUSH_TIMEOUT_S)
+                except Exception:  # noqa: BLE001
+                    return False
+        finally:
+            for item in items:
+                self._running_locs.pop(bytes(item[0].task_id), None)
+        with self._completion_slots:
+            for item, result in zip(items, reply.results):
+                self._apply_push_result(result, item[1], item[0].name)
+                self._finish_item(item)
+        if self._cancelled_tasks:
+            with self._cancel_lock:
+                for item in items:
+                    self._cancelled_tasks.discard(bytes(item[0].task_id))
+        return True
 
     def _finish_item(self, item) -> None:
         """Release an item's flight-time pins exactly once."""
@@ -1631,9 +1804,10 @@ class ClusterRuntime(CoreRuntime):
         del spec.tpu_chips[:]
         spec.tpu_chips.extend(lease["tpu_chips"])
         # Visible to cancel() for the duration of the push: a CancelTask
-        # RPC to this address interrupts the executor.
-        with self._cancel_lock:
-            self._running_locs[tid] = lease["worker_address"]
+        # RPC to this address interrupts the executor. Plain (GIL-atomic)
+        # dict write — cancel() tolerates the tiny record/read race as
+        # best-effort, and a lock here is per-task hot-path cost.
+        self._running_locs[tid] = lease["worker_address"]
         try:
             result = self._push_fast(lease.get("fast_address", ""), spec)
             if result is False:
@@ -1664,12 +1838,12 @@ class ClusterRuntime(CoreRuntime):
                             continue
                         return False
         finally:
-            with self._cancel_lock:
-                self._running_locs.pop(tid, None)
+            self._running_locs.pop(tid, None)
         with self._completion_slots:
             self._apply_push_result(result, return_ids, spec.name)
-        with self._cancel_lock:
-            self._cancelled_tasks.discard(tid)
+        if self._cancelled_tasks:
+            with self._cancel_lock:
+                self._cancelled_tasks.discard(tid)
         return True
 
     def _push_with_lease(self, spec: pb.TaskSpec,
@@ -1860,13 +2034,24 @@ class ClusterRuntime(CoreRuntime):
                 continue  # large result: fetched on demand via the directory
             data = result.inline_results[i]
             self.memory.put(oid, loads_store(data))
-            # Inline results also flush (batched, async) to the node store
-            # + directory: a DIFFERENT worker consuming this return as a
-            # task arg fetches through the directory, and an object living
-            # only in this process's memory store would never resolve
-            # (reference: the owner serves its in-process objects;
-            # this runtime's data plane is the node store).
-            self._enqueue_put(("data", oid, data))
+            # Inline results flush to the node store + directory LAZILY —
+            # only when the ref ESCAPES this process (used as a task arg,
+            # pickled into a payload/put): a different worker consuming
+            # the return fetches through the directory, but the common
+            # case (result get() locally and dropped) never leaves this
+            # process, and the eager per-task store put + directory
+            # registration was ~30% of the cluster's per-task CPU. A ref
+            # that escaped BEFORE the result arrived flushes right now.
+            # Order: STORE first, then check escape and pop — a concurrent
+            # _flush_escaped (which adds to the set before popping) can
+            # then never miss the bytes; the atomic pop decides who
+            # flushes.
+            ob = oid.binary()
+            self._lazy_results[ob] = data
+            if ob in self._escaped_ids:
+                taken = self._lazy_results.pop(ob, None)
+                if taken is not None:
+                    self._enqueue_put(("data", oid, taken))
         if return_ids:
             self._task_done.add(return_ids[0].task_id().binary())
         self._complete_pending(return_ids)
@@ -1898,6 +2083,8 @@ class ClusterRuntime(CoreRuntime):
                           force, recursive)
 
     def _task_cancelled(self, tid: bytes) -> bool:
+        if not self._cancelled_tasks:
+            return False  # lock-free fast path: cancels are rare
         with self._cancel_lock:
             return bytes(tid) in self._cancelled_tasks
 
@@ -1984,6 +2171,7 @@ class ClusterRuntime(CoreRuntime):
         # placement can take minutes, during which the caller may drop its
         # only refs (same flight-time rule as submit_task).
         if contained:
+            self._flush_escaped(contained)
             for oid in contained:
                 self.refs.incr(oid)
             with self._actor_lock:
@@ -2091,6 +2279,7 @@ class ClusterRuntime(CoreRuntime):
         # promoted payload is pinned the same way (released after the push —
         # actor tasks are not lineage-reconstructed).
         pinned = list(contained)
+        self._flush_escaped(contained)
         if payload_oid is not None:
             pinned.append(payload_oid)
         for oid in pinned:
@@ -2167,8 +2356,7 @@ class ClusterRuntime(CoreRuntime):
             while True:
                 try:
                     info = self._resolve_actor(actor_id)
-                    with self._cancel_lock:
-                        self._running_locs[tid] = info.address
+                    self._running_locs[tid] = info.address
                     result = self._push_fast(info.fast_address, spec)
                     if result is False:
                         # Connection died mid-call: the task MAY have
@@ -2203,9 +2391,10 @@ class ClusterRuntime(CoreRuntime):
                         return_ids)
                     return
         finally:
-            with self._cancel_lock:
-                self._running_locs.pop(tid, None)
-                self._cancelled_tasks.discard(tid)
+            self._running_locs.pop(tid, None)
+            if self._cancelled_tasks:
+                with self._cancel_lock:
+                    self._cancelled_tasks.discard(tid)
             with st["cond"]:
                 st["done"] = max(st["done"], seq + 1)
                 st["cond"].notify_all()

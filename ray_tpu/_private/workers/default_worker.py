@@ -311,6 +311,10 @@ class WorkerServer:
             req = pb.PushTaskRequest()
             req.ParseFromString(payload)
             return self.PushTask(req, None).SerializeToString()
+        if kind == fastpath.KIND_PUSH_BATCH:
+            breq = pb.PushTaskBatchRequest()
+            breq.ParseFromString(payload)
+            return self.PushTaskBatch(breq, None).SerializeToString()
         raise ValueError(f"unknown fastpath frame kind {kind}")
 
     # ------------------------------------------------------------- helpers
@@ -418,6 +422,15 @@ class WorkerServer:
         if spec.actor_id:
             return self._push_actor_task(spec)
         return self._push_normal_task(spec)
+
+    def PushTaskBatch(self, request, context):
+        """Execute a chunk of normal tasks back-to-back (one frame, one
+        reply): lease-holding submitters drain their queues in batches so
+        sub-millisecond tasks don't pay a full RPC round per task."""
+        reply = pb.PushTaskBatchReply()
+        for spec in request.specs:
+            reply.results.append(self._push_normal_task(spec))
+        return reply
 
     def _report_task(self, spec, state: str, **extra) -> None:
         if self.task_events is not None:

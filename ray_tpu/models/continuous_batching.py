@@ -87,8 +87,15 @@ def _decode_tick(params, tokens, positions, cache: KVCache,
     scale = c.head_dim ** -0.5
 
     def layer_fn(carry, inputs):
-        x = carry
-        layer, ck, cv = inputs
+        # Cache rides the CARRY (updated in place layer by layer via
+        # dynamic_update_slice), not scan xs/ys: threading it as
+        # per-iteration inputs/outputs made XLA materialize full cache
+        # copies every tick — the decode tick was 2-3x the HBM roofline
+        # from copy traffic alone.
+        x, ck_all, cv_all, li = carry
+        layer = inputs
+        ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
         h = rms_norm(x, layer["attn_norm"], c.rms_eps)
         q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(c.dtype))
         k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(c.dtype))
@@ -98,6 +105,8 @@ def _decode_tick(params, tokens, positions, cache: KVCache,
         ck = _scatter_slot(ck, k[:, 0].astype(ck.dtype), positions)
         cv = _scatter_slot(cv, v[:, 0].astype(cv.dtype), positions)
         o = _attend_decode(q[:, 0], ck, cv, positions, scale)
+        ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, li, 0)
+        cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, li, 0)
         x = x + jnp.einsum("bhd,hde->be", o,
                            layer["wo"].astype(c.dtype))[:, None, :]
         h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
@@ -105,14 +114,18 @@ def _decode_tick(params, tokens, positions, cache: KVCache,
         up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
         x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(gate) * up,
                            layer["w_down"].astype(c.dtype))
-        return x, (ck, cv)
+        return (x, ck_all, cv_all, li + 1), None
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_fn, x, (params["layers"], cache.k, cache.v))
+    (x, new_k, new_v, _), _ = jax.lax.scan(
+        layer_fn, (x, cache.k, cache.v, jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     logits = jnp.einsum("bse,ev->bsv", x.astype(jnp.float32),
                         params["lm_head"].astype(jnp.float32))
-    return logits[:, 0], KVCache(k=new_k, v=new_v)
+    # Greedy selection stays ON DEVICE: the host needs 4 bytes per slot,
+    # not the [B, V] logits — shipping full logits per tick was the
+    # serving bottleneck on remote-attached chips (512KB x RTT per token).
+    next_tokens = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+    return next_tokens, positions + 1, KVCache(k=new_k, v=new_v)
 
 
 def _bucket(n: int, floor: int = 16) -> int:
@@ -127,19 +140,41 @@ class ContinuousBatcher:
 
     def __init__(self, config: llama.LlamaConfig, params=None,
                  num_slots: int = 8, max_len: int = 512, seed: int = 0,
-                 eos_token: Optional[int] = None, token_callback=None):
+                 eos_token: Optional[int] = None, token_callback=None,
+                 sync_every: int = 1):
         """``token_callback(rid, token)`` fires for every generated token
-        as it is produced (serving streams ride this)."""
+        as it is produced (serving streams ride this).
+
+        ``sync_every=K > 1`` enables SPECULATIVE BUFFERED decode for
+        high-latency host↔device links (remote-attached chips: a fetch
+        costs a full tunnel RTT regardless of size): the engine runs K
+        ticks per host synchronization, fetching token batches
+        double-buffered so the transfer overlaps the next K ticks'
+        compute. Greedy decode is deterministic, so ticks run ahead of
+        host bookkeeping speculatively; when a request finishes, the
+        engine rewinds to host-known state and redoes ≤2K ticks (freed
+        slots need re-admission). Outputs are bit-identical to
+        ``sync_every=1``; only finish *detection* lags."""
         self.config = config
         self.num_slots = num_slots
         self.max_len = max_len
         self.eos_token = eos_token
+        self.sync_every = max(1, int(sync_every))
+        self._buf: List[Any] = []       # unstacked device token vectors
+        self._pending: Optional[tuple] = None  # (stacked, [(slot, rid)])
         self.params = params if params is not None else llama.init_params(
             config, jax.random.PRNGKey(seed))
         self.token_callback = token_callback
         self.cache = KVCache.create(config, num_slots, max_len)
         self._free: List[int] = list(range(num_slots))
         self._slots: Dict[int, Dict[str, Any]] = {}   # slot -> request
+        # Device-resident decode state: last tokens + positions live on
+        # the chip between ticks (uploaded only when slot membership
+        # changes), so a steady decode tick moves 4 bytes/slot host-ward
+        # and nothing device-ward.
+        self._d_tokens = None
+        self._d_positions = None
+        self._dirty = True
         self._waiting: deque = deque()
         self._rid = itertools.count()
         self._finished: Dict[int, List[int]] = {}
@@ -197,6 +232,7 @@ class ContinuousBatcher:
             if st["rid"] == rid:
                 del self._slots[slot]
                 self._free.append(slot)
+                self._dirty = True
                 return True
         return self._finished.pop(rid, None) is not None
 
@@ -209,11 +245,14 @@ class ContinuousBatcher:
         self._waiting.clear()
         self._free = list(range(self.num_slots))
         self._finished.clear()
+        self._buf = []
+        self._pending = None
         # The prefill/tick jits donate the pooled cache; after a mid-step
         # failure the old buffers may already be deleted, so rebuild the
         # pool or every later step would raise "Array has been deleted".
         self.cache = KVCache.create(self.config, self.num_slots,
                                     self.max_len)
+        self._dirty = True
         return dropped
 
     @property
@@ -221,7 +260,8 @@ class ContinuousBatcher:
         return len(self._slots)
 
     def has_work(self) -> bool:
-        return bool(self._slots or self._waiting or self._finished)
+        return bool(self._slots or self._waiting or self._finished
+                    or self._buf or self._pending)
 
     def _admit(self) -> None:
         while self._waiting and self._free:
@@ -245,6 +285,7 @@ class ContinuousBatcher:
                 "pos": true_len,       # next decode writes here
                 "last": first,
             }
+            self._dirty = True  # device tokens/positions need re-upload
             self._maybe_finish(slot)
 
     def _maybe_finish(self, slot: int) -> None:
@@ -258,30 +299,102 @@ class ContinuousBatcher:
             del self._slots[slot]
             self._free.append(slot)
 
-    def step(self) -> Dict[int, List[int]]:
-        """Admit waiting requests, run one decode tick over all active
-        slots, and return the requests that finished this tick."""
-        self._admit()
-        if self._slots:
-            tokens = np.zeros(self.num_slots, np.int32)
-            positions = np.zeros(self.num_slots, np.int32)
-            for slot, st in self._slots.items():
-                tokens[slot] = st["last"]
-                positions[slot] = st["pos"]
-            logits, self.cache = self._tick(
-                self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                self.cache)
-            logits = np.asarray(logits)
-            for slot, st in list(self._slots.items()):
-                nxt = int(np.argmax(logits[slot]))
+    def _upload_state(self) -> None:
+        tokens = np.zeros(self.num_slots, np.int32)
+        positions = np.zeros(self.num_slots, np.int32)
+        for slot, st in self._slots.items():
+            tokens[slot] = st["last"]
+            positions[slot] = st["pos"]
+        self._d_tokens = jnp.asarray(tokens)
+        self._d_positions = jnp.asarray(positions)
+        self._dirty = False
+
+    def _apply_tokens(self, nxt_rows, membership) -> bool:
+        """Book one or more fetched tick rows; returns True when any
+        request finished (membership changed)."""
+        finished_any = False
+        for row in nxt_rows:
+            for slot, rid in membership:
+                st = self._slots.get(slot)
+                if st is None or st["rid"] != rid:
+                    continue  # finished earlier in this batch: skip tail
+                tok = int(row[slot])
                 if self.token_callback is not None:
-                    self.token_callback(st["rid"], nxt)
-                st["out"].append(nxt)
-                st["last"] = nxt
+                    self.token_callback(rid, tok)
+                st["out"].append(tok)
+                st["last"] = tok
                 st["pos"] += 1
                 self._maybe_finish(slot)
+                if slot not in self._slots:
+                    finished_any = True
+        return finished_any
+
+    def step(self) -> Dict[int, List[int]]:
+        """Admit waiting requests, run one decode tick over all active
+        slots, and return the requests that finished (with
+        ``sync_every > 1``, finish detection lags up to 2K ticks)."""
+        if self.sync_every == 1:
+            self._admit()
+            if self._slots:
+                if self._dirty:
+                    self._upload_state()
+                self._d_tokens, self._d_positions, self.cache = self._tick(
+                    self.params, self._d_tokens, self._d_positions,
+                    self.cache)
+                nxt = np.asarray(self._d_tokens)  # 4 bytes/slot
+                if self._apply_tokens(
+                        [nxt], [(s, st["rid"])
+                                for s, st in self._slots.items()]):
+                    self._dirty = True
+            out, self._finished = self._finished, {}
+            return out
+        return self._step_buffered()
+
+    def _step_buffered(self) -> Dict[int, List[int]]:
+        # Admission only at a clean boundary (no speculative ticks in
+        # flight): an upload mid-buffer would rewind the device sequence.
+        if not self._buf and self._pending is None:
+            self._admit()
+        if self._slots:
+            if self._dirty and not self._buf and self._pending is None:
+                self._upload_state()
+            self._d_tokens, self._d_positions, self.cache = self._tick(
+                self.params, self._d_tokens, self._d_positions, self.cache)
+            self._buf.append(self._d_tokens)
+        if len(self._buf) >= self.sync_every or (
+                not self._slots and (self._buf or self._pending is not None)):
+            # The zero-slot arms drain in-flight state (e.g. the last
+            # active request was cancelled with a fetch outstanding) so
+            # the engine can admit again instead of wedging.
+            self._flush_buffered()
         out, self._finished = self._finished, {}
         return out
+
+    def _flush_buffered(self) -> None:
+        # 1. Apply the PRIOR pending fetch first — its transfer has been
+        # overlapping the ticks just buffered. If it finished requests,
+        # the current buffer is stale speculation over freed slots:
+        # discard it and rewind (re-upload host state next step).
+        if self._pending is not None:
+            stacked, membership = self._pending
+            self._pending = None
+            rows = np.asarray(stacked)  # overlapped: usually ready
+            if self._apply_tokens(list(rows), membership):
+                self._buf = []
+                self._dirty = True
+                return
+        if not self._buf:
+            return
+        # 2. Stack this buffer into ONE transfer and start it async; it
+        # lands while the next K ticks run.
+        stacked = jnp.stack(self._buf)
+        self._buf = []
+        try:
+            stacked.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — platform without async copy
+            pass
+        self._pending = (stacked,
+                         [(s, st["rid"]) for s, st in self._slots.items()])
 
     def run_to_completion(self) -> Dict[int, List[int]]:
         """Drive ticks until every submitted request finished."""
