@@ -110,19 +110,38 @@ class GcsServer:
             max_workers=16, thread_name_prefix="gcs-work")
         self._persist_path = persist_path or os.environ.get(
             "RAY_TPU_GCS_PERSIST_PATH") or None
+        # External WAL backend (reference: the Redis store client,
+        # redis_store_client.h:107 — persistence that survives head
+        # MACHINE loss): RAY_TPU_GCS_WAL_URL=logd://host:port points at a
+        # WalLogServer; a replacement GCS on any machine recovers from it.
+        self._wal_url = os.environ.get("RAY_TPU_GCS_WAL_URL", "")
         self._wal = None
-        loaded = False
-        if self._persist_path and os.path.exists(self._persist_path):
-            self._load_snapshot()
-            loaded = True
-        if self._persist_path:
-            replayed = self._replay_wal()
+        self._wal_backend = None
+        if self._persist_path or self._wal_url:
+            from ray_tpu._private.gcs.wal import WriteAheadLog, parse_records
+            from ray_tpu._private.gcs.wal_backend import backend_from_url
+
+            base = self._persist_path or os.path.join(
+                os.getcwd(), "gcs_state")
+            self._wal_backend = backend_from_url(
+                self._wal_url, base + ".wal", base)
+            loaded = False
+            snap = self._wal_backend.load_snapshot()
+            if snap:
+                self._load_snapshot(snap)
+                loaded = True
+            replayed = 0
+            for rec in parse_records(self._wal_backend.read_log()):
+                try:
+                    self._apply_wal_record(rec)
+                    replayed += 1
+                except Exception:  # noqa: BLE001 — one bad record must not
+                    logger.exception("skipping unreplayable WAL record")
+            if replayed:
+                logger.info("replayed %d WAL records", replayed)
             if loaded or replayed:
                 self._finish_restore()
-            from ray_tpu._private.gcs.wal import WriteAheadLog
-
-            self._wal = WriteAheadLog(self._persist_path + ".wal",
-                                      self._state_blob, self._persist_path)
+            self._wal = WriteAheadLog(self._wal_backend, self._state_blob)
         self._server, self.port = rpc.serve("GcsService", self, port=port)
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="gcs-health")
@@ -163,10 +182,9 @@ class GcsServer:
             }
         return pickle.dumps(state)
 
-    def _load_snapshot(self):
+    def _load_snapshot(self, blob: bytes):
         try:
-            with open(self._persist_path, "rb") as f:
-                state = pickle.loads(f.read())
+            state = pickle.loads(blob)
         except Exception:  # noqa: BLE001
             logger.exception("GCS snapshot load failed; starting empty")
             return
@@ -193,21 +211,6 @@ class GcsServer:
             self._holder_meta[h] = (nid, is_drv, now)
         for oid in state.get("freed", ()):
             self._freed[oid] = now
-
-    def _replay_wal(self) -> int:
-        """Apply log records over the loaded snapshot (recovery step 2)."""
-        from ray_tpu._private.gcs.wal import WriteAheadLog
-
-        n = 0
-        for rec in WriteAheadLog.replay(self._persist_path + ".wal"):
-            try:
-                self._apply_wal_record(rec)
-                n += 1
-            except Exception:  # noqa: BLE001 — one bad record must not
-                logger.exception("skipping unreplayable WAL record")
-        if n:
-            logger.info("replayed %d WAL records", n)
-        return n
 
     def _apply_wal_record(self, rec) -> None:
         kind = rec[0]

@@ -33,28 +33,53 @@ logger = logging.getLogger(__name__)
 _LEN = struct.Struct("<I")
 
 
+def parse_records(data: bytes) -> Iterator[Tuple]:
+    """Records from framed log bytes, tolerating a torn final record
+    (a crash mid-append truncates cleanly at the last whole record)."""
+    off, total = 0, len(data)
+    while True:
+        if off + _LEN.size > total:
+            return
+        (n,) = _LEN.unpack_from(data, off)
+        off += _LEN.size
+        if off + n > total:
+            return  # torn tail record
+        blob = data[off:off + n]
+        off += n
+        try:
+            yield pickle.loads(blob)
+        except Exception:  # noqa: BLE001 — corrupt record: stop
+            logger.warning("corrupt WAL record; ignoring tail")
+            return
+
+
 class WriteAheadLog:
-    """Batched appender with snapshot-based compaction.
+    """Batched appender with snapshot-based compaction over a pluggable
+    :class:`~ray_tpu._private.gcs.wal_backend.WalBackend` (local files by
+    default; a remote log server for head-machine-loss survival).
 
     ``snapshot_fn()`` must return the full-state blob under the owner's
-    state locks; ``snapshot_path`` is where compaction installs it
-    (atomic rename).
+    state locks.
     """
 
     FLUSH_PERIOD_S = 0.05
 
-    def __init__(self, path: str, snapshot_fn: Callable[[], bytes],
-                 snapshot_path: str,
+    def __init__(self, path_or_backend, snapshot_fn: Callable[[], bytes],
+                 snapshot_path: str = "",
                  compact_threshold: int = 8 << 20):
-        self.path = path
-        self.snapshot_path = snapshot_path
+        from ray_tpu._private.gcs.wal_backend import (FileWalBackend,
+                                                      WalBackend)
+
+        if isinstance(path_or_backend, WalBackend):
+            self._backend = path_or_backend
+        else:
+            self._backend = FileWalBackend(path_or_backend, snapshot_path)
         self._snapshot_fn = snapshot_fn
         self._threshold = compact_threshold
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._stop = False
-        self._file = open(path, "ab")
-        self._size = self._file.tell()
+        self._size = len(self._backend.read_log())
         self._thread = threading.Thread(target=self._writer_loop,
                                         daemon=True, name="gcs-wal")
         self._thread.start()
@@ -67,28 +92,6 @@ class WriteAheadLog:
             if len(self._q) == 1:
                 self._cv.notify()
 
-    @staticmethod
-    def replay(path: str) -> Iterator[Tuple]:
-        """Records of an existing log, tolerating a torn final record
-        (a crash mid-append truncates cleanly at the last whole record)."""
-        try:
-            f = open(path, "rb")
-        except OSError:
-            return
-        with f:
-            while True:
-                head = f.read(_LEN.size)
-                if len(head) < _LEN.size:
-                    return
-                (n,) = _LEN.unpack(head)
-                blob = f.read(n)
-                if len(blob) < n:
-                    return  # torn tail record
-                try:
-                    yield pickle.loads(blob)
-                except Exception:  # noqa: BLE001 — corrupt record: stop
-                    logger.warning("corrupt WAL record; ignoring tail")
-                    return
 
     def close(self) -> None:
         with self._cv:
@@ -108,7 +111,7 @@ class WriteAheadLog:
             self._compact()
         except Exception:  # noqa: BLE001
             logger.exception("final WAL compaction failed")
-        self._file.close()
+        self._backend.close()
 
     # ------------------------------------------------------------- writer
     def _writer_loop(self) -> None:
@@ -125,7 +128,8 @@ class WriteAheadLog:
                 if self._size > self._threshold:
                     self._compact()
             except Exception:  # noqa: BLE001
-                logger.exception("WAL write failed")
+                logger.exception("WAL write failed (will retry)")
+                time.sleep(0.5)  # backoff before retrying the requeue
 
     def _drain_to_file(self) -> None:
         with self._cv:
@@ -141,26 +145,23 @@ class WriteAheadLog:
             parts.append(_LEN.pack(len(blob)))
             parts.append(blob)
         data = b"".join(parts)
-        self._file.write(data)
-        self._file.flush()
-        os.fsync(self._file.fileno())
+        try:
+            self._backend.append(data)
+        except Exception:
+            # A failed append (remote backend blip) must NOT drop state
+            # mutations — requeue the batch at the FRONT (order preserved)
+            # and let the writer loop retry; durability is the point.
+            with self._cv:
+                self._q.extendleft(reversed(batch))
+            raise
         self._size += len(data)
 
     def _compact(self) -> None:
         """Snapshot-then-truncate. Mutations racing the snapshot capture
         end up in both the snapshot and the next log batch — harmless,
         records are idempotent upserts."""
-        blob = self._snapshot_fn()
-        tmp = f"{self.snapshot_path}.tmp.{os.getpid()}"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.snapshot_path)
-        self._file.truncate(0)
-        self._file.seek(0)
-        os.fsync(self._file.fileno())
+        self._backend.install_snapshot(self._snapshot_fn())
         self._size = 0
 
 
-__all__ = ["WriteAheadLog"]
+__all__ = ["WriteAheadLog", "parse_records"]

@@ -513,9 +513,13 @@ class ClusterRuntime(CoreRuntime):
         batch.items.append(pb.PutObjectRequest(
             object_id=oid.binary(), shm_name=seg, size=size,
             owner=self.worker_id))
+        # Short timeout: this is a tiny metadata frame on the user's put()
+        # call path — a stalled node must degrade to the async flusher,
+        # not hang the caller. Registration is idempotent, so a timed-out
+        # frame that DID land is harmlessly re-sent by the flusher.
         status, reply = fastpath.call_proto(
             self._node_fast_address(), fastpath.KIND_PUT_BATCH, batch,
-            pb.PutObjectBatchReply, timeout=30)
+            pb.PutObjectBatchReply, timeout=2)
         if status != "ok":
             return False  # transport/no client: let the flusher handle it
         if reply.rejected and reply.rejected[0]:

@@ -361,16 +361,18 @@ class ContinuousBatcher:
             self._d_tokens, self._d_positions, self.cache = self._tick(
                 self.params, self._d_tokens, self._d_positions, self.cache)
             self._buf.append(self._d_tokens)
-        if len(self._buf) >= self.sync_every or (
+        want_admit = bool(self._waiting and self._free)
+        if len(self._buf) >= self.sync_every or want_admit or (
                 not self._slots and (self._buf or self._pending is not None)):
-            # The zero-slot arms drain in-flight state (e.g. the last
-            # active request was cancelled with a fetch outstanding) so
-            # the engine can admit again instead of wedging.
-            self._flush_buffered()
+            # Non-K arms drain in-flight state early: a waiting request
+            # with a free slot must not starve behind steady pipelining
+            # (time-to-first-token), and a cancel of the last request
+            # must not wedge admission.
+            self._flush_buffered(force_boundary=want_admit)
         out, self._finished = self._finished, {}
         return out
 
-    def _flush_buffered(self) -> None:
+    def _flush_buffered(self, force_boundary: bool = False) -> None:
         # 1. Apply the PRIOR pending fetch first — its transfer has been
         # overlapping the ticks just buffered. If it finished requests,
         # the current buffer is stale speculation over freed slots:
@@ -383,6 +385,16 @@ class ContinuousBatcher:
                 self._buf = []
                 self._dirty = True
                 return
+        if force_boundary and self._buf:
+            # A waiting request needs a clean boundary to admit: apply the
+            # just-stacked-would-be buffer SYNCHRONOUSLY instead of
+            # pipelining it, then rewind so the next step re-admits.
+            rows = np.asarray(jnp.stack(self._buf))
+            membership = [(s, st["rid"]) for s, st in self._slots.items()]
+            self._buf = []
+            self._apply_tokens(list(rows), membership)
+            self._dirty = True
+            return
         if not self._buf:
             return
         # 2. Stack this buffer into ONE transfer and start it async; it

@@ -209,3 +209,26 @@ def test_buffered_cancel_last_request_does_not_wedge(setup):
     rid2 = eng.submit([4, 5], max_new_tokens=3)
     out = eng.run_to_completion()
     assert rid2 in out and len(out[rid2]) == 3
+
+
+def test_buffered_admission_not_starved(setup):
+    """A request submitted mid-pipeline with a free slot must join within
+    ~2K ticks, not wait for the running request to finish."""
+    config, gen, _ = setup
+    eng = ContinuousBatcher(config, params=gen.params, num_slots=2,
+                            max_len=128, sync_every=4)
+    r_long = eng.submit([1, 2, 3], max_new_tokens=100)
+    for _ in range(6):
+        eng.step()
+    r_short = eng.submit([4, 5, 6], max_new_tokens=3)
+    finished = {}
+    for i in range(30):  # << the ~100 ticks r_long needs
+        finished.update(eng.step())
+        if r_short in finished:
+            break
+    assert r_short in finished, "waiting request starved behind pipeline"
+    assert r_long not in finished
+    out = eng.run_to_completion()
+    assert r_long in out and len(out[r_long]) == 100
+    # The long request's output is unaffected by the mid-flight rewinds.
+    assert out[r_long] == _reference(gen, [1, 2, 3], 100)

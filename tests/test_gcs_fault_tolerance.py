@@ -147,3 +147,41 @@ def test_restored_pending_actor_rescheduled(persistent_cluster):
         time.sleep(0.25)
     assert state_seen == "ALIVE", \
         f"restored PENDING actor stuck in {state_seen!r}"
+
+
+def test_head_loss_recovers_from_external_wal(tmp_path, monkeypatch):
+    """Head-MACHINE loss: with RAY_TPU_GCS_WAL_URL pointing at an
+    external log server (reference analog: the Redis store client,
+    redis_store_client.h:107), a replacement GCS recovers the cluster
+    from the external log alone — no local snapshot/log files."""
+    from ray_tpu._private.gcs.wal_backend import WalLogServer
+
+    logd = WalLogServer(str(tmp_path / "walstore"))
+    monkeypatch.setenv("RAY_TPU_GCS_WAL_URL", f"logd://{logd.address}")
+    monkeypatch.chdir(tmp_path / "walstore")  # catch stray local writes
+    c = Cluster(head_node_args={"num_cpus": 4})
+    try:
+        ray_tpu.init(address=c.address)
+        gcs = rpc.get_stub("GcsService", c.address)
+        gcs.KvPut(pb.KvRequest(ns="ha", key="k", value=b"remote",
+                               overwrite=True))
+        a = Stateful.options(name="ha_actor", lifetime="detached").remote()
+        assert ray_tpu.get(a.inc.remote(), timeout=60) == 1
+        time.sleep(0.5)  # WAL flush period is 50ms; let appends land
+
+        # The replacement head recovers purely from the log server.
+        c.restart_gcs()
+        assert _wait_alive_nodes(c.address, 1), "node did not re-register"
+        reply = gcs.KvGet(pb.KvRequest(ns="ha", key="k"))
+        assert reply.found and reply.value == b"remote"
+        b = ray_tpu.get_actor("ha_actor")
+        assert ray_tpu.get(b.inc.remote(), timeout=60) == 2
+        assert ray_tpu.get(_double.remote(21), timeout=60) == 42
+        # No local persistence was written next to the head.
+        assert not any(p.name.startswith("gcs_state")
+                       for p in (tmp_path / "walstore").iterdir()
+                       if p.is_file())
+    finally:
+        ray_tpu.shutdown()
+        c.shutdown()
+        logd.close()
