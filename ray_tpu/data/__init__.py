@@ -1,5 +1,14 @@
 """ray_tpu.data: distributed data processing (reference: ``python/ray/data``)."""
 
+from ray_tpu.data.datasource import (
+    BinaryFilesDatasource,
+    CSVDatasource,
+    Datasource,
+    JSONDatasource,
+    ParquetDatasource,
+    TextDatasource,
+    read_datasource,
+)
 from ray_tpu.data.dataset import (
     ActorPoolStrategy,
     DataIterator,
@@ -17,6 +26,9 @@ from ray_tpu.data.dataset import (
 )
 
 __all__ = [
+    "Datasource", "read_datasource",
+    "BinaryFilesDatasource", "CSVDatasource", "JSONDatasource",
+    "ParquetDatasource", "TextDatasource",
     "ActorPoolStrategy", "DataIterator", "Dataset", "from_arrow", "from_items", "from_numpy",
     "from_pandas", "range", "read_binary_files", "read_csv", "read_json",
     "read_parquet", "read_text",

@@ -99,21 +99,57 @@ def _rows_of(table: pa.Table) -> List[Dict[str, Any]]:
     return table.to_pylist()
 
 
+def _tensor_column_to_numpy(col: pa.ChunkedArray, field: pa.Field):
+    """Reassemble a tensor column ([N, d1, d2, ...] ndarray stored as a
+    FixedSizeList of the flattened trailing dims) without a per-row copy:
+    the flat value buffer views straight into an ndarray and reshapes."""
+    import json as _json
+
+    arr = col.combine_chunks()
+    flat = arr.values.to_numpy(zero_copy_only=False)
+    shape = None
+    if field.metadata and b"tensor_shape" in field.metadata:
+        shape = tuple(_json.loads(field.metadata[b"tensor_shape"]))
+    if shape is None:
+        shape = (arr.type.list_size,)
+    return flat.reshape((len(arr),) + shape)
+
+
 def _batch_of(table: pa.Table, fmt: str):
     if fmt == "pyarrow":
         return table
     if fmt == "pandas":
         return table.to_pandas()
-    return {name: np.asarray(col) for name, col in
-            zip(table.column_names, (c.to_numpy(zero_copy_only=False)
-                                     for c in table.columns))}
+    out = {}
+    for i, name in enumerate(table.column_names):
+        field = table.schema.field(i)
+        if pa.types.is_fixed_size_list(field.type):
+            out[name] = _tensor_column_to_numpy(table.column(i), field)
+        else:
+            out[name] = table.column(i).to_numpy(zero_copy_only=False)
+    return out
+
+
+def _tensor_column(arr: np.ndarray):
+    """(array, field_metadata) for a rectangular [N, d1, d2, ...] tensor:
+    stored as a FixedSizeList over the flattened trailing dims, shape in
+    the field metadata — iter_batches reconstructs the exact ndarray with
+    no per-row copies, ready to shard onto a device mesh."""
+    import json as _json
+
+    n = arr.shape[0]
+    inner = int(np.prod(arr.shape[1:])) if arr.ndim > 1 else 1
+    flat = pa.array(np.ascontiguousarray(arr).reshape(-1))
+    values = pa.FixedSizeListArray.from_arrays(flat, inner)
+    meta = {b"tensor_shape": _json.dumps(list(arr.shape[1:])).encode()}
+    return values, meta
 
 
 def _table_from_batch(batch) -> pa.Table:
     if isinstance(batch, pa.Table):
         return batch
     if isinstance(batch, dict):
-        cols = {}
+        names, arrays, fields = [], [], []
         for k, v in batch.items():
             if isinstance(v, np.ndarray):
                 arr = v
@@ -122,18 +158,29 @@ def _table_from_batch(batch) -> pa.Table:
                     arr = np.asarray(v)
                 except Exception:  # noqa: BLE001 — truly ragged input
                     arr = np.asarray(v, dtype=object)
-            if arr.dtype == object or arr.ndim > 1:
-                # Ragged / nested rows (token-id lists, embeddings):
+            if arr.ndim > 1 and arr.dtype != object:
+                # Rectangular tensor column (embeddings, images, token
+                # blocks): fixed-size-list layout, shape in metadata.
+                values, meta = _tensor_column(arr)
+                col = values
+                field = pa.field(k, values.type, metadata=meta)
+            elif arr.dtype == object:
+                # Ragged / nested rows (variable-length token lists):
                 # build an Arrow list array instead of a flat one.
-                cols[k] = pa.array([
+                col = pa.array([
                     None if x is None
                     else (list(x) if hasattr(x, "__len__")
                           and not isinstance(x, (str, bytes, dict))
                           else x)
                     for x in v])
+                field = pa.field(k, col.type)
             else:
-                cols[k] = pa.array(arr)
-        return pa.table(cols)
+                col = pa.array(arr)
+                field = pa.field(k, col.type)
+            names.append(k)
+            arrays.append(col)
+            fields.append(field)
+        return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
     import pandas as pd
 
     if isinstance(batch, pd.DataFrame):
@@ -215,21 +262,91 @@ class _MapWorker:
         return True
 
 
+
 @ray_tpu.remote
-def _read_file_block(path: str, fmt: str) -> pa.Table:
-    if fmt == "parquet":
-        import pyarrow.parquet as pq
+def _block_len(table: pa.Table) -> int:
+    return len(table)
 
-        return pq.read_table(path)
-    if fmt == "csv":
-        import pyarrow.csv as pcsv
 
-        return pcsv.read_csv(path)
-    if fmt == "json":
-        import pyarrow.json as pjson
+@ray_tpu.remote
+def _slice_block(table: pa.Table, off: int, length: int) -> pa.Table:
+    return table.slice(off, length)
 
-        return pjson.read_json(path)
-    raise ValueError(fmt)
+
+@ray_tpu.remote
+def _zip_block(left: pa.Table, *right_parts) -> pa.Table:
+    right = (pa.concat_tables([p for p in right_parts if len(p)])
+             if any(len(p) for p in right_parts) else pa.table({}))
+    # Rebuild with the SOURCE fields (not bare pa.table) so tensor-column
+    # shape metadata survives the zip.
+    arrays, fields, seen = [], [], set()
+    for i, name in enumerate(left.column_names):
+        arrays.append(left.column(i))
+        fields.append(left.schema.field(i))
+        seen.add(name)
+    for i, name in enumerate(right.column_names):
+        out_name = name
+        while out_name in seen:  # reference: right-side dups get _1
+            out_name += "_1"
+        seen.add(out_name)
+        arrays.append(right.column(i))
+        f = right.schema.field(i)
+        fields.append(pa.field(out_name, f.type, metadata=f.metadata))
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
+
+
+@ray_tpu.remote
+def _hash_partition_block(table: pa.Table, key: str, n: int):
+    """Split one block into n key-hashed parts (join map stage)."""
+    import zlib
+
+    if key not in table.column_names:
+        if table.num_columns:
+            raise KeyError(
+                f"join key {key!r} not in columns {table.column_names}")
+        col = []  # genuinely schema-less empty block
+    else:
+        col = table.column(key).to_pylist()
+    idx = [[] for _ in builtins.range(n)]
+    for i, v in enumerate(col):
+        idx[zlib.crc32(repr(v).encode()) % n].append(i)
+    parts = [table.take(pa.array(ix, type=pa.int64()))
+             for ix in idx]
+    return tuple(parts) if n > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _join_reduce(join_type: str, on, n_left: int, *parts) -> pa.Table:
+    # Keep empty partitions: they carry the side's full SCHEMA, which
+    # the outer join variants need to null-fill missing columns.
+    lparts = [p for p in parts[:n_left] if p.num_columns]
+    rparts = [p for p in parts[n_left:] if p.num_columns]
+    if not lparts or not rparts:
+        return pa.table({})  # a schema-less side: nothing to join
+    lt = pa.concat_tables(lparts)
+    rt = pa.concat_tables(rparts)
+    if not len(lt) and join_type in ("inner", "left outer"):
+        return pa.table({})
+    if not len(rt) and join_type in ("inner", "right outer"):
+        return pa.table({})
+    out = lt.join(rt, keys=on, join_type=join_type)
+    # Arrow's join drops field metadata: re-attach tensor shapes from
+    # whichever source schema carries the same-named field.
+    fields = []
+    changed = False
+    for i, name in enumerate(out.column_names):
+        f = out.schema.field(i)
+        for src in (lt, rt):
+            if name in src.schema.names:
+                sf = src.schema.field(name)
+                if sf.metadata:
+                    f = f.with_metadata(sf.metadata)
+                    changed = True
+                break
+        fields.append(f)
+    if changed:
+        out = out.cast(pa.schema(fields))
+    return out
 
 
 class ActorPoolStrategy:
@@ -467,6 +584,65 @@ class Dataset:
         ds._last_shuffle = {"mode": "distributed", "map_tasks": len(refs),
                             "reduce_tasks": num_parts}
         return ds
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Column-wise combination of two row-aligned datasets (reference:
+        ``Dataset.zip`` — duplicate right-side columns get a ``_1``
+        suffix). The right side is sliced remotely to the left side's
+        block boundaries; no blocks concentrate on the driver."""
+        a_refs = self._execute()
+        b_refs = other._execute()
+        a_counts = ray_tpu.get([_block_len.remote(r) for r in a_refs],
+                               timeout=600)
+        b_counts = ray_tpu.get([_block_len.remote(r) for r in b_refs],
+                               timeout=600)
+        if sum(a_counts) != sum(b_counts):
+            raise ValueError(
+                f"zip requires equal row counts; "
+                f"got {sum(a_counts)} vs {sum(b_counts)}")
+        out = []
+        bi, b_off = 0, 0
+        for a_ref, need in builtins.zip(a_refs, a_counts):
+            pieces = []
+            while need > 0:
+                avail = b_counts[bi] - b_off
+                take = min(need, avail)
+                pieces.append(_slice_block.remote(b_refs[bi], b_off, take))
+                b_off += take
+                need -= take
+                if b_off >= b_counts[bi]:
+                    bi += 1
+                    b_off = 0
+            out.append(_zip_block.remote(a_ref, *pieces))
+        return Dataset(out)
+
+    def join(self, other: "Dataset", on, *, join_type: str = "inner",
+             num_partitions: Optional[int] = None) -> "Dataset":
+        """Distributed hash join on key column(s) ``on`` (reference:
+        ``Dataset.join``): both sides hash-partition by key (map stage),
+        then each partition joins independently via Arrow's native join
+        (reduce stage) — the same two-stage shape as the shuffle, so no
+        driver-side concatenation."""
+        if isinstance(on, str):
+            on = [on]
+        key = on[0]
+        a_refs = self._execute()
+        b_refs = other._execute()
+        n = num_partitions or max(len(a_refs), len(b_refs))
+        opts = {"num_returns": n} if n > 1 else {}
+        a_parts = [_hash_partition_block.options(**opts).remote(r, key, n)
+                   for r in a_refs]
+        b_parts = [_hash_partition_block.options(**opts).remote(r, key, n)
+                   for r in b_refs]
+        if n == 1:
+            a_parts = [[p] for p in a_parts]
+            b_parts = [[p] for p in b_parts]
+        out = [
+            _join_reduce.remote(join_type, list(on), len(a_parts),
+                                *[p[j] for p in a_parts],
+                                *[p[j] for p in b_parts])
+            for j in builtins.range(n)]
+        return Dataset(out)
 
     def repartition(self, num_blocks: int) -> "Dataset":
         refs = self._execute()
@@ -875,9 +1051,10 @@ def range(n: int, *, parallelism: int = 8) -> Dataset:  # noqa: A001
 
 def from_numpy(arr: np.ndarray, *, parallelism: int = 8,
                column: str = "data") -> Dataset:
+    """Multi-dim arrays become tensor columns: ``iter_batches`` yields
+    the exact [N, d1, ...] ndarray back, mesh-shardable without copies."""
     parts = np.array_split(arr, max(1, parallelism))
-    refs = [ray_tpu.put(pa.table({column: pa.array(list(p))
-                                  if p.ndim > 1 else pa.array(p)}))
+    refs = [ray_tpu.put(_table_from_batch({column: p}))
             for p in parts if len(p)]
     return Dataset(refs)
 
@@ -907,31 +1084,27 @@ def _expand_paths(paths) -> List[str]:
     return files
 
 
-def _read_files(paths, fmt: str, parallelism: int) -> Dataset:
-    refs = [_read_file_block.remote(f, fmt) for f in _expand_paths(paths)]
-    return Dataset(refs)
-
-
+# File readers: thin wrappers over the Datasource interface (reference:
+# ``python/ray/data/read_api.py`` delegating to datasource classes) —
+# custom sources use ``ray_tpu.data.read_datasource`` with the same
+# machinery.
 def read_parquet(paths, *, parallelism: int = 8) -> Dataset:
-    return _read_files(paths, "parquet", parallelism)
+    from ray_tpu.data.datasource import ParquetDatasource, read_datasource
+
+    return read_datasource(ParquetDatasource(paths),
+                           parallelism=parallelism)
 
 
 def read_csv(paths, *, parallelism: int = 8) -> Dataset:
-    return _read_files(paths, "csv", parallelism)
+    from ray_tpu.data.datasource import CSVDatasource, read_datasource
+
+    return read_datasource(CSVDatasource(paths), parallelism=parallelism)
 
 
 def read_json(paths, *, parallelism: int = 8) -> Dataset:
-    return _read_files(paths, "json", parallelism)
+    from ray_tpu.data.datasource import JSONDatasource, read_datasource
 
-
-def _read_grouped(paths, parallelism: int, loader) -> Dataset:
-    """Stride files into groups and run ``loader(group) -> pa.Table`` as
-    one remote task per group (shared scaffold for whole-file readers)."""
-    files = _expand_paths(paths)
-    groups = [g for i in builtins.range(parallelism)
-              if (g := files[i::parallelism])]
-    remote_loader = ray_tpu.remote(loader)
-    return Dataset([remote_loader.remote(g) for g in groups])
+    return read_datasource(JSONDatasource(paths), parallelism=parallelism)
 
 
 def read_binary_files(paths, *, include_paths: bool = True,
@@ -939,30 +1112,16 @@ def read_binary_files(paths, *, include_paths: bool = True,
     """One row per file: ``{"bytes": ..., "path": ...}`` (reference:
     ``ray.data.read_binary_files`` — the raw-ingest entry point image/audio
     pipelines decode with ``map``)."""
-    def load(group):
-        rows = {"bytes": []}
-        if include_paths:
-            rows["path"] = []
-        for path in group:
-            with open(path, "rb") as f:
-                rows["bytes"].append(f.read())
-            if include_paths:
-                rows["path"].append(path)
-        return pa.table(rows)
+    from ray_tpu.data.datasource import (BinaryFilesDatasource,
+                                         read_datasource)
 
-    return _read_grouped(paths, parallelism, load)
+    return read_datasource(BinaryFilesDatasource(paths, include_paths),
+                           parallelism=parallelism)
 
 
 def read_text(paths, *, parallelism: int = 8) -> Dataset:
     """One row per line: ``{"text": ...}`` (reference:
     ``ray.data.read_text``)."""
-    def load(group):
-        lines = []
-        for path in group:
-            with open(path, encoding="utf-8") as f:
-                # Only \n terminates rows (str.splitlines would also split
-                # on \u2028 etc. inside records); rstrip handles CRLF.
-                lines.extend(line.rstrip("\r\n") for line in f)
-        return pa.table({"text": lines})
+    from ray_tpu.data.datasource import TextDatasource, read_datasource
 
-    return _read_grouped(paths, parallelism, load)
+    return read_datasource(TextDatasource(paths), parallelism=parallelism)

@@ -20,6 +20,7 @@ from ray_tpu.rllib.env_runner import (
     TransitionEnvRunner,
 )
 from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.learner_group import LearnerGroup
 from ray_tpu.rllib.multi_agent import (
@@ -33,7 +34,7 @@ from ray_tpu.rllib.ppo import PPO, PPOConfig
 
 __all__ = [
     "ContinuousEnvRunner", "DQN", "DQNConfig", "DQNLearner", "DQNModule",
-    "EnvRunnerGroup", "FaultTolerantActorManager", "IMPALA", "IMPALAConfig",
+    "EnvRunnerGroup", "FaultTolerantActorManager", "APPO", "APPOConfig", "IMPALA", "IMPALAConfig",
     "ImpalaLearner", "LearnerGroup", "MultiAgentEnv", "MultiAgentEnvRunner",
     "MultiAgentPPO", "MultiAgentPPOConfig", "PPO", "PPOConfig", "PPOLearner",
     "PPOModule", "ReplayBuffer", "SAC", "SACConfig", "SACLearner",

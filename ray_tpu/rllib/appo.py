@@ -1,0 +1,47 @@
+"""APPO: asynchronous PPO on the IMPALA machinery.
+
+Reference: ``rllib/algorithms/appo/appo.py`` — APPO is IMPALA's decoupled
+actor/learner architecture (stale behavior policies, V-trace off-policy
+correction, continuous in-flight rollouts) with PPO's clipped surrogate
+objective in place of the plain V-trace policy gradient: the likelihood
+ratio is taken against the BEHAVIOR policy (the async analog of PPO's
+"old" policy) and clipped to ``clip_param``, bounding per-update policy
+movement while sampling never blocks on learning.
+
+Everything else — env runners, the multi-learner
+:class:`~ray_tpu.rllib.learner_group.LearnerGroup` allreduce, runner
+respawn on failure — is inherited from :class:`~ray_tpu.rllib.impala.IMPALA`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig
+
+
+@dataclasses.dataclass
+class APPOConfig(IMPALAConfig):
+    clip_param: float = 0.2
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    @staticmethod
+    def _learner_builder(module_spec, cfg):
+        def builder():
+            from ray_tpu.rllib.core import ImpalaLearner, PPOModule
+
+            return ImpalaLearner(PPOModule(**module_spec), lr=cfg.lr,
+                                 gamma=cfg.gamma, vf_coeff=cfg.vf_coeff,
+                                 entropy_coeff=cfg.entropy_coeff,
+                                 rho_bar=cfg.rho_bar, c_bar=cfg.c_bar,
+                                 seed=cfg.seed,
+                                 clip_param=cfg.clip_param)
+
+        return builder
+
+
+__all__ = ["APPO", "APPOConfig"]

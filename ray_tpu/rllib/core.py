@@ -209,12 +209,19 @@ class ImpalaLearner:
     def __init__(self, module: PPOModule, lr: float = 5e-4,
                  gamma: float = 0.99, vf_coeff: float = 0.5,
                  entropy_coeff: float = 0.01, rho_bar: float = 1.0,
-                 c_bar: float = 1.0, seed: int = 0):
+                 c_bar: float = 1.0, seed: int = 0,
+                 clip_param: Optional[float] = None):
+        """``clip_param`` switches the policy loss from IMPALA's plain
+        V-trace policy gradient to APPO's PPO-style clipped surrogate
+        over the V-trace advantages (reference:
+        ``rllib/algorithms/appo/appo.py`` — async PPO = the IMPALA
+        architecture with the clipped surrogate objective)."""
         self.module = module
         self.optimizer = optax.adam(lr)
         self.params = module.init(jax.random.PRNGKey(seed))
         self.opt_state = self.optimizer.init(self.params)
         mod, g, vf_c, ent_c = module, gamma, vf_coeff, entropy_coeff
+        clip = clip_param
 
         def loss_fn(params, b):
             T, N = b["actions"].shape
@@ -228,7 +235,16 @@ class ImpalaLearner:
             vs, pg_adv = vtrace(logp, b["behavior_logp"], b["rewards"],
                                 b["dones"], values, bootstrap, g,
                                 rho_bar, c_bar)
-            pg_loss = -jnp.mean(logp * pg_adv)
+            if clip is None:
+                pg_loss = -jnp.mean(logp * pg_adv)
+            else:
+                # APPO: clipped surrogate against the BEHAVIOR policy
+                # (the async analog of PPO's old policy).
+                ratio = jnp.exp(logp - b["behavior_logp"])
+                surr = jnp.minimum(
+                    ratio * pg_adv,
+                    jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * pg_adv)
+                pg_loss = -jnp.mean(surr)
             vf_loss = 0.5 * jnp.mean((vs - values) ** 2)
             entropy = -jnp.mean(
                 jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))

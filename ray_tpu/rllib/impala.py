@@ -108,17 +108,7 @@ class IMPALA:
                        "hidden": tuple(config.hidden_sizes)}
         self._spec = module_spec
         self._creator = creator
-        cfg = config
-
-        def builder():
-            from ray_tpu.rllib.core import ImpalaLearner, PPOModule
-
-            return ImpalaLearner(PPOModule(**module_spec), lr=cfg.lr,
-                                 gamma=cfg.gamma, vf_coeff=cfg.vf_coeff,
-                                 entropy_coeff=cfg.entropy_coeff,
-                                 rho_bar=cfg.rho_bar, c_bar=cfg.c_bar,
-                                 seed=cfg.seed)
-
+        builder = self._learner_builder(module_spec, config)
         self.learner_group = LearnerGroup(builder,
                                           num_learners=config.num_learners)
         runner_cls = ray_tpu.remote(TrajectoryEnvRunner)
@@ -181,6 +171,21 @@ class IMPALA:
             "time_this_iter_s": time.monotonic() - t0,
             **metrics,
         }
+
+    @staticmethod
+    def _learner_builder(module_spec, cfg):
+        """Learner factory shipped to the learner actors; subclasses
+        (APPO) override to plug a different loss."""
+        def builder():
+            from ray_tpu.rllib.core import ImpalaLearner, PPOModule
+
+            return ImpalaLearner(PPOModule(**module_spec), lr=cfg.lr,
+                                 gamma=cfg.gamma, vf_coeff=cfg.vf_coeff,
+                                 entropy_coeff=cfg.entropy_coeff,
+                                 rho_bar=cfg.rho_bar, c_bar=cfg.c_bar,
+                                 seed=cfg.seed)
+
+        return builder
 
     def get_weights(self):
         return self.learner_group.get_weights()

@@ -314,3 +314,28 @@ def test_env_runner_killed_mid_iteration_recovers():
         assert result["num_env_steps_sampled"] > 0
     finally:
         algo.stop()
+
+
+def test_appo_learns_cartpole():
+    """APPO (reference: rllib/algorithms/appo) = IMPALA's async
+    architecture + PPO's clipped surrogate, multi-learner."""
+    from ray_tpu.rllib import APPOConfig
+
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=4,
+                         rollout_fragment_length=64)
+            .training(lr=3e-3, entropy_coeff=0.01,
+                      updates_per_iteration=8, clip_param=0.3)
+            .learners(num_learners=2)
+            .build())
+    best = -np.inf
+    for _ in range(30):
+        result = algo.train()
+        r = result["episode_return_mean"]
+        if np.isfinite(r):
+            best = max(best, r)
+        if best >= 120:
+            break
+    algo.stop()
+    assert best >= 120, f"APPO failed to learn CartPole: best={best}"
