@@ -212,6 +212,29 @@ class GcsServer:
         for oid in state.get("freed", ()):
             self._freed[oid] = now
 
+    def _claim_actor_name(self, info) -> None:
+        """Maintain the name table for one actor update (caller holds the
+        state lock; also used verbatim by WAL replay so live and
+        restored name resolution can never diverge). A name is released
+        when its holder reports DEAD, and CLAIMED only when unheld or
+        held by a dead/unknown actor — a stale ALIVE update from a
+        lagging node manager must not steal a name a successor owns."""
+        if not info.name:
+            return
+        aid = bytes(info.actor_id)
+        key = (info.namespace or "default", info.name)
+        if info.state == "DEAD":
+            if self._actor_names.get(key) == aid:
+                del self._actor_names[key]
+            return
+        cur = self._actor_names.get(key)
+        if cur is None or cur == aid:
+            self._actor_names[key] = aid
+            return
+        holder = self._actors.get(cur)
+        if holder is None or holder.state == "DEAD":
+            self._actor_names[key] = aid
+
     def _apply_wal_record(self, rec) -> None:
         kind = rec[0]
         if kind == "kv":
@@ -224,12 +247,7 @@ class GcsServer:
             info = pb.ActorInfo()
             info.ParseFromString(rec[1])
             self._actors[bytes(info.actor_id)] = info
-            if info.name:
-                key = (info.namespace or "default", info.name)
-                if info.state != "DEAD":
-                    self._actor_names[key] = bytes(info.actor_id)
-                elif self._actor_names.get(key) == bytes(info.actor_id):
-                    del self._actor_names[key]
+            self._claim_actor_name(info)
         elif kind == "pg":
             info = pb.PlacementGroupInfo()
             info.ParseFromString(rec[2])
@@ -548,10 +566,7 @@ class GcsServer:
                     info.state = "DEAD"
                     info.death_cause = info.death_cause or "worker died"
             self._actors[info.actor_id] = info
-            if info.name and info.state == "DEAD":
-                key = (info.namespace or "default", info.name)
-                if self._actor_names.get(key) == info.actor_id:
-                    del self._actor_names[key]
+            self._claim_actor_name(info)
             self._wal_append(("actor", info.SerializeToString()))
         self._export_event("ACTOR_STATE", actor_id=info.actor_id.hex(),
                            state=info.state, node_id=info.node_id,

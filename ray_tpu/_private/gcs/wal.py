@@ -124,7 +124,24 @@ class WriteAheadLog:
             # Brief coalesce: one write+fsync for a burst of records.
             time.sleep(self.FLUSH_PERIOD_S)
             try:
-                self._drain_to_file()
+                # Drain the WHOLE queue this wake (one write+fsync per
+                # 4096-record chunk, no sleep between chunks): capping a
+                # wake at one chunk throttled the log to ~80k records/s
+                # and left actor-churn bursts unflushed when the process
+                # was killed (scale-stress hotspot #1).
+                while True:
+                    with self._cv:
+                        empty = not self._q
+                    if empty:
+                        break
+                    self._drain_to_file()
+                    if self._size > self._threshold:
+                        # Compact mid-drain too: sustained append load
+                        # keeps the queue non-empty, and waiting for an
+                        # idle moment would grow the log without bound
+                        # (records are idempotent upserts, so a mutation
+                        # racing the snapshot replays harmlessly).
+                        self._compact()
                 if self._size > self._threshold:
                     self._compact()
             except Exception:  # noqa: BLE001

@@ -95,8 +95,23 @@ def _table_from_rows(rows: List[Any]) -> pa.Table:
     return pa.table(cols)
 
 
+def _tensor_fields(table: pa.Table):
+    return [(i, table.schema.field(i)) for i in
+            builtins.range(table.num_columns)
+            if pa.types.is_fixed_size_list(table.schema.field(i).type)
+            and table.schema.field(i).metadata
+            and b"tensor_shape" in table.schema.field(i).metadata]
+
+
 def _rows_of(table: pa.Table) -> List[Dict[str, Any]]:
-    return table.to_pylist()
+    rows = table.to_pylist()
+    # Tensor columns come back as per-row ndarrays with their true shape
+    # (to_pylist alone would hand out the flattened fixed-size list).
+    for i, field in _tensor_fields(table):
+        arrs = _tensor_column_to_numpy(table.column(i), field)
+        for row, a in zip(rows, arrs):
+            row[field.name] = a
+    return rows
 
 
 def _tensor_column_to_numpy(col: pa.ChunkedArray, field: pa.Field):
@@ -119,7 +134,13 @@ def _batch_of(table: pa.Table, fmt: str):
     if fmt == "pyarrow":
         return table
     if fmt == "pandas":
-        return table.to_pandas()
+        df = table.to_pandas()
+        for i, field in _tensor_fields(table):
+            # Per-cell ndarrays with the true tensor shape, not the
+            # flattened fixed-size list.
+            df[field.name] = list(_tensor_column_to_numpy(table.column(i),
+                                                          field))
+        return df
     out = {}
     for i, name in enumerate(table.column_names):
         field = table.schema.field(i)
@@ -295,11 +316,25 @@ def _zip_block(left: pa.Table, *right_parts) -> pa.Table:
     return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
 
 
+def _key_partition(v, n: int) -> int:
+    """Partition index for a join key. Must respect EQUALITY (0.0 == -0.0
+    == 0 must land together — repr-hashing broke that) and be stable
+    ACROSS PROCESSES (str hash() is seed-randomized, so strings go
+    through crc32; numeric hash() is deterministic)."""
+    import zlib
+
+    if isinstance(v, bytes):
+        return zlib.crc32(v) % n
+    if isinstance(v, str):
+        return zlib.crc32(v.encode()) % n
+    if isinstance(v, (int, float, np.integer, np.floating)):
+        return hash(v) % n  # Python numeric hash: equal values, equal hash
+    return zlib.crc32(repr(v).encode()) % n
+
+
 @ray_tpu.remote
 def _hash_partition_block(table: pa.Table, key: str, n: int):
     """Split one block into n key-hashed parts (join map stage)."""
-    import zlib
-
     if key not in table.column_names:
         if table.num_columns:
             raise KeyError(
@@ -309,7 +344,7 @@ def _hash_partition_block(table: pa.Table, key: str, n: int):
         col = table.column(key).to_pylist()
     idx = [[] for _ in builtins.range(n)]
     for i, v in enumerate(col):
-        idx[zlib.crc32(repr(v).encode()) % n].append(i)
+        idx[_key_partition(v, n)].append(i)
     parts = [table.take(pa.array(ix, type=pa.int64()))
              for ix in idx]
     return tuple(parts) if n > 1 else parts[0]
@@ -592,10 +627,9 @@ class Dataset:
         block boundaries; no blocks concentrate on the driver."""
         a_refs = self._execute()
         b_refs = other._execute()
-        a_counts = ray_tpu.get([_block_len.remote(r) for r in a_refs],
-                               timeout=600)
-        b_counts = ray_tpu.get([_block_len.remote(r) for r in b_refs],
-                               timeout=600)
+        counts = ray_tpu.get(
+            [_block_len.remote(r) for r in a_refs + b_refs], timeout=600)
+        a_counts, b_counts = counts[:len(a_refs)], counts[len(a_refs):]
         if sum(a_counts) != sum(b_counts):
             raise ValueError(
                 f"zip requires equal row counts; "

@@ -148,3 +148,25 @@ def test_zip_preserves_tensor_shape_and_join_key_errors():
     with pytest.raises(Exception, match="uuid"):
         rdata.from_items([{"uid": 1}]).join(
             rdata.from_items([{"uid": 1}]), on="uuid").take_all()
+
+
+def test_join_matches_arrow_semantics_for_signed_zero():
+    """Partitioning must be no coarser than Arrow's join equality: the
+    distributed join must give the SAME answer as a single-table Arrow
+    join (0.0/-0.0 land in one partition, then Arrow decides)."""
+    lt = pa.table({"k": [0.0, 1.0], "side": ["a", "a2"]})
+    rt = pa.table({"k": [-0.0, 1.0], "amt": [1, 2]})
+    expected = len(lt.join(rt, keys=["k"], join_type="inner"))
+    a = rdata.from_arrow(lt)
+    b = rdata.from_arrow(rt)
+    rows = a.join(b, on="k", num_partitions=4).take_all()
+    assert len(rows) == expected
+
+
+def test_tensor_rows_and_pandas_keep_shape():
+    arr = np.arange(2 * 4 * 3, dtype=np.float32).reshape(2, 4, 3)
+    ds = rdata.from_numpy(arr, parallelism=1)
+    row = ds.take_all()[0]
+    assert getattr(row["data"], "shape", None) == (4, 3)
+    df = next(iter(ds.iter_batches(batch_size=2, batch_format="pandas")))
+    assert df["data"].iloc[0].shape == (4, 3)
