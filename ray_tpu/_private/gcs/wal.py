@@ -142,8 +142,6 @@ class WriteAheadLog:
                         # (records are idempotent upserts, so a mutation
                         # racing the snapshot replays harmlessly).
                         self._compact()
-                if self._size > self._threshold:
-                    self._compact()
             except Exception:  # noqa: BLE001
                 logger.exception("WAL write failed (will retry)")
                 time.sleep(0.5)  # backoff before retrying the requeue

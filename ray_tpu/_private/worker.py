@@ -82,10 +82,18 @@ def init(
             address = os.environ["RAY_TPU_ADDRESS"]
         if address is not None and address.startswith("ray://"):
             # Remote-driver scheme (reference: Ray Client,
-            # util/client/server). No proxy tier is needed here: the driver
-            # protocol is already plain gRPC against the GCS/node control
-            # plane, so a remote driver connects exactly like a local one.
-            address = address[len("ray://"):]
+            # util/client/server/server.py:96): connect to the head's
+            # driver PROXY over one framed-TCP endpoint — the driver
+            # needs no reachability to the GCS, node managers, or
+            # workers. Start the proxy with
+            # ``python -m ray_tpu._private.client_proxy --address <gcs>``.
+            from ray_tpu._private.client_proxy import ProxyRuntime
+
+            core = ProxyRuntime(address[len("ray://"):],
+                                namespace=namespace or "default")
+            _global_worker = Worker(core, "client", namespace or "default")
+            atexit.register(shutdown)
+            return RuntimeContextInfo(_global_worker)
         if address == "auto":
             from ray_tpu.scripts.cli import _auto_address
 

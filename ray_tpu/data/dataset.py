@@ -328,6 +328,9 @@ def _key_partition(v, n: int) -> int:
     if isinstance(v, str):
         return zlib.crc32(v.encode()) % n
     if isinstance(v, (int, float, np.integer, np.floating)):
+        if v != v:
+            return 0  # NaN: hash() is id-based on 3.10+, but Arrow's
+            # join matches NaN==NaN — give every NaN one bucket.
         return hash(v) % n  # Python numeric hash: equal values, equal hash
     return zlib.crc32(repr(v).encode()) % n
 

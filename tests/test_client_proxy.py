@@ -1,0 +1,151 @@
+"""ray:// remote-driver proxy (reference: Ray Client,
+``python/ray/util/client/server/server.py:96``): a driver in ANOTHER
+process, given only the proxy endpoint, runs the public API — tasks,
+actors, puts/gets, named actors, cancellation — with zero reachability
+assumptions about the GCS/nodes/workers."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture(scope="module")
+def proxy_cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 4})
+    c.wait_for_nodes()
+    ray_tpu.init(address=c.address)  # the proxy shares this runtime
+    from ray_tpu._private.client_proxy import ClientProxyServer
+
+    proxy = ClientProxyServer(c.address)
+    yield c, proxy
+    proxy._server.close()
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+CLIENT_SCRIPT = textwrap.dedent("""
+    import time
+    import ray_tpu
+    from ray_tpu import exceptions
+
+    ray_tpu.init(address="ray://{proxy}")
+
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    assert ray_tpu.get(add.remote(2, 3), timeout=60) == 5
+    refs = [add.remote(i, i) for i in range(20)]
+    ready, _ = ray_tpu.wait(refs, num_returns=20, timeout=60)
+    assert len(ready) == 20
+    assert sum(ray_tpu.get(refs, timeout=60)) == sum(2 * i for i in range(20))
+
+    # dependencies through the proxy
+    r = add.remote(add.remote(1, 1), 1)
+    assert ray_tpu.get(r, timeout=60) == 3
+
+    # put/get
+    big = ray_tpu.put(list(range(1000)))
+    assert ray_tpu.get(big, timeout=60)[-1] == 999
+
+    # actors + named lookup
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+        def incr(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.options(name="proxy_counter", lifetime="detached").remote()
+    assert ray_tpu.get(c.incr.remote(), timeout=60) == 1
+    c2 = ray_tpu.get_actor("proxy_counter")
+    assert ray_tpu.get(c2.incr.remote(), timeout=60) == 2
+
+    # errors propagate typed
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("client boom")
+    try:
+        ray_tpu.get(boom.remote(), timeout=60)
+        raise AssertionError("no error raised")
+    except ValueError:
+        pass
+
+    # cancellation
+    @ray_tpu.remote
+    def spin():
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30:
+            time.sleep(0.01)
+    ref = spin.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(ref)
+    try:
+        ray_tpu.get(ref, timeout=30)
+        raise AssertionError("cancel did not take")
+    except exceptions.TaskCancelledError:
+        pass
+
+    # cluster introspection
+    assert ray_tpu.cluster_resources().get("CPU") == 4.0
+    ray_tpu.shutdown()
+    print("CLIENT_OK")
+""")
+
+
+def test_remote_driver_full_api(proxy_cluster):
+    _, proxy = proxy_cluster
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [os.path.dirname(os.path.dirname(__file__))]
+                   + sys.path))
+    out = subprocess.run(
+        [sys.executable, "-c",
+         CLIENT_SCRIPT.format(proxy=proxy.address)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert "CLIENT_OK" in out.stdout, \
+        f"client failed:\nstdout={out.stdout}\nstderr={out.stderr[-3000:]}"
+
+
+def test_session_refs_released_on_close(proxy_cluster):
+    from ray_tpu._private.client_proxy import ProxyRuntime
+
+    _, proxy = proxy_cluster
+    rt = ProxyRuntime(proxy.address)
+    ref = rt.put([1, 2, 3])
+    sid = rt._sid
+    assert sid in proxy._sessions
+    assert proxy._sessions[sid]["refs"]
+    rt.shutdown()
+    assert sid not in proxy._sessions
+
+
+def test_namespace_isolation_through_proxy(proxy_cluster):
+    from ray_tpu._private.client_proxy import ProxyRuntime
+    from ray_tpu._private.options import RemoteOptions
+
+    _, proxy = proxy_cluster
+
+    class Holder:
+        def ping(self):
+            return "pong"
+
+    a = ProxyRuntime(proxy.address, namespace="team-a")
+    b = ProxyRuntime(proxy.address, namespace="team-b")
+    opts = RemoteOptions(_is_actor=True, name="nsvc", lifetime="detached")
+    a.create_actor(Holder, (), {}, opts)
+    aid, cls, _ = a.get_named_actor("nsvc", None)
+    assert cls.__name__ == "Holder"
+    with pytest.raises(ValueError):
+        b.get_named_actor("nsvc", None)
+    a.shutdown()
+    b.shutdown()
