@@ -70,6 +70,7 @@ class ClientProxyServer:
                                            port=port)
         self.address = self._server.address
         self.port = self._server.port
+        self._owns_runtime = w is None
         self._stop = threading.Event()
         threading.Thread(target=self._reaper_loop, daemon=True,
                          name="client-proxy-reaper").start()
@@ -206,10 +207,13 @@ class ClientProxyServer:
     def close(self) -> None:
         self._stop.set()
         self._server.close()
-        try:
-            self._runtime.shutdown()
-        except Exception:  # noqa: BLE001
-            pass
+        if self._owns_runtime:
+            # Only shut down a runtime this proxy created — when embedded
+            # in a driver process, the host's runtime outlives the proxy.
+            try:
+                self._runtime.shutdown()
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class ProxyRuntime(CoreRuntime):
@@ -235,15 +239,23 @@ class ProxyRuntime(CoreRuntime):
         # The proxy's shared runtime has ONE namespace; this client's
         # namespace rides explicitly on named-actor ops instead.
         self.namespace = namespace
-        self._call("ping")
+        # Bounded handshake: a wrong-but-listening endpoint must fail
+        # init() in seconds, not hang on the data-op timeout.
+        try:
+            self._call("ping", _timeout=10.0)
+        except Exception as e:
+            raise ConnectionError(
+                f"ray:// endpoint {proxy_address} did not answer the "
+                f"proxy handshake — is the client proxy running there? "
+                f"(python -m ray_tpu._private.client_proxy)") from e
         threading.Thread(target=self._ping_loop, daemon=True,
                          name="client-proxy-ping").start()
 
     # ------------------------------------------------------------ plumbing
-    def _call(self, op: str, *args):
+    def _call(self, op: str, *args, _timeout: float = 24 * 3600.0):
         data = self._fc.call(
             KIND_CLIENT, cloudpickle.dumps((op, self._sid, args)),
-            timeout=24 * 3600.0)
+            timeout=_timeout)
         status, out = cloudpickle.loads(data)
         if status == "err":
             raise out
@@ -269,16 +281,10 @@ class ProxyRuntime(CoreRuntime):
 
     def get(self, refs: Sequence[ObjectRef], timeout: Optional[float]
             ) -> List[Any]:
+        # Errors are OUT of band: the server-side get raises and _call
+        # re-raises the relayed exception, typed.
         blob = self._call("get", [r.id().binary() for r in refs], timeout)
-        values = cloudpickle.loads(blob)
-        from ray_tpu import exceptions
-
-        for v in values:
-            if isinstance(v, exceptions.RayTaskError):
-                raise v.as_instanceof_cause()
-            if isinstance(v, exceptions.RayTpuError):
-                raise v
-        return values
+        return cloudpickle.loads(blob)
 
     def wait(self, refs, num_returns, timeout, fetch_local):
         by_id = {r.id().binary(): r for r in refs}
@@ -395,6 +401,7 @@ def main(argv=None):  # pragma: no cover — subprocess entry
     logging.basicConfig(level=logging.INFO)
     server = ClientProxyServer(args.address, host=args.host,
                                port=args.port)
+    print(f"CLIENT_PROXY_PORT={server.port}", flush=True)
     print(f"CLIENT_PROXY_ADDRESS={server.address}", flush=True)
     threading.Event().wait()
 

@@ -70,6 +70,19 @@ def cmd_start(args):
     nm_port = _read_port(nm, "NODE_PORT")
     print(f"Node manager started at 127.0.0.1:{nm_port}")
 
+    if args.head and args.ray_client_server_port >= 0:
+        # ray:// driver proxy (reference: Ray Client server on 10001).
+        proxy = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.client_proxy",
+             "--address", address, "--host", "0.0.0.0",
+             "--port", str(args.ray_client_server_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
+        _save_pid(proxy.pid)
+        proxy_port = _read_port(proxy, "CLIENT_PROXY_PORT")
+        print(f"ray:// driver proxy on port {proxy_port} "
+              f"(connect: ray_tpu.init(address='ray://<host>:"
+              f"{proxy_port}'))")
+
     if args.head and args.dashboard:
         from ray_tpu.dashboard import Dashboard
 
@@ -465,6 +478,9 @@ def main(argv=None):
     p.add_argument("--labels", help='JSON, e.g. \'{"tpu-slice": "s0"}\'')
     p.add_argument("--dashboard", action="store_true", default=True)
     p.add_argument("--dashboard-port", type=int, default=8265)
+    p.add_argument("--ray-client-server-port", type=int, default=10001,
+                   help="ray:// driver proxy port (reference default "
+                        "10001); -1 disables the proxy")
     p.add_argument("--block", action="store_true")
     p.set_defaults(fn=cmd_start)
 

@@ -350,18 +350,42 @@ def test_task_events_state_api_and_timeline(cluster):
 
 
 def test_init_ray_scheme(cluster):
+    """ray:// now goes through the driver proxy (reference: Ray Client);
+    see tests/test_client_proxy.py for the full API surface."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
     import ray_tpu
+    from ray_tpu._private.client_proxy import ClientProxyServer
 
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
-    ray_tpu.init(address=f"ray://{cluster.address}")
+    ray_tpu.init(address=cluster.address)  # proxy shares this runtime
+    proxy = ClientProxyServer(cluster.address)
+    try:
+        script = textwrap.dedent(f"""
+            import ray_tpu
+            ray_tpu.init(address="ray://{proxy.address}")
 
-    @ray_tpu.remote
-    def f():
-        return "via-ray-scheme"
+            @ray_tpu.remote
+            def f():
+                return "via-ray-scheme"
 
-    assert ray_tpu.get(f.remote(), timeout=60) == "via-ray-scheme"
-    ray_tpu.shutdown()
+            assert ray_tpu.get(f.remote(), timeout=60) == "via-ray-scheme"
+            ray_tpu.shutdown()
+            print("RAY_SCHEME_OK")
+        """)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=os.pathsep.join(sys.path))
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=120,
+                             env=env)
+        assert "RAY_SCHEME_OK" in out.stdout, out.stderr[-2000:]
+    finally:
+        proxy._server.close()
+        ray_tpu.shutdown()
 
 
 def test_dashboard_logs_and_tasks_endpoints(cluster):
