@@ -21,6 +21,7 @@
 #include <functional>
 #include <map>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <vector>
@@ -123,12 +124,28 @@ class Client {
 using CppTaskFn = std::function<rpc::XLangValue(
     const std::vector<rpc::XLangValue>&)>;
 
+// A C++ ACTOR instance is its named methods over captured state (the
+// factory's closure variables ARE the actor state). Reference:
+// cpp/src/ray/runtime/task/task_executor.cc actor dispatch — here state
+// lives behind std::function captures instead of member pointers.
+using CppActorMethods = std::map<std::string, CppTaskFn>;
+using CppActorFactory = std::function<CppActorMethods(
+    const std::vector<rpc::XLangValue>&)>;
+
 class TaskExecutor {
  public:
   TaskExecutor() : listen_fd_(-1), port_(0), stopping_(false) {}
   ~TaskExecutor();
 
   void Register(const std::string& name, CppTaskFn fn);
+
+  // Register an actor CLASS: the factory runs per CreateActor with the
+  // constructor args and returns the instance's method table. Announced
+  // in KV "__cpp_actor_classes__"; Python reaches it via
+  // cross_language.cpp_actor_class(name), C++ clients via the gateway's
+  // CreateActor. Method calls on one instance are serialized (ordered
+  // actor semantics); distinct instances run concurrently.
+  void RegisterActorClass(const std::string& name, CppActorFactory factory);
 
   // Bind (ephemeral port when 0), announce every registered function via
   // `gateway`, and serve on a background thread. Returns the bound port
@@ -150,8 +167,18 @@ class TaskExecutor {
 
   void AcceptLoop();
   void ServeConn(int fd, std::shared_ptr<std::atomic<bool>> done);
+  rpc::XLangResult HandleActorOp(uint8_t op, const rpc::XLangCall& call);
+
+  struct ActorInst {
+    CppActorMethods methods;
+    std::mutex mu;  // ordered actor semantics per instance
+  };
 
   std::map<std::string, CppTaskFn> fns_;
+  std::map<std::string, CppActorFactory> actor_classes_;
+  std::map<std::string, std::shared_ptr<ActorInst>> instances_;
+  std::mutex inst_mu_;
+  uint64_t next_iid_ = 1;  // guarded by inst_mu_
   int listen_fd_;
   int port_;
   std::atomic<bool> stopping_;

@@ -315,6 +315,11 @@ bool Client::KvGet(const std::string& ns, const std::string& key,
 
 TaskExecutor::~TaskExecutor() { Stop(); }
 
+void TaskExecutor::RegisterActorClass(const std::string& name,
+                                      CppActorFactory factory) {
+  actor_classes_[name] = std::move(factory);
+}
+
 void TaskExecutor::Register(const std::string& name, CppTaskFn fn) {
   fns_[name] = std::move(fn);
 }
@@ -347,6 +352,15 @@ int TaskExecutor::Serve(Client& gateway, const std::string& advertise_host,
   const std::string address = host + ":" + std::to_string(port_);
   for (const auto& kv : fns_) {
     if (!gateway.KvPut("__cpp_executors__", kv.first, address)) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return 0;
+    }
+  }
+  // Actor classes announce in their own namespace so Python's
+  // cpp_actor_class() / the gateway's CreateActor can route to us.
+  for (const auto& kv : actor_classes_) {
+    if (!gateway.KvPut("__cpp_actor_classes__", kv.first, address)) {
       ::close(listen_fd_);
       listen_fd_ = -1;
       return 0;
@@ -400,6 +414,81 @@ void TaskExecutor::AcceptLoop() {
   }
 }
 
+rpc::XLangResult TaskExecutor::HandleActorOp(uint8_t op,
+                                             const rpc::XLangCall& call) {
+  // op 2: function = class name, args = ctor args -> value.s = instance
+  // id. op 3: function = "<iid>:<method>" -> method result. op 4:
+  // function = iid.
+  rpc::XLangResult result;
+  std::vector<rpc::XLangValue> args(call.args().begin(), call.args().end());
+  try {
+    if (op == 2) {
+      auto it = actor_classes_.find(call.function());
+      if (it == actor_classes_.end()) {
+        result.set_ok(false);
+        result.set_error("unknown C++ actor class: " + call.function());
+        return result;
+      }
+      auto inst = std::make_shared<ActorInst>();
+      inst->methods = it->second(args);
+      std::string iid;
+      {
+        std::lock_guard<std::mutex> lk(inst_mu_);
+        iid = call.function() + "-" + std::to_string(next_iid_++);
+        instances_[iid] = inst;
+      }
+      result.set_ok(true);
+      result.mutable_value()->set_s(iid);
+      return result;
+    }
+    if (op == 4) {
+      std::lock_guard<std::mutex> lk(inst_mu_);
+      instances_.erase(call.function());
+      result.set_ok(true);
+      return result;
+    }
+    // op == 3: instance method call, serialized per instance.
+    const std::string& target = call.function();
+    const size_t sep = target.rfind(':');
+    if (sep == std::string::npos) {
+      result.set_ok(false);
+      result.set_error("malformed actor call target: " + target);
+      return result;
+    }
+    const std::string iid = target.substr(0, sep);
+    const std::string method = target.substr(sep + 1);
+    std::shared_ptr<ActorInst> inst;
+    {
+      std::lock_guard<std::mutex> lk(inst_mu_);
+      auto it = instances_.find(iid);
+      if (it != instances_.end()) inst = it->second;
+    }
+    if (!inst) {
+      result.set_ok(false);
+      result.set_error("dead or unknown C++ actor instance: " + iid);
+      return result;
+    }
+    auto mit = inst->methods.find(method);
+    if (mit == inst->methods.end()) {
+      result.set_ok(false);
+      result.set_error("C++ actor has no method: " + method);
+      return result;
+    }
+    std::lock_guard<std::mutex> call_lk(inst->mu);
+    *result.mutable_value() = mit->second(args);
+    result.set_ok(true);
+    return result;
+  } catch (const std::exception& e) {
+    result.set_ok(false);
+    result.set_error(std::string("C++ actor raised: ") + e.what());
+    return result;
+  } catch (...) {
+    result.set_ok(false);
+    result.set_error("C++ actor raised a non-standard exception");
+    return result;
+  }
+}
+
 void TaskExecutor::ServeConn(int fd,
                              std::shared_ptr<std::atomic<bool>> done) {
   // Per-request: [u32 len][u8 op][XLangCall] -> [u32 len][u8 ok][XLangResult]
@@ -411,9 +500,13 @@ void TaskExecutor::ServeConn(int fd,
     if (length > 0 && !RecvAllFd(fd, &body[0], length)) break;
     rpc::XLangResult result;
     rpc::XLangCall call;
-    if (header[4] != 1 || !call.ParseFromString(body)) {
+    const uint8_t op = static_cast<uint8_t>(header[4]);
+    if ((op < 1 || op > 4) || !call.ParseFromString(body)) {
       result.set_ok(false);
       result.set_error("malformed executor request");
+    } else if (op != 1) {
+      // 2=CreateActor, 3=ActorCall, 4=KillActor.
+      result = HandleActorOp(op, call);
     } else {
       auto it = fns_.find(call.function());
       if (it == fns_.end()) {

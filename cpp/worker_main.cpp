@@ -38,6 +38,26 @@ int main(int argc, char** argv) {
                     -> ray_tpu::rpc::XLangValue {
                   throw std::runtime_error("intentional c++ failure");
                 });
+  // C++-defined ACTOR class: state lives in the factory's captures.
+  exec.RegisterActorClass(
+      "CppCounter",
+      [](const std::vector<ray_tpu::rpc::XLangValue>& ctor) {
+        auto n = std::make_shared<int64_t>(
+            ctor.empty() ? 0 : ctor.at(0).i());
+        ray_tpu::CppActorMethods m;
+        m["add"] = [n](const std::vector<ray_tpu::rpc::XLangValue>& a) {
+          *n += a.at(0).i();
+          return ray_tpu::V(*n);
+        };
+        m["get"] = [n](const std::vector<ray_tpu::rpc::XLangValue>&) {
+          return ray_tpu::V(*n);
+        };
+        m["boom"] = [](const std::vector<ray_tpu::rpc::XLangValue>&)
+            -> ray_tpu::rpc::XLangValue {
+          throw std::runtime_error("actor method failure");
+        };
+        return m;
+      });
   int port = exec.Serve(gateway);
   if (port == 0) {
     std::fprintf(stderr, "executor serve failed\n");

@@ -66,10 +66,13 @@ def register_function(name: str, fn=None):
 _CPP_EXEC_NS = "__cpp_executors__"
 
 
-def _call_cpp_executor(address: str, function: str, args) -> Any:
-    """Dial a C++ TaskExecutor (cpp/include/ray_tpu/api.h) and run one
-    registered function: [u32 len][u8 op=1][XLangCall] ->
-    [u32 len][u8 ok][XLangResult]."""
+def _call_cpp_executor(address: str, function: str, args,
+                       op: int = 1) -> Any:
+    """Dial a C++ TaskExecutor (cpp/include/ray_tpu/api.h) for one op:
+    [u32 len][u8 op][XLangCall] -> [u32 len][u8 ok][XLangResult].
+    op 1 = run a registered function; 2 = CreateActor (function = class
+    name, returns the instance id); 3 = ActorCall (function =
+    "<iid>:<method>"); 4 = KillActor (function = iid)."""
     from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
     call = pb.XLangCall(function=function)
@@ -78,7 +81,7 @@ def _call_cpp_executor(address: str, function: str, args) -> Any:
     body = call.SerializeToString()
     host, port = address.rsplit(":", 1)
     with socket.create_connection((host, int(port)), timeout=30) as conn:
-        conn.sendall(struct.pack("<IB", len(body), 1) + body)
+        conn.sendall(struct.pack("<IB", len(body), op) + body)
         header = ClientGateway._recv_exact(conn, 5)
         if header is None:
             raise ConnectionError(f"C++ executor at {address} hung up")
@@ -88,7 +91,7 @@ def _call_cpp_executor(address: str, function: str, args) -> Any:
             raise ConnectionError(f"C++ executor at {address} hung up")
     result = pb.XLangResult.FromString(reply)
     if not result.ok:
-        raise RuntimeError(result.error or f"C++ task {function!r} failed")
+        raise RuntimeError(result.error or f"C++ op {function!r} failed")
     return from_xlang_value(result.value)
 
 
@@ -115,6 +118,120 @@ def cpp_function(name: str):
     import ray_tpu
 
     return ray_tpu.remote(functools.partial(_invoke_cpp, name))
+
+
+_CPP_ACTOR_NS = "__cpp_actor_classes__"
+
+
+class _CppActorProxy:
+    """Python proxy actor hosting ONE C++ actor instance (reference:
+    C++ actors, ``cpp/src/ray/runtime/``): the instance lives in the C++
+    process that registered the class via
+    ``TaskExecutor::RegisterActorClass``; this proxy rides the normal
+    actor machinery (placement, ordering, restarts, handle passing) and
+    forwards each method call over the executor's framed socket."""
+
+    def __init__(self, class_name: str, *ctor_args):
+        from ray_tpu.experimental.internal_kv import internal_kv_get
+
+        addr = internal_kv_get(class_name, namespace=_CPP_ACTOR_NS)
+        if addr is None:
+            raise KeyError(
+                f"no C++ actor class registered as {class_name!r}")
+        self._addr = addr.decode()
+        self._iid = _call_cpp_executor(self._addr, class_name, ctor_args,
+                                       op=2)
+
+    def call(self, method: str, *args):
+        return _call_cpp_executor(self._addr, f"{self._iid}:{method}",
+                                  args, op=3)
+
+    def release(self):
+        """Free the C++-side instance (also called on proxy death)."""
+        try:
+            _call_cpp_executor(self._addr, self._iid, (), op=4)
+        except Exception:  # noqa: BLE001 — executor already gone
+            pass
+
+    def __del__(self):
+        self.release()
+
+
+class _GatewayCppActor:
+    """Gateway-held adapter for a C++-defined actor: translates
+    ActorCall frames into the proxy's ``call`` method."""
+
+    def __init__(self, proxy_handle):
+        self.handle = proxy_handle
+        self._actor_id = proxy_handle._actor_id
+
+    def call_method(self, method: str, args):
+        return self.handle.call.remote(method, *args)
+
+
+_PROXY_REMOTE_CLS = None
+
+
+def _proxy_cls():
+    global _PROXY_REMOTE_CLS
+    if _PROXY_REMOTE_CLS is None:
+        import ray_tpu
+
+        _PROXY_REMOTE_CLS = ray_tpu.remote(_CppActorProxy)
+    return _PROXY_REMOTE_CLS
+
+
+class _CppActorMethod:
+    def __init__(self, handle, method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args):
+        return self._handle._proxy.call.remote(self._method, *args)
+
+
+class CppActorHandle:
+    """Handle to a C++-defined actor: attribute access yields remote
+    methods, exactly like a Python ActorHandle."""
+
+    def __init__(self, proxy_handle):
+        self._proxy = proxy_handle
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _CppActorMethod(self, name)
+
+    def kill(self, no_restart: bool = True):
+        import ray_tpu
+
+        try:
+            # Best-effort: a crashed proxy can't release, but the kill
+            # below must still clean it up without raising.
+            ray_tpu.get(self._proxy.release.remote(), timeout=30)
+        except Exception:  # noqa: BLE001
+            pass
+        ray_tpu.kill(self._proxy, no_restart=no_restart)
+
+
+class _CppActorClass:
+    def __init__(self, name: str):
+        self._name = name
+
+    def remote(self, *ctor_args) -> CppActorHandle:
+        # Creation is async, like any actor: an unknown class or a ctor
+        # raise surfaces on the first method call (normal actor
+        # semantics).
+        return CppActorHandle(_proxy_cls().remote(self._name, *ctor_args))
+
+
+def cpp_actor_class(name: str) -> _CppActorClass:
+    """Handle to a C++-registered actor CLASS:
+    ``cpp_actor_class("Counter").remote(args)`` creates the instance in
+    the C++ worker that registered it; the returned handle's methods
+    forward through a Python proxy actor (reference:
+    ``ray.cross_language.cpp_actor_class``)."""
+    return _CppActorClass(name)
 
 
 def _resource_opts(resources) -> Dict[str, Any]:
@@ -304,11 +421,28 @@ class ClientGateway:
         # method calls for thin clients, util/client/server/server.py:96).
         if op == OP_CREATE_ACTOR:
             call = pb.XLangCall.FromString(body)
-            actor_cls = self._resolve_actor_class(call.function)
             args = [from_xlang_value(a) for a in call.args]
             opts = _resource_opts(call.resources)
-            remote_cls = actor_cls.options(**opts) if opts else actor_cls
-            handle = remote_cls.remote(*args)
+            try:
+                actor_cls = self._resolve_actor_class(call.function)
+            except KeyError:
+                # Not a Python class: a C++ TaskExecutor may have
+                # registered it (RegisterActorClass) — create through the
+                # proxy actor so C++ clients drive C++-defined actors.
+                from ray_tpu.experimental.internal_kv import internal_kv_get
+
+                if internal_kv_get(call.function,
+                                   namespace=_CPP_ACTOR_NS) is None:
+                    raise
+                proxy_cls = _proxy_cls()
+                if opts:
+                    proxy_cls = proxy_cls.options(**opts)
+                handle = _GatewayCppActor(
+                    proxy_cls.remote(call.function, *args))
+            else:
+                remote_cls = actor_cls.options(**opts) if opts \
+                    else actor_cls
+                handle = remote_cls.remote(*args)
             aid = handle._actor_id.binary()
             evicted = []
             with self._lock:
@@ -320,6 +454,15 @@ class ClientGateway:
                 # Unlike an evicted ref (which only loses its pin), a
                 # dropped ActorHandle has no GC: kill or it leaks forever.
                 try:
+                    if isinstance(old, _GatewayCppActor):
+                        # Free the C++-side instance or it leaks in the
+                        # executor's map for its whole lifetime.
+                        try:
+                            ray_tpu.get(old.handle.release.remote(),
+                                        timeout=30)
+                        except Exception:  # noqa: BLE001
+                            pass
+                        old = old.handle
                     ray_tpu.kill(old)
                 except Exception:  # noqa: BLE001
                     pass
@@ -335,7 +478,10 @@ class ClientGateway:
                 raise KeyError(
                     "unknown actor id (gateway-held actors only)")
             args = [from_xlang_value(a) for a in call.args]
-            ref = getattr(handle, call.method).remote(*args)
+            if isinstance(handle, _GatewayCppActor):
+                ref = handle.call_method(call.method, args)
+            else:
+                ref = getattr(handle, call.method).remote(*args)
             self._hold(ref)
             return True, pb.GatewayRef(
                 object_id=ref.id().binary()).SerializeToString()
@@ -344,6 +490,14 @@ class ClientGateway:
             with self._lock:
                 handle = self._actors.pop(bytes(ref_msg.object_id), None)
             if handle is not None:
+                if isinstance(handle, _GatewayCppActor):
+                    # Free the C++-side instance before the proxy dies.
+                    try:
+                        ray_tpu.get(handle.handle.release.remote(),
+                                    timeout=30)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    handle = handle.handle
                 ray_tpu.kill(handle)
             return True, pb.XLangResult(
                 ok=handle is not None).SerializeToString()

@@ -152,3 +152,58 @@ def test_cpp_worker_tasks_from_cpp_client(cpp_worker, gateway):
                        capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, f"{r.stdout}\n{r.stderr}"
     assert "CHECK cpp_worker mul=54 ok" in r.stdout
+
+
+def test_cpp_actor_from_python(cpp_worker):
+    """C++-DEFINED actors (TaskExecutor::RegisterActorClass): Python
+    creates instances, state persists across method calls in the C++
+    process, instances are independent, and errors propagate typed."""
+    Counter = cross_language.cpp_actor_class("CppCounter")
+    a = Counter.remote(100)
+    b = Counter.remote()
+    assert ray_tpu.get(a.add.remote(5), timeout=60) == 105
+    assert ray_tpu.get(a.add.remote(5), timeout=60) == 110
+    assert ray_tpu.get(b.add.remote(1), timeout=60) == 1  # independent
+    assert ray_tpu.get(a.get.remote(), timeout=60) == 110
+    with pytest.raises(RuntimeError, match="actor method failure"):
+        ray_tpu.get(a.boom.remote(), timeout=60)
+    # Still alive after a method error.
+    assert ray_tpu.get(a.get.remote(), timeout=60) == 110
+    a.kill()
+    b.kill()
+
+
+def test_cpp_actor_from_cpp_client(cpp_worker, gateway):
+    """A C++ client drives a C++-defined actor THROUGH the gateway:
+    CreateActor routes to the registering executor via a proxy actor."""
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+    from ray_tpu.cross_language import (OP_ACTOR_CALL, OP_CREATE_ACTOR,
+                                        OP_KILL_ACTOR, ClientGateway,
+                                        from_xlang_value, to_xlang_value)
+    import socket
+    import struct
+
+    def call(conn, op, msg):
+        body = msg.SerializeToString()
+        conn.sendall(struct.pack("<IB", len(body), op) + body)
+        header = ClientGateway._recv_exact(conn, 5)
+        (length,) = struct.unpack("<I", header[:4])
+        reply = ClientGateway._recv_exact(conn, length)
+        assert header[4] == 1, reply
+        return reply
+
+    with socket.create_connection(("127.0.0.1", gateway.port),
+                                  timeout=30) as conn:
+        create = pb.XLangCall(function="CppCounter")
+        create.args.append(to_xlang_value(7))
+        aid = pb.GatewayRef.FromString(
+            call(conn, OP_CREATE_ACTOR, create)).object_id
+        mc = pb.XLangActorCall(actor_id=aid, method="add")
+        mc.args.append(to_xlang_value(3))
+        ref = pb.GatewayRef.FromString(call(conn, OP_ACTOR_CALL, mc))
+        get = pb.GatewayRef(object_id=ref.object_id)
+        from ray_tpu.cross_language import OP_GET
+
+        out = pb.XLangResult.FromString(call(conn, OP_GET, get))
+        assert out.ok and from_xlang_value(out.value) == 10
+        call(conn, OP_KILL_ACTOR, pb.GatewayRef(object_id=aid))
