@@ -48,6 +48,14 @@ def read_proc_stats(spill_dir: str = "") -> Dict[str, Any]:
         stats["num_cpus"] = os.cpu_count()
     except OSError:
         pass
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    stats["rss_bytes"] = int(line.split()[1]) * 1024
+                    break
+    except (OSError, ValueError, IndexError):
+        pass
     if spill_dir:
         try:
             st = os.statvfs(spill_dir if os.path.isdir(spill_dir)
@@ -126,6 +134,47 @@ class NodeAgent:
                                         daemon=True, name="node-agent")
         self._thread.start()
         self._register()
+        # Time-series push plane (the dashboard-agent role grown into a
+        # TSDB feed): node vitals become tagged gauges in this process's
+        # registry, and the generic pusher ships the registry to the head
+        # every interval (metrics_pusher.py).
+        self._stop_vitals = threading.Event()
+        threading.Thread(target=self._vitals_loop, daemon=True,
+                         name="node-agent-vitals").start()
+        from ray_tpu._private import metrics_pusher
+
+        metrics_pusher.ensure_pusher(gcs_address,
+                                     labels={"role": "agent"})
+
+    def _vitals_loop(self) -> None:
+        from ray_tpu._private import metrics_defs as mdefs
+        from ray_tpu._private import metrics_pusher
+
+        tags = {"node_id": self.node_id[:12]}
+        interval = metrics_pusher.push_interval_s()
+        while not self._stop_vitals.wait(interval):
+            try:
+                stats = read_proc_stats(self.spill_dir)
+                # `is not None`, not truthiness: a 0 reading (OOM, disk
+                # full) is exactly the sample these gauges must not skip.
+                if stats.get("mem_available_bytes") is not None:
+                    mdefs.NODE_MEM_AVAILABLE.set(
+                        stats["mem_available_bytes"], tags=tags)
+                if stats.get("loadavg_1m") is not None:
+                    mdefs.NODE_LOADAVG.set(stats["loadavg_1m"], tags=tags)
+                if stats.get("rss_bytes") is not None:
+                    mdefs.AGENT_RSS.set(stats["rss_bytes"], tags=tags)
+                if stats.get("disk_free_bytes") is not None:
+                    mdefs.AGENT_DISK_FREE.set(stats["disk_free_bytes"],
+                                              tags=tags)
+                with self._lock:
+                    states = list(self._prewarm.values())
+                for state in ("building", "ready", "failed"):
+                    mdefs.AGENT_PREWARMS.set(
+                        sum(1 for s in states if s.startswith(state)),
+                        tags={**tags, "state": state})
+            except Exception:  # noqa: BLE001 — vitals are best-effort
+                pass
 
     def prometheus_metrics(self) -> str:
         """This node's series: the agent process's metric registry plus
@@ -145,6 +194,10 @@ class NodeAgent:
         }
         for name, value in gauges.items():
             if value is None:
+                continue
+            if f"# TYPE {name} " in registry:
+                # Already exported as a tagged registry gauge (the vitals
+                # loop); a second TYPE line fails strict text parsers.
                 continue
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {float(value)}")
@@ -205,6 +258,7 @@ class NodeAgent:
             pass
 
     def stop(self) -> None:
+        self._stop_vitals.set()
         self._server.shutdown()
         self._server.server_close()
 

@@ -18,6 +18,7 @@ daemon thread).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 import uuid
@@ -35,6 +36,17 @@ logger = logging.getLogger(__name__)
 KIND_CLIENT = 24
 SESSION_IDLE_TTL_S = 120.0
 PING_PERIOD_S = 20.0
+
+
+def _session_ttl_s() -> float:
+    """Idle TTL after which a silent client's session is reaped (its refs
+    released, pinned objects freed). Env-overridable so crash-path tests
+    don't wait two minutes for the sweep."""
+    try:
+        return float(os.environ.get("RAY_TPU_CLIENT_SESSION_TTL_S",
+                                    SESSION_IDLE_TTL_S))
+    except ValueError:
+        return SESSION_IDLE_TTL_S
 
 
 class ClientProxyServer:
@@ -91,8 +103,10 @@ class ClientProxyServer:
             s["refs"].clear()  # ObjectRef __del__ releases the pins
 
     def _reaper_loop(self) -> None:
-        while not self._stop.wait(10.0):
-            cutoff = time.monotonic() - SESSION_IDLE_TTL_S
+        ttl = _session_ttl_s()
+        period = min(10.0, max(ttl / 4.0, 0.25))
+        while not self._stop.wait(period):
+            cutoff = time.monotonic() - ttl
             with self._lock:
                 dead = [sid for sid, s in self._sessions.items()
                         if s["last"] < cutoff]
@@ -129,7 +143,10 @@ class ClientProxyServer:
 
     # ---------------------------------------------------------------- ops
     def _op_ping(self, session):
-        return True
+        # The reply carries the PROXY-side session TTL so clients pace
+        # keep-alives off the authoritative value — a TTL shortened only
+        # on the head must not let it reap live-but-idle clients.
+        return {"ttl_s": _session_ttl_s()}
 
     def _op_put(self, session, blob: bytes):
         value = cloudpickle.loads(blob)
@@ -241,8 +258,11 @@ class ProxyRuntime(CoreRuntime):
         self.namespace = namespace
         # Bounded handshake: a wrong-but-listening endpoint must fail
         # init() in seconds, not hang on the data-op timeout.
+        self._server_ttl_s = None
         try:
-            self._call("ping", _timeout=10.0)
+            hello = self._call("ping", _timeout=10.0)
+            if isinstance(hello, dict):
+                self._server_ttl_s = hello.get("ttl_s")
         except Exception as e:
             raise ConnectionError(
                 f"ray:// endpoint {proxy_address} did not answer the "
@@ -262,8 +282,14 @@ class ProxyRuntime(CoreRuntime):
         return out
 
     def _ping_loop(self):
+        # Ping faster than the server reaps, or a live-but-idle client
+        # would be swept between keep-alives. The TTL comes from the
+        # proxy's handshake reply (authoritative — the env knob may be
+        # set only on the head), falling back to this process's env.
+        ttl = self._server_ttl_s or _session_ttl_s()
+        period = min(PING_PERIOD_S, max(ttl / 3.0, 0.2))
         while not self._closed:
-            time.sleep(PING_PERIOD_S)
+            time.sleep(period)
             try:
                 self._call("ping")
             except Exception:  # noqa: BLE001 — proxy gone; ops will fail

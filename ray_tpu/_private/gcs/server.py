@@ -45,6 +45,10 @@ HEALTH_FAILURE_THRESHOLD_S = 3.0
 DRIVER_HOLDER_TTL_S = 10.0
 FREE_GRACE_S = 0.5
 MAX_FREED_REMEMBERED = 65536
+# Jobs whose submitting client stops heartbeating for this long are
+# reconciled to FAILED (the client-side supervisor died with its process;
+# see job_submission.py + _reconcile_jobs).
+JOB_HEARTBEAT_TTL_S = 10.0
 
 
 class GcsServer:
@@ -101,6 +105,24 @@ class GcsServer:
         # surface ObjectLostError instead of waiting forever.
         self._freed: Dict[bytes, float] = {}
 
+        # Head-side metrics TSDB (tsdb.py): ingests METRICS pubsub batches
+        # from every cluster process plus this process's own registry
+        # (sampled locally — no RPC loop to self), served through the
+        # reserved __metrics__ KV namespace for the dashboard/CLI.
+        from ray_tpu._private.tsdb import TimeSeriesDB
+
+        self._tsdb = TimeSeriesDB(
+            retention_s=float(os.environ.get(
+                "RAY_TPU_METRICS_RETENTION_S", 1800.0)),
+            resolution_s=float(os.environ.get(
+                "RAY_TPU_METRICS_RESOLUTION_S", 0.25)))
+        self._job_ttl_s = float(os.environ.get(
+            "RAY_TPU_JOB_HEARTBEAT_TTL_S", JOB_HEARTBEAT_TTL_S))
+        # Reconciler grace: clients can't refresh heartbeats while the
+        # GCS is down, so a freshly (re)started server must let one full
+        # TTL of beats land before treating a lapse as a dead client.
+        self._reconcile_after = time.monotonic() + self._job_ttl_s
+
         self._lock = threading.RLock()
         self._stop = threading.Event()
         # Bounded pool for actor creation/restart and PG placement work
@@ -146,6 +168,31 @@ class GcsServer:
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="gcs-health")
         self._health_thread.start()
+        # This process's registry feeds the TSDB directly (covers the GCS
+        # itself plus in-process node managers/drivers in test clusters);
+        # remote processes push over the METRICS channel instead.
+        from ray_tpu._private import metrics_pusher
+
+        metrics_pusher.note_inprocess_gcs(f"127.0.0.1:{self.port}")
+        threading.Thread(target=self._metrics_sample_loop, daemon=True,
+                         name="gcs-metrics-sampler").start()
+
+    def _metrics_sample_loop(self):
+        # Known limitation (matches Prometheus registry semantics): the
+        # process registry has no unregistration, so series from torn-down
+        # in-process components keep their last value and stay stamped
+        # fresh until max_series eviction ages them out. Their role/node
+        # labels keep them distinguishable.
+        from ray_tpu._private import metrics_pusher
+        from ray_tpu.util import metrics
+
+        interval = metrics_pusher.push_interval_s()
+        while not self._stop.wait(interval):
+            try:
+                self._tsdb.ingest(metrics.collect_samples(),
+                                  labels={"role": "head"}, ts=time.time())
+            except Exception:  # noqa: BLE001 — sampling is best-effort
+                pass
 
     # ------------------------------------------------------------ persistence
     # Mutations append idempotent delta records to a write-ahead log
@@ -426,7 +473,9 @@ class GcsServer:
 
     def _health_loop(self):
         """Reference: GcsHealthCheckManager (gcs_health_check_manager.h:45)."""
+        tick = 0
         while not self._stop.wait(HEALTH_CHECK_PERIOD_S):
+            tick += 1
             now = time.monotonic()
             dead = []
             stale_drivers = []
@@ -450,6 +499,50 @@ class GcsServer:
                 logger.warning("reaping %d stale driver holder(s)",
                                len(stale_drivers))
                 self._reap_holders(stale_drivers)
+            if tick % 4 == 0:  # job TTLs are seconds; don't scan per tick
+                self._reconcile_jobs()
+
+    def _reconcile_jobs(self):
+        """Sweep jobs stuck PENDING/RUNNING after their submitting client
+        died: the client-side supervisor (job_submission.py) heartbeats
+        into the job record while the entrypoint runs; a record whose
+        heartbeat lapses past the TTL can never be finalized by its
+        (dead) client, so finalize it here as FAILED with a reason. A
+        wrongly-failed job self-heals: the client supervisor flips the
+        record back to RUNNING on its next heartbeat (job_submission)."""
+        if time.monotonic() < self._reconcile_after:
+            return
+        now = time.time()
+        with self._lock:
+            jobs = [(key, blob) for (ns, key), blob in self._kv.items()
+                    if ns == "job"]
+        for job_id, blob in jobs:
+            try:
+                info = json.loads(blob)
+            except Exception:  # noqa: BLE001 — not a job record
+                continue
+            if info.get("status") not in ("PENDING", "RUNNING"):
+                continue
+            hb = info.get("heartbeat_time") or info.get("start_time") or 0
+            if now - float(hb) <= self._job_ttl_s:
+                continue
+            info["status"] = "FAILED"
+            info["end_time"] = now
+            info["message"] = ("submitting client died (job heartbeat "
+                               f"lapsed for more than {self._job_ttl_s}s)")
+            value = json.dumps(info).encode()
+            with self._lock:
+                # Re-check under the lock: a final status written by a
+                # live client between the scan and now must win.
+                cur = self._kv.get(("job", job_id))
+                if cur is not blob and cur != blob:
+                    continue
+                self._kv[("job", job_id)] = value
+                self._wal_append(("kv", "job", job_id, value))
+            logger.warning("job %s reconciled to FAILED (client died)",
+                           job_id)
+            self._export_event("JOB_RECONCILED", job_id=job_id,
+                               reason=info["message"])
 
     def _mark_dead(self, node_id: str, reason: str):
         with self._lock:
@@ -465,7 +558,8 @@ class GcsServer:
 
     # ------------------------------------------------------------- kv
     def KvPut(self, request, context):
-        if request.ns in ("__task_events__", "__memory__", "__events__"):
+        if request.ns in ("__task_events__", "__memory__", "__events__",
+                          "__metrics__"):
             # Reserved: reads in these namespaces serve the task-event ring
             # buffer / memory report, so stored values would be unreachable.
             return pb.KvReply(ok=False)
@@ -488,6 +582,35 @@ class GcsServer:
             with self._lock:
                 events = list(self._export_events)
             return pb.KvReply(found=True, value=pickle.dumps(events))
+        if request.ns == "__metrics__":
+            # TSDB read path. key "series" lists series metadata; any
+            # other key is a JSON query dict (see tsdb.TimeSeriesDB.query:
+            # name/since/until/labels/agg/step — "since"/"until" under
+            # 10^9 are relative seconds before now).
+            if request.key in ("", "series"):
+                return pb.KvReply(found=True,
+                                  value=pickle.dumps(self._tsdb.series()))
+            try:
+                q = json.loads(request.key)
+                now = time.time()
+                for bound in ("since", "until"):
+                    v = q.get(bound)
+                    if v is not None and float(v) < 1e9:
+                        q[bound] = now - float(v)
+                hits = self._tsdb.query(
+                    name=q.get("name") or None,
+                    since=q.get("since"), until=q.get("until"),
+                    labels=q.get("labels") or None,
+                    agg=q.get("agg") or None, step=q.get("step"))
+                limit = q.get("limit")
+                if limit:
+                    # Serve only what the caller will render: unlimited
+                    # panel queries on big clusters ship MBs per refresh.
+                    hits = hits[:int(limit)]
+            except Exception as e:  # noqa: BLE001 — malformed query
+                return pb.KvReply(found=False,
+                                  value=repr(e).encode())
+            return pb.KvReply(found=True, value=pickle.dumps(hits))
         if request.ns == "__memory__":
             # Reserved: cluster memory report for `ray-tpu memory` / state
             # API (reference: `ray memory` over the owner refcount tables).
@@ -797,6 +920,17 @@ class GcsServer:
 
     # ------------------------------------------------------------- pubsub
     def Publish(self, request, context):
+        if request.channel == "METRICS":
+            # Per-process metric push (metrics_pusher.py): ingest into the
+            # head TSDB; the batch's labels distinguish pushing processes.
+            try:
+                batch = pickle.loads(request.data)
+                self._tsdb.ingest(batch.get("samples", ()),
+                                  labels=batch.get("labels"),
+                                  ts=batch.get("ts") or time.time())
+            except Exception:  # noqa: BLE001 — a bad batch must not 500
+                pass
+            return pb.Empty()
         if request.channel == "TASK_EVENT":
             # Cluster task-event sink (reference C32: workers push task
             # state transitions to the GCS task-event GCS sink,
@@ -1234,6 +1368,9 @@ class GcsServer:
     # ------------------------------------------------------------- lifecycle
     def shutdown(self):
         self._stop.set()
+        from ray_tpu._private import metrics_pusher
+
+        metrics_pusher.forget_inprocess_gcs(f"127.0.0.1:{self.port}")
         self._work_pool.shutdown(wait=False)
         if self._wal is not None:
             try:

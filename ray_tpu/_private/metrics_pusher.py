@@ -1,0 +1,155 @@
+"""Per-process metrics pusher: ships registry snapshots to the head TSDB.
+
+Reference: each reference node runs a metrics agent that exports to
+Prometheus; here every cluster process (driver, worker, node manager,
+node agent) pushes its process-local registry to the GCS over the
+existing pubsub plane (``Publish`` on the ``METRICS`` channel) where the
+head-side :class:`~ray_tpu._private.tsdb.TimeSeriesDB` ingests it.
+
+One pusher per (process, GCS address). Processes that HOST an in-process
+GCS (the single-process test clusters, `ray-tpu start --head`) skip the
+RPC hop entirely — the GCS samples the shared process-local registry
+itself (gcs/server.py), and a pusher would double-ingest every sample.
+A pusher that fails to publish repeatedly (its cluster died) stops and
+deregisters itself.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import threading
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+METRICS_CHANNEL = "METRICS"
+# 2s default keeps head-side ingest load modest with hundreds of pushing
+# processes (far finer than Prometheus' 15s scrape norm); deployments and
+# tests tune RAY_TPU_METRICS_PUSH_INTERVAL_S.
+DEFAULT_INTERVAL_S = 2.0
+MAX_CONSECUTIVE_FAILURES = 10
+
+_lock = threading.Lock()
+_pushers: Dict[str, "MetricsPusher"] = {}
+_refs: Dict[str, int] = {}  # per-address ensure() count (shared pushers)
+_inprocess_gcs: set = set()
+
+
+def note_inprocess_gcs(address: str) -> None:
+    """Record that this process hosts the GCS at ``address`` (the GCS
+    samples the registry locally; pushers to it are redundant)."""
+    with _lock:
+        _inprocess_gcs.add(address)
+        _refs.pop(address, None)
+        pusher = _pushers.pop(address, None)
+    if pusher is not None:
+        pusher.stop()
+
+
+def forget_inprocess_gcs(address: str) -> None:
+    with _lock:
+        _inprocess_gcs.discard(address)
+
+
+def push_interval_s() -> float:
+    try:
+        return float(os.environ.get("RAY_TPU_METRICS_PUSH_INTERVAL_S",
+                                    DEFAULT_INTERVAL_S))
+    except ValueError:
+        return DEFAULT_INTERVAL_S
+
+
+def ensure_pusher(gcs_address: str,
+                  labels: Optional[Dict[str, str]] = None
+                  ) -> Optional["MetricsPusher"]:
+    """Start (or return) this process's pusher toward ``gcs_address``."""
+    if not gcs_address or \
+            os.environ.get("RAY_TPU_METRICS_PUSH", "1") == "0":
+        return None
+    with _lock:
+        if gcs_address in _inprocess_gcs:
+            return None
+        _refs[gcs_address] = _refs.get(gcs_address, 0) + 1
+        pusher = _pushers.get(gcs_address)
+        if pusher is not None and pusher.alive:
+            return pusher
+        pusher = _pushers[gcs_address] = MetricsPusher(
+            gcs_address, labels or {})
+    return pusher
+
+
+def release_pusher(gcs_address: str) -> None:
+    """Drop one component's claim on the address's shared pusher; the
+    pusher stops only when the last claimant releases (a driver's
+    shutdown must not silence a co-resident node manager's metrics)."""
+    pusher = None
+    with _lock:
+        n = _refs.get(gcs_address, 0) - 1
+        if n > 0:
+            _refs[gcs_address] = n
+        else:
+            _refs.pop(gcs_address, None)
+            pusher = _pushers.pop(gcs_address, None)
+    if pusher is not None:
+        pusher.stop()
+
+
+def stop_all() -> None:
+    with _lock:
+        pushers = list(_pushers.values())
+        _pushers.clear()
+        _refs.clear()
+    for p in pushers:
+        p.stop()
+
+
+class MetricsPusher:
+    def __init__(self, gcs_address: str, labels: Dict[str, str]):
+        self.gcs_address = gcs_address
+        self.labels = {"pid": str(os.getpid()), **labels}
+        self._stop = threading.Event()
+        self._failures = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="metrics-pusher")
+        self._thread.start()
+
+    @property
+    def alive(self) -> bool:
+        return not self._stop.is_set()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _deregister(self) -> None:
+        with _lock:
+            if _pushers.get(self.gcs_address) is self:
+                del _pushers[self.gcs_address]
+
+    def _loop(self) -> None:
+        from ray_tpu._private import rpc
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+        from ray_tpu.util import metrics
+
+        gcs = rpc.get_stub("GcsService", self.gcs_address)
+        interval = push_interval_s()
+        while not self._stop.wait(interval):
+            samples = metrics.collect_samples()
+            if not samples:
+                continue
+            batch = {"ts": time.time(), "labels": self.labels,
+                     "samples": samples}
+            try:
+                gcs.Publish(pb.PublishRequest(
+                    channel=METRICS_CHANNEL,
+                    data=pickle.dumps(batch)), timeout=5)
+                self._failures = 0
+            except Exception:  # noqa: BLE001 — head briefly unreachable
+                self._failures += 1
+                if self._failures >= MAX_CONSECUTIVE_FAILURES:
+                    # Cluster is gone for good (sequential test clusters,
+                    # torn-down heads): stop rather than spin forever.
+                    self._stop.set()
+        self._deregister()

@@ -24,6 +24,7 @@ import uuid
 from collections import defaultdict
 from typing import Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private import metrics_defs as mdefs
 from ray_tpu._private import rpc
 from ray_tpu._private.scheduler import policies
 from ray_tpu.protobuf import ray_tpu_pb2 as pb
@@ -156,6 +157,13 @@ class NodeManager:
             int(os.environ.get("RAY_TPU_MAX_CONCURRENT_PUSHES", 8)))
 
         self._stop = threading.Event()
+        # Observability: per-node tag for every series this daemon emits;
+        # the per-process pusher ships them to the head TSDB (a no-op when
+        # the GCS runs in this process — it samples the registry itself).
+        # Set before the gRPC server goes live: lease RPCs touch both.
+        self._mtags = {"node_id": self.node_id[:12]}
+        self._queued_leases = 0
+        self._queued_leases_lock = threading.Lock()
         # Pool sized above any single driver's submit concurrency: queued
         # lease RPCs briefly hold server threads (see _queue_for_resources).
         self._server, self.port = rpc.serve("NodeService", self, port=port,
@@ -178,6 +186,12 @@ class NodeManager:
             info.labels[k] = v
         self.labels = dict(labels or {})
         self.gcs.RegisterNode(pb.RegisterNodeRequest(info=info))
+        from ray_tpu._private import metrics_pusher
+
+        metrics_pusher.ensure_pusher(gcs_address,
+                                     labels={"role": "node_manager"})
+        threading.Thread(target=self._metrics_loop, daemon=True,
+                         name="nm-metrics").start()
 
         self._hb_thread = threading.Thread(target=self._heartbeat_loop,
                                            daemon=True, name="nm-heartbeat")
@@ -350,6 +364,42 @@ class NodeManager:
             self._reap_idle_workers()
             self._check_dead_workers()
             self._check_agent()
+
+    def _metrics_loop(self):
+        """Dedicated sampling thread: gauge refreshes must never ride the
+        heartbeat loop — under GIL saturation (worker spawn storms, task
+        fan-outs) the extra per-tick python work delayed heartbeat sends
+        past the 3s liveness threshold and got healthy nodes marked dead."""
+        from ray_tpu._private import metrics_pusher
+
+        interval = max(metrics_pusher.push_interval_s(), 1.0)
+        while not self._stop.wait(interval):
+            self._sample_node_metrics()
+
+    def _sample_node_metrics(self):
+        """Refresh this node's gauges each heartbeat tick (worker-pool
+        states, lease-queue depth, store fill, host vitals)."""
+        try:
+            with self._pool_lock:
+                total = len(self._workers)
+                idle = len(self._idle)
+                busy = sum(1 for w in self._workers.values()
+                           if w.leased_for is not None)
+            for state, count in (("total", total), ("idle", idle),
+                                 ("busy", busy)):
+                mdefs.NODE_WORKERS.set(count, tags={**self._mtags,
+                                                    "state": state})
+            mdefs.NODE_LEASE_QUEUE.set(self._queued_leases,
+                                       tags=self._mtags)
+            if self._shm is not None:
+                used, count = self._shm.stats()
+                mdefs.STORE_USED_BYTES.set(used, tags=self._mtags)
+                mdefs.STORE_OBJECTS.set(count, tags=self._mtags)
+            # Host vitals (mem/load/disk) are published by the node
+            # AGENT's vitals loop only — a second publisher here would
+            # double-count the host under agg=sum queries.
+        except Exception:  # noqa: BLE001 — sampling must never kill the
+            pass           # heartbeat loop
 
     # ------------------------------------------------------------- agent
     AGENT_START_GRACE_S = 60.0
@@ -851,6 +901,7 @@ class NodeManager:
                 self._idle.remove(worker.worker_id)
         # Stash demand so ReturnWorker releases it.
         self._leases[lease_id] = (worker.worker_id, demand)
+        mdefs.NODE_LEASES_GRANTED.inc(tags=self._mtags)
         return pb.LeaseReply(granted=True,
                              worker_address=worker.address,
                              worker_fast_address=worker.fast_address,
@@ -871,6 +922,8 @@ class NodeManager:
         loop takes over."""
         if not self._lease_queue_slots.acquire(blocking=False):
             return pb.LeaseReply(granted=False)
+        with self._queued_leases_lock:
+            self._queued_leases += 1
         try:
             deadline = time.monotonic() + self.LEASE_QUEUE_WAIT_S
             with self._res_cv:
@@ -883,6 +936,8 @@ class NodeManager:
                     return pb.LeaseReply(granted=False)
             return self._grant_lease(lease_id, demand)
         finally:
+            with self._queued_leases_lock:
+                self._queued_leases -= 1
             self._lease_queue_slots.release()
 
     def ReturnWorker(self, request, context):
@@ -1071,6 +1126,8 @@ class NodeManager:
                     break
                 self._spilled[oid] = (path, len(data))
                 self._shm.delete(oid)
+                mdefs.STORE_SPILLED.inc(tags=self._mtags)
+                mdefs.STORE_SPILLED_BYTES.inc(len(data), tags=self._mtags)
 
     def _restore_spilled(self, oid_hex: str) -> Optional[bytes]:
         """Bring a spilled object back (reference:
@@ -1094,6 +1151,7 @@ class NodeManager:
                     os.unlink(path)
                 except OSError:
                     pass
+        mdefs.STORE_RESTORED.inc(tags=self._mtags)
         self._maybe_spill()  # the restore itself may breach the high water
         return data
 
@@ -1153,6 +1211,7 @@ class NodeManager:
         except Exception:  # noqa: BLE001
             return False
         self.oom_kills += 1
+        mdefs.NODE_OOM_KILLS.inc(tags=self._mtags)
         return True
 
     # ------------------------------------------------------------ objects
@@ -1181,6 +1240,8 @@ class NodeManager:
                 from ray_tpu._private.shm import ShmClient
 
                 ShmClient.unlink_segment(request.shm_name)
+                mdefs.STORE_PUTS.inc(tags={**self._mtags,
+                                           "outcome": "rejected"})
                 return None
         elif self._shm is not None and request.data:
             if not self._seat_with_backpressure(
@@ -1188,10 +1249,16 @@ class NodeManager:
                                           request.data) is not None, size):
                 logger.warning("store full: rejecting put of %s "
                                "(%d bytes)", oid_hex[:12], size)
+                mdefs.STORE_PUTS.inc(tags={**self._mtags,
+                                           "outcome": "rejected"})
                 return None
         else:
             with self._obj_lock:
                 self._objects[request.object_id] = request.data
+        # Counted only once the object actually seated — rejected puts
+        # must not inflate the store-fill byte series.
+        mdefs.STORE_PUT_BYTES.inc(size, tags=self._mtags)
+        mdefs.STORE_PUTS.inc(tags={**self._mtags, "outcome": "ok"})
         return size
 
     def _seat_with_backpressure(self, attempt, size: int,
@@ -1246,6 +1313,12 @@ class NodeManager:
         return pb.PutObjectBatchReply(rejected=rejected)
 
     def GetObject(self, request, context):
+        reply = self._get_object_inner(request)
+        mdefs.STORE_GETS.inc(tags={
+            **self._mtags, "outcome": "hit" if reply.found else "miss"})
+        return reply
+
+    def _get_object_inner(self, request):
         oid_hex = request.object_id.hex()
         if self._shm is not None:
             meta = self._shm.get(oid_hex)

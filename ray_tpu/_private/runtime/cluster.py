@@ -30,6 +30,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import cloudpickle
 
 from ray_tpu import exceptions
+from ray_tpu._private import metrics_defs as mdefs
 from ray_tpu._private import rpc
 from ray_tpu._private.ids import ActorID, JobID, ObjectID, TaskID
 from ray_tpu._private.memory_store import MemoryStore
@@ -132,6 +133,26 @@ def read_object_reply(reply) -> Any:
     return loads_store(reply.data)
 
 
+def _run_callback(cb) -> None:
+    try:
+        cb()
+    except Exception:  # noqa: BLE001 — a future callback must not leak
+        logger.exception("future completion callback failed")
+
+
+def _future_set(fut: Future, value: Any) -> None:
+    """Resolve an ObjectRef future with get() semantics: stored task
+    errors become the future's exception, everything else its result."""
+    if fut.done():
+        return
+    if isinstance(value, exceptions.RayTaskError):
+        fut.set_exception(value.as_instanceof_cause())
+    elif isinstance(value, exceptions.RayTpuError):
+        fut.set_exception(value)
+    else:
+        fut.set_result(value)
+
+
 class _PullManager:
     """Receiver-side transfer admission (reference C13 PullManager,
     ``pull_manager.h:53``): bounds the bytes of concurrently in-flight
@@ -221,6 +242,11 @@ class ClusterRuntime(CoreRuntime):
         # reply — getters wait on these events instead of probing the
         # store/directory (3 RPCs per spin, the r3 roundtrip bottleneck).
         self._pending_results: Dict[bytes, threading.Event] = {}
+        # oid -> completion callbacks (as_future): invoked by the thread
+        # that applies the push result, so futures resolve without a
+        # parked waiter thread each (the r5 async fan-in cost: one pool
+        # thread per in-flight future).
+        self._pending_callbacks: Dict[bytes, List] = {}
         self._pending_res_lock = threading.Lock()
         # Small-put flusher: puts enqueue here; one thread batches them
         # into PutObjectBatch RPCs (an RPC per 1KB put made put() RPC-bound).
@@ -310,6 +336,11 @@ class ClusterRuntime(CoreRuntime):
         self._sub_thread = threading.Thread(
             target=self._subscriber_loop, daemon=True, name="gcs-subscriber")
         self._sub_thread.start()
+        from ray_tpu._private import metrics_pusher
+
+        metrics_pusher.ensure_pusher(
+            gcs_address, labels={"role": "worker" if is_worker
+                                 else "driver"})
 
     @classmethod
     def connect(cls, address: str, namespace: str = "default") -> "ClusterRuntime":
@@ -1040,6 +1071,7 @@ class ClusterRuntime(CoreRuntime):
 
     # ---------------------------------------------------------------- tasks
     def submit_task(self, function, function_name, args, kwargs, options):
+        mdefs.TASKS_SUBMITTED.inc(tags={"kind": "task"})
         task_id = TaskID.for_normal_task(self.job_id)
         streaming = is_streaming(options.num_returns)
         nreturns = 1 if streaming else max(options.num_returns, 1)
@@ -1261,13 +1293,25 @@ class ClusterRuntime(CoreRuntime):
             return ev
 
     def _complete_pending(self, return_ids) -> None:
+        cbs: List = []
         with self._pending_res_lock:
-            evs = {self._pending_results.pop(
-                oid.binary() if hasattr(oid, "binary") else oid, None)
-                for oid in return_ids}
+            evs = set()
+            for oid in return_ids:
+                ob = oid.binary() if hasattr(oid, "binary") else oid
+                evs.add(self._pending_results.pop(ob, None))
+                cbs.extend(self._pending_callbacks.pop(ob, ()))
         for ev in evs:
             if ev is not None:
                 ev.set()
+        for cb in cbs:
+            # Dispatch off this thread: it holds a _completion_slots
+            # permit, and resolving a future runs user done-callbacks —
+            # a blocking callback (e.g. a get() continuation) inline
+            # here could hold every slot and deadlock task completion.
+            try:
+                self._pool.submit(_run_callback, cb)
+            except RuntimeError:  # pool closed mid-shutdown
+                _run_callback(cb)
 
     PAYLOAD_PROMOTE_BYTES = 100 * 1024  # reference: >100KB args to plasma
     PAYLOAD_INDEX = (1 << 30) - 1       # object index reserved for payloads
@@ -1452,8 +1496,12 @@ class ClusterRuntime(CoreRuntime):
         with self._lease_cache_lock:
             lst = self._lease_cache.get(sig)
             if lst:
-                return lst.pop()
-        return None
+                lease = lst.pop()
+            else:
+                lease = None
+        mdefs.LEASE_CACHE.inc(tags={
+            "outcome": "hit" if lease is not None else "miss"})
+        return lease
 
     def _cache_lease(self, sig, lease: dict) -> bool:
         lease["ts"] = time.monotonic()
@@ -1630,6 +1678,7 @@ class ClusterRuntime(CoreRuntime):
             breq.specs.append(spec)
             self._running_locs[bytes(spec.task_id)] = \
                 lease["worker_address"]
+        push_start = time.monotonic()
         try:
             status, reply = fastpath.call_proto(
                 lease.get("fast_address", ""), fastpath.KIND_PUSH_BATCH,
@@ -1648,6 +1697,8 @@ class ClusterRuntime(CoreRuntime):
         finally:
             for item in items:
                 self._running_locs.pop(bytes(item[0].task_id), None)
+        mdefs.PUSH_LATENCY.observe(time.monotonic() - push_start,
+                                   tags={"mode": "batch"})
         with self._completion_slots:
             for item, result in zip(items, reply.results):
                 self._apply_push_result(result, item[1], item[0].name)
@@ -1812,6 +1863,7 @@ class ClusterRuntime(CoreRuntime):
         # dict write — cancel() tolerates the tiny record/read race as
         # best-effort, and a lock here is per-task hot-path cost.
         self._running_locs[tid] = lease["worker_address"]
+        push_start = time.monotonic()
         try:
             result = self._push_fast(lease.get("fast_address", ""), spec)
             if result is False:
@@ -1843,6 +1895,8 @@ class ClusterRuntime(CoreRuntime):
                         return False
         finally:
             self._running_locs.pop(tid, None)
+        mdefs.PUSH_LATENCY.observe(time.monotonic() - push_start,
+                                   tags={"mode": "single"})
         with self._completion_slots:
             self._apply_push_result(result, return_ids, spec.name)
         if self._cancelled_tasks:
@@ -1921,6 +1975,10 @@ class ClusterRuntime(CoreRuntime):
         negotiators wait for a worker to free."""
         self._submit_slots.acquire()
         slot_acquired = time.monotonic()
+        lease_kind = ("pg" if spec.placement_group_id else
+                      "affinity" if spec.affinity_node_id else
+                      (spec.strategy or "default").lower())
+        negotiate_start = slot_acquired
         try:
             pg_targets: List[Any] = []
             if spec.placement_group_id:
@@ -1974,6 +2032,10 @@ class ClusterRuntime(CoreRuntime):
                     target = self.node
                     continue
                 if reply.granted:
+                    mdefs.LEASE_REQUESTS.inc(tags={"result": "granted"})
+                    mdefs.LEASE_LATENCY.observe(
+                        time.monotonic() - negotiate_start,
+                        tags={"kind": lease_kind})
                     break
                 if reply.error == "infeasible":
                     where = ("placement group bundle"
@@ -1993,6 +2055,7 @@ class ClusterRuntime(CoreRuntime):
                     pg_targets = pg_targets[1:] + pg_targets[:1]
                     target = pg_targets[0]
                 if reply.spillback_address:
+                    mdefs.LEASE_REQUESTS.inc(tags={"result": "spillback"})
                     target = rpc.get_stub("NodeService",
                                           reply.spillback_address)
                     # Damp spillback ping-pong: nodes with stale views can
@@ -2006,6 +2069,7 @@ class ClusterRuntime(CoreRuntime):
                 if time.monotonic() > deadline:
                     raise exceptions.RayTpuError(
                         f"Timed out leasing a worker for {spec.name}")
+                mdefs.LEASE_REQUESTS.inc(tags={"result": "retry"})
                 time.sleep(backoff)
                 # The node queues lease requests server-side for up to 2s,
                 # so client retries are rare; a long backoff here would
@@ -2027,12 +2091,14 @@ class ClusterRuntime(CoreRuntime):
         # observed "done" with the value still missing would conclude
         # "produced then lost" and re-execute the task spuriously.
         if not result.ok:
+            mdefs.TASKS_COMPLETED.inc(tags={"status": "error"})
             err = pickle.loads(result.error) if result.error else \
                 exceptions.RayTaskError(name, "task failed")
             self._store_error(err, return_ids)
             if return_ids:
                 self._task_done.add(return_ids[0].task_id().binary())
             return
+        mdefs.TASKS_COMPLETED.inc(tags={"status": "ok"})
         for i, oid in enumerate(return_ids):
             if i < len(result.in_store) and result.in_store[i]:
                 continue  # large result: fetched on demand via the directory
@@ -2243,6 +2309,7 @@ class ClusterRuntime(CoreRuntime):
             checked_gcs = False
 
     def submit_actor_task(self, actor_id, method_name, args, kwargs, options):
+        mdefs.TASKS_SUBMITTED.inc(tags={"kind": "actor"})
         task_id = TaskID.for_actor_task(actor_id)
         streaming = is_streaming(options.num_returns)
         nreturns = 1 if streaming else max(options.num_returns, 1)
@@ -2449,14 +2516,57 @@ class ClusterRuntime(CoreRuntime):
 
     # ---------------------------------------------------------------- misc
     def as_future(self, ref: ObjectRef) -> Future:
+        """ObjectRef → Future. Resolution is event-driven for locally
+        in-flight tasks: the completion callback fires from the thread
+        applying the push result, so a 1k-call async fan-in parks ZERO
+        threads (the old poll-per-future design burned a 64-wide pool
+        slot per outstanding future — the r5 async-actor parity
+        bottleneck). Only refs owned elsewhere fall back to a polling
+        thread. Failed tasks resolve the future to their exception
+        (matching the local runtime and ``await ref`` semantics)."""
         fut: Future = Future()
+        oid = ref.id()
+
+        def resolve_from_store() -> bool:
+            try:
+                value = self.memory.get_if_ready(oid)
+            except KeyError:
+                return False
+            _future_set(fut, value)
+            return True
+
+        if resolve_from_store():
+            mdefs.ASYNC_FUTURES.inc(tags={"path": "inline"})
+            return fut
+        ob = oid.binary()
 
         def poll():
             try:
-                fut.set_result(self._get_one(ref, None))
+                _future_set(fut, self._get_one(ref, None))
             except BaseException as e:  # noqa: BLE001
                 fut.set_exception(e)
 
+        # poll is defined before on_complete can possibly fire: the
+        # completion thread may invoke the callback the instant the
+        # lock below is released.
+        def on_complete():
+            if not resolve_from_store():
+                # Result lives only in the node store (large, in_store
+                # push reply): fetch off-thread.
+                self._pool.submit(poll)
+
+        with self._pending_res_lock:
+            registered = ob in self._pending_results
+            if registered:
+                self._pending_callbacks.setdefault(ob, []).append(
+                    on_complete)
+
+        if registered:
+            mdefs.ASYNC_FUTURES.inc(tags={"path": "callback"})
+            return fut
+        # Completed between the store check and registration, or owned by
+        # another process: the polling path handles both.
+        mdefs.ASYNC_FUTURES.inc(tags={"path": "poll"})
         self._pool.submit(poll)
         return fut
 
@@ -2514,6 +2624,14 @@ class ClusterRuntime(CoreRuntime):
         if self._shutdown:
             return
         self._shutdown = True
+        # Release this runtime's claim on the process's metric pusher: a
+        # disconnected driver must not keep publishing its frozen registry
+        # to the live head (the TSDB would stamp those stale series as
+        # fresh forever), but a co-resident node manager's claim on the
+        # same pusher survives.
+        from ray_tpu._private import metrics_pusher
+
+        metrics_pusher.release_pusher(self.gcs_address)
         self._drain_lease_cache()
         try:
             self.refs.shutdown()  # release all held refcounts at the GCS

@@ -1,0 +1,222 @@
+"""In-memory ring-buffer time-series database for cluster metrics.
+
+Reference: the reference ships node metrics to Prometheus and keeps no
+history in-process; production debugging of the TPU runtime needs history
+*inside* the system (MFU regressions, decode-throughput dips, queue-depth
+spikes) without deploying an external TSDB. This build keeps a two-tier
+ring per series, hosted by the GCS/dashboard process:
+
+* a high-resolution tier: raw samples coalesced to ``resolution_s``
+  buckets, kept for ``hires_retention_s``;
+* a downsampled tier: ``downsample_s`` buckets carrying (min, max, sum,
+  count), kept up to ``retention_s``.
+
+Time only moves forward per series (driven by the newest sample's
+timestamp, so tests can feed synthetic clocks). Series beyond
+``max_series`` evict least-recently-updated first.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict, deque
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+LabelTuple = Tuple[Tuple[str, str], ...]
+
+
+def _label_tuple(labels) -> LabelTuple:
+    if not labels:
+        return ()
+    if isinstance(labels, dict):
+        return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+    return tuple(sorted((str(k), str(v)) for k, v in labels))
+
+
+class _Series:
+    __slots__ = ("hi", "lo", "last_ts", "last_value")
+
+    def __init__(self):
+        self.hi: deque = deque()   # [ts, value] resolution-coalesced
+        self.lo: deque = deque()   # [bucket_ts, mn, mx, total, count, last]
+        self.last_ts = 0.0
+        self.last_value = 0.0
+
+
+class TimeSeriesDB:
+    def __init__(self, retention_s: float = 1800.0,
+                 resolution_s: float = 0.25,
+                 hires_retention_s: float = 300.0,
+                 downsample_s: float = 10.0,
+                 max_series: int = 4096):
+        self.retention_s = float(retention_s)
+        self.resolution_s = max(float(resolution_s), 1e-3)
+        self.hires_retention_s = min(float(hires_retention_s),
+                                     self.retention_s)
+        self.downsample_s = max(float(downsample_s), self.resolution_s)
+        self.max_series = int(max_series)
+        # Update-ordered so the eviction victim (least-recently-updated
+        # series) pops in O(1); a min() scan here made every append past
+        # the cap O(max_series) and melted down under label churn.
+        self._series: "OrderedDict[Tuple[str, LabelTuple], _Series]" = \
+            OrderedDict()
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------------- write
+    def append(self, name: str, labels, value: float,
+               ts: float) -> None:
+        key = (name, _label_tuple(labels))
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                if len(self._series) >= self.max_series:
+                    self._series.popitem(last=False)
+                s = self._series[key] = _Series()
+            else:
+                self._series.move_to_end(key)
+            if ts < s.last_ts:
+                ts = s.last_ts  # per-series time never runs backwards
+            bucket = ts - ts % self.resolution_s
+            if s.hi and s.hi[-1][0] == bucket:
+                s.hi[-1][1] = float(value)  # coalesce within resolution
+            else:
+                s.hi.append([bucket, float(value)])
+            s.last_ts = ts
+            s.last_value = float(value)
+            self._roll(s)
+
+    def ingest(self, samples: Iterable[Tuple[str, Any, float]],
+               labels=None, ts: float = 0.0) -> int:
+        """Bulk append: ``samples`` are (name, labels, value) tuples
+        (a metrics-registry snapshot); ``labels`` merge under the
+        per-sample labels. Returns the number ingested."""
+        base = dict(_label_tuple(labels))
+        n = 0
+        for name, slabels, value in samples:
+            merged = dict(base)
+            merged.update(dict(_label_tuple(slabels)))
+            self.append(name, merged, value, ts)
+            n += 1
+        return n
+
+    def _roll(self, s: _Series) -> None:
+        """Move hi-tier points older than the hires window into
+        downsampled buckets; drop lo buckets past full retention.
+        All ages are relative to the series' newest timestamp."""
+        now = s.last_ts
+        hi_cutoff = now - self.hires_retention_s
+        while s.hi and s.hi[0][0] < hi_cutoff:
+            ts, value = s.hi.popleft()
+            bts = ts - ts % self.downsample_s
+            if s.lo and s.lo[-1][0] == bts:
+                b = s.lo[-1]
+                b[1] = min(b[1], value)
+                b[2] = max(b[2], value)
+                b[3] += value
+                b[4] += 1
+                b[5] = value  # hi points fold in chronological order
+            else:
+                s.lo.append([bts, value, value, value, 1, value])
+        lo_cutoff = now - self.retention_s
+        while s.lo and s.lo[0][0] < lo_cutoff:
+            s.lo.popleft()
+
+    # ----------------------------------------------------------------- read
+    def series(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            out = []
+            for (name, labels), s in self._series.items():
+                out.append({"name": name, "labels": dict(labels),
+                            "points": len(s.hi) + len(s.lo),
+                            "last_ts": s.last_ts,
+                            "last_value": s.last_value})
+        out.sort(key=lambda e: (e["name"], sorted(e["labels"].items())))
+        return out
+
+    @staticmethod
+    def _match(series_labels: LabelTuple, want: Dict[str, str]) -> bool:
+        have = dict(series_labels)
+        return all(have.get(k) == v for k, v in want.items())
+
+    def query(self, name: Optional[str] = None,
+              since: Optional[float] = None,
+              until: Optional[float] = None,
+              labels: Optional[Dict[str, str]] = None,
+              agg: Optional[str] = None,
+              step: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Points for every matching series. ``name`` matches exactly, or
+        as a prefix with a trailing ``*``. ``agg`` in (avg, min, max, sum,
+        last) re-buckets points onto a ``step``-second grid (defaulting
+        to the downsample interval, so ``agg`` alone never silently
+        returns raw points). Downsampled buckets contribute their stored
+        min/max/sum under the matching ``agg`` — a 1s spike inside a 10s
+        bucket must survive an ``agg=max`` query."""
+        if agg and not step:
+            step = self.downsample_s
+        want = {str(k): str(v) for k, v in (labels or {}).items()}
+        prefix = None
+        if name and name.endswith("*"):
+            prefix, name = name[:-1], None
+        with self._lock:
+            hits = []
+            for (sname, slabels), s in self._series.items():
+                if name is not None and sname != name:
+                    continue
+                if prefix is not None and not sname.startswith(prefix):
+                    continue
+                if want and not self._match(slabels, want):
+                    continue
+                points: List[List[float]] = []
+                for bts, mn, mx, total, count, last in s.lo:
+                    if agg == "min":
+                        v = mn
+                    elif agg == "max":
+                        v = mx
+                    elif agg == "sum":
+                        v = total
+                    elif agg == "last":
+                        v = last
+                    else:
+                        v = total / max(count, 1)
+                    points.append([bts, v])
+                points.extend([ts, v] for ts, v in s.hi)
+                hits.append({"name": sname, "labels": dict(slabels),
+                             "points": points})
+        for h in hits:
+            pts = [p for p in h["points"]
+                   if (since is None or p[0] >= since)
+                   and (until is None or p[0] <= until)]
+            if agg and step:
+                pts = _rebucket(pts, agg, float(step))
+            h["points"] = pts
+        hits = [h for h in hits if h["points"]]
+        hits.sort(key=lambda e: (e["name"], sorted(e["labels"].items())))
+        return hits
+
+
+def _rebucket(points: Sequence[Sequence[float]], agg: str,
+              step: float) -> List[List[float]]:
+    step = max(step, 1e-3)
+    buckets: Dict[float, List[float]] = {}
+    order: List[float] = []
+    for ts, v in points:
+        bts = ts - ts % step
+        if bts not in buckets:
+            buckets[bts] = []
+            order.append(bts)
+        buckets[bts].append(v)
+    out = []
+    for bts in order:
+        vs = buckets[bts]
+        if agg == "min":
+            val = min(vs)
+        elif agg == "max":
+            val = max(vs)
+        elif agg == "sum":
+            val = sum(vs)
+        elif agg == "last":
+            val = vs[-1]
+        else:  # avg (default)
+            val = sum(vs) / len(vs)
+        out.append([bts, val])
+    return out

@@ -77,9 +77,17 @@ _INDEX_HTML = """<!doctype html>
  .ok{color:#7c6} .bad{color:#e66} #status{color:#aaa;font-size:.8rem}
  pre{background:#181818;padding:.5rem;max-height:14rem;overflow:auto;
      font-size:.75rem}
+ .spark{display:flex;align-items:center;gap:.5rem;font-size:.72rem}
+ .spark svg{flex:none;background:#181818;border:1px solid #333}
+ .sname{color:#aaa;overflow:hidden;text-overflow:ellipsis;
+        white-space:nowrap;max-width:34rem}
+ .sval{color:#7c6;margin-left:auto}
+ #metrics{display:grid;grid-template-columns:repeat(2,minmax(0,1fr));
+          gap:.1rem .8rem}
 </style></head><body>
 <h1>ray_tpu dashboard <span id="status"></span></h1>
 <h2>Cluster</h2><div id="cluster"></div>
+<h2>Metrics (last 5 min)</h2><div id="metrics"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Node agents</h2><table id="agents"></table>
 <h2>Actors</h2><table id="actors"></table>
@@ -98,6 +106,36 @@ function table(el,rows,cols){
     +"</tr>").join("");
 }
 async function j(p){const r=await fetch(p);return r.json();}
+function spark(pts){
+  // Inline-SVG sparkline over [ts, value] points from the head TSDB.
+  if(!pts.length)return "<svg width=\\"120\\" height=\\"22\\"></svg>";
+  const w=120,h=22;
+  const xs=pts.map(p=>p[0]),ys=pts.map(p=>p[1]);
+  const x0=Math.min(...xs),x1=Math.max(...xs);
+  const y0=Math.min(...ys),y1=Math.max(...ys);
+  const sx=t=>x1===x0?w/2:1+(t-x0)/(x1-x0)*(w-2);
+  const sy=v=>y1===y0?h/2:h-1-(v-y0)/(y1-y0)*(h-2);
+  const d=pts.map(p=>sx(p[0]).toFixed(1)+","+sy(p[1]).toFixed(1)).join(" ");
+  return `<svg width="${w}" height="${h}"><polyline fill="none" `+
+         `stroke="#8cf" stroke-width="1" points="${d}"/></svg>`;
+}
+async function metricsPanel(){
+  // 3s avg buckets: ~100 points per 120px sparkline; full 0.25s
+  // resolution would ship ~10x the payload for identical pixels. The
+  // limit matches the rendered row count so big clusters don't ship
+  // thousands of series per refresh just to be sliced client-side.
+  const data=await j("/api/v1/metrics/query?since=300&agg=avg&step=3&limit=80");
+  const rows=data.slice(0,80).map(s=>{
+    const last=s.points.length?s.points[s.points.length-1][1]:0;
+    const lbl=Object.entries(s.labels).filter(([k])=>k!=="pid")
+      .map(([k,v])=>`${k}=${v}`).join(",");
+    const val=Math.abs(last)>=100?last.toFixed(0):last.toFixed(3);
+    return `<div class="spark">${spark(s.points)}<span class="sname">`+
+      `${esc(s.name)}${lbl?"{"+esc(lbl)+"}":""}</span>`+
+      `<span class="sval">${esc(val)}</span></div>`;
+  });
+  document.getElementById("metrics").innerHTML=rows.join("")||"(no series)";
+}
 async function refresh(){
   try{
     const cs=await j("/api/cluster_status");
@@ -114,6 +152,7 @@ async function refresh(){
     const logs=await j("/api/logs");
     document.getElementById("logs").textContent=logs.slice(-200)
       .map(l=>`[${l.worker} ${l.pid}] ${l.line}`).join("\\n");
+    await metricsPanel();
     document.getElementById("status").textContent=
       "updated "+new Date().toLocaleTimeString();
   }catch(e){
@@ -267,6 +306,35 @@ class Dashboard:
             parts.extend(probe_agents("/metrics", transform))
             return _merge_expositions(parts)
 
+        def metrics_series():
+            reply = gcs.KvGet(pb.KvRequest(ns="__metrics__", key="series"))
+            return pickle.loads(reply.value) if reply.found else []
+
+        def metrics_query(params):
+            """Translate HTTP query params into a TSDB query served by the
+            GCS ``__metrics__`` KV namespace: ``series`` (exact name, or
+            prefix with trailing ``*``), ``since``/``until`` (seconds ago,
+            or absolute unix ts), ``label.<k>=<v>`` filters, ``agg``
+            (avg/min/max/sum/last) with ``step`` seconds."""
+            q = {
+                "name": params.get("series") or None,
+                "since": float(params.get("since", 300.0)),
+                "until": (float(params["until"])
+                          if "until" in params else None),
+                "labels": {k[len("label."):]: v for k, v in params.items()
+                           if k.startswith("label.")},
+                "agg": params.get("agg") or None,
+                "step": float(params["step"]) if "step" in params else None,
+                "limit": (int(params["limit"])
+                          if "limit" in params else None),
+            }
+            reply = gcs.KvGet(pb.KvRequest(ns="__metrics__",
+                                           key=json.dumps(q)))
+            if not reply.found:
+                raise ValueError(
+                    f"bad metrics query: {reply.value.decode()}")
+            return pickle.loads(reply.value)
+
         def cluster_status():
             ns = nodes()
             total, avail = {}, {}
@@ -283,13 +351,25 @@ class Dashboard:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802
+                from urllib.parse import parse_qs, urlsplit
+
+                parts = urlsplit(self.path)
+                path = parts.path
+                params = {k: v[0] for k, v
+                          in parse_qs(parts.query).items()}
                 try:
-                    if self.path == "/metrics":
+                    if path == "/metrics":
                         body = cluster_metrics().encode()
                         ctype = "text/plain; version=0.0.4"
-                    elif self.path in ("/", "/index.html"):
+                    elif path in ("/", "/index.html"):
                         body = _INDEX_HTML.encode()
                         ctype = "text/html; charset=utf-8"
+                    elif path == "/api/v1/metrics/series":
+                        body = json.dumps(metrics_series()).encode()
+                        ctype = "application/json"
+                    elif path == "/api/v1/metrics/query":
+                        body = json.dumps(metrics_query(params)).encode()
+                        ctype = "application/json"
                     else:
                         route = {
                             "/api/cluster_status": cluster_status,
@@ -299,7 +379,7 @@ class Dashboard:
                             "/api/logs": logs,
                             "/api/tasks": tasks,
                             "/api/agents": agents,
-                        }.get(self.path)
+                        }.get(path)
                         if route is None:
                             self.send_response(404)
                             self.end_headers()

@@ -23,6 +23,11 @@ from ray_tpu._private import rpc
 from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
 KV_NS = "job"
+# The supervisor thread refreshes the job record's heartbeat at this
+# cadence while the entrypoint runs; the GCS job reconciler marks records
+# FAILED once the heartbeat lapses past its TTL (a dead client can never
+# finalize its own jobs — gcs/server.py::_reconcile_jobs).
+HEARTBEAT_PERIOD_S = 2.0
 
 
 class JobStatus:
@@ -39,6 +44,10 @@ class JobSubmissionClient:
         self.address = address
         self.gcs = rpc.get_stub("GcsService", address)
         self._procs: Dict[str, subprocess.Popen] = {}
+        # Jobs this client stopped: the supervisor must neither heartbeat
+        # them (a load→save racing stop_job could resurrect RUNNING over
+        # STOPPED) nor finalize them as FAILED on the kill's exit code.
+        self._stopped: set = set()
 
     # ------------------------------------------------------------- kv helpers
     def _save(self, job_id: str, info: Dict[str, Any]):
@@ -64,6 +73,7 @@ class JobSubmissionClient:
             "job_id": job_id, "entrypoint": entrypoint,
             "status": JobStatus.PENDING, "metadata": metadata or {},
             "start_time": time.time(), "end_time": None,
+            "heartbeat_time": time.time(),
             "log_path": os.path.join(logdir, "driver.log"),
         }
         self._save(job_id, info)
@@ -87,8 +97,47 @@ class JobSubmissionClient:
         return job_id
 
     def _supervise(self, job_id: str, proc: subprocess.Popen):
-        rc = proc.wait()
+        # Poll (don't block in wait()): the record's heartbeat must keep
+        # refreshing or the GCS reconciler would sweep a healthy long job.
+        last_beat = 0.0
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                break
+            now = time.monotonic()
+            if now - last_beat >= HEARTBEAT_PERIOD_S:
+                last_beat = now
+                try:
+                    if job_id not in self._stopped:
+                        info = self._load(job_id) or {}
+                        status = info.get("status")
+                        if status == JobStatus.FAILED and \
+                                "client died" in str(info.get("message")):
+                            # The reconciler false-positived (GCS outage
+                            # outlived the TTL): the entrypoint is alive
+                            # — this beat proves it — so take the record
+                            # back.
+                            info["status"] = JobStatus.RUNNING
+                            info.pop("end_time", None)
+                            info.pop("message", None)
+                            status = JobStatus.RUNNING
+                        if status == JobStatus.RUNNING:
+                            info["heartbeat_time"] = time.time()
+                            self._save(job_id, info)
+                except Exception:  # noqa: BLE001 — GCS briefly unreachable
+                    pass
+            time.sleep(0.25)
         info = self._load(job_id) or {}
+        if job_id in self._stopped:
+            # stop_job finalized the record; re-assert STOPPED in case a
+            # racing heartbeat save clobbered it with a stale RUNNING.
+            if info.get("status") != JobStatus.STOPPED:
+                info["status"] = JobStatus.STOPPED
+                info.setdefault("end_time", time.time())
+                self._save(job_id, info)
+            return
+        if info.get("status") == JobStatus.STOPPED:
+            return  # stop_job already finalized the record
         info["status"] = JobStatus.SUCCEEDED if rc == 0 else JobStatus.FAILED
         info["end_time"] = time.time()
         info["return_code"] = rc
@@ -121,6 +170,7 @@ class JobSubmissionClient:
     def stop_job(self, job_id: str) -> bool:
         proc = self._procs.get(job_id)
         if proc is not None and proc.poll() is None:
+            self._stopped.add(job_id)
             proc.terminate()
             info = self._load(job_id) or {}
             info["status"] = JobStatus.STOPPED

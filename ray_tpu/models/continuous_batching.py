@@ -138,6 +138,8 @@ def _bucket(n: int, floor: int = 16) -> int:
 class ContinuousBatcher:
     """Iteration-level scheduler over a fixed pool of KV-cache slots."""
 
+    _engine_ids = itertools.count()  # per-process engine tag suffix
+
     def __init__(self, config: llama.LlamaConfig, params=None,
                  num_slots: int = 8, max_len: int = 512, seed: int = 0,
                  eos_token: Optional[int] = None, token_callback=None,
@@ -178,6 +180,12 @@ class ContinuousBatcher:
         self._waiting: deque = deque()
         self._rid = itertools.count()
         self._finished: Dict[int, List[int]] = {}
+        # Observability: engine label for the slot-occupancy / decode-rate
+        # series (continuous-batching is the serving hot loop the decode
+        # roofline work tunes — the TSDB needs its history). The instance
+        # counter keeps co-resident engines' series from colliding.
+        self._mtags = {"engine":
+                       f"slots{num_slots}-{next(self._engine_ids)}"}
         cfg = config
 
         @partial(jax.jit, donate_argnums=(2,))
@@ -313,6 +321,7 @@ class ContinuousBatcher:
         """Book one or more fetched tick rows; returns True when any
         request finished (membership changed)."""
         finished_any = False
+        applied = 0
         for row in nxt_rows:
             for slot, rid in membership:
                 st = self._slots.get(slot)
@@ -324,15 +333,27 @@ class ContinuousBatcher:
                 st["out"].append(tok)
                 st["last"] = tok
                 st["pos"] += 1
+                applied += 1
                 self._maybe_finish(slot)
                 if slot not in self._slots:
                     finished_any = True
+        if applied:
+            from ray_tpu._private import metrics_defs as mdefs
+
+            mdefs.CB_DECODE_TOKENS.inc(applied, tags=self._mtags)
         return finished_any
 
     def step(self) -> Dict[int, List[int]]:
         """Admit waiting requests, run one decode tick over all active
         slots, and return the requests that finished (with
         ``sync_every > 1``, finish detection lags up to 2K ticks)."""
+        from ray_tpu._private import metrics_defs as mdefs
+
+        active = len(self._slots)
+        mdefs.CB_ACTIVE_SLOTS.set(active, tags=self._mtags)
+        mdefs.CB_WAITING_REQUESTS.set(len(self._waiting), tags=self._mtags)
+        mdefs.CB_SLOT_OCCUPANCY.set(active / max(self.num_slots, 1),
+                                    tags=self._mtags)
         if self.sync_every == 1:
             self._admit()
             if self._slots:

@@ -240,6 +240,87 @@ def cmd_memory(args):
               f"nodes=[{locs}]  refs=[{holders}]")
 
 
+def _metrics_kv(address, key: str):
+    """Read the GCS-hosted TSDB through the reserved __metrics__ KV
+    namespace (key "series" lists; a JSON dict key queries)."""
+    import pickle
+
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    gcs = rpc.get_stub("GcsService", address)
+    reply = gcs.KvGet(pb.KvRequest(ns="__metrics__", key=key))
+    if not reply.found:
+        raise SystemExit(f"metrics query failed: {reply.value.decode()}")
+    return pickle.loads(reply.value)
+
+
+def _metrics_query_key(args, since: float = None) -> str:
+    labels = dict(kv.split("=", 1) for kv in (args.label or []))
+    return json.dumps({"name": args.series,
+                       "since": args.since if since is None else since,
+                       "labels": labels, "agg": args.agg,
+                       "step": args.step})
+
+
+def _fmt_labels(labels: dict) -> str:
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return "{" + inner + "}" if inner else ""
+
+
+def cmd_metrics(args):
+    """Time-series observability CLI over the head TSDB (list series,
+    tail one live, dump history as CSV)."""
+    address = args.address or _auto_address()
+    if args.action == "list":
+        for s in _metrics_kv(address, "series"):
+            print(f"{s['name']}{_fmt_labels(s['labels'])}  "
+                  f"points={s['points']}  last={s['last_value']:g}")
+        return
+    if args.action == "tail":
+        if not args.series:
+            raise SystemExit("metrics tail requires a series name")
+        seen: dict = {}
+        since = None  # full --since window once, then only fresh points
+        try:
+            while True:
+                for s in _metrics_kv(address,
+                                     _metrics_query_key(args, since)):
+                    key = (s["name"], tuple(sorted(s["labels"].items())))
+                    for ts, value in s["points"]:
+                        if ts <= seen.get(key, 0.0):
+                            continue
+                        seen[key] = ts
+                        stamp = time.strftime("%H:%M:%S",
+                                              time.localtime(ts))
+                        print(f"{stamp} {s['name']}"
+                              f"{_fmt_labels(s['labels'])} {value:g}",
+                              flush=True)
+                if args.once:
+                    return
+                since = args.interval * 2 + 1  # dedup absorbs the overlap
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return
+    # dump: CSV history for one series (or every series with no name).
+    import csv
+
+    out = open(args.output, "w", newline="") if args.output else sys.stdout
+    try:
+        w = csv.writer(out)
+        w.writerow(["name", "labels", "ts", "value"])
+        n = 0
+        for s in _metrics_kv(address, _metrics_query_key(args)):
+            labels = _fmt_labels(s["labels"])
+            for ts, value in s["points"]:
+                w.writerow([s["name"], labels, f"{ts:.3f}", value])
+                n += 1
+        print(f"wrote {n} samples", file=sys.stderr)
+    finally:
+        if args.output:
+            out.close()
+
+
 def cmd_logs(args):
     """Tail cluster logs (reference: ``ray logs`` + the dashboard log
     viewer over the LOG pubsub channel)."""
@@ -520,6 +601,26 @@ def main(argv=None):
     p.add_argument("--address")
     p.add_argument("--limit", type=int, default=50)
     p.set_defaults(fn=cmd_memory)
+
+    p = sub.add_parser("metrics",
+                       help="cluster time-series: list/tail/dump")
+    p.add_argument("action", choices=["list", "tail", "dump"])
+    p.add_argument("series", nargs="?",
+                   help="series name (exact, or prefix ending with *)")
+    p.add_argument("--address")
+    p.add_argument("--label", action="append", metavar="K=V",
+                   help="label filter, repeatable")
+    p.add_argument("--since", type=float, default=600.0,
+                   help="history window in seconds (default 600)")
+    p.add_argument("--agg", choices=["avg", "min", "max", "sum", "last"])
+    p.add_argument("--step", type=float,
+                   help="aggregation bucket seconds (with --agg)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="tail poll period")
+    p.add_argument("--once", action="store_true",
+                   help="tail: print current window and exit")
+    p.add_argument("--output", "-o", help="dump: CSV path (default stdout)")
+    p.set_defaults(fn=cmd_metrics)
 
     p = sub.add_parser("logs", help="tail worker logs (or one job's logs)")
     p.add_argument("--address")

@@ -648,6 +648,21 @@ class DeploymentResponseGenerator:
         return ray_tpu.get(ref, timeout=self._timeout)
 
 
+# Process-wide in-flight request counts per deployment: the queue-depth
+# gauge must aggregate across every handle to a deployment (independent
+# get_handle() calls have separate router states, and a per-handle sum
+# would overwrite the series last-writer-wins).
+_QUEUE_DEPTH: Dict[str, int] = {}
+_QUEUE_DEPTH_LOCK = threading.Lock()
+
+
+def _queue_depth_delta(deployment: str, delta: int) -> int:
+    with _QUEUE_DEPTH_LOCK:
+        depth = max(_QUEUE_DEPTH.get(deployment, 0) + delta, 0)
+        _QUEUE_DEPTH[deployment] = depth
+    return depth
+
+
 class _RouterState:
     """Routing table + subscription shared by a handle and its clones."""
 
@@ -818,8 +833,22 @@ class DeploymentHandle:
             st.shared_loads = loads
         return loads
 
+    def _observe_done(self, start: float) -> None:
+        from ray_tpu._private import metrics_defs as mdefs
+
+        mdefs.SERVE_LATENCY.observe(time.monotonic() - start,
+                                    tags={"deployment": self._name})
+        mdefs.SERVE_QUEUE_DEPTH.set(_queue_depth_delta(self._name, -1),
+                                    tags={"deployment": self._name})
+
     def remote(self, *args, **kwargs):
+        from ray_tpu._private import metrics_defs as mdefs
+
         idx, replica = self._choose(self._model_id)
+        mdefs.SERVE_REQUESTS.inc(tags={"deployment": self._name})
+        mdefs.SERVE_QUEUE_DEPTH.set(_queue_depth_delta(self._name, +1),
+                                    tags={"deployment": self._name})
+        start = time.monotonic()
         if self._stream:
             gen = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(
@@ -829,6 +858,7 @@ class DeploymentHandle:
                 with self._lock:
                     self._inflight[idx] = max(
                         self._inflight.get(idx, 1) - 1, 0)
+                self._observe_done(start)
 
             try:
                 gen.completed().future().add_done_callback(_sdone)
@@ -841,12 +871,19 @@ class DeploymentHandle:
         def _done(_fut):
             with self._lock:
                 self._inflight[idx] = max(self._inflight.get(idx, 1) - 1, 0)
+            self._observe_done(start)
 
         try:
             ref.future().add_done_callback(_done)
         except Exception:  # noqa: BLE001
+            from ray_tpu._private import metrics_defs as mdefs
+
             with self._lock:
                 self._inflight[idx] = max(self._inflight.get(idx, 1) - 1, 0)
+            # Balance the queue-depth gauge: the done callback that would
+            # normally decrement it will never fire.
+            mdefs.SERVE_QUEUE_DEPTH.set(_queue_depth_delta(self._name, -1),
+                                        tags={"deployment": self._name})
         return DeploymentResponse(ref, handle=self, call=(args, kwargs),
                                   replica=replica)
 

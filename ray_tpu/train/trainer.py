@@ -200,6 +200,31 @@ class JaxTrainer:
     def _drive(self, executor: BackendExecutor, run_refs,
                manager: CheckpointManager, history: List[Dict[str, Any]]):
         """Poll session queues until every worker's run() completes."""
+        from ray_tpu._private import metrics_defs as mdefs
+
+        mtags = {"trainer": type(self).__name__}
+        last_report_ts = 0.0
+
+        def observe_round(metrics, nreports):
+            """Per-step observability: report cadence is the step cadence
+            (reference: workers report once per step), so the wall time
+            since the previous poll round, split across the ``nreports``
+            steps merged this round, is the per-step time — recording the
+            raw inter-call gap would log ~0s for every buffered report
+            when steps back up. A tokens_per_s metric key feeds the
+            throughput gauge."""
+            nonlocal last_report_ts
+            now = time.monotonic()
+            mdefs.TRAIN_REPORTS.inc(nreports, tags=mtags)
+            if last_report_ts:
+                per_step = (now - last_report_ts) / nreports
+                for _ in range(nreports):
+                    mdefs.TRAIN_STEP_SECONDS.observe(per_step, tags=mtags)
+            last_report_ts = now
+            tps = (metrics or {}).get("tokens_per_s")
+            if isinstance(tps, (int, float)):
+                mdefs.TRAIN_TOKENS_PER_S.set(float(tps), tags=mtags)
+
         while True:
             polls = executor.poll()
             # Merge this round's reports: workers report at the same cadence;
@@ -222,6 +247,8 @@ class JaxTrainer:
                         Checkpoint(ckpt_path), metrics or {})
                     entry["checkpoint"] = persisted
                 history.append(entry)
+            if max_reports:
+                observe_round(metrics, max_reports)
 
             done, _ = ray_tpu.wait(run_refs, num_returns=len(run_refs),
                                    timeout=0.02)

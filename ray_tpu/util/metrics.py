@@ -31,6 +31,23 @@ class Metric:
         with _registry_lock:
             _registry.append(self)
 
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def description(self) -> str:
+        return self._description
+
+    @property
+    def tag_keys(self) -> Tuple[str, ...]:
+        return self._tag_keys
+
+    def samples(self) -> List[Tuple[str, Tuple, float]]:
+        """Current (name, label_tuple, value) samples — the push-plane
+        snapshot the metrics pusher ships to the head TSDB."""
+        return []
+
     def set_default_tags(self, tags: Dict[str, str]):
         self._default_tags = dict(tags)
         return self
@@ -55,6 +72,10 @@ class Counter(Metric):
         with self._lock:
             self._values[self._key(tags)] += value
 
+    def samples(self):
+        with self._lock:
+            return [(self._name, key, v) for key, v in self._values.items()]
+
     def render(self) -> List[str]:
         out = [f"# HELP {self._name} {self._description}",
                f"# TYPE {self._name} counter"]
@@ -72,6 +93,10 @@ class Gauge(Metric):
     def set(self, value: float, tags: Optional[Dict[str, str]] = None):
         with self._lock:
             self._values[self._key(tags)] = value
+
+    def samples(self):
+        with self._lock:
+            return [(self._name, key, v) for key, v in self._values.items()]
 
     def render(self) -> List[str]:
         out = [f"# HELP {self._name} {self._description}",
@@ -123,6 +148,18 @@ class Histogram(Metric):
         return out
 
 
+    def samples(self):
+        # Histograms ship their sum and count (rate + mean latency are
+        # derivable at query time; per-bucket series would multiply the
+        # TSDB's series count by the bucket count).
+        with self._lock:
+            out = []
+            for key, total in self._totals.items():
+                out.append((f"{self._name}_count", key, float(total)))
+                out.append((f"{self._name}_sum", key, self._sums[key]))
+            return out
+
+
 def prometheus_text() -> str:
     """Render every registered metric (the /metrics endpoint body)."""
     lines: List[str] = []
@@ -131,3 +168,16 @@ def prometheus_text() -> str:
     for m in metrics:
         lines.extend(m.render())
     return "\n".join(lines) + "\n"
+
+
+def all_metrics() -> List[Metric]:
+    with _registry_lock:
+        return list(_registry)
+
+
+def collect_samples() -> List[Tuple[str, Tuple, float]]:
+    """Snapshot every registered metric's samples (push-plane payload)."""
+    out: List[Tuple[str, Tuple, float]] = []
+    for m in all_metrics():
+        out.extend(m.samples())
+    return out

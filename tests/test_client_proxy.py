@@ -129,6 +129,89 @@ def test_session_refs_released_on_close(proxy_cluster):
     assert sid not in proxy._sessions
 
 
+CRASH_CLIENT_SCRIPT = textwrap.dedent("""
+    import time
+    import ray_tpu
+
+    ray_tpu.init(address="ray://{proxy}")
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(2)
+        return "done"
+
+    ref = slow.remote()
+    held = ray_tpu.put(list(range(2048)))  # session-held ref to sweep
+    time.sleep(0.5)  # let the lease land on a worker
+    print("IN_GET", flush=True)
+    print(ray_tpu.get(ref, timeout=120))
+""")
+
+
+def test_sigkilled_client_session_swept_and_workers_freed(proxy_cluster,
+                                                          monkeypatch):
+    """SIGKILL a remote driver mid-``get``: the proxy's idle reaper must
+    sweep the session's refs and the leased worker must return to the
+    pool (VERDICT Weak #6 crash path)."""
+    import signal
+    import time
+
+    from ray_tpu._private.client_proxy import ClientProxyServer
+
+    c, _ = proxy_cluster
+    monkeypatch.setenv("RAY_TPU_CLIENT_SESSION_TTL_S", "2")
+    proxy = ClientProxyServer(c.address)  # shares the module runtime
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   RAY_TPU_CLIENT_SESSION_TTL_S="2",
+                   PYTHONPATH=os.pathsep.join(
+                       [os.path.dirname(os.path.dirname(__file__))]
+                       + sys.path))
+        proc = subprocess.Popen(
+            [sys.executable, "-c",
+             CRASH_CLIENT_SCRIPT.format(proxy=proxy.address)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env)
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                line = proc.stdout.readline().strip()
+                if line == "IN_GET":
+                    break
+                assert proc.poll() is None, "client died before get()"
+            else:
+                raise AssertionError("client never reached get()")
+            # The session exists and pins refs on the client's behalf.
+            assert len(proxy._sessions) == 1
+            sid = next(iter(proxy._sessions))
+            assert proxy._sessions[sid]["refs"]
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=10)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+        # Refs swept: pings stopped, so the idle reaper drops the session.
+        deadline = time.monotonic() + 30
+        while proxy._sessions and time.monotonic() < deadline:
+            time.sleep(0.25)
+        assert not proxy._sessions, "dead client's session never reaped"
+
+        # Leased workers freed: once the in-flight task drains, the
+        # cluster's available CPUs return to the full total.
+        total = ray_tpu.cluster_resources()["CPU"]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if ray_tpu.available_resources().get("CPU", 0) == total:
+                return
+            time.sleep(0.25)
+        raise AssertionError(
+            f"workers not freed: {ray_tpu.available_resources()} "
+            f"vs total {total}")
+    finally:
+        proxy._server.close()
+
+
 def test_namespace_isolation_through_proxy(proxy_cluster):
     from ray_tpu._private.client_proxy import ProxyRuntime
     from ray_tpu._private.options import RemoteOptions

@@ -1,0 +1,51 @@
+"""Tier-1 lint: the framework metric catalog stays self-documenting.
+
+Every framework metric (``ray_tpu_*`` and the rpc instrumentation) must
+declare a non-empty description and explicit ``tag_keys`` — the README
+metrics catalog and the dashboard/CLI views are only as good as this
+metadata. New framework metrics belong in ``_private/metrics_defs.py``.
+"""
+
+import inspect
+
+from ray_tpu._private import metrics_defs
+from ray_tpu.util import metrics as metrics_mod
+
+FRAMEWORK_PREFIXES = ("ray_tpu_", "rpc_")
+
+
+def _framework_metrics():
+    return [m for m in metrics_mod.all_metrics()
+            if m.name.startswith(FRAMEWORK_PREFIXES)]
+
+
+def test_catalog_is_nonempty_and_registered():
+    catalog = [v for _, v in inspect.getmembers(metrics_defs)
+               if isinstance(v, metrics_mod.Metric)]
+    assert len(catalog) >= 20, "metrics catalog shrank unexpectedly"
+    registered = set(map(id, metrics_mod.all_metrics()))
+    assert all(id(m) in registered for m in catalog)
+
+
+def test_every_framework_metric_is_documented():
+    undocumented = [m.name for m in _framework_metrics()
+                    if not m.description.strip()]
+    assert not undocumented, (
+        f"metrics without a description: {undocumented} — add one in "
+        f"_private/metrics_defs.py")
+
+
+def test_every_framework_metric_declares_tag_keys():
+    untagged = [m.name for m in _framework_metrics() if not m.tag_keys]
+    assert not untagged, (
+        f"metrics without declared tag_keys: {untagged} — declare them in "
+        f"_private/metrics_defs.py so series stay filterable")
+
+
+def test_catalog_names_follow_conventions():
+    for m in _framework_metrics():
+        if not m.name.startswith("ray_tpu_"):
+            continue
+        if isinstance(m, metrics_mod.Counter):
+            assert m.name.endswith("_total"), (
+                f"counter {m.name} must end in _total")
