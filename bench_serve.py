@@ -4,7 +4,11 @@ Prints ONE JSON line and writes ``BENCH_SERVE_r{N}.json``.
 
 Metric: steady-state decode tokens/sec/chip of the ContinuousBatcher
 (``models/continuous_batching.py``) running the same ~1B-param Llama the
-training bench uses, all KV slots saturated.
+training bench uses, all KV slots saturated. Also reported: time-to-
+first-token (submit -> first streamed token, p50/p95 over every request
+admitted during the run), prefill tokens/s, and a per-tick bytes-read
+estimate so ``hbm_efficiency`` regressions are attributable to a
+specific traffic term (params vs KV vs upcast copies).
 
 Criterion (v5e HBM roofline): every decode tick must read the full
 parameter set plus the active KV prefixes from HBM, so
@@ -12,7 +16,9 @@ parameter set plus the active KV prefixes from HBM, so
 The criterion is 10% of this roofline: XLA (non-pallas) decode with
 per-slot cache scatter plus a REMOTE-attached chip (every host fetch
 costs a ~90ms tunnel RTT; the engine's speculative buffered decode hides
-most but not all of it) lands 10-15%; vLLM-class stacks on local GPUs
+most but not all of it) lands 10-15%; the fused pallas decode kernel
+(``ops/decode_attention.py``, reads K/V once in bf16 instead of twice in
+fp32) plus bf16 lm_head targets >=25%; vLLM-class stacks on local GPUs
 land ~15-30%. ``vs_baseline`` = achieved / (0.10 * roofline), and
 ``hbm_efficiency`` reports the raw fraction transparently.
 """
@@ -42,6 +48,13 @@ def _hbm_bw(device) -> float:
     return 819e9
 
 
+def _pct(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
 def main() -> None:
     from ray_tpu.models import llama
     from ray_tpu.models.continuous_batching import ContinuousBatcher
@@ -59,24 +72,58 @@ def main() -> None:
         num_slots, max_len, prompt_len, ticks = 4, 64, 8, 20
         sync_every = 4
 
+    # TTFT: submit timestamp per rid; first token closes the interval.
+    submit_ts = {}
+    ttft_s = []
+
+    def on_token(rid, _tok):
+        t0 = submit_ts.pop(rid, None)
+        if t0 is not None:
+            ttft_s.append(time.perf_counter() - t0)
+
     eng = ContinuousBatcher(config, num_slots=num_slots, max_len=max_len,
-                            sync_every=sync_every)
+                            sync_every=sync_every, token_callback=on_token)
     param_bytes = sum(x.nbytes for x in jax.tree_util.tree_leaves(eng.params))
 
-    def top_up():
+    def top_up(max_new=None, stamp=False):
+        max_new = max_new if max_new is not None \
+            else max_len - prompt_len - 1
         while len(eng._slots) + len(eng._waiting) < num_slots:
-            eng.submit(list(range(1, prompt_len + 1)),
-                       max_new_tokens=max_len - prompt_len - 1)
+            rid = eng.submit(list(range(1, prompt_len + 1)),
+                             max_new_tokens=max_new)
+            if stamp:
+                submit_ts[rid] = time.perf_counter()
 
-    # Warm: compile prefill + tick, reach steady state.
+    # Phase 1 — compile warm-up: a full admission burst + tick shapes.
+    top_up(max_new=2)
+    while eng.has_work():
+        eng.step()
+
+    # Phase 2 — churn (timed): short generations at full admission
+    # pressure. The steady-state window below never frees a slot, so
+    # TTFT (queueing included) and prefill throughput are measured here.
+    ttft_s.clear()
+    submit_ts.clear()
+    prefill_tokens0 = eng.prefill_tokens
+    prefill_seconds0 = eng.prefill_seconds
+    for _ in range(2 * num_slots):
+        rid = eng.submit(list(range(1, prompt_len + 1)), max_new_tokens=4)
+        submit_ts[rid] = time.perf_counter()
+    while eng.has_work():
+        eng.step()
+    prefill_tokens = eng.prefill_tokens - prefill_tokens0
+    # Denominator is the engine's own dispatch->sync prefill interval, so
+    # a decode-tick regression cannot masquerade as a prefill one.
+    prefill_wall = max(eng.prefill_seconds - prefill_seconds0, 1e-9)
+
+    # Phase 3 — steady-state decode at full occupancy. No per-tick
+    # device sync: the buffered engine's whole point is overlapping
+    # fetches with compute, so the wall clock over the window is the
+    # honest measure.
     top_up()
     for _ in range(5):
         eng.step()
         top_up()
-
-    # Timed region at full occupancy. No per-tick device sync: the
-    # buffered engine's whole point is overlapping fetches with compute,
-    # so the wall clock over the window is the honest measure.
     t0 = time.perf_counter()
     for _ in range(ticks):
         top_up()
@@ -88,12 +135,20 @@ def main() -> None:
 
     # Roofline: params + average live KV prefix, read once per tick.
     avg_pos = (prompt_len + max_len) / 2
+    kv_itemsize = jnp.dtype(config.dtype).itemsize
     kv_bytes = (num_slots * avg_pos * config.num_layers
-                * 2 * config.num_kv_heads * config.head_dim * 2)
+                * 2 * config.num_kv_heads * config.head_dim * kv_itemsize)
     bw = _hbm_bw(jax.devices()[0])
     roofline = num_slots * bw / (param_bytes + kv_bytes)
     criterion = 0.10 * roofline
+    # What one tick SHOULD read at minimum (kernel on: params once + live
+    # KV once in storage dtype). The reference XLA path reads the KV pool
+    # twice per layer in fp32 (QK^T and PV upcasts) — ~4x kv_bytes —
+    # which is exactly the traffic the fused kernel removes; comparing
+    # hbm_efficiency against this floor attributes a regression.
+    bytes_read_per_tick = param_bytes + kv_bytes
 
+    ttft_sorted = sorted(ttft_s)
     out = {
         "metric": "decode_tokens_per_s_per_chip",
         "value": round(tokens_per_s, 1),
@@ -102,6 +157,12 @@ def main() -> None:
         "roofline_tokens_per_s": round(roofline, 1),
         "hbm_efficiency": round(tokens_per_s / roofline, 3),
         "mean_tick_ms": round(med * 1e3, 2),
+        "ttft_p50_ms": round(_pct(ttft_sorted, 0.50) * 1e3, 2),
+        "ttft_p95_ms": round(_pct(ttft_sorted, 0.95) * 1e3, 2),
+        "ttft_samples": len(ttft_sorted),
+        "prefill_tokens_per_s": round(prefill_tokens / prefill_wall, 1),
+        "bytes_read_per_tick_est": int(bytes_read_per_tick),
+        "decode_kernel": eng.use_decode_kernel,
         "num_slots": num_slots,
         "sync_every": sync_every,
         "param_bytes": param_bytes,

@@ -164,3 +164,19 @@ CB_DECODE_TOKENS = Counter(
     "ray_tpu_cb_decode_tokens_total",
     "Tokens produced by the continuous-batching decode loop",
     ("engine",))
+CB_TICK_MS = Histogram(
+    "ray_tpu_cb_tick_ms",
+    "Wall milliseconds per decode tick (dispatch+compute+fetch with "
+    "per-tick sync; dispatch only when speculative buffering overlaps "
+    "the fetch)",
+    boundaries=(0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0,
+                500.0, 1000.0),
+    tag_keys=("engine",))
+CB_PREFILL_REQUESTS = Counter(
+    "ray_tpu_cb_prefill_requests_total",
+    "Requests admitted into KV slots via (batched bucketed) prefill",
+    ("engine",))
+CB_PREFILL_TOKENS = Counter(
+    "ray_tpu_cb_prefill_tokens_total",
+    "Prompt tokens prefilled (true lengths; bucket padding excluded)",
+    ("engine",))

@@ -70,7 +70,13 @@ class ContinuousLlamaDeployment:
 
     def __init__(self, config: Optional[llama.LlamaConfig] = None,
                  params=None, num_slots: int = 8, max_len: int = 512,
-                 eos_token: Optional[int] = None):
+                 eos_token: Optional[int] = None, sync_every: int = 1,
+                 use_decode_kernel: Optional[bool] = None):
+        """Engine knobs (``num_slots``, ``max_len``, ``sync_every``,
+        ``use_decode_kernel``) pass straight to the ContinuousBatcher and
+        are overridable per-deploy via the serve config ``init_kwargs``
+        (see serve/config.py) — no application-module edits to retune a
+        replica."""
         import queue
         import threading
 
@@ -84,7 +90,8 @@ class ContinuousLlamaDeployment:
         self.batcher = ContinuousBatcher(
             self.config, params=params, num_slots=num_slots,
             max_len=max_len, eos_token=eos_token,
-            token_callback=self._on_token)
+            token_callback=self._on_token, sync_every=sync_every,
+            use_decode_kernel=use_decode_kernel)
         threading.Thread(target=self._tick_loop, daemon=True,
                          name="llm-ticks").start()
 
@@ -159,9 +166,14 @@ class ContinuousLlamaDeployment:
 
 def build_continuous_llama_app(config: Optional[llama.LlamaConfig] = None,
                                num_replicas: int = 1, num_slots: int = 8,
-                               max_len: int = 512):
+                               max_len: int = 512, sync_every: int = 1,
+                               use_decode_kernel: Optional[bool] = None):
     dep = ContinuousLlamaDeployment.options(num_replicas=num_replicas)
-    return dep.bind(config, None, num_slots, max_len)
+    # Keyword bind so per-deploy ``init_kwargs`` overrides (serve config
+    # files) can retarget any engine knob without positional conflicts.
+    return dep.bind(config=config, num_slots=num_slots, max_len=max_len,
+                    sync_every=sync_every,
+                    use_decode_kernel=use_decode_kernel)
 
 
 __all__ += ["ContinuousLlamaDeployment", "build_continuous_llama_app"]

@@ -20,6 +20,7 @@ overwrites position ``p`` before attending ``[0..p]``.
 from __future__ import annotations
 
 import itertools
+import time
 from collections import deque
 from functools import partial
 from typing import Any, Dict, List, Optional
@@ -29,8 +30,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.models import llama
-from ray_tpu.models.inference import KVCache, _forward_cached
+from ray_tpu.models.inference import KVCache, _forward_cached, lm_head_logits
 from ray_tpu.models.llama import rms_norm
+from ray_tpu.ops.decode_attention import (decode_applicable,
+                                          decode_attention,
+                                          decode_attention_reference,
+                                          env_flag)
 from ray_tpu.ops.rope import rope_frequencies
 
 
@@ -55,31 +60,20 @@ def _scatter_slot(cache, new, positions):
     return jax.vmap(one)(cache, new, positions)
 
 
-def _attend_decode(q, cache_k, cache_v, positions, scale):
-    """Single-token attention with per-slot positions.
-
-    q [B, H, D]; cache [B, S_max, KVH, D]; positions [B] (the absolute
-    position each slot's query occupies).
-    """
-    b, hq, d = q.shape
-    s_max, hkv = cache_k.shape[1], cache_k.shape[2]
-    group = hq // hkv
-    qg = q.reshape(b, hkv, group, d).astype(jnp.float32)
-    logits = jnp.einsum("bhgd,bkhd->bhgk", qg,
-                        cache_k.astype(jnp.float32)) * scale
-    slots = jnp.arange(s_max)
-    mask = positions[:, None] >= slots[None, :]             # [B, S_max]
-    logits = jnp.where(mask[:, None, None, :], logits, -1e30)
-    probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhgk,bkhd->bhgd", probs,
-                     cache_v.astype(jnp.float32))
-    return out.reshape(b, hq, d).astype(q.dtype)
+# The XLA reference single-query attention now lives next to the fused
+# kernel (ops/decode_attention.py); keep the old name importable — it is
+# the parity baseline the kernel tests compare against.
+_attend_decode = decode_attention_reference
 
 
 def _decode_tick(params, tokens, positions, cache: KVCache,
-                 config: llama.LlamaConfig):
+                 config: llama.LlamaConfig, use_kernel: bool = False):
     """One decode step for every slot: tokens [B] at per-slot absolute
-    ``positions`` [B]. Returns (logits [B, V], cache)."""
+    ``positions`` [B]. Returns (logits [B, V], cache).
+
+    ``use_kernel`` (static) routes attention through the fused pallas
+    decode kernel — one pass over the KV pool in its storage dtype —
+    instead of the fp32-upcast whole-cache einsums of the reference."""
     c = config
     cos, sin = rope_frequencies(c.head_dim, 0, c.rope_theta,
                                 positions=positions)  # [B, D//2]
@@ -104,7 +98,8 @@ def _decode_tick(params, tokens, positions, cache: KVCache,
         k = _apply_rope_batched(k, cos, sin)
         ck = _scatter_slot(ck, k[:, 0].astype(ck.dtype), positions)
         cv = _scatter_slot(cv, v[:, 0].astype(cv.dtype), positions)
-        o = _attend_decode(q[:, 0], ck, cv, positions, scale)
+        o = decode_attention(q[:, 0], ck, cv, positions, scale,
+                             use_kernel=use_kernel)
         ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, li, 0)
         cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, li, 0)
         x = x + jnp.einsum("bhd,hde->be", o,
@@ -119,8 +114,9 @@ def _decode_tick(params, tokens, positions, cache: KVCache,
     (x, new_k, new_v, _), _ = jax.lax.scan(
         layer_fn, (x, cache.k, cache.v, jnp.int32(0)), params["layers"])
     x = rms_norm(x, params["final_norm"], c.rms_eps)
-    logits = jnp.einsum("bse,ev->bsv", x.astype(jnp.float32),
-                        params["lm_head"].astype(jnp.float32))
+    # lm_head in the params' storage dtype with fp32 accumulation (shared
+    # with the prefill path) — bf16 params are no longer upcast in HBM.
+    logits = lm_head_logits(x, params, c)
     # Greedy selection stays ON DEVICE: the host needs 4 bytes per slot,
     # not the [B, V] logits — shipping full logits per tick was the
     # serving bottleneck on remote-attached chips (512KB x RTT per token).
@@ -135,6 +131,28 @@ def _bucket(n: int, floor: int = 16) -> int:
     return b
 
 
+def _resolve_decode_kernel(config: llama.LlamaConfig, max_len: int,
+                           use_decode_kernel: Optional[bool]) -> bool:
+    """Engine-level kernel toggle: explicit arg > RAY_TPU_DECODE_KERNEL
+    env > auto (fused kernel on TPU when the shapes tile; the XLA
+    reference elsewhere — CPU tests opt in explicitly and run the kernel
+    in interpret mode)."""
+    from ray_tpu.ops.decode_attention import pltpu as _pltpu
+
+    if _pltpu is None:
+        # No pallas TPU support in this jax build: the dispatcher would
+        # silently run the reference, so report the truth.
+        return False
+    if use_decode_kernel is None:
+        use_decode_kernel = env_flag("RAY_TPU_DECODE_KERNEL")
+    if use_decode_kernel is None:
+        return (jax.default_backend() == "tpu"
+                and decode_applicable(max_len, config.head_dim,
+                                      config.num_heads,
+                                      config.num_kv_heads))
+    return bool(use_decode_kernel)
+
+
 class ContinuousBatcher:
     """Iteration-level scheduler over a fixed pool of KV-cache slots."""
 
@@ -143,7 +161,8 @@ class ContinuousBatcher:
     def __init__(self, config: llama.LlamaConfig, params=None,
                  num_slots: int = 8, max_len: int = 512, seed: int = 0,
                  eos_token: Optional[int] = None, token_callback=None,
-                 sync_every: int = 1):
+                 sync_every: int = 1,
+                 use_decode_kernel: Optional[bool] = None):
         """``token_callback(rid, token)`` fires for every generated token
         as it is produced (serving streams ride this).
 
@@ -156,12 +175,26 @@ class ContinuousBatcher:
         host bookkeeping speculatively; when a request finishes, the
         engine rewinds to host-known state and redoes ≤2K ticks (freed
         slots need re-admission). Outputs are bit-identical to
-        ``sync_every=1``; only finish *detection* lags."""
+        ``sync_every=1``; only finish *detection* lags.
+
+        ``use_decode_kernel`` routes decode attention through the fused
+        pallas kernel (``ops/decode_attention.py``); ``None`` resolves
+        via ``RAY_TPU_DECODE_KERNEL`` then auto (TPU with tiling shapes).
+        Outputs are bit-identical kernel on/off."""
         self.config = config
         self.num_slots = num_slots
         self.max_len = max_len
         self.eos_token = eos_token
         self.sync_every = max(1, int(sync_every))
+        self.use_decode_kernel = _resolve_decode_kernel(
+            config, max_len, use_decode_kernel)
+        # Prefill accounting (bench_serve.py reads these; the metric
+        # counters mirror them into the TSDB).
+        self.prefill_batches = 0
+        self.prefill_requests = 0
+        self.prefill_tokens = 0
+        self.prefill_seconds = 0.0          # dispatch->first-token sync
+        self._prefill_shapes: set = set()   # (N_pad, L_pad) compiled
         self._buf: List[Any] = []       # unstacked device token vectors
         self._pending: Optional[tuple] = None  # (stacked, [(slot, rid)])
         self.params = params if params is not None else llama.init_params(
@@ -188,30 +221,46 @@ class ContinuousBatcher:
                        f"slots{num_slots}-{next(self._engine_ids)}"}
         cfg = config
 
+        use_kernel = self.use_decode_kernel
+
         @partial(jax.jit, donate_argnums=(2,))
-        def prefill(params, tokens, cache, slot):
-            # Slot extraction + write-back live INSIDE the jit with the
-            # pooled cache donated, so admission is an in-place update
-            # rather than eager whole-cache copies.
+        def prefill(params, tokens, cache, slots, last_idx):
+            # BATCHED BUCKETED PREFILL: tokens [N, L] holds N same-bucket
+            # prompts destined for KV slots ``slots`` [N]; ``last_idx``
+            # [N] is each prompt's true_len - 1. Slot gather + write-back
+            # live INSIDE the jit with the pooled cache donated, so an
+            # admission burst is one in-place program, not N whole-cache
+            # copies. Only the N first tokens leave the device (argmax on
+            # chip), not [N, L, V] logits.
             positions = jnp.arange(tokens.shape[1])
-            slot_cache = KVCache(
-                k=jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, 1),
-                v=jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, 1))
+            slot_cache = KVCache(k=jnp.take(cache.k, slots, axis=1),
+                                 v=jnp.take(cache.v, slots, axis=1))
             logits, sc = _forward_cached(params, tokens, positions,
                                          slot_cache, cfg)
-            cache = KVCache(
-                k=jax.lax.dynamic_update_slice_in_dim(cache.k, sc.k,
-                                                      slot, 1),
-                v=jax.lax.dynamic_update_slice_in_dim(cache.v, sc.v,
-                                                      slot, 1))
-            return logits, cache
+            cache = KVCache(k=cache.k.at[:, slots].set(sc.k),
+                            v=cache.v.at[:, slots].set(sc.v))
+            last = jnp.take_along_axis(
+                logits, last_idx[:, None, None], axis=1)[:, 0]   # [N, V]
+            first = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            return first, cache
 
         @partial(jax.jit, donate_argnums=(3,))
         def tick(params, tokens, positions, cache):
-            return _decode_tick(params, tokens, positions, cache, cfg)
+            return _decode_tick(params, tokens, positions, cache, cfg,
+                                use_kernel=use_kernel)
 
         self._prefill = prefill
         self._tick = tick
+
+    def prefill_cache_misses(self) -> int:
+        """Compiled prefill program count (one per (N, bucket) shape) —
+        the admission-burst acceptance check reads this. Prefers jax's
+        real jit-cache counter (private API); falls back to the shapes
+        this engine dispatched if a jax upgrade drops it."""
+        cache_size = getattr(self._prefill, "_cache_size", None)
+        if cache_size is not None:
+            return cache_size()
+        return len(self._prefill_shapes)
 
     # ---------------------------------------------------------------- api
     def submit(self, prompt_tokens: List[int],
@@ -272,29 +321,66 @@ class ContinuousBatcher:
                     or self._buf or self._pending)
 
     def _admit(self) -> None:
+        if not (self._waiting and self._free):
+            return
+        from ray_tpu._private import metrics_defs as mdefs
+
+        # Drain every admissible request FIRST, grouped by power-of-two
+        # bucket (compile reuse, never beyond the cache length), so an
+        # admission burst costs one prefill dispatch per bucket instead
+        # of one per request. Slots are independent, so batched admission
+        # is bit-identical to the old one-at-a-time loop.
+        groups: Dict[int, List] = {}
         while self._waiting and self._free:
             req = self._waiting.popleft()
             slot = self._free.pop()
-            prompt = req["prompt"]
-            true_len = len(prompt)
-            # Bucket for compile reuse, but never beyond the cache length.
-            padded_len = min(_bucket(true_len), self.max_len)
-            padded = prompt + [0] * (padded_len - true_len)
-            tokens = jnp.asarray([padded], jnp.int32)
-            logits, self.cache = self._prefill(self.params, tokens,
-                                               self.cache, slot)
-            first = int(jnp.argmax(logits[0, true_len - 1]))
-            if self.token_callback is not None:
-                self.token_callback(req["rid"], first)
-            out = [first]
-            self._slots[slot] = {
-                "rid": req["rid"], "out": out,
-                "max_new": req["max_new"],
-                "pos": true_len,       # next decode writes here
-                "last": first,
-            }
-            self._dirty = True  # device tokens/positions need re-upload
-            self._maybe_finish(slot)
+            padded_len = min(_bucket(len(req["prompt"])), self.max_len)
+            groups.setdefault(padded_len, []).append((req, slot))
+        for padded_len, group in groups.items():
+            n = len(group)
+            # The batch dim buckets to a power of two as well, so the
+            # compiled prefill program count stays log(N) x log(L).
+            # Padding rows REPEAT the last request: a duplicate slot
+            # index in the scatter writes byte-identical KV twice, which
+            # is well-defined; the duplicate's first token is dropped.
+            n_pad = min(_bucket(n, floor=1), self.num_slots)
+            tokens = np.zeros((n_pad, padded_len), np.int32)
+            slots = np.zeros(n_pad, np.int32)
+            last_idx = np.zeros(n_pad, np.int32)
+            for i in range(n_pad):
+                req, slot = group[min(i, n - 1)]
+                prompt = req["prompt"]
+                tokens[i, :len(prompt)] = prompt
+                slots[i] = slot
+                last_idx[i] = len(prompt) - 1
+            t0 = time.perf_counter()
+            first, self.cache = self._prefill(
+                self.params, jnp.asarray(tokens), self.cache,
+                jnp.asarray(slots), jnp.asarray(last_idx))
+            first = np.asarray(first)            # N ints, one transfer
+            # The fetch syncs the dispatch, so this interval is the real
+            # prefill cost — bench_serve derives prefill tokens/s from
+            # it without decode/queueing time polluting the denominator.
+            self.prefill_seconds += time.perf_counter() - t0
+            self._prefill_shapes.add((n_pad, padded_len))
+            true_tokens = int(last_idx[:n].sum()) + n
+            self.prefill_batches += 1
+            self.prefill_requests += n
+            self.prefill_tokens += true_tokens
+            mdefs.CB_PREFILL_REQUESTS.inc(n, tags=self._mtags)
+            mdefs.CB_PREFILL_TOKENS.inc(true_tokens, tags=self._mtags)
+            for (req, slot), tok in zip(group, first):
+                tok = int(tok)
+                if self.token_callback is not None:
+                    self.token_callback(req["rid"], tok)
+                self._slots[slot] = {
+                    "rid": req["rid"], "out": [tok],
+                    "max_new": req["max_new"],
+                    "pos": len(req["prompt"]),   # next decode writes here
+                    "last": tok,
+                }
+                self._maybe_finish(slot)
+        self._dirty = True  # device tokens/positions need re-upload
 
     def _maybe_finish(self, slot: int) -> None:
         st = self._slots.get(slot)
@@ -359,10 +445,15 @@ class ContinuousBatcher:
             if self._slots:
                 if self._dirty:
                     self._upload_state()
+                t0 = time.perf_counter()
                 self._d_tokens, self._d_positions, self.cache = self._tick(
                     self.params, self._d_tokens, self._d_positions,
                     self.cache)
                 nxt = np.asarray(self._d_tokens)  # 4 bytes/slot
+                # Per-tick sync: the fetch IS the device sync, so this is
+                # the honest tick latency (dispatch + compute + fetch).
+                mdefs.CB_TICK_MS.observe(
+                    (time.perf_counter() - t0) * 1e3, tags=self._mtags)
                 if self._apply_tokens(
                         [nxt], [(s, st["rid"])
                                 for s, st in self._slots.items()]):
@@ -379,8 +470,16 @@ class ContinuousBatcher:
         if self._slots:
             if self._dirty and not self._buf and self._pending is None:
                 self._upload_state()
+            from ray_tpu._private import metrics_defs as mdefs
+
+            t0 = time.perf_counter()
             self._d_tokens, self._d_positions, self.cache = self._tick(
                 self.params, self._d_tokens, self._d_positions, self.cache)
+            # Buffered mode overlaps fetches with compute, so this is
+            # dispatch time only; steady-state backpressure still makes
+            # the histogram track the real tick cadence.
+            mdefs.CB_TICK_MS.observe(
+                (time.perf_counter() - t0) * 1e3, tags=self._mtags)
             self._buf.append(self._d_tokens)
         want_admit = bool(self._waiting and self._free)
         if len(self._buf) >= self.sync_every or want_admit or (

@@ -85,6 +85,22 @@ def _block(x, layer, cache_k, cache_v, positions, cos, sin, c):
     return x, cache_k, cache_v
 
 
+def lm_head_logits(x, params, config: llama.LlamaConfig):
+    """Final-norm hidden states [B, S, E] -> fp32 logits [B, S, V].
+
+    The projection runs in the params' storage dtype (bf16 on TPU) with
+    fp32 MXU accumulation (``preferred_element_type``) instead of
+    materializing an fp32 upcast of the lm_head — at decode batch sizes
+    the head read dominates the tick's non-KV bytes, so this halves it.
+    Greedy argmax over the result must stay bit-stable vs the fp32 path
+    (tests/test_continuous_batching.py::test_bf16_lm_head_argmax_parity).
+    """
+    c = config
+    return jnp.einsum("bse,ev->bsv", x.astype(c.dtype),
+                      params["lm_head"].astype(c.dtype),
+                      preferred_element_type=jnp.float32)
+
+
 def _forward_cached(params, tokens, positions, cache: KVCache,
                     config: llama.LlamaConfig):
     """tokens [B, S] at absolute ``positions`` [S]; returns (logits, cache)."""
@@ -102,8 +118,7 @@ def _forward_cached(params, tokens, positions, cache: KVCache,
     x, (new_k, new_v) = jax.lax.scan(
         layer_fn, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"], c.rms_eps)
-    logits = jnp.einsum("bse,ev->bsv", x.astype(jnp.float32),
-                        params["lm_head"].astype(jnp.float32))
+    logits = lm_head_logits(x, params, c)
     return logits, KVCache(k=new_k, v=new_v)
 
 

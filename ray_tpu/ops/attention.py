@@ -33,6 +33,8 @@ try:  # pltpu is importable on CPU builds of jax as well
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from ray_tpu.ops.decode_attention import _interpret_default
+
 DEFAULT_MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
@@ -377,7 +379,9 @@ def flash_attention(
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # RAY_TPU_PALLAS_INTERPRET overrides (the pallas_interpret test
+        # fixture), else interpret everywhere but real TPU.
+        interpret = _interpret_default()
 
     # Kernels use [B, H, S, D].
     qt = q.transpose(0, 2, 1, 3)

@@ -914,7 +914,8 @@ class Deployment:
                  max_ongoing_requests: int = 100,
                  ray_actor_options: Optional[Dict] = None,
                  autoscaling_config: Optional[Dict[str, Any]] = None,
-                 placement_strategy: Optional[str] = None):
+                 placement_strategy: Optional[str] = None,
+                 init_kwargs: Optional[Dict[str, Any]] = None):
         self._cls_or_fn = cls_or_fn
         self.name = name
         self.num_replicas = num_replicas
@@ -922,6 +923,11 @@ class Deployment:
         self.ray_actor_options = ray_actor_options or {}
         self.autoscaling_config = autoscaling_config
         self.placement_strategy = placement_strategy
+        # Constructor overrides merged over bind() kwargs at deploy time:
+        # config-file deploys tune replica knobs (e.g. the LLM engine's
+        # num_slots / sync_every / use_decode_kernel) without editing the
+        # application module.
+        self.init_kwargs = dict(init_kwargs or {})
 
     def options(self, *, num_replicas: Optional[Any] = None,
                 name: Optional[str] = None,
@@ -929,6 +935,7 @@ class Deployment:
                 autoscaling_config: Optional[Dict[str, Any]] = None,
                 placement_strategy: Optional[str] = None,
                 ray_actor_options: Optional[Dict] = None,
+                init_kwargs: Optional[Dict[str, Any]] = None,
                 **_) -> "Deployment":
         return Deployment(
             self._cls_or_fn, name or self.name,
@@ -938,7 +945,8 @@ class Deployment:
             else self.ray_actor_options,
             autoscaling_config if autoscaling_config is not None
             else self.autoscaling_config,
-            placement_strategy or self.placement_strategy)
+            placement_strategy or self.placement_strategy,
+            init_kwargs if init_kwargs is not None else self.init_kwargs)
 
     def bind(self, *args, **kwargs) -> Application:
         return Application(self, args, kwargs)
@@ -1010,6 +1018,37 @@ def _deploy_application(controller, app: Application,
                  for a in app.args)
     kwargs = {k: _resolve_bound_args(controller, v, deployed)
               for k, v in app.kwargs.items()}
+    if dep.init_kwargs:
+        # Config overrides win over bind(). Rebind positional bind()
+        # args by name first, so overriding e.g. a positionally-bound
+        # num_slots retunes it instead of crashing the replica with a
+        # duplicate-argument TypeError.
+        try:
+            sig = inspect.signature(dep._cls_or_fn)
+        except (TypeError, ValueError):   # C callables etc.
+            sig = None
+        var_kw = None if sig is None else next(
+            (p.name for p in sig.parameters.values()
+             if p.kind is inspect.Parameter.VAR_KEYWORD), None)
+        if sig is not None and var_kw is None:
+            unknown = set(dep.init_kwargs) - set(sig.parameters)
+            if unknown:
+                raise ValueError(
+                    f"init_kwargs {sorted(unknown)} not accepted by "
+                    f"{dep.name}'s constructor")
+        try:
+            bound = sig.bind_partial(*args, **kwargs)
+            for key, value in dep.init_kwargs.items():
+                if key in sig.parameters and key != var_kw:
+                    bound.arguments[key] = value
+                else:
+                    # **kwargs catch-all: BoundArguments nests extras
+                    # under the VAR_KEYWORD parameter; top-level keys
+                    # would be silently dropped.
+                    bound.arguments.setdefault(var_kw, {})[key] = value
+            args, kwargs = bound.args, dict(bound.kwargs)
+        except (TypeError, AttributeError):   # sig None / args mismatch
+            kwargs = {**kwargs, **dep.init_kwargs}
     is_function = not inspect.isclass(dep._cls_or_fn)
     ray_tpu.get(controller.deploy.remote(
         dep.name, dep._cls_or_fn, args, kwargs, dep.num_replicas,

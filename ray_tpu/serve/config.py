@@ -17,6 +17,9 @@ Config shape::
             num_replicas: 3
             max_ongoing_requests: 8
             ray_actor_options: {num_cpus: 1}
+            init_kwargs:             # constructor overrides, merged over
+              num_slots: 16          # bind() kwargs (e.g. the continuous
+              sync_every: 8          # -batching engine knobs)
 """
 
 from __future__ import annotations
@@ -30,7 +33,7 @@ logger = logging.getLogger(__name__)
 
 _OVERRIDABLE = ("num_replicas", "max_ongoing_requests",
                 "autoscaling_config", "placement_strategy",
-                "ray_actor_options")
+                "ray_actor_options", "init_kwargs")
 
 
 def _load_import_path(import_path: str):
@@ -58,7 +61,8 @@ def _apply_overrides(deployment, overrides: Dict[str, Any]):
                              f"(supported: {_OVERRIDABLE})")
         if key in ("num_replicas", "max_ongoing_requests"):
             kwargs[key] = int(value)
-        elif key in ("autoscaling_config", "ray_actor_options"):
+        elif key in ("autoscaling_config", "ray_actor_options",
+                     "init_kwargs"):
             kwargs[key] = dict(value)
         else:
             kwargs[key] = value

@@ -52,6 +52,20 @@ def shutdown_only():
     ray_tpu.shutdown()
 
 
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    """Force pallas kernels into interpret mode so TPU kernel tests run
+    under tier-1 (``JAX_PLATFORMS=cpu``) without TPU-only skips.
+
+    The ops dispatchers (``ops/decode_attention.py``) resolve
+    ``interpret=None`` via ``RAY_TPU_PALLAS_INTERPRET`` before falling
+    back to backend detection, so this works on CPU (where it is also
+    the backend default) AND pins interpret mode on a TPU host — kernel
+    tests behave identically everywhere."""
+    monkeypatch.setenv("RAY_TPU_PALLAS_INTERPRET", "1")
+    yield
+
+
 @pytest.fixture(scope="session")
 def cpu_mesh8():
     """8-device CPU mesh for sharding tests."""

@@ -211,6 +211,141 @@ def test_buffered_cancel_last_request_does_not_wedge(setup):
     assert rid2 in out and len(out[rid2]) == 3
 
 
+# ------------------------------------- fused decode kernel / batched prefill
+
+def test_decode_kernel_on_off_bit_identical(setup, pallas_interpret):
+    """The fused pallas decode kernel (interpret mode on CPU) produces
+    token-for-token identical greedy output to the XLA reference path,
+    and to the sequential generator."""
+    config, gen, _ = setup
+    rng = np.random.default_rng(11)
+    reqs = [(list(rng.integers(1, 250, size=n)), m)
+            for n, m in [(5, 7), (9, 4), (17, 6)]]
+    results = {}
+    for use_kernel in (False, True):
+        eng = ContinuousBatcher(config, params=gen.params, num_slots=2,
+                                max_len=128, use_decode_kernel=use_kernel)
+        assert eng.use_decode_kernel is use_kernel
+        rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+        out = eng.run_to_completion()
+        results[use_kernel] = [out[r] for r in rids]
+    assert results[True] == results[False]
+    for (prompt, m), toks in zip(reqs, results[True]):
+        assert toks == _reference(gen, prompt, m)
+
+
+def test_decode_kernel_across_sync_every(setup, pallas_interpret):
+    """Kernel on, sync_every in {1, K}: speculative buffered decode must
+    stay bit-identical with the fused kernel in the tick."""
+    config, gen, _ = setup
+    rng = np.random.default_rng(12)
+    reqs = [(list(rng.integers(1, 250, size=n)), m)
+            for n, m in [(4, 9), (12, 5)]]
+    results = {}
+    for sync_every in (1, 4):
+        eng = ContinuousBatcher(config, params=gen.params, num_slots=2,
+                                max_len=128, sync_every=sync_every,
+                                use_decode_kernel=True)
+        rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+        out = eng.run_to_completion()
+        results[sync_every] = [out[r] for r in rids]
+    assert results[1] == results[4]
+    for (prompt, m), toks in zip(reqs, results[1]):
+        assert toks == _reference(gen, prompt, m)
+
+
+def test_burst_admission_is_one_prefill_program(setup):
+    """A burst of same-bucket requests admits in ONE batched prefill
+    dispatch (not one per request), the batch dim buckets to a power of
+    two so compiled program count stays logarithmic, and outputs are
+    identical to one-at-a-time admission."""
+    config, gen, _ = setup
+    rng = np.random.default_rng(13)
+    prompts = [list(rng.integers(1, 250, size=n)) for n in (5, 9, 12, 7)]
+
+    eng = ContinuousBatcher(config, params=gen.params, num_slots=4,
+                            max_len=128)
+    rids = [eng.submit(p, max_new_tokens=3) for p in prompts]  # one bucket
+    assert eng.prefill_batches == 0
+    eng.step()
+    assert eng.prefill_batches == 1, "burst took >1 prefill dispatch"
+    assert eng.prefill_requests == 4
+    assert eng.prefill_tokens == sum(len(p) for p in prompts)
+    assert eng.prefill_cache_misses() == 1
+    burst_out = eng.run_to_completion()
+
+    # A 3-request burst pads its batch dim to 4 and REUSES the compiled
+    # [4, 16] program: no new jit cache miss.
+    for p in prompts[:3]:
+        eng.submit(p, max_new_tokens=2)
+    eng.step()
+    assert eng.prefill_batches == 2
+    assert eng.prefill_cache_misses() == 1, "N-bucketing failed to reuse"
+    burst_out.update(eng.run_to_completion())
+
+    # One-at-a-time admission (a step between submits => burst of 1).
+    seq = ContinuousBatcher(config, params=gen.params, num_slots=4,
+                            max_len=128)
+    seq_out = {}
+    for p in prompts:
+        rid = seq.submit(p, max_new_tokens=3)
+        seq.step()
+        seq_out[rid] = None
+        while seq.has_work():
+            out = seq.step()
+            for r in out:
+                seq_out[r] = out[r]
+    seq_toks = list(seq_out.values())
+    assert [burst_out[r] for r in rids] == seq_toks
+    for p, toks in zip(prompts, seq_toks):
+        assert toks == _reference(gen, p, 3)
+    # Singleton admissions share one compiled [1, 16] program.
+    assert seq.prefill_cache_misses() == 1
+
+
+def test_mixed_bucket_burst_admits_per_bucket(setup):
+    """Requests spanning two length buckets admit in exactly two batched
+    dispatches, results still exact."""
+    config, gen, _ = setup
+    rng = np.random.default_rng(14)
+    short = [list(rng.integers(1, 250, size=n)) for n in (5, 9)]    # 16
+    long = [list(rng.integers(1, 250, size=n)) for n in (20, 25)]   # 32
+    eng = ContinuousBatcher(config, params=gen.params, num_slots=4,
+                            max_len=128)
+    rids = [eng.submit(p, max_new_tokens=3) for p in short + long]
+    eng.step()
+    assert eng.prefill_batches == 2
+    assert eng.prefill_requests == 4
+    out = eng.run_to_completion()
+    for p, rid in zip(short + long, rids):
+        assert out[rid] == _reference(gen, p, 3)
+
+
+def test_bf16_lm_head_argmax_parity():
+    """lm_head in bf16 with fp32 accumulation picks the SAME greedy token
+    as the old fp32-upcast projection on a seeded model — the decode
+    de-fattening must not change sampled text."""
+    import jax
+
+    from ray_tpu.models.inference import lm_head_logits
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.bfloat16)
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    rng = np.random.default_rng(5)
+    tokens = jnp.asarray(rng.integers(1, cfg.vocab_size, size=(4, 16)),
+                         jnp.int32)
+    # Stand-in final hidden states: embeddings are the same scale/dtype
+    # the final norm emits.
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    new = lm_head_logits(x, params, cfg)
+    old = jnp.einsum("bse,ev->bsv", x.astype(jnp.float32),
+                     params["lm_head"].astype(jnp.float32))
+    assert new.dtype == jnp.float32
+    np.testing.assert_array_equal(
+        np.asarray(jnp.argmax(new, axis=-1)),
+        np.asarray(jnp.argmax(old, axis=-1)))
+
+
 def test_buffered_admission_not_starved(setup):
     """A request submitted mid-pipeline with a free slot must join within
     ~2K ticks, not wait for the running request to finish."""

@@ -152,6 +152,42 @@ def test_declarative_deploy_from_yaml(tmp_path, ingress):
         sys.path.remove(str(tmp_path))
 
 
+def test_declarative_init_kwargs_override(tmp_path, ingress):
+    """``init_kwargs`` in a config file retunes replica constructor knobs
+    (the LLM engine's num_slots / sync_every ride this) without editing
+    the application module."""
+    http_port, _ = ingress
+    app_py = tmp_path / "my_knob_app.py"
+    app_py.write_text(
+        "from ray_tpu import serve\n"
+        "@serve.deployment\n"
+        "class Knobbed:\n"
+        "    def __init__(self, num_slots=8, sync_every=1):\n"
+        "        self.num_slots = num_slots\n"
+        "        self.sync_every = sync_every\n"
+        "    def __call__(self, payload):\n"
+        "        return {'num_slots': self.num_slots,\n"
+        "                'sync_every': self.sync_every}\n")
+    cfg = tmp_path / "knob_config.yaml"
+    cfg.write_text(
+        "applications:\n"
+        "  - import_path: my_knob_app:Knobbed\n"
+        "    deployments:\n"
+        "      - name: Knobbed\n"
+        "        init_kwargs: {num_slots: 16, sync_every: 8}\n")
+    import sys
+
+    sys.path.insert(0, str(tmp_path))
+    try:
+        serve.deploy_config_file(str(cfg))
+        conn = http.client.HTTPConnection("127.0.0.1", http_port)
+        resp, body = _post(conn, "/Knobbed", {})
+        assert json.loads(body) == {"num_slots": 16, "sync_every": 8}
+        conn.close()
+    finally:
+        sys.path.remove(str(tmp_path))
+
+
 def test_rest_deploy_endpoint(tmp_path, ingress):
     """PUT /-/deploy with a YAML body deploys (reference: REST api)."""
     http_port, _ = ingress
