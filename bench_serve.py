@@ -7,8 +7,11 @@ Metric: steady-state decode tokens/sec/chip of the ContinuousBatcher
 training bench uses, all KV slots saturated. Also reported: time-to-
 first-token (submit -> first streamed token, p50/p95 over every request
 admitted during the run), prefill tokens/s, and a per-tick bytes-read
-estimate so ``hbm_efficiency`` regressions are attributable to a
-specific traffic term (params vs KV vs upcast copies).
+figure — the tick program's ``cost_analysis()`` harvested by the XLA
+monitor when the backend provides one (``bytes_read_source:
+cost_analysis``), the hand estimate otherwise — so ``hbm_efficiency``
+regressions are attributable to a specific traffic term (params vs KV
+vs upcast copies).
 
 Criterion (v5e HBM roofline): every decode tick must read the full
 parameter set plus the active KV prefixes from HBM, so
@@ -147,6 +150,17 @@ def main() -> None:
     # which is exactly the traffic the fused kernel removes; comparing
     # hbm_efficiency against this floor attributes a regression.
     bytes_read_per_tick = param_bytes + kv_bytes
+    bytes_source = "estimate"
+    # Prefer the compiler's own answer: the XLA monitor harvested the
+    # tick program's cost_analysis() at compile time (bytes accessed per
+    # invocation). The hand estimate stays as the fallback — some
+    # backends return no cost analysis.
+    from ray_tpu._private import xla_monitor
+
+    tick_stats = xla_monitor.program_stats("cb_tick") or {}
+    if tick_stats.get("bytes_accessed"):
+        bytes_read_per_tick = tick_stats["bytes_accessed"]
+        bytes_source = "cost_analysis"
 
     ttft_sorted = sorted(ttft_s)
     out = {
@@ -162,6 +176,8 @@ def main() -> None:
         "ttft_samples": len(ttft_sorted),
         "prefill_tokens_per_s": round(prefill_tokens / prefill_wall, 1),
         "bytes_read_per_tick_est": int(bytes_read_per_tick),
+        "bytes_read_source": bytes_source,
+        "tick_flops": int(tick_stats.get("flops", 0)),
         "decode_kernel": eng.use_decode_kernel,
         "num_slots": num_slots,
         "sync_every": sync_every,

@@ -105,7 +105,19 @@ class NodeAgent:
                     self.end_headers()
                     self.wfile.write(body)
                 elif self.path == "/stats":
-                    self._send(200, read_proc_stats(agent.spill_dir))
+                    stats = read_proc_stats(agent.spill_dir)
+                    try:
+                        from ray_tpu._private import xla_monitor
+
+                        # Graceful []: CPU backends report no memory
+                        # stats, and the sampler refuses to fresh-import
+                        # jax into a supervisor process.
+                        stats["devices"] = \
+                            xla_monitor.sample_device_memory(
+                                node_id=agent.node_id)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._send(200, stats)
                 elif self.path.startswith("/runtime_env/status"):
                     with agent._lock:
                         self._send(200, dict(agent._prewarm))
@@ -148,11 +160,23 @@ class NodeAgent:
 
     def _vitals_loop(self) -> None:
         from ray_tpu._private import metrics_defs as mdefs
-        from ray_tpu._private import metrics_pusher
+        from ray_tpu._private import metrics_pusher, xla_monitor
 
         tags = {"node_id": self.node_id[:12]}
         interval = metrics_pusher.push_interval_s()
+        # Device-memory vitals ride alongside host vitals. The sampler
+        # never IMPORTS jax into this process (a fresh import on a TPU
+        # host would grab the chips out from under the workers): stats
+        # flow when jax is already resident (embedded agents, CPU/GPU
+        # nodes that opted in via RAY_TPU_AGENT_DEVICE_VITALS=1); on TPU
+        # the workers' own xla_monitor publishes the per-device series.
+        force_dev = os.environ.get("RAY_TPU_AGENT_DEVICE_VITALS") == "1"
         while not self._stop_vitals.wait(interval):
+            try:
+                xla_monitor.sample_device_memory(node_id=self.node_id,
+                                                 force=force_dev)
+            except Exception:  # noqa: BLE001 — vitals are best-effort
+                pass
             try:
                 stats = read_proc_stats(self.spill_dir)
                 # `is not None`, not truthiness: a 0 reading (OOM, disk

@@ -180,3 +180,68 @@ CB_PREFILL_TOKENS = Counter(
     "ray_tpu_cb_prefill_tokens_total",
     "Prompt tokens prefilled (true lengths; bucket padding excluded)",
     ("engine",))
+
+# ------------------------------------------------- XLA plane (_private/
+# xla_monitor.py): compiles/retraces per instrumented program, compiler
+# cost analysis, and achieved throughput against it.
+XLA_COMPILES = Counter(
+    "ray_tpu_xla_compiles_total",
+    "XLA compilations of instrumented programs (one per new signature)",
+    ("program",))
+XLA_COMPILE_SECONDS = Histogram(
+    "ray_tpu_xla_compile_seconds",
+    "Wall time of one XLA compilation (lower + compile)",
+    boundaries=(0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
+    tag_keys=("program",))
+XLA_RETRACES = Counter(
+    "ray_tpu_xla_retraces_total",
+    "Recompiles of an instrumented program for an UNEXPECTED new "
+    "shape/dtype signature (bucketed growth is exempt); the offending "
+    "signature diff is logged",
+    ("program",))
+XLA_PROGRAM_FLOPS = Gauge(
+    "ray_tpu_xla_program_flops",
+    "Compiler cost-analysis FLOPs per invocation of the latest "
+    "compiled signature",
+    ("program",))
+XLA_PROGRAM_BYTES = Gauge(
+    "ray_tpu_xla_program_bytes_accessed",
+    "Compiler cost-analysis bytes accessed (HBM traffic) per invocation "
+    "of the latest compiled signature",
+    ("program",))
+XLA_ACHIEVED_FLOPS = Gauge(
+    "ray_tpu_xla_achieved_flops_per_s",
+    "Achieved FLOP/s: cost-analysis FLOPs over measured step/tick wall "
+    "time (no estimation)",
+    ("program",))
+XLA_ACHIEVED_BW = Gauge(
+    "ray_tpu_xla_achieved_bandwidth_bytes_per_s",
+    "Achieved memory bandwidth: cost-analysis bytes accessed over "
+    "measured step/tick wall time",
+    ("program",))
+XLA_MFU = Gauge(
+    "ray_tpu_xla_model_flops_utilization",
+    "Achieved FLOP/s over the chip's peak (emitted only on known "
+    "device kinds)",
+    ("program",))
+
+# --------------------------------------------- device memory vitals
+DEVICE_MEM_USED = Gauge(
+    "ray_tpu_device_mem_used_bytes",
+    "Accelerator bytes_in_use from device memory_stats() (absent on "
+    "backends without memory stats, e.g. CPU)",
+    ("node_id", "device"))
+DEVICE_MEM_PEAK = Gauge(
+    "ray_tpu_device_mem_peak_bytes",
+    "Accelerator peak_bytes_in_use from device memory_stats()",
+    ("node_id", "device"))
+DEVICE_MEM_LIMIT = Gauge(
+    "ray_tpu_device_mem_limit_bytes",
+    "Accelerator bytes_limit from device memory_stats()",
+    ("node_id", "device"))
+
+# --------------------------------------------- on-demand profiler capture
+PROFILE_CAPTURES = Counter(
+    "ray_tpu_profile_captures_total",
+    "jax.profiler trace captures executed by this process, by outcome",
+    ("status",))

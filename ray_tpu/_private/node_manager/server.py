@@ -186,10 +186,11 @@ class NodeManager:
             info.labels[k] = v
         self.labels = dict(labels or {})
         self.gcs.RegisterNode(pb.RegisterNodeRequest(info=info))
-        from ray_tpu._private import metrics_pusher
+        from ray_tpu._private import metrics_pusher, xla_monitor
 
         metrics_pusher.ensure_pusher(gcs_address,
                                      labels={"role": "node_manager"})
+        xla_monitor.connect(gcs_address, node_id=self.node_id)
         threading.Thread(target=self._metrics_loop, daemon=True,
                          name="nm-metrics").start()
 

@@ -336,11 +336,15 @@ class ClusterRuntime(CoreRuntime):
         self._sub_thread = threading.Thread(
             target=self._subscriber_loop, daemon=True, name="gcs-subscriber")
         self._sub_thread.start()
-        from ray_tpu._private import metrics_pusher
+        from ray_tpu._private import metrics_pusher, xla_monitor
 
         metrics_pusher.ensure_pusher(
             gcs_address, labels={"role": "worker" if is_worker
                                  else "driver"})
+        # XLA plane wiring: telemetry destination + capture-listener
+        # target for any jit work this process runs (lazy — processes
+        # that never compile pay nothing beyond this address note).
+        xla_monitor.connect(gcs_address, node_id=node_id)
 
     @classmethod
     def connect(cls, address: str, namespace: str = "default") -> "ClusterRuntime":
@@ -2629,9 +2633,14 @@ class ClusterRuntime(CoreRuntime):
         # to the live head (the TSDB would stamp those stale series as
         # fresh forever), but a co-resident node manager's claim on the
         # same pusher survives.
-        from ray_tpu._private import metrics_pusher
+        from ray_tpu._private import metrics_pusher, xla_monitor
 
         metrics_pusher.release_pusher(self.gcs_address)
+        # Same story for the XLA plane's capture listener: release this
+        # runtime's claim (refcounted — a co-resident node manager's
+        # capture plane survives; listeners on dead heads self-reap
+        # after repeated stream failures).
+        xla_monitor.disconnect(self.gcs_address)
         self._drain_lease_cache()
         try:
             self.refs.shutdown()  # release all held refcounts at the GCS

@@ -179,13 +179,25 @@ class TimeSeriesDB:
                     else:
                         v = total / max(count, 1)
                     points.append([bts, v])
+                n_coarse = len(points)
                 points.extend([ts, v] for ts, v in s.hi)
                 hits.append({"name": sname, "labels": dict(slabels),
-                             "points": points})
+                             "points": points, "_n_coarse": n_coarse})
         for h in hits:
-            pts = [p for p in h["points"]
+            n_coarse = h.pop("_n_coarse")
+            pts = [p for p in h["points"][:n_coarse]
                    if (since is None or p[0] >= since)
                    and (until is None or p[0] <= until)]
+            # Tier accounting (pre-aggregation): consumers hint when a
+            # window lands ENTIRELY in the coarse tier — the CLI's tail
+            # prints a one-liner instead of silently showing 10s buckets
+            # as if they were raw samples.
+            h["coarse_points"] = len(pts)
+            hi_pts = [p for p in h["points"][n_coarse:]
+                      if (since is None or p[0] >= since)
+                      and (until is None or p[0] <= until)]
+            h["hires_points"] = len(hi_pts)
+            pts += hi_pts
             if agg and step:
                 pts = _rebucket(pts, agg, float(step))
             h["points"] = pts

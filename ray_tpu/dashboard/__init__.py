@@ -88,6 +88,9 @@ _INDEX_HTML = """<!doctype html>
 <h1>ray_tpu dashboard <span id="status"></span></h1>
 <h2>Cluster</h2><div id="cluster"></div>
 <h2>Metrics (last 5 min)</h2><div id="metrics"></div>
+<h2>XLA programs (compiles / retraces / achieved)</h2>
+<table id="xla"></table>
+<h2>Profiler captures</h2><table id="captures"></table>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Node agents</h2><table id="agents"></table>
 <h2>Actors</h2><table id="actors"></table>
@@ -136,6 +139,37 @@ async function metricsPanel(){
   });
   document.getElementById("metrics").innerHTML=rows.join("")||"(no series)";
 }
+async function xlaPanel(){
+  // Compile/retrace table per (node, program) from the xla series the
+  // push plane lands in the TSDB, plus the registered profiler captures.
+  const data=await j("/api/v1/metrics/query?series=ray_tpu_xla_*"+
+                     "&agg=last&step=10&since=600&limit=400");
+  const rows={};
+  for(const s of data){
+    // One row per (process, program): XLA series carry pid labels, and
+    // merging pids would show one arbitrary process's counters.
+    const node=(s.labels.node_id||s.labels.role||"?")+
+      (s.labels.pid?" pid="+s.labels.pid:"");
+    const key=node+"|"+(s.labels.program||"");
+    const last=s.points.length?s.points[s.points.length-1][1]:0;
+    (rows[key]=rows[key]||{node,program:s.labels.program||""})[s.name]=last;
+  }
+  const fmt1=v=>v==null?"":(v>=1e9?(v/1e9).toFixed(2)+"G":
+    v>=1e6?(v/1e6).toFixed(2)+"M":(+v).toFixed(v>=100?0:2));
+  table(document.getElementById("xla"),
+    Object.values(rows).map(r=>({
+      node:r.node,program:r.program,
+      compiles:fmt1(r["ray_tpu_xla_compiles_total"]),
+      retraces:fmt1(r["ray_tpu_xla_retraces_total"]),
+      "flops/s":fmt1(r["ray_tpu_xla_achieved_flops_per_s"]),
+      "bytes/s":fmt1(r["ray_tpu_xla_achieved_bandwidth_bytes_per_s"]),
+      mfu:fmt1(r["ray_tpu_xla_model_flops_utilization"])})),
+    ["node","program","compiles","retraces","flops/s","bytes/s","mfu"]);
+  table(document.getElementById("captures"),
+    (await j("/api/v1/profile/list")).slice(0,20).map(e=>({
+      capture:e.capture_id,status:e.status,node:e.node_id,pid:e.pid,
+      trace_dir:e.trace_dir||"",files:e.files||""})));
+}
 async function refresh(){
   try{
     const cs=await j("/api/cluster_status");
@@ -153,6 +187,7 @@ async function refresh(){
     document.getElementById("logs").textContent=logs.slice(-200)
       .map(l=>`[${l.worker} ${l.pid}] ${l.line}`).join("\\n");
     await metricsPanel();
+    await xlaPanel();
     document.getElementById("status").textContent=
       "updated "+new Date().toLocaleTimeString();
   }catch(e){
@@ -310,6 +345,27 @@ class Dashboard:
             reply = gcs.KvGet(pb.KvRequest(ns="__metrics__", key="series"))
             return pickle.loads(reply.value) if reply.found else []
 
+        # XLA profiling plane (reference: the dashboard drives on-demand
+        # profiler runs through the per-node agents; here the command is
+        # a GCS pubsub publish and the results register in the KV).
+        def profile_list():
+            from ray_tpu._private import xla_monitor
+
+            return xla_monitor.list_captures(gcs_address)
+
+        def profile_capture(params):
+            from ray_tpu._private import xla_monitor
+
+            capture_id = xla_monitor.request_capture(
+                gcs_address, node=params.get("node", "*"),
+                duration_s=float(params.get("duration", 2.0)))
+            return {"capture_id": capture_id}
+
+        def xla_programs():
+            from ray_tpu._private import xla_monitor
+
+            return xla_monitor.list_programs(gcs_address)
+
         def metrics_query(params):
             """Translate HTTP query params into a TSDB query served by the
             GCS ``__metrics__`` KV namespace: ``series`` (exact name, or
@@ -369,6 +425,15 @@ class Dashboard:
                         ctype = "application/json"
                     elif path == "/api/v1/metrics/query":
                         body = json.dumps(metrics_query(params)).encode()
+                        ctype = "application/json"
+                    elif path == "/api/v1/profile/list":
+                        body = json.dumps(profile_list()).encode()
+                        ctype = "application/json"
+                    elif path == "/api/v1/profile/capture":
+                        body = json.dumps(profile_capture(params)).encode()
+                        ctype = "application/json"
+                    elif path == "/api/v1/xla/programs":
+                        body = json.dumps(xla_programs()).encode()
                         ctype = "application/json"
                     else:
                         route = {

@@ -22,13 +22,13 @@ from __future__ import annotations
 import itertools
 import time
 from collections import deque
-from functools import partial
 from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu._private import xla_monitor
 from ray_tpu.models import llama
 from ray_tpu.models.inference import KVCache, _forward_cached, lm_head_logits
 from ray_tpu.models.llama import rms_norm
@@ -223,7 +223,15 @@ class ContinuousBatcher:
 
         use_kernel = self.use_decode_kernel
 
-        @partial(jax.jit, donate_argnums=(2,))
+        # The XLA monitor dispatches per signature and audits shape
+        # growth: prefill's signatures are pow-2 bucketed in N and L by
+        # design (allowed caps included — max_len/num_slots need not be
+        # powers of two), so legitimate bucket growth stays silent while
+        # a stray odd shape raises ray_tpu_xla_retraces_total. The tick
+        # has exactly ONE legitimate signature.
+        @xla_monitor.instrument(name="cb_prefill", shape_policy="bucketed",
+                                allowed_dims=(max_len, num_slots),
+                                donate_argnums=(2,))
         def prefill(params, tokens, cache, slots, last_idx):
             # BATCHED BUCKETED PREFILL: tokens [N, L] holds N same-bucket
             # prompts destined for KV slots ``slots`` [N]; ``last_idx``
@@ -244,7 +252,7 @@ class ContinuousBatcher:
             first = jnp.argmax(last, axis=-1).astype(jnp.int32)
             return first, cache
 
-        @partial(jax.jit, donate_argnums=(3,))
+        @xla_monitor.instrument(name="cb_tick", donate_argnums=(3,))
         def tick(params, tokens, positions, cache):
             return _decode_tick(params, tokens, positions, cache, cfg,
                                 use_kernel=use_kernel)
@@ -360,8 +368,12 @@ class ContinuousBatcher:
             first = np.asarray(first)            # N ints, one transfer
             # The fetch syncs the dispatch, so this interval is the real
             # prefill cost — bench_serve derives prefill tokens/s from
-            # it without decode/queueing time polluting the denominator.
-            self.prefill_seconds += time.perf_counter() - t0
+            # it without decode/queueing time polluting the denominator,
+            # and the XLA monitor turns it into achieved-FLOPs/bandwidth
+            # gauges against this bucket's compiler cost analysis.
+            prefill_wall = time.perf_counter() - t0
+            self.prefill_seconds += prefill_wall
+            self._prefill.note_execution(prefill_wall)
             self._prefill_shapes.add((n_pad, padded_len))
             true_tokens = int(last_idx[:n].sum()) + n
             self.prefill_batches += 1
@@ -451,9 +463,12 @@ class ContinuousBatcher:
                     self.cache)
                 nxt = np.asarray(self._d_tokens)  # 4 bytes/slot
                 # Per-tick sync: the fetch IS the device sync, so this is
-                # the honest tick latency (dispatch + compute + fetch).
-                mdefs.CB_TICK_MS.observe(
-                    (time.perf_counter() - t0) * 1e3, tags=self._mtags)
+                # the honest tick latency (dispatch + compute + fetch) —
+                # also the denominator for the tick's achieved-FLOPs/
+                # bandwidth gauges (cost_analysis over measured wall).
+                tick_wall = time.perf_counter() - t0
+                mdefs.CB_TICK_MS.observe(tick_wall * 1e3, tags=self._mtags)
+                self._tick.note_execution(tick_wall)
                 if self._apply_tokens(
                         [nxt], [(s, st["rid"])
                                 for s, st in self._slots.items()]):

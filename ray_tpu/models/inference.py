@@ -16,12 +16,12 @@ decisions:
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from ray_tpu._private import xla_monitor
 from ray_tpu.models import llama
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.rope import apply_rope, rope_frequencies
@@ -134,12 +134,16 @@ class LlamaGenerator:
 
         cfg = config
 
-        @jax.jit
+        # Whole-prompt prefill legitimately compiles once per distinct
+        # prompt length (the batch generate API pads nothing); the
+        # production serving path is the bucketed engine, so this one is
+        # compile-tracked but exempt from retrace flagging.
+        @xla_monitor.instrument(name="llama_prefill", shape_policy="free")
         def prefill(params, tokens, cache):
             positions = jnp.arange(tokens.shape[1])
             return _forward_cached(params, tokens, positions, cache, cfg)
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
+        @xla_monitor.instrument(name="llama_decode", donate_argnums=(2,))
         def decode(params, token, cache, pos):
             positions = jnp.asarray([pos])
             logits, cache = _forward_cached(

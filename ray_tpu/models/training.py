@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu._private import xla_monitor
 from ray_tpu.models import llama
 from ray_tpu.parallel import sharding as shd
 
@@ -181,7 +182,9 @@ class ShardedTrainer:
                 is_leaf=lambda x: isinstance(x, P),
             ),
         )
-        self._init = jax.jit(init_fn, out_shardings=self.state_shardings)
+        self._init = xla_monitor.instrument(
+            init_fn, name="train_init", shape_policy="free",
+            out_shardings=self.state_shardings)
 
         def step_fn(state: TrainState, batch: Dict[str, jnp.ndarray]):
             def loss(params):
@@ -204,8 +207,14 @@ class ShardedTrainer:
             metrics["grad_norm"] = optax.global_norm(grads)
             return new_state, metrics
 
-        self._step = jax.jit(
+        # One legitimate signature per trainer: a second compile means
+        # the batch shape churned (a classic silent-retrace source in
+        # training loops) and raises ray_tpu_xla_retraces_total. Step
+        # cadence feeds the achieved-FLOPs/MFU gauges — honest whenever
+        # the loop syncs per step (fetching the loss does).
+        self._step = xla_monitor.instrument(
             step_fn,
+            name="train_step",
             in_shardings=(self.state_shardings,
                           {"tokens": self.batch_sharding,
                            "mask": self.batch_sharding}),

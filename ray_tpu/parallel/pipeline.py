@@ -18,8 +18,9 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_tpu.util.jax_compat import shard_map
 
 
 def pipelined(

@@ -268,6 +268,20 @@ def _fmt_labels(labels: dict) -> str:
     return "{" + inner + "}" if inner else ""
 
 
+def _coarse_tier_hint(hits) -> str:
+    """One-line hint when a queried window exists ONLY in the TSDB's
+    downsampled tier (every returned point is a coarse bucket): the
+    output would otherwise silently show 10s buckets as raw samples."""
+    if not hits:
+        return ""
+    if all(h.get("coarse_points", 0) and not h.get("hires_points", 1)
+           for h in hits):
+        return ("note: window predates the hi-res retention — showing "
+                "downsampled buckets (use --agg min/max/avg to pick how "
+                "they collapse)")
+    return ""
+
+
 def cmd_metrics(args):
     """Time-series observability CLI over the head TSDB (list series,
     tail one live, dump history as CSV)."""
@@ -282,12 +296,32 @@ def cmd_metrics(args):
             raise SystemExit("metrics tail requires a series name")
         seen: dict = {}
         since = None  # full --since window once, then only fresh points
+        hinted = False
         try:
             while True:
-                for s in _metrics_kv(address,
-                                     _metrics_query_key(args, since)):
+                hits = _metrics_kv(address,
+                                   _metrics_query_key(args, since))
+                if not hinted:
+                    hint = _coarse_tier_hint(hits)
+                    if hint:
+                        print(hint, file=sys.stderr)
+                    hinted = True
+                # The newest bucket (resolution coalescing / the
+                # trailing --agg step) may still be accumulating; the
+                # ts-keyed dedup would freeze its FIRST partial value,
+                # so hold points back until their bucket window has
+                # passed (age-based, so a series that stops updating
+                # still prints its final sample on a later poll).
+                # --once keeps snapshot semantics.
+                hold_s = (args.step or 10.0) if args.agg else 1.0
+                closed_before = time.time() - hold_s
+                for s in hits:
                     key = (s["name"], tuple(sorted(s["labels"].items())))
-                    for ts, value in s["points"]:
+                    points = s["points"]
+                    if not args.once:
+                        points = [p for p in points
+                                  if p[0] <= closed_before]
+                    for ts, value in points:
                         if ts <= seen.get(key, 0.0):
                             continue
                         seen[key] = ts
@@ -298,7 +332,10 @@ def cmd_metrics(args):
                               flush=True)
                 if args.once:
                     return
-                since = args.interval * 2 + 1  # dedup absorbs the overlap
+                # Dedup absorbs the overlap; the window must also cover
+                # the hold-back age or a held bucket never reappears.
+                since = max(args.interval * 2 + 1,
+                            hold_s + args.interval + 1)
                 time.sleep(args.interval)
         except KeyboardInterrupt:
             return
@@ -319,6 +356,96 @@ def cmd_metrics(args):
     finally:
         if args.output:
             out.close()
+
+
+def cmd_profile(args):
+    """On-demand XLA profiler capture plane (``_private/xla_monitor``):
+
+    * ``capture`` publishes a capture command on the GCS PROFILE channel;
+      every XLA-active process on the target node runs ``jax.profiler``
+      for --duration seconds and registers its trace dir in the GCS.
+    * ``list`` shows registered captures (trace dirs open in
+      TensorBoard / xprof).
+    * ``programs`` dumps the cost-analysis program registry
+      (per-program FLOPs, bytes accessed, compile time, retraces).
+    """
+    from ray_tpu._private import xla_monitor
+
+    address = args.address or _auto_address()
+    if args.action == "capture":
+        capture_id = xla_monitor.request_capture(
+            address, node=args.node, duration_s=args.duration)
+        print(f"capture {capture_id} requested "
+              f"(node={args.node}, {args.duration:g}s)")
+        if args.no_wait:
+            return
+        deadline = time.monotonic() + args.duration + args.wait_timeout
+        done: dict = {}
+        prev_seen = None
+        while time.monotonic() < deadline:
+            # One KV scan per poll serves both checks (the namespace can
+            # hold hundreds of old captures; don't double the RPC load).
+            mine = [e for e in xla_monitor.list_captures(address)
+                    if e.get("capture_id") == capture_id]
+            for e in mine:
+                if e.get("status") in ("done", "failed", "busy"):
+                    done[(e.get("node_id"), e.get("pid"))] = e
+            # Terminal AND stable across two polls: a slow process may
+            # not have registered anything yet when the first fast one
+            # finishes — one quiet settle poll catches stragglers.
+            seen = sorted((e.get("node_id"), e.get("pid"),
+                           e.get("status")) for e in mine)
+            if done and all(e.get("status") != "capturing"
+                            for e in mine) and seen == prev_seen:
+                break
+            prev_seen = seen
+            time.sleep(0.5)
+        if not done:
+            raise SystemExit(
+                "no capture registered before the timeout — is any "
+                "process on that node running XLA work? (the capture "
+                "listener activates with the first instrumented "
+                "compile)")
+        for e in sorted(done.values(), key=lambda d: d.get("pid", 0)):
+            line = (f"  {e['status']:8} node={e.get('node_id')} "
+                    f"pid={e.get('pid')}")
+            if e.get("trace_dir"):
+                line += f"  {e['trace_dir']} ({e.get('files', 0)} files)"
+            if e.get("error"):
+                line += f"  {e['error']}"
+            print(line)
+        return
+    if args.action == "programs":
+        rows = xla_monitor.list_programs(address)
+        if args.format == "json":
+            print(json.dumps(rows, indent=2))
+            return
+        for e in rows:
+            flops = e.get("flops")
+            nbytes = e.get("bytes_accessed")
+            print(f"{e.get('program', '?'):24} node={e.get('node_id')} "
+                  f"pid={e.get('pid')} "
+                  f"compiles={e.get('compiles', '?')} "
+                  f"retraces={e.get('retraces', '?')} "
+                  f"sig={e.get('signature')} "
+                  f"compile={e.get('compile_seconds', 0):.3f}s "
+                  f"flops={flops if flops is not None else '-'} "
+                  f"bytes={nbytes if nbytes is not None else '-'}"
+                  + ("  RETRACE" if e.get("retrace") else ""))
+        return
+    # list
+    entries = xla_monitor.list_captures(address)
+    if args.format == "json":
+        print(json.dumps(entries, indent=2))
+        return
+    if not entries:
+        print("no captures registered")
+        return
+    for e in entries:
+        stamp = time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0)))
+        print(f"{stamp} {e.get('capture_id'):28} {e.get('status', '?'):9} "
+              f"node={e.get('node_id')} pid={e.get('pid')} "
+              f"{e.get('trace_dir', '')}")
 
 
 def cmd_logs(args):
@@ -621,6 +748,21 @@ def main(argv=None):
                    help="tail: print current window and exit")
     p.add_argument("--output", "-o", help="dump: CSV path (default stdout)")
     p.set_defaults(fn=cmd_metrics)
+
+    p = sub.add_parser("profile",
+                       help="XLA profiler captures: capture/list/programs")
+    p.add_argument("action", choices=["capture", "list", "programs"])
+    p.add_argument("--address")
+    p.add_argument("--node", default="*",
+                   help="target node id (prefix ok; default: every node)")
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="trace capture seconds (default 2)")
+    p.add_argument("--no-wait", action="store_true",
+                   help="capture: publish the command and exit")
+    p.add_argument("--wait-timeout", type=float, default=30.0,
+                   help="capture: extra seconds to wait for registration")
+    p.add_argument("--format", choices=["table", "json"], default="table")
+    p.set_defaults(fn=cmd_profile)
 
     p = sub.add_parser("logs", help="tail worker logs (or one job's logs)")
     p.add_argument("--address")

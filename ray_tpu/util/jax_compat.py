@@ -1,0 +1,38 @@
+"""Compatibility shims over moving jax APIs.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` to a
+top-level ``jax.shard_map`` export; the framework supports both ends of
+that migration (the pinned CI jax still ships only the experimental
+path). Import it from here, never from ``jax`` directly.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _sm
+    # Some versions expose ``jax.shard_map`` as a MODULE; the callable
+    # lives one attribute deeper.
+    _shard_map = _sm if callable(_sm) else _sm.shard_map
+except ImportError:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+def shard_map(*args, **kwargs):
+    """``jax.shard_map`` across the experimental->top-level migration.
+
+    The replication-check knob was renamed ``check_rep`` -> ``check_vma``
+    mid-migration; translate whichever spelling the caller used into the
+    one the installed jax accepts.
+    """
+    for theirs, ours in (("check_vma", "check_rep"),
+                         ("check_rep", "check_vma")):
+        if theirs in kwargs and theirs not in _PARAMS and ours in _PARAMS:
+            kwargs[ours] = kwargs.pop(theirs)
+    return _shard_map(*args, **kwargs)
+
+
+__all__ = ["shard_map"]

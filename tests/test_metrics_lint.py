@@ -49,3 +49,68 @@ def test_catalog_names_follow_conventions():
         if isinstance(m, metrics_mod.Counter):
             assert m.name.endswith("_total"), (
                 f"counter {m.name} must end in _total")
+
+
+def test_xla_and_device_memory_series_are_cataloged():
+    """The XLA profiling plane's series ship described + tagged in the
+    catalog (the generic lints above then cover their metadata)."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_xla_compiles_total",
+        "ray_tpu_xla_compile_seconds",
+        "ray_tpu_xla_retraces_total",
+        "ray_tpu_xla_program_flops",
+        "ray_tpu_xla_program_bytes_accessed",
+        "ray_tpu_xla_achieved_flops_per_s",
+        "ray_tpu_xla_achieved_bandwidth_bytes_per_s",
+        "ray_tpu_xla_model_flops_utilization",
+        "ray_tpu_device_mem_used_bytes",
+        "ray_tpu_device_mem_peak_bytes",
+        "ray_tpu_device_mem_limit_bytes",
+        "ray_tpu_profile_captures_total",
+    }
+    missing = required - names
+    assert not missing, (
+        f"XLA/device-memory series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name.startswith(("ray_tpu_xla_", "ray_tpu_device_mem_")):
+            assert m.description.strip() and m.tag_keys
+
+
+# Framework-owned jax.jit call sites must go through the instrumented
+# wrapper (ray_tpu._private.xla_monitor.instrument) so every compile,
+# retrace and cost analysis is observed. Intentional raw jits are
+# allowlisted here WITH a reason.
+RAW_JIT_ALLOWLIST = {
+    # The wrapper itself wraps jax.jit.
+    "_private/xla_monitor.py": "the instrumented wrapper's own jit",
+    # RL host loops: many tiny per-algorithm jits driven at env cadence,
+    # not cluster-serving hot paths; instrumenting them would flood the
+    # program registry without a roofline story.
+    "rllib/env_runner.py": "RL env-loop jits",
+    "rllib/multi_agent.py": "RL env-loop jits",
+    "rllib/core.py": "RL learner jits",
+}
+
+
+def test_framework_jits_go_through_the_instrumented_wrapper():
+    import pathlib
+    import re
+
+    import ray_tpu
+
+    root = pathlib.Path(ray_tpu.__file__).parent
+    offenders = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel in RAW_JIT_ALLOWLIST:
+            continue
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1):
+            code = line.split("#", 1)[0]
+            if re.search(r"\bjax\.jit\b", code):
+                offenders.append(f"{rel}:{lineno}")
+    assert not offenders, (
+        f"raw jax.jit call sites outside the allowlist: {offenders} — "
+        f"route them through ray_tpu._private.xla_monitor.instrument "
+        f"(or allowlist them with a reason in test_metrics_lint.py)")

@@ -6,8 +6,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.util.jax_compat import shard_map
 
 from ray_tpu.ops.attention import flash_attention, mha_reference
 from ray_tpu.ops.norms import layer_norm, rms_norm

@@ -1,0 +1,324 @@
+"""XLA profiling plane (ISSUE 3): compile/retrace tracking, cost-analysis
+registry + achieved gauges, device-memory vitals, on-demand profiler
+capture — all exercised under ``JAX_PLATFORMS=cpu``.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu._private import metrics_defs as mdefs
+from ray_tpu._private import xla_monitor as xm
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+_uniq = iter(range(10_000))
+
+
+def _name(prefix: str) -> str:
+    # Program records are process-global: every test gets fresh names.
+    return f"{prefix}_{next(_uniq)}"
+
+
+def _counter_value(counter, program: str) -> float:
+    for name, key, value in counter.samples():
+        if dict(key).get("program") == program:
+            return value
+    return 0.0
+
+
+# -------------------------------------------------- retrace detection
+
+
+def test_retrace_fires_on_shape_churn():
+    name = _name("churn")
+
+    @xm.instrument(name=name)
+    def f(x):
+        return x * 2
+
+    f(jnp.ones((8,)))
+    assert _counter_value(mdefs.XLA_RETRACES, name) == 0
+    f(jnp.ones((9,)))          # same treedef, new shape: silent retrace
+    f(jnp.ones((10,)))
+    stats = xm.program_stats(name)
+    assert stats["compiles"] == 3
+    assert stats["retraces"] == 2
+    assert _counter_value(mdefs.XLA_RETRACES, name) == 2
+    assert _counter_value(mdefs.XLA_COMPILES, name) == 3
+
+
+def test_retrace_silent_on_bucketed_shapes():
+    name = _name("bucketed")
+
+    @xm.instrument(name=name, shape_policy="bucketed", allowed_dims=(48,))
+    def f(x):
+        return x.sum()
+
+    for n in (16, 32, 64, 48):     # pow-2 growth + the declared cap
+        f(jnp.ones((n,)))
+    assert xm.program_stats(name)["retraces"] == 0
+    f(jnp.ones((17,)))             # stray odd shape: a real retrace
+    assert xm.program_stats(name)["retraces"] == 1
+    # dtype churn is never "bucketed growth".
+    f(jnp.ones((16,), jnp.float64)
+      if False else jnp.ones((16,), jnp.int32))
+    assert xm.program_stats(name)["retraces"] == 2
+
+
+def test_repeat_calls_do_not_recompile():
+    name = _name("stable")
+
+    @xm.instrument(name=name)
+    def f(x, i):
+        return x + i
+
+    for i in range(5):             # python-int arg: keyed by type
+        f(jnp.ones((4,)), i)
+    stats = xm.program_stats(name)
+    assert stats["compiles"] == 1 and stats["retraces"] == 0
+
+
+# --------------------------------------------- cost-analysis registry
+
+
+def test_cost_registry_populated_after_jit_call():
+    name = _name("cost")
+
+    @xm.instrument(name=name)
+    def f(x):
+        return jnp.dot(x, x)
+
+    f(jnp.ones((64, 64)))
+    stats = xm.program_stats(name)
+    assert stats is not None
+    # The CPU backend provides cost analysis: FLOPs and bytes accessed
+    # must be real, positive numbers — zero estimation.
+    assert stats["flops"] > 0
+    assert stats["bytes_accessed"] > 0
+    assert stats["compile_seconds"] > 0
+
+
+def test_note_execution_sets_achieved_gauges():
+    name = _name("achieved")
+
+    @xm.instrument(name=name)
+    def f(x):
+        return jnp.dot(x, x)
+
+    w = f
+    w(jnp.ones((32, 32)))
+    out = w.note_execution(0.01)
+    assert out and out["achieved_flops_per_s"] > 0
+    assert out["achieved_bandwidth_bytes_per_s"] > 0
+    samples = {dict(k).get("program"): v
+               for _, k, v in mdefs.XLA_ACHIEVED_FLOPS.samples()}
+    assert samples.get(name, 0) > 0
+
+
+# --------------------------------- serve tick / train step integration
+
+
+def test_engine_tick_and_prefill_feed_the_plane():
+    from ray_tpu.models import llama
+    from ray_tpu.models.continuous_batching import ContinuousBatcher
+
+    eng = ContinuousBatcher(llama.LlamaConfig.tiny(), num_slots=4,
+                            max_len=64)
+    for rid in range(3):
+        eng.submit([1, 2, 3], max_new_tokens=3)
+    eng.run_to_completion()
+    for prog in ("cb_tick", "cb_prefill"):
+        stats = xm.program_stats(prog)
+        assert stats and stats["flops"] > 0, prog
+    # Measured tick/prefill wall time -> non-null achieved gauges.
+    flops = {dict(k).get("program"): v
+             for _, k, v in mdefs.XLA_ACHIEVED_FLOPS.samples()}
+    bw = {dict(k).get("program"): v
+          for _, k, v in mdefs.XLA_ACHIEVED_BW.samples()}
+    assert flops.get("cb_tick", 0) > 0 and bw.get("cb_tick", 0) > 0
+    assert flops.get("cb_prefill", 0) > 0
+    # A same-bucket admission burst reuses ONE compiled prefill program
+    # and pow-2 bucket growth never reads as a retrace.
+    assert xm.program_stats("cb_prefill")["retraces"] == 0
+
+
+def test_train_step_feeds_the_plane():
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.models.training import ShardedTrainer, synthetic_batch
+    from ray_tpu.parallel import MeshConfig, make_mesh
+
+    config = llama.LlamaConfig.tiny()
+    trainer = ShardedTrainer(config, make_mesh(MeshConfig(fsdp=-1)))
+    state = trainer.init_state()
+    batch = trainer.shard_batch(synthetic_batch(8, 16, config.vocab_size))
+    for _ in range(3):
+        state, metrics = trainer.train_step(state, batch)
+        jax.block_until_ready(metrics["loss"])  # sync: honest cadence
+    stats = xm.program_stats("train_step")
+    assert stats and stats["flops"] > 0 and stats["bytes_accessed"] > 0
+    assert stats["compiles"] == 1      # one signature, no retraces
+    flops = {dict(k).get("program"): v
+             for _, k, v in mdefs.XLA_ACHIEVED_FLOPS.samples()}
+    assert flops.get("train_step", 0) > 0
+
+
+# ------------------------------------------------ device memory vitals
+
+
+def test_device_memory_sampler_graceful_on_cpu():
+    # jax is resident in this process, so the sampler runs; CPU devices
+    # report no memory_stats() and the answer is the documented [].
+    out = xm.sample_device_memory(node_id="testnode")
+    assert out == [] or all("device" in e for e in out)
+
+
+# ------------------------------------- capture plane + CLI + dashboard
+
+
+@pytest.fixture
+def gcs_server():
+    from ray_tpu._private.gcs.server import GcsServer
+
+    server = GcsServer(port=0)
+    yield server
+    server.shutdown()
+    xm.stop_all()
+
+
+def _wait_profile_subscriber(server, timeout_s: float = 10.0):
+    """Pubsub has no replay: block until the capture listener's
+    subscription is registered server-side before publishing."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if server._subscribers.get(xm.PROFILE_CHANNEL):
+            return
+        time.sleep(0.05)
+    raise AssertionError("profile listener never subscribed")
+
+
+def test_capture_rpc_roundtrip_and_listing(gcs_server, tmp_path, capsys,
+                                           monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    address = f"127.0.0.1:{gcs_server.port}"
+    xm.start_profile_listener(address, node_id="testnode123")
+    _wait_profile_subscriber(gcs_server)
+    # An XLA-active process: the capture wraps real device activity.
+    jnp.dot(jnp.ones((32, 32)), jnp.ones((32, 32))).block_until_ready()
+
+    capture_id = xm.request_capture(address, node="testnode",
+                                    duration_s=0.3)
+    # The first stop_trace in a process pays profiler init/flush (~15s
+    # observed on this box); the deadline covers a loaded CI.
+    deadline = time.monotonic() + 60
+    entry = None
+    while time.monotonic() < deadline:
+        done = [e for e in xm.list_captures(address)
+                if e.get("capture_id") == capture_id
+                and e.get("status") in ("done", "failed")]
+        if done:
+            entry = done[0]
+            break
+        time.sleep(0.2)
+    assert entry is not None, "capture never registered"
+    assert entry["status"] == "done", entry
+    assert entry["node_id"] == "testnode123"[:12]
+    assert os.path.isdir(entry["trace_dir"])
+    assert entry["files"] > 0          # jax.profiler wrote a real trace
+    assert str(tmp_path) in entry["trace_dir"]
+
+    # `ray-tpu profile list` shows it.
+    from ray_tpu.scripts import cli
+
+    cli.main(["profile", "list", "--address", address])
+    out = capsys.readouterr().out
+    assert capture_id in out and "done" in out
+
+    # The cost-analysis program registry persisted via the GCS KV.
+    # Flush is periodic best-effort; poke it directly so the test
+    # doesn't sleep through a push interval.
+    xm._flush_pending_kv()
+    reply = gcs_server.KvKeys(
+        pb.KvRequest(ns=xm.PROGRAM_KV_NS, prefix=""), None)
+    assert reply.keys, "program registry never reached the GCS KV"
+
+    # Dashboard routes over the same plane.
+    from ray_tpu.dashboard import Dashboard
+
+    dash = Dashboard(address, port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/api/v1/profile/list",
+                timeout=10) as r:
+            entries = json.loads(r.read())
+        assert any(e.get("capture_id") == capture_id for e in entries)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{dash.port}/api/v1/xla/programs",
+                timeout=10) as r:
+            programs = json.loads(r.read())
+        assert programs and all("program" in e for e in programs)
+        with urllib.request.urlopen(f"http://127.0.0.1:{dash.port}/",
+                                    timeout=10) as r:
+            html = r.read().decode()
+        assert "/api/v1/profile/list" in html and "xlaPanel" in html
+    finally:
+        dash.stop()
+
+
+def test_capture_cli_end_to_end(gcs_server, tmp_path, capsys,
+                                monkeypatch):
+    """`ray-tpu profile capture --duration ...` against a live listener
+    prints the registered trace dir (the acceptance-criteria flow)."""
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    address = f"127.0.0.1:{gcs_server.port}"
+    xm.start_profile_listener(address, node_id="clinode")
+    _wait_profile_subscriber(gcs_server)
+    from ray_tpu.scripts import cli
+
+    cli.main(["profile", "capture", "--address", address,
+              "--duration", "0.3", "--node", "clinode",
+              "--wait-timeout", "60"])
+    out = capsys.readouterr().out
+    assert "done" in out and str(tmp_path) in out
+    cli.main(["profile", "list", "--address", address])
+    assert "done" in capsys.readouterr().out
+
+
+def test_capture_targets_other_node_is_ignored(gcs_server, tmp_path,
+                                               monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SESSION_DIR", str(tmp_path))
+    address = f"127.0.0.1:{gcs_server.port}"
+    xm.start_profile_listener(address, node_id="nodeA")
+    _wait_profile_subscriber(gcs_server)
+    capture_id = xm.request_capture(address, node="nodeZZZ",
+                                    duration_s=0.2)
+    time.sleep(1.0)
+    assert not [e for e in xm.list_captures(address)
+                if e.get("capture_id") == capture_id]
+
+
+# --------------------------------------- metrics tail downsample hint
+
+
+def test_tsdb_reports_tier_counts_and_cli_hints():
+    from ray_tpu._private.tsdb import TimeSeriesDB
+    from ray_tpu.scripts.cli import _coarse_tier_hint
+
+    db = TimeSeriesDB(retention_s=3600.0, resolution_s=1.0,
+                      hires_retention_s=60.0, downsample_s=10.0)
+    for t in range(0, 1000):
+        db.append("m", {}, float(t), ts=float(t))
+    # Window entirely below the hi-res horizon: coarse buckets only.
+    [old] = db.query(name="m", since=100.0, until=500.0)
+    assert old["coarse_points"] > 0 and old["hires_points"] == 0
+    assert "downsampled" in _coarse_tier_hint([old])
+    # A recent window has raw points: no hint.
+    [fresh] = db.query(name="m", since=950.0)
+    assert fresh["hires_points"] > 0
+    assert _coarse_tier_hint([fresh]) == ""
+    assert _coarse_tier_hint([]) == ""
