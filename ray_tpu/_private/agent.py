@@ -146,6 +146,18 @@ class NodeAgent:
                                         daemon=True, name="node-agent")
         self._thread.start()
         self._register()
+        # Preemption watch (checkpoint plane, ray_tpu/checkpoint/
+        # preempt.py): SIGTERM or the TPU maintenance-event sentinel
+        # (RAY_TPU_MAINTENANCE_SENTINEL) publishes a PREEMPT notice so
+        # training processes on this node run their just-in-time
+        # checkpoint before the host dies. Signal installation is left
+        # to main() (handlers need the main thread; embedded agents must
+        # not steal the host process's SIGTERM).
+        from ray_tpu.checkpoint.preempt import PreemptionWatcher
+
+        self.preempt_watcher = PreemptionWatcher(
+            node_id=node_id, gcs_address=gcs_address,
+            install_signal=False)
         # Time-series push plane (the dashboard-agent role grown into a
         # TSDB feed): node vitals become tagged gauges in this process's
         # registry, and the generic pusher ships the registry to the head
@@ -283,6 +295,7 @@ class NodeAgent:
 
     def stop(self) -> None:
         self._stop_vitals.set()
+        self.preempt_watcher.stop()
         self._server.shutdown()
         self._server.server_close()
 
@@ -298,6 +311,18 @@ def main(argv=None):  # pragma: no cover - subprocess entry
     args = p.parse_args(argv)
     agent = NodeAgent(args.gcs_address, args.node_id, port=args.port,
                       spill_dir=args.spill_dir)
+    # The agent subprocess owns its lifecycle: SIGTERM (the preemption
+    # notice on managed instances) publishes PREEMPT before exiting.
+    import signal as _signal
+
+    def _on_sigterm(signum, frame):  # pragma: no cover - signal path
+        agent.preempt_watcher.trigger("SIGTERM")
+        raise SystemExit(0)
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        pass
     print(f"AGENT_PORT={agent.port}", flush=True)
     try:
         while True:

@@ -92,15 +92,29 @@ class ClientProxyServer:
         with self._lock:
             s = self._sessions.get(sid)
             if s is None:
-                s = self._sessions[sid] = {"refs": {}, "last": 0.0}
+                s = self._sessions[sid] = {"refs": {}, "actors": {},
+                                           "last": 0.0}
             s["last"] = time.monotonic()
             return s
 
     def _drop_session(self, sid: str) -> None:
         with self._lock:
             s = self._sessions.pop(sid, None)
-        if s:
-            s["refs"].clear()  # ObjectRef __del__ releases the pins
+        if not s:
+            return
+        s["refs"].clear()  # ObjectRef __del__ releases the pins
+        # Non-detached actors belong to the (now gone) remote driver:
+        # without this they outlive the session forever, since the
+        # proxy-side runtime that nominally owns them never exits.
+        for aid_bin, detached in s["actors"].items():
+            if detached:
+                continue
+            try:
+                self._runtime.kill_actor(ActorID(aid_bin),
+                                         no_restart=True)
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
+        s["actors"].clear()
 
     def _reaper_loop(self) -> None:
         ttl = _session_ttl_s()
@@ -176,8 +190,10 @@ class ClientProxyServer:
 
     def _op_create_actor(self, session, blob: bytes):
         cls, args, kwargs, options = cloudpickle.loads(blob)
-        return self._runtime.create_actor(cls, args, kwargs,
-                                          options).binary()
+        actor_id = self._runtime.create_actor(cls, args, kwargs, options)
+        detached = getattr(options, "lifetime", None) == "detached"
+        session["actors"][actor_id.binary()] = detached
+        return actor_id.binary()
 
     def _op_submit_actor_task(self, session, actor_id_bin, method_name,
                               blob, options_blob):
@@ -189,6 +205,7 @@ class ClientProxyServer:
         return [r.id().binary() for r in refs]
 
     def _op_kill_actor(self, session, actor_id_bin, no_restart):
+        session["actors"].pop(actor_id_bin, None)
         return self._runtime.kill_actor(ActorID(actor_id_bin), no_restart)
 
     def _op_get_named_actor(self, session, name, namespace):
@@ -251,6 +268,7 @@ class ProxyRuntime(CoreRuntime):
         self._counts: Dict[bytes, int] = {}
         self._lock = threading.Lock()
         self._closed = False
+        self._session_lost = False
         self.node_id = f"client-{self._sid[:8]}"
         self.job_id = self.node_id
         # The proxy's shared runtime has ONE namespace; this client's
@@ -273,6 +291,12 @@ class ProxyRuntime(CoreRuntime):
 
     # ------------------------------------------------------------ plumbing
     def _call(self, op: str, *args, _timeout: float = 24 * 3600.0):
+        if self._session_lost and op not in ("close",):
+            raise ConnectionError(
+                "ray:// session lost: the proxy was unreachable for "
+                "longer than the session TTL, so the server-side "
+                "session (and every object/actor it pinned) has been "
+                "reaped — reconnect with a fresh ray_tpu.init()")
         data = self._fc.call(
             KIND_CLIENT, cloudpickle.dumps((op, self._sid, args)),
             timeout=_timeout)
@@ -286,14 +310,37 @@ class ProxyRuntime(CoreRuntime):
         # would be swept between keep-alives. The TTL comes from the
         # proxy's handshake reply (authoritative — the env knob may be
         # set only on the head), falling back to this process's env.
+        # A FAILED ping must not end the loop (one dropped frame or a
+        # proxy restart used to kill keep-alives permanently, so the
+        # proxy reaped a perfectly live client minutes later): retry
+        # with backoff, and only once the outage outlasts the TTL flag
+        # the session lost so the next op fails with a clear error
+        # instead of silently acting on a reaped (auto-recreated,
+        # empty) server-side session.
         ttl = self._server_ttl_s or _session_ttl_s()
         period = min(PING_PERIOD_S, max(ttl / 3.0, 0.2))
+        last_ok = time.monotonic()
+        failures = 0
         while not self._closed:
-            time.sleep(period)
+            time.sleep(period if failures == 0
+                       else min(period, 0.25 * (2 ** min(failures, 4))))
+            if self._closed:
+                return
             try:
                 self._call("ping")
-            except Exception:  # noqa: BLE001 — proxy gone; ops will fail
-                return
+                failures = 0
+                last_ok = time.monotonic()
+            except Exception:  # noqa: BLE001 — proxy briefly unreachable
+                if self._session_lost:
+                    return
+                failures += 1
+                if time.monotonic() - last_ok > ttl:
+                    self._session_lost = True
+                    logger.warning(
+                        "ray:// proxy unreachable for %.0fs (> session "
+                        "TTL %.0fs); session %s is lost",
+                        time.monotonic() - last_ok, ttl, self._sid[:8])
+                    return
 
     def _make_refs(self, oid_bins: List[bytes]) -> List[ObjectRef]:
         return [ObjectRef(ObjectID(ob), owner_address=self._address)

@@ -501,6 +501,8 @@ class GcsServer:
                 self._reap_holders(stale_drivers)
             if tick % 4 == 0:  # job TTLs are seconds; don't scan per tick
                 self._reconcile_jobs()
+            if tick % 120 == 0:  # ~minutely: ckpt TTLs are minutes
+                self._sweep_checkpoints()
 
     def _reconcile_jobs(self):
         """Sweep jobs stuck PENDING/RUNNING after their submitting client
@@ -543,6 +545,55 @@ class GcsServer:
                            job_id)
             self._export_event("JOB_RECONCILED", job_id=job_id,
                                reason=info["message"])
+
+    CKPT_STALE_TTL_S = 900.0
+
+    def _sweep_checkpoints(self, now: Optional[float] = None,
+                           ttl_s: Optional[float] = None) -> int:
+        """Manifest sweep of the ``__ckpt__`` namespace (checkpoint
+        plane, ray_tpu/checkpoint/plane.py): shard registrations of a
+        step whose MANIFEST never committed — a participant crashed
+        mid-write — are invisible to readers by design, and this sweep
+        reaps their KV records once stale so half-written checkpoints
+        don't accumulate forever. Committed manifests are never touched.
+        Returns the number of keys deleted."""
+        now = time.time() if now is None else now
+        ttl = ttl_s if ttl_s is not None else float(os.environ.get(
+            "RAY_TPU_CKPT_STALE_TTL_S", self.CKPT_STALE_TTL_S))
+        with self._lock:
+            ckpt = [(k, v) for (ns, k), v in self._kv.items()
+                    if ns == "__ckpt__"]
+        manifests = set()
+        shards: Dict[str, List[Tuple[str, float]]] = defaultdict(list)
+        for key, value in ckpt:
+            if key.endswith("/MANIFEST"):
+                manifests.add(key[:-len("/MANIFEST")])
+            elif "/shard/" in key:
+                ts = 0.0
+                try:
+                    ts = float(json.loads(value).get("ts", 0.0))
+                except Exception:  # noqa: BLE001 — not a shard record
+                    continue
+                shards[key.split("/shard/")[0]].append((key, ts))
+        deleted = 0
+        for prefix, entries in shards.items():
+            if prefix in manifests:
+                continue
+            if max(ts for _, ts in entries) > now - ttl:
+                continue  # may still be filling in
+            with self._lock:
+                for key, _ in entries:
+                    if self._kv.pop(("__ckpt__", key), None) is not None:
+                        self._wal_append(("kv", "__ckpt__", key, None))
+                        deleted += 1
+            run_step = prefix.rsplit("/", 1)
+            self._export_event(
+                "CKPT_SWEPT", run=run_step[0],
+                step=run_step[1] if len(run_step) > 1 else "",
+                shards=len(entries))
+            logger.info("swept %d stale uncommitted checkpoint shard "
+                        "record(s) for %s", len(entries), prefix)
+        return deleted
 
     def _mark_dead(self, node_id: str, reason: str):
         with self._lock:

@@ -240,6 +240,41 @@ DEVICE_MEM_LIMIT = Gauge(
     "Accelerator bytes_limit from device memory_stats()",
     ("node_id", "device"))
 
+# --------------------------------------------- checkpoint plane (ckpt/)
+CKPT_BLOCK_MS = Histogram(
+    "ray_tpu_ckpt_block_ms",
+    "Milliseconds the step loop was blocked by a save (device→host "
+    "snapshot only; serialization and the write run in the background)",
+    boundaries=(1.0, 5.0, 10.0, 50.0, 100.0, 500.0, 1000.0, 5000.0,
+                30000.0),
+    tag_keys=("run",))
+CKPT_SAVE_SECONDS = Histogram(
+    "ray_tpu_ckpt_save_seconds",
+    "End-to-end wall time of one participant's checkpoint persist "
+    "(snapshot through shard write and commit attempt)",
+    boundaries=(0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
+    tag_keys=("run",))
+CKPT_RESTORE_SECONDS = Histogram(
+    "ray_tpu_ckpt_restore_seconds",
+    "Wall time of one elastic restore (manifest read, shard reassembly, "
+    "re-shard device_put)",
+    boundaries=(0.05, 0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0),
+    tag_keys=("run",))
+CKPT_BYTES = Counter(
+    "ray_tpu_ckpt_bytes_total",
+    "Checkpoint bytes moved by this process, by direction (save/restore)",
+    ("run", "direction"))
+CKPT_SAVES = Counter(
+    "ray_tpu_ckpt_saves_total",
+    "Checkpoint persists by outcome: committed (this participant flipped "
+    "the manifest), registered (a peer commits), failed",
+    ("run", "outcome"))
+CKPT_PREEMPT_NOTICES = Counter(
+    "ray_tpu_ckpt_preempt_notices_total",
+    "Preemption notices delivered to this process, by source "
+    "(local/publish/pubsub)",
+    ("source",))
+
 # --------------------------------------------- on-demand profiler capture
 PROFILE_CAPTURES = Counter(
     "ray_tpu_profile_captures_total",

@@ -1701,6 +1701,17 @@ class ClusterRuntime(CoreRuntime):
         finally:
             for item in items:
                 self._running_locs.pop(bytes(item[0].task_id), None)
+        if len(reply.results) != len(items):
+            # Short (or over-long) reply: zipping it against items would
+            # silently drop the tail — those tasks would never complete
+            # and their flight pins would never release. Treat it like a
+            # dead worker so every item goes through the retry/error
+            # gate (which always releases pins).
+            logger.warning(
+                "batch push returned %d results for %d tasks; routing "
+                "the batch through the retry path",
+                len(reply.results), len(items))
+            return False
         mdefs.PUSH_LATENCY.observe(time.monotonic() - push_start,
                                    tags={"mode": "batch"})
         with self._completion_slots:

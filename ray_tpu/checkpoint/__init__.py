@@ -1,0 +1,48 @@
+"""ray_tpu.checkpoint: the distributed checkpoint plane.
+
+Async sharded snapshots with a two-phase-commit manifest, elastic
+re-sharded restore, and preemption-aware just-in-time saves — shared by
+``ray_tpu.train`` (:func:`ray_tpu.train.get_checkpoint_plane`), raw
+``ShardedTrainer`` loops (``ShardedTrainer.save_state``/``restore_state``)
+and the serve engine (``checkpoint_path=`` on the LLM deployments).
+See ``plane.py`` for the save/commit/restore protocol and ``preempt.py``
+for the PREEMPT pubsub plane.
+"""
+
+from ray_tpu.checkpoint.plane import (
+    CKPT_KV_NS,
+    CheckpointPlane,
+    SaveHandle,
+    inspect_dir,
+    list_checkpoints,
+    list_manifests_kv,
+    load_latest,
+)
+from ray_tpu.checkpoint.preempt import (
+    PREEMPT_CHANNEL,
+    PreemptionGuard,
+    PreemptionWatcher,
+    notify_preemption,
+    publish_preempt,
+    register_preempt_callback,
+    start_preempt_listener,
+    unregister_preempt_callback,
+)
+
+__all__ = [
+    "CKPT_KV_NS",
+    "CheckpointPlane",
+    "PREEMPT_CHANNEL",
+    "PreemptionGuard",
+    "PreemptionWatcher",
+    "SaveHandle",
+    "inspect_dir",
+    "list_checkpoints",
+    "list_manifests_kv",
+    "load_latest",
+    "notify_preemption",
+    "publish_preempt",
+    "register_preempt_callback",
+    "start_preempt_listener",
+    "unregister_preempt_callback",
+]

@@ -1,0 +1,287 @@
+"""Preemption plane: SIGTERM / maintenance-event watch → PREEMPT pubsub.
+
+TPU pods lose hosts routinely (spot preemption, maintenance events). The
+shape here mirrors Gemini-style fast-recovery systems: the node agent (or
+any process) watches for the death notice, publishes a ``PREEMPT`` record
+on the GCS pubsub plane, and registered training processes run a
+just-in-time checkpoint before the host dies; the trainer controller then
+treats the loss as retryable and resumes from the newest committed
+manifest (``ray_tpu/checkpoint/plane.py``).
+
+Local (in-process) runtimes have no GCS: ``publish_preempt`` then fires
+this process's registered callbacks directly, so the whole flow stays
+testable on one host.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+PREEMPT_CHANNEL = "PREEMPT"
+
+_state_lock = threading.Lock()
+_callbacks: list = []
+_listeners: Dict[str, threading.Event] = {}
+
+
+def register_preempt_callback(fn: Callable[[Dict[str, Any]], None]):
+    """Register ``fn(notice)`` to run when a preemption notice reaches
+    this process (local publish or matching pubsub delivery). Returns
+    ``fn`` as the unregister handle."""
+    with _state_lock:
+        _callbacks.append(fn)
+    return fn
+
+
+def unregister_preempt_callback(fn) -> None:
+    with _state_lock:
+        try:
+            _callbacks.remove(fn)
+        except ValueError:
+            pass
+
+
+def notify_preemption(notice: Dict[str, Any]) -> None:
+    """Fire this process's registered callbacks (each isolated — a bad
+    callback must not stop the JIT saves of the others)."""
+    from ray_tpu._private import metrics_defs as mdefs
+
+    mdefs.CKPT_PREEMPT_NOTICES.inc(
+        tags={"source": str(notice.get("source", "local"))})
+    with _state_lock:
+        callbacks = list(_callbacks)
+    for fn in callbacks:
+        try:
+            fn(dict(notice))
+        except Exception:  # noqa: BLE001
+            logger.exception("preemption callback failed")
+
+
+def _gcs_stub(gcs_address: Optional[str]):
+    if gcs_address:
+        from ray_tpu._private import rpc
+
+        return rpc.get_stub("GcsService", gcs_address)
+    try:
+        from ray_tpu._private import worker as worker_mod
+
+        w = worker_mod.global_worker_or_none()
+        return getattr(w.core, "gcs", None) if w is not None else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def publish_preempt(reason: str = "preempted", node: str = "*",
+                    gcs_address: Optional[str] = None,
+                    deadline_s: Optional[float] = None) -> Dict[str, Any]:
+    """Publish a preemption notice cluster-wide (GCS PREEMPT channel);
+    without a reachable GCS the notice fires locally instead. ``node``
+    scopes delivery (``*`` = every subscriber)."""
+    notice = {"reason": reason, "node": node or "*", "ts": time.time(),
+              "source": "publish"}
+    if deadline_s is not None:
+        notice["deadline_s"] = float(deadline_s)
+    gcs = _gcs_stub(gcs_address)
+    if gcs is not None:
+        import pickle
+
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        gcs.Publish(pb.PublishRequest(
+            channel=PREEMPT_CHANNEL, data=pickle.dumps(notice)),
+            timeout=10)
+    else:
+        notify_preemption(notice)
+    return notice
+
+
+def start_preempt_listener(gcs_address: str,
+                           node_id: Optional[str] = None) -> None:
+    """Subscribe this process to PREEMPT notices (idempotent per
+    address). Notices scoped to another node are ignored."""
+    with _state_lock:
+        if gcs_address in _listeners:
+            return
+        stop = _listeners[gcs_address] = threading.Event()
+    threading.Thread(target=_listener_loop,
+                     args=(gcs_address, node_id or "", stop),
+                     daemon=True, name="preempt-listener").start()
+
+
+def stop_listeners() -> None:
+    with _state_lock:
+        for stop in _listeners.values():
+            stop.set()
+        _listeners.clear()
+
+
+def _listener_loop(address: str, node_id: str,
+                   stop: threading.Event) -> None:
+    import pickle
+
+    from ray_tpu._private import rpc
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    # Retry FOREVER with capped backoff: this listener is the safety
+    # channel for just-in-time saves — a GCS outage longer than some
+    # failure budget must not leave the rest of a days-long run deaf to
+    # preemption notices (guards only subscribe once, at construction).
+    failures = 0
+    while not stop.is_set():
+        try:
+            gcs = rpc.get_stub("GcsService", address)
+            stream = gcs.Subscribe(pb.SubscribeRequest(
+                channels=[PREEMPT_CHANNEL],
+                subscriber_id=f"preempt-{os.getpid()}"),
+                timeout=365 * 86400.0)
+            for msg in stream:
+                failures = 0
+                if stop.is_set():
+                    break
+                try:
+                    notice = pickle.loads(msg.data)
+                except Exception:  # noqa: BLE001
+                    continue
+                target = str(notice.get("node", "*"))
+                if target in ("", "*", "all") or not node_id or \
+                        node_id == target or node_id.startswith(target):
+                    notice = dict(notice, source="pubsub")
+                    notify_preemption(notice)
+            stop.wait(0.5)  # clean stream end (GCS restarting)
+        except Exception:  # noqa: BLE001 — GCS down or restarting
+            failures += 1
+            stop.wait(min(0.5 * failures, 5.0))
+    with _state_lock:
+        if _listeners.get(address) is stop:
+            del _listeners[address]
+
+
+class PreemptionGuard:
+    """Training-loop side: latches the first preemption notice so the
+    step loop can run a just-in-time save at a safe point.
+
+    In cluster mode the guard also subscribes this process to the PREEMPT
+    channel (lazily, via the connected worker's GCS)."""
+
+    def __init__(self, gcs_address: Optional[str] = None,
+                 node_id: Optional[str] = None):
+        self._event = threading.Event()
+        self._notice: Optional[Dict[str, Any]] = None
+
+        def on_notice(notice: Dict[str, Any]) -> None:
+            self._notice = notice
+            self._event.set()
+
+        self._cb = register_preempt_callback(on_notice)
+        address = gcs_address
+        if address is None:
+            try:
+                from ray_tpu._private import worker as worker_mod
+
+                w = worker_mod.global_worker_or_none()
+                address = getattr(w.core, "gcs_address", None) \
+                    if w is not None else None
+            except Exception:  # noqa: BLE001
+                address = None
+        if address:
+            try:
+                start_preempt_listener(address, node_id=node_id)
+            except Exception:  # noqa: BLE001 — guard still works locally
+                logger.exception("preempt listener failed to start")
+
+    @property
+    def triggered(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def notice(self) -> Optional[Dict[str, Any]]:
+        return self._notice
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def close(self) -> None:
+        unregister_preempt_callback(self._cb)
+
+    def __enter__(self) -> "PreemptionGuard":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PreemptionWatcher:
+    """Host side: turns SIGTERM and the TPU maintenance-event sentinel
+    into one PREEMPT publish (the node agent runs one per node).
+
+    ``sentinel_path`` (default ``$RAY_TPU_MAINTENANCE_SENTINEL``) is
+    polled for existence — cloud providers surface maintenance events as
+    a droppable file/flag; tests touch the file. Signal installation is
+    opt-in: handlers only install from the main thread of a process that
+    owns its lifecycle (the agent subprocess), never from embedded
+    library code."""
+
+    def __init__(self, node_id: str = "", gcs_address: Optional[str] = None,
+                 sentinel_path: Optional[str] = None,
+                 install_signal: bool = False, poll_s: float = 1.0):
+        self.node_id = node_id
+        self.gcs_address = gcs_address
+        self.sentinel_path = (sentinel_path if sentinel_path is not None
+                              else os.environ.get(
+                                  "RAY_TPU_MAINTENANCE_SENTINEL", ""))
+        self._fired = threading.Event()
+        self._stop = threading.Event()
+        self._prev_handler = None
+        if install_signal:
+            try:
+                self._prev_handler = signal.signal(
+                    signal.SIGTERM, self._on_sigterm)
+            except ValueError:  # not the main thread
+                logger.warning("PreemptionWatcher: cannot install "
+                               "SIGTERM handler off the main thread")
+        if self.sentinel_path:
+            threading.Thread(target=self._poll_loop, args=(poll_s,),
+                             daemon=True,
+                             name="preempt-sentinel").start()
+
+    def _on_sigterm(self, signum, frame) -> None:
+        self.trigger("SIGTERM")
+        prev = self._prev_handler
+        if callable(prev):
+            prev(signum, frame)
+
+    def _poll_loop(self, poll_s: float) -> None:
+        while not self._stop.wait(poll_s):
+            try:
+                if os.path.exists(self.sentinel_path):
+                    self.trigger("maintenance-event")
+                    return
+            except OSError:
+                pass
+
+    def trigger(self, reason: str) -> None:
+        """Publish the PREEMPT notice exactly once."""
+        if self._fired.is_set():
+            return
+        self._fired.set()
+        logger.warning("preemption detected on node %s: %s",
+                       self.node_id[:12] or "?", reason)
+        try:
+            publish_preempt(reason=reason, node=self.node_id or "*",
+                            gcs_address=self.gcs_address)
+        except Exception:  # noqa: BLE001 — the host is dying; best effort
+            logger.exception("failed to publish PREEMPT notice")
+
+    @property
+    def fired(self) -> bool:
+        return self._fired.is_set()
+
+    def stop(self) -> None:
+        self._stop.set()

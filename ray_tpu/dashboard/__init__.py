@@ -91,6 +91,7 @@ _INDEX_HTML = """<!doctype html>
 <h2>XLA programs (compiles / retraces / achieved)</h2>
 <table id="xla"></table>
 <h2>Profiler captures</h2><table id="captures"></table>
+<h2>Checkpoints (committed manifests)</h2><table id="ckpts"></table>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Node agents</h2><table id="agents"></table>
 <h2>Actors</h2><table id="actors"></table>
@@ -169,6 +170,11 @@ async function xlaPanel(){
     (await j("/api/v1/profile/list")).slice(0,20).map(e=>({
       capture:e.capture_id,status:e.status,node:e.node_id,pid:e.pid,
       trace_dir:e.trace_dir||"",files:e.files||""})));
+  table(document.getElementById("ckpts"),
+    (await j("/api/v1/checkpoints")).slice(0,20).map(m=>({
+      run:m.run,step:m.step,nprocs:m.nprocs,bytes:m.bytes,
+      dir:m.dir||"",
+      at:new Date((m.ts||0)*1000).toLocaleTimeString()})));
 }
 async function refresh(){
   try{
@@ -366,6 +372,11 @@ class Dashboard:
 
             return xla_monitor.list_programs(gcs_address)
 
+        def checkpoints():
+            from ray_tpu.checkpoint.plane import list_manifests_kv
+
+            return list_manifests_kv(gcs)
+
         def metrics_query(params):
             """Translate HTTP query params into a TSDB query served by the
             GCS ``__metrics__`` KV namespace: ``series`` (exact name, or
@@ -434,6 +445,9 @@ class Dashboard:
                         ctype = "application/json"
                     elif path == "/api/v1/xla/programs":
                         body = json.dumps(xla_programs()).encode()
+                        ctype = "application/json"
+                    elif path == "/api/v1/checkpoints":
+                        body = json.dumps(checkpoints()).encode()
                         ctype = "application/json"
                     else:
                         route = {

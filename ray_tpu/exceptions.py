@@ -150,6 +150,18 @@ class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 
 
+class PreemptedError(RayTpuError):
+    """A training worker stopped because its host is being preempted
+    (SIGTERM / TPU maintenance event). Raised by train loops after their
+    just-in-time checkpoint; the trainer controller treats it as
+    retryable and resumes from the newest committed manifest."""
+
+    def __init__(self, reason: str = "host preempted", notice=None):
+        self.reason = reason
+        self.notice = notice
+        super().__init__(reason)
+
+
 class NodeDiedError(RayTpuError):
     """The node running the task/actor died."""
 

@@ -27,8 +27,11 @@ class LlamaDeployment:
 
     def __init__(self, config: Optional[llama.LlamaConfig] = None,
                  params=None, max_len: int = 512,
-                 max_batch_size: int = 8):
+                 max_batch_size: int = 8,
+                 checkpoint_path: Optional[str] = None):
         self.config = config or llama.LlamaConfig.tiny()
+        if params is None and checkpoint_path:
+            params = _params_from_checkpoint(checkpoint_path)
         self.generator = LlamaGenerator(self.config, params=params,
                                         max_len=max_len)
         self.max_batch_size = max_batch_size
@@ -52,10 +55,25 @@ class LlamaDeployment:
         ]
 
 
+def _params_from_checkpoint(path: str):
+    """Cold-start params from a training run's committed checkpoint
+    (checkpoint plane, ``ray_tpu/checkpoint/plane.py``): the newest
+    committed manifest under ``path`` — a plane root, run dir, or
+    anything ``load_latest`` accepts. A saved ``TrainState`` contributes
+    its ``params``; a bare params pytree loads as-is. The serving mesh
+    need not match the training topology (elastic restore)."""
+    from ray_tpu.checkpoint import load_latest
+
+    state = load_latest(path)
+    return getattr(state, "params", state)
+
+
 def build_llama_app(config: Optional[llama.LlamaConfig] = None,
-                    num_replicas: int = 1, max_len: int = 512):
+                    num_replicas: int = 1, max_len: int = 512,
+                    checkpoint_path: Optional[str] = None):
     dep = LlamaDeployment.options(num_replicas=num_replicas)
-    return dep.bind(config, None, max_len)
+    return dep.bind(config, None, max_len,
+                    checkpoint_path=checkpoint_path)
 
 
 __all__ = ["LlamaDeployment", "build_llama_app"]
@@ -71,18 +89,22 @@ class ContinuousLlamaDeployment:
     def __init__(self, config: Optional[llama.LlamaConfig] = None,
                  params=None, num_slots: int = 8, max_len: int = 512,
                  eos_token: Optional[int] = None, sync_every: int = 1,
-                 use_decode_kernel: Optional[bool] = None):
+                 use_decode_kernel: Optional[bool] = None,
+                 checkpoint_path: Optional[str] = None):
         """Engine knobs (``num_slots``, ``max_len``, ``sync_every``,
         ``use_decode_kernel``) pass straight to the ContinuousBatcher and
         are overridable per-deploy via the serve config ``init_kwargs``
         (see serve/config.py) — no application-module edits to retune a
-        replica."""
+        replica. ``checkpoint_path`` cold-starts params from a training
+        run's newest committed checkpoint (manifest plane)."""
         import queue
         import threading
 
         from ray_tpu.models.continuous_batching import ContinuousBatcher
 
         self.config = config or llama.LlamaConfig.tiny()
+        if params is None and checkpoint_path:
+            params = _params_from_checkpoint(checkpoint_path)
         self._queues: Dict[int, "queue.Queue"] = {}
         self._lock = threading.Lock()
         self._work = threading.Event()
@@ -167,13 +189,15 @@ class ContinuousLlamaDeployment:
 def build_continuous_llama_app(config: Optional[llama.LlamaConfig] = None,
                                num_replicas: int = 1, num_slots: int = 8,
                                max_len: int = 512, sync_every: int = 1,
-                               use_decode_kernel: Optional[bool] = None):
+                               use_decode_kernel: Optional[bool] = None,
+                               checkpoint_path: Optional[str] = None):
     dep = ContinuousLlamaDeployment.options(num_replicas=num_replicas)
     # Keyword bind so per-deploy ``init_kwargs`` overrides (serve config
     # files) can retarget any engine knob without positional conflicts.
     return dep.bind(config=config, num_slots=num_slots, max_len=max_len,
                     sync_every=sync_every,
-                    use_decode_kernel=use_decode_kernel)
+                    use_decode_kernel=use_decode_kernel,
+                    checkpoint_path=checkpoint_path)
 
 
 __all__ += ["ContinuousLlamaDeployment", "build_continuous_llama_app"]

@@ -238,6 +238,25 @@ class ShardedTrainer:
             lambda x: jax.device_put(x, self.batch_sharding), batch
         )
 
+    # -- checkpoint plane hooks --------------------------------------------
+    def save_state(self, plane, state: TrainState, step: Optional[int] = None):
+        """Async-save ``state`` through a checkpoint plane
+        (:class:`ray_tpu.checkpoint.CheckpointPlane`). The device→host
+        handoff happens before this returns; serialization + write +
+        manifest commit run in the background. Returns the SaveHandle."""
+        if step is None:
+            step = int(state.step)  # syncs the step scalar only
+        return plane.save_async(int(step), state)
+
+    def restore_state(self, plane, step: Optional[int] = None) -> TrainState:
+        """Restore a committed checkpoint onto THIS trainer's mesh layout.
+
+        The saving topology is irrelevant: shards are reassembled and
+        re-sharded per ``self.state_shardings`` (elastic restore — save on
+        ``fsdp=8``, restore on ``fsdp=4×tp=2`` is bit-identical)."""
+        with self.mesh:
+            return plane.restore(self.state_shardings, step=step)
+
 
 def synthetic_batch(
     batch_size: int, seq_len: int, vocab_size: int, seed: int = 0

@@ -448,6 +448,58 @@ def cmd_profile(args):
               f"{e.get('trace_dir', '')}")
 
 
+def cmd_ckpt(args):
+    """Checkpoint plane CLI:
+
+    * ``list`` shows committed manifests — from the cluster KV when an
+      address is reachable, or from ``--root`` (filesystem scan) for
+      offline runs.
+    * ``inspect`` dumps one step directory: commit status, shard files,
+      and per-leaf shape/dtype/bytes/shard-count.
+    """
+    from ray_tpu.checkpoint import plane as ckpt_plane
+
+    if args.action == "inspect":
+        if not args.path:
+            raise SystemExit("ckpt inspect needs a step directory path")
+        info = ckpt_plane.inspect_dir(args.path)
+        if args.format == "json":
+            print(json.dumps(info, indent=2))
+            return
+        status = "committed" if info["committed"] else "UNCOMMITTED"
+        print(f"{info['dir']}  [{status}]  "
+              f"shard_files={info['num_shard_files']}")
+        man = info["manifest"]
+        if man:
+            stamp = time.strftime("%Y-%m-%d %H:%M:%S",
+                                  time.localtime(man.get("ts", 0)))
+            print(f"  run={man.get('run')} step={man.get('step')} "
+                  f"nprocs={man.get('nprocs')} bytes={man.get('bytes')} "
+                  f"committed_by=proc{man.get('committed_by')} at {stamp}")
+        for i, leaf in enumerate(info["leaves"]):
+            print(f"  leaf[{i:3d}] shape={tuple(leaf['shape'])} "
+                  f"dtype={leaf['dtype']} shards={leaf['shards']} "
+                  f"bytes={leaf['bytes']}")
+        return
+    # list
+    if args.root:
+        manifests = ckpt_plane.list_checkpoints(args.root)
+    else:
+        manifests = ckpt_plane.list_manifests_kv(
+            args.address or _auto_address())
+    if args.format == "json":
+        print(json.dumps(manifests, indent=2))
+        return
+    if not manifests:
+        print("no committed checkpoints")
+        return
+    for m in manifests:
+        stamp = time.strftime("%H:%M:%S", time.localtime(m.get("ts", 0)))
+        print(f"{stamp} run={m.get('run'):16} step={m.get('step'):>8} "
+              f"nprocs={m.get('nprocs')} bytes={m.get('bytes')} "
+              f"{m.get('dir', '')}")
+
+
 def cmd_logs(args):
     """Tail cluster logs (reference: ``ray logs`` + the dashboard log
     viewer over the LOG pubsub channel)."""
@@ -763,6 +815,19 @@ def main(argv=None):
                    help="capture: extra seconds to wait for registration")
     p.add_argument("--format", choices=["table", "json"], default="table")
     p.set_defaults(fn=cmd_profile)
+
+    p = sub.add_parser("ckpt",
+                       help="checkpoint plane: list committed manifests, "
+                            "inspect a step dir")
+    p.add_argument("action", choices=["list", "inspect"])
+    p.add_argument("path", nargs="?",
+                   help="inspect: a step-<n> checkpoint directory")
+    p.add_argument("--address")
+    p.add_argument("--root",
+                   help="list: scan this checkpoint root on disk instead "
+                        "of the cluster KV")
+    p.add_argument("--format", choices=["table", "json"], default="table")
+    p.set_defaults(fn=cmd_ckpt)
 
     p = sub.add_parser("logs", help="tail worker logs (or one job's logs)")
     p.add_argument("--address")

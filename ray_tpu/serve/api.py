@@ -377,12 +377,24 @@ class ServeController:
             return cached[1]
         replicas = list(self.replicas.get(name, []))
         refs = [r.metrics.remote() for r in replicas]
-        loads = []
-        for ref in refs:
-            try:
-                loads.append(ray_tpu.get(ref, timeout=1)["ongoing"])
-            except Exception:  # noqa: BLE001 — dying replica: avoid it
-                loads.append(1 << 20)
+        # One SHARED deadline for the whole probe fan-out: serial
+        # per-replica 1s timeouts made a deployment with several dying
+        # replicas stall the controller (and every router waiting on it)
+        # for N seconds per refresh.
+        loads = [1 << 20] * len(refs)  # dying replica: avoid it
+        try:
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=1.0)
+            ready_ids = {r.id().binary() for r in ready}
+            for i, ref in enumerate(refs):
+                if ref.id().binary() not in ready_ids:
+                    continue
+                try:
+                    loads[i] = ray_tpu.get(ref, timeout=0.1)["ongoing"]
+                except Exception:  # noqa: BLE001 — replica died mid-probe
+                    pass
+        except Exception:  # noqa: BLE001 — wait itself failed
+            pass
         self._loads_cache[name] = (now, loads)
         return loads
 

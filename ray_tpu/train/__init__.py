@@ -24,7 +24,12 @@ from ray_tpu.train.config import (
     RunConfig,
     ScalingConfig,
 )
-from ray_tpu.train.session import get_checkpoint, get_context, report
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_checkpoint_plane,
+    get_context,
+    report,
+)
 from ray_tpu.train.storage import AsyncCheckpointer, StorageContext
 from ray_tpu.train.trainer import ControllerState, JaxTrainer
 
@@ -33,8 +38,8 @@ __all__ = [
     "CheckpointConfig", "CheckpointManager", "ControllerState",
     "FailureConfig", "JaxBackend", "JaxTrainer", "Result", "RunConfig",
     "ScalingConfig", "StorageContext", "TrainWorker", "WorkerGroup",
-    "get_checkpoint", "get_context", "load_pytree", "report",
-    "save_pytree",
+    "get_checkpoint", "get_checkpoint_plane", "get_context",
+    "load_pytree", "report", "save_pytree",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -89,11 +89,12 @@ class TrainWorker:
         return jax.process_index()
 
     def run(self, fn: Callable, config: Optional[Dict[str, Any]],
-            restore_checkpoint_path: Optional[str]):
+            restore_checkpoint_path: Optional[str],
+            run_dir: Optional[str] = None):
         """Run the user train loop to completion (blocking actor call)."""
         ckpt = (Checkpoint(restore_checkpoint_path)
                 if restore_checkpoint_path else None)
-        s = session_mod._Session(self._ctx, ckpt)
+        s = session_mod._Session(self._ctx, ckpt, run_dir=run_dir)
         with self._lock:
             self._session = s
         session_mod._set_session(s)
@@ -101,6 +102,13 @@ class TrainWorker:
             s.result = fn(config) if config is not None else fn()
             return s.result
         finally:
+            if s.checkpoint_plane is not None:
+                # Join in-flight async saves so a committed manifest is
+                # durable before the controller sees this worker finish.
+                try:
+                    s.checkpoint_plane.close()
+                except Exception:  # noqa: BLE001 — loop outcome wins
+                    logger.exception("checkpoint plane close failed")
             s.finished.set()
             session_mod._set_session(None)
 
@@ -206,10 +214,11 @@ class BackendExecutor:
 
     def start_training(self, train_fn: Callable,
                        config: Optional[Dict[str, Any]],
-                       restore_checkpoint_path: Optional[str]) -> List[Any]:
+                       restore_checkpoint_path: Optional[str],
+                       run_dir: Optional[str] = None) -> List[Any]:
         assert self.worker_group is not None
         return self.worker_group.execute_async(
-            "run", train_fn, config, restore_checkpoint_path)
+            "run", train_fn, config, restore_checkpoint_path, run_dir)
 
     def poll(self) -> List[Dict[str, Any]]:
         assert self.worker_group is not None

@@ -52,9 +52,12 @@ class TrainContext:
 
 class _Session:
     def __init__(self, context: TrainContext,
-                 checkpoint: Optional[Checkpoint] = None):
+                 checkpoint: Optional[Checkpoint] = None,
+                 run_dir: Optional[str] = None):
         self.context = context
         self.restore_checkpoint = checkpoint
+        self.run_dir = run_dir
+        self.checkpoint_plane = None  # lazily built, one per session
         self.reports: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
         self.error: Optional[BaseException] = None
@@ -98,3 +101,28 @@ def get_checkpoint() -> Optional[Checkpoint]:
     """Checkpoint to restore from (set when recovering from failure)."""
     s = _get_session()
     return s.restore_checkpoint
+
+
+def get_checkpoint_plane(run: str = "train"):
+    """This run's distributed checkpoint plane
+    (:class:`ray_tpu.checkpoint.CheckpointPlane`), rooted inside the
+    experiment directory and keyed by this worker's rank — every worker
+    of one run participates in the same two-phase-commit manifest stream.
+    Use it for async sharded saves, elastic restores, and preemption-time
+    just-in-time checkpoints."""
+    import os
+
+    s = _get_session()
+    if s.checkpoint_plane is None:
+        if s.run_dir is None:
+            raise RuntimeError(
+                "this session has no run directory — "
+                "get_checkpoint_plane() needs a JaxTrainer-managed run")
+        from ray_tpu.checkpoint import CheckpointPlane
+
+        ctx = s.context
+        s.checkpoint_plane = CheckpointPlane(
+            os.path.join(s.run_dir, "ckpt_plane"), run=run,
+            process_index=ctx.get_world_rank(),
+            process_count=ctx.get_world_size())
+    return s.checkpoint_plane

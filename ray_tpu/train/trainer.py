@@ -126,6 +126,11 @@ class JaxTrainer:
 
         failure_cfg: FailureConfig = rc.failure_config
         failures = 0
+        preemptions = 0
+        # Preemptions are routine on TPU pods, not failures: they get
+        # their own (generous) budget instead of consuming max_failures.
+        max_preemptions = int(os.environ.get(
+            "RAY_TPU_MAX_PREEMPTIONS", 64))
         restore: Optional[Checkpoint] = self.resume_from_checkpoint
         latest_metrics: Optional[Dict[str, Any]] = None
         history: List[Dict[str, Any]] = []
@@ -147,7 +152,7 @@ class JaxTrainer:
             executor.start()
             run_refs = executor.start_training(
                 self.train_loop, self.train_loop_config,
-                restore.path if restore else None)
+                restore.path if restore else None, run_dir=exp_dir)
             self._set_state(ControllerState.RUNNING)
             try:
                 self._drive(executor, run_refs, manager, history)
@@ -156,6 +161,31 @@ class JaxTrainer:
                 executor.shutdown()
                 self._set_state(ControllerState.FINISHED)
                 break
+            except exceptions.PreemptedError as e:
+                # A worker host is going away (SIGTERM / maintenance
+                # event): the loop already ran its just-in-time save, so
+                # restart and resume from the newest COMMITTED manifest
+                # — the checkpoint plane guarantees readers never see the
+                # half-written one (see ray_tpu/checkpoint/plane.py).
+                executor.shutdown()
+                preemptions += 1
+                if preemptions > max_preemptions:
+                    error = e
+                    latest_metrics = history[-1]["metrics"] if history else None
+                    self._set_state(ControllerState.ERRORED)
+                    break
+                self._set_state(ControllerState.RESTARTING)
+                try:
+                    manager.flush()
+                except Exception as persist_err:  # noqa: BLE001
+                    logger.warning("checkpoint persist failed (%s); "
+                                   "restoring from the previous one",
+                                   persist_err)
+                restore = manager.latest or restore
+                logger.warning(
+                    "worker preempted (%s); resuming from the newest "
+                    "committed checkpoint (preemption %d/%d)",
+                    e.reason, preemptions, max_preemptions)
             except (exceptions.RayTaskError, exceptions.ActorDiedError,
                     exceptions.WorkerCrashedError) as e:
                 executor.shutdown()

@@ -32,6 +32,13 @@ except Exception:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: expensive test excluded from the tier-1 window "
+        "(-m 'not slow')")
+
+
 @pytest.fixture
 def ray_start_regular():
     """In-process runtime, fresh per test (reference: conftest.py::ray_start_regular)."""

@@ -77,6 +77,27 @@ def test_xla_and_device_memory_series_are_cataloged():
             assert m.description.strip() and m.tag_keys
 
 
+def test_checkpoint_plane_series_are_cataloged():
+    """The checkpoint plane's series (ray_tpu/checkpoint/) ship described
+    + tagged in the catalog, including the acceptance-criteria
+    ``ray_tpu_ckpt_block_ms`` step-blocking gauge."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_ckpt_block_ms",
+        "ray_tpu_ckpt_save_seconds",
+        "ray_tpu_ckpt_restore_seconds",
+        "ray_tpu_ckpt_bytes_total",
+        "ray_tpu_ckpt_saves_total",
+        "ray_tpu_ckpt_preempt_notices_total",
+    }
+    missing = required - names
+    assert not missing, (
+        f"checkpoint-plane series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name.startswith("ray_tpu_ckpt_"):
+            assert m.description.strip() and m.tag_keys
+
+
 # Framework-owned jax.jit call sites must go through the instrumented
 # wrapper (ray_tpu._private.xla_monitor.instrument) so every compile,
 # retrace and cost analysis is observed. Intentional raw jits are
