@@ -180,6 +180,19 @@ CB_PREFILL_TOKENS = Counter(
     "ray_tpu_cb_prefill_tokens_total",
     "Prompt tokens prefilled (true lengths; bucket padding excluded)",
     ("engine",))
+CB_KV_BLOCKS_USED = Gauge(
+    "ray_tpu_cb_kv_blocks_used",
+    "Paged-KV arena blocks currently reserved by active slots",
+    ("engine",))
+CB_KV_BLOCKS_TOTAL = Gauge(
+    "ray_tpu_cb_kv_blocks_total",
+    "Paged-KV arena capacity in blocks (garbage block excluded)",
+    ("engine",))
+CB_KV_FRAG_RATIO = Gauge(
+    "ray_tpu_cb_kv_frag_ratio",
+    "Reserved-but-unwritten fraction of used paged-KV blocks "
+    "(internal fragmentation of the arena)",
+    ("engine",))
 
 # ------------------------------------------------- XLA plane (_private/
 # xla_monitor.py): compiles/retraces per instrumented program, compiler

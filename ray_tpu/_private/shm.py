@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import ctypes
 import logging
+import mmap
 import os
 import threading
 import uuid
@@ -216,13 +217,33 @@ class ShmClient:
             lib.shm_client_unmap(ptr, size)
 
     @staticmethod
-    def map_segment(name: str, size: int) -> Optional[memoryview]:
-        """Zero-copy read-only view (caller must keep the view referenced)."""
-        lib = get_lib()
-        if lib is None:
+    def map_segment_view(name: str, size: int) -> Optional[memoryview]:
+        """Zero-copy read: mmap the segment and hand back a memoryview
+        whose lifetime OWNS the mapping — slices (and numpy arrays
+        deserialized over them) keep the map alive, and the mapping is
+        released when the last view is garbage-collected. This is the
+        ``get()`` data plane: the old ``read_segment`` copies the whole
+        object into a bytes (the large-``get`` throughput collapse,
+        ROADMAP item 3); deserialization over this view is copy-free
+        because pickle-5 out-of-band buffers are sub-views. POSIX keeps
+        the mapping valid after the store unlinks/evicts the segment, so
+        readers never race eviction.
+
+        Tradeoff (shared with plasma-style stores): a live reader view
+        pins the unlinked segment's tmpfs pages until garbage-collected,
+        so the store's used-bytes accounting can transiently undercount
+        what /dev/shm actually holds. Readers that keep long-lived
+        references to LARGE fetched objects keep their whole segment
+        resident — copy out (``np.array(x)``) to release it early."""
+        path = f"/dev/shm/{name.lstrip('/')}"
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except OSError:
             return None
-        ptr = lib.shm_client_map(name.encode(), size)
-        if not ptr:
+        try:
+            mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        except (OSError, ValueError):
             return None
-        array = (ctypes.c_char * size).from_address(ptr)
-        return memoryview(array)
+        finally:
+            os.close(fd)
+        return memoryview(mm)

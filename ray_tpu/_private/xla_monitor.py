@@ -529,16 +529,27 @@ class InstrumentedJit:
                 _set_achieved(_record(self.name),
                               self._cost_for(self._last_key), dt)
 
-    def note_execution(self, seconds: float) -> Optional[Dict[str, float]]:
+    def note_execution(self, seconds: float,
+                       bytes_hint: Optional[float] = None
+                       ) -> Optional[Dict[str, float]]:
         """Feed back a MEASURED wall time for the most recent call (the
         serve tick measures dispatch→fetch, prefill measures
         dispatch→first-token sync). Disables the cadence fallback for
-        this wrapper and returns the achieved figures."""
+        this wrapper and returns the achieved figures.
+
+        ``bytes_hint`` overrides the compiler cost-analysis bytes for
+        the achieved-bandwidth gauge: programs whose real traffic is
+        data-dependent (the paged decode tick reads only LIVE KV blocks)
+        would otherwise be priced at the compiled worst case — the
+        gauge must scale with live tokens, not ``S_max``."""
         self._external_timing = True
         if seconds <= 0:
             return None
-        return _set_achieved(_record(self.name),
-                             self._cost_for(self._last_key), seconds)
+        cost = self._cost_for(self._last_key)
+        if bytes_hint is not None and bytes_hint > 0:
+            cost = dict(cost) if cost else {}
+            cost["bytes_accessed"] = float(bytes_hint)
+        return _set_achieved(_record(self.name), cost, seconds)
 
     def _cost_for(self, key) -> Optional[Dict[str, Any]]:
         rec = _programs.get(self.name)

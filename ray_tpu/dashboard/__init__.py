@@ -87,6 +87,7 @@ _INDEX_HTML = """<!doctype html>
 </style></head><body>
 <h1>ray_tpu dashboard <span id="status"></span></h1>
 <h2>Cluster</h2><div id="cluster"></div>
+<h2>Serve / KV arena</h2><div id="serve"></div>
 <h2>Metrics (last 5 min)</h2><div id="metrics"></div>
 <h2>XLA programs (compiles / retraces / achieved)</h2>
 <table id="xla"></table>
@@ -123,13 +124,9 @@ function spark(pts){
   return `<svg width="${w}" height="${h}"><polyline fill="none" `+
          `stroke="#8cf" stroke-width="1" points="${d}"/></svg>`;
 }
-async function metricsPanel(){
-  // 3s avg buckets: ~100 points per 120px sparkline; full 0.25s
-  // resolution would ship ~10x the payload for identical pixels. The
-  // limit matches the rendered row count so big clusters don't ship
-  // thousands of series per refresh just to be sliced client-side.
-  const data=await j("/api/v1/metrics/query?since=300&agg=avg&step=3&limit=80");
-  const rows=data.slice(0,80).map(s=>{
+function sparkRows(data,limit){
+  // Shared sparkline row builder for the metrics + serve panels.
+  return data.slice(0,limit).map(s=>{
     const last=s.points.length?s.points[s.points.length-1][1]:0;
     const lbl=Object.entries(s.labels).filter(([k])=>k!=="pid")
       .map(([k,v])=>`${k}=${v}`).join(",");
@@ -137,8 +134,26 @@ async function metricsPanel(){
     return `<div class="spark">${spark(s.points)}<span class="sname">`+
       `${esc(s.name)}${lbl?"{"+esc(lbl)+"}":""}</span>`+
       `<span class="sval">${esc(val)}</span></div>`;
-  });
-  document.getElementById("metrics").innerHTML=rows.join("")||"(no series)";
+  }).join("");
+}
+async function metricsPanel(){
+  // 3s avg buckets: ~100 points per 120px sparkline; full 0.25s
+  // resolution would ship ~10x the payload for identical pixels. The
+  // limit matches the rendered row count so big clusters don't ship
+  // thousands of series per refresh just to be sliced client-side.
+  const data=await j("/api/v1/metrics/query?since=300&agg=avg&step=3&limit=80");
+  document.getElementById("metrics").innerHTML=
+    sparkRows(data,80)||"(no series)";
+}
+async function servePanel(){
+  // Serving hot-loop vitals: slot occupancy, decode rate, and the paged
+  // KV arena (blocks used/total + fragmentation) per engine — the
+  // sparkline makes admission stalls from arena exhaustion visible at a
+  // glance.
+  const data=await j("/api/v1/metrics/query?series=ray_tpu_cb_*"+
+                     "&since=300&agg=avg&step=3&limit=60");
+  document.getElementById("serve").innerHTML=
+    sparkRows(data,60)||"(no serve engines)";
 }
 async function xlaPanel(){
   // Compile/retrace table per (node, program) from the xla series the
@@ -193,6 +208,7 @@ async function refresh(){
     document.getElementById("logs").textContent=logs.slice(-200)
       .map(l=>`[${l.worker} ${l.pid}] ${l.line}`).join("\\n");
     await metricsPanel();
+    await servePanel();
     await xlaPanel();
     document.getElementById("status").textContent=
       "updated "+new Date().toLocaleTimeString();

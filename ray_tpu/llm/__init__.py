@@ -90,13 +90,23 @@ class ContinuousLlamaDeployment:
                  params=None, num_slots: int = 8, max_len: int = 512,
                  eos_token: Optional[int] = None, sync_every: int = 1,
                  use_decode_kernel: Optional[bool] = None,
+                 paged: Optional[bool] = None, block_size: int = 64,
+                 kv_dtype: Optional[str] = None,
+                 num_blocks: Optional[int] = None,
+                 sampling=None,
                  checkpoint_path: Optional[str] = None):
         """Engine knobs (``num_slots``, ``max_len``, ``sync_every``,
-        ``use_decode_kernel``) pass straight to the ContinuousBatcher and
-        are overridable per-deploy via the serve config ``init_kwargs``
-        (see serve/config.py) — no application-module edits to retune a
-        replica. ``checkpoint_path`` cold-starts params from a training
-        run's newest committed checkpoint (manifest plane)."""
+        ``use_decode_kernel``, and the paged-KV plane's ``paged`` /
+        ``block_size`` / ``kv_dtype`` / ``num_blocks`` / ``sampling``)
+        pass straight to the ContinuousBatcher and are overridable
+        per-deploy via the serve config ``init_kwargs`` (see
+        serve/config.py) — no application-module edits to retune a
+        replica. ``sampling`` accepts a
+        :class:`~ray_tpu.models.sampling.SamplingParams` or a plain dict
+        (``{"temperature": 0.7, "top_p": 0.9, "seed": 0}``), which is
+        what YAML-sourced deploy configs produce. ``checkpoint_path``
+        cold-starts params from a training run's newest committed
+        checkpoint (manifest plane)."""
         import queue
         import threading
 
@@ -113,7 +123,9 @@ class ContinuousLlamaDeployment:
             self.config, params=params, num_slots=num_slots,
             max_len=max_len, eos_token=eos_token,
             token_callback=self._on_token, sync_every=sync_every,
-            use_decode_kernel=use_decode_kernel)
+            use_decode_kernel=use_decode_kernel, paged=paged,
+            block_size=block_size, kv_dtype=kv_dtype,
+            num_blocks=num_blocks, sampling=sampling)
         threading.Thread(target=self._tick_loop, daemon=True,
                          name="llm-ticks").start()
 
@@ -190,13 +202,20 @@ def build_continuous_llama_app(config: Optional[llama.LlamaConfig] = None,
                                num_replicas: int = 1, num_slots: int = 8,
                                max_len: int = 512, sync_every: int = 1,
                                use_decode_kernel: Optional[bool] = None,
+                               paged: Optional[bool] = None,
+                               block_size: int = 64,
+                               kv_dtype: Optional[str] = None,
+                               num_blocks: Optional[int] = None,
+                               sampling=None,
                                checkpoint_path: Optional[str] = None):
     dep = ContinuousLlamaDeployment.options(num_replicas=num_replicas)
     # Keyword bind so per-deploy ``init_kwargs`` overrides (serve config
     # files) can retarget any engine knob without positional conflicts.
     return dep.bind(config=config, num_slots=num_slots, max_len=max_len,
                     sync_every=sync_every,
-                    use_decode_kernel=use_decode_kernel,
+                    use_decode_kernel=use_decode_kernel, paged=paged,
+                    block_size=block_size, kv_dtype=kv_dtype,
+                    num_blocks=num_blocks, sampling=sampling,
                     checkpoint_path=checkpoint_path)
 
 

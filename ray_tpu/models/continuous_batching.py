@@ -15,6 +15,15 @@ of compiled programs stays logarithmic. Padded prefill is sound without
 length masking because a slot's garbage cache entries live only at
 positions strictly greater than its next decode position — every decode
 overwrites position ``p`` before attending ``[0..p]``.
+
+The KV data plane is PAGED by default (``models/paged_kv.py``): slots
+share one block arena through per-slot block tables, so a tick's
+attention streams only the blocks a slot actually filled — no
+``S_max`` padding traffic — with optional int8 arena storage halving
+bytes-per-token again. ``paged=False`` keeps the dense pooled cache
+(one private ``[S_max]`` stripe per slot). Sampling (temperature/top-p)
+runs in-device inside the tick jit either way; only token ids cross to
+the host.
 """
 
 from __future__ import annotations
@@ -32,10 +41,16 @@ from ray_tpu._private import xla_monitor
 from ray_tpu.models import llama
 from ray_tpu.models.inference import KVCache, _forward_cached, lm_head_logits
 from ray_tpu.models.llama import rms_norm
+from ray_tpu.models.paged_kv import (GARBAGE_BLOCK, BlockAllocator,
+                                     PagedKVCache, quantize_kv,
+                                     resolve_kv_dtype)
+from ray_tpu.models.sampling import SamplingParams, sample_tokens, step_key
 from ray_tpu.ops.decode_attention import (decode_applicable,
                                           decode_attention,
                                           decode_attention_reference,
                                           env_flag)
+from ray_tpu.ops.paged_decode_attention import (paged_applicable,
+                                                paged_decode_attention)
 from ray_tpu.ops.rope import rope_frequencies
 
 
@@ -60,16 +75,71 @@ def _scatter_slot(cache, new, positions):
     return jax.vmap(one)(cache, new, positions)
 
 
-# The XLA reference single-query attention now lives next to the fused
+def _scatter_arena(arena, new, flat_pos):
+    """Paged scatter: arena [NB, bs, ...] viewed flat over tokens; one
+    entry per slot written at ``flat_pos`` [B] (= block_id * bs +
+    offset). Freed slots all target the garbage block — duplicate
+    indices write byte-garbage there, which nothing ever attends."""
+    nb, bs = arena.shape[0], arena.shape[1]
+    flat = arena.reshape(nb * bs, *arena.shape[2:])
+    flat = flat.at[flat_pos].set(new.astype(arena.dtype))
+    return flat.reshape(arena.shape)
+
+
+# The XLA reference single-query attention lives next to the fused
 # kernel (ops/decode_attention.py); keep the old name importable — it is
 # the parity baseline the kernel tests compare against.
 _attend_decode = decode_attention_reference
 
 
-def _decode_tick(params, tokens, positions, cache: KVCache,
-                 config: llama.LlamaConfig, use_kernel: bool = False):
+def _next_tokens(logits, step, sampling: SamplingParams, salt: int = 0):
+    """In-device token selection from tick/prefill logits [B, 1, V]:
+    greedy argmax, or temperature/top-p sampling keyed off the
+    device-threaded ``step`` counter (deterministic under a fixed seed,
+    including speculative-rewind replays of the same step). ``salt``
+    separates the prefill and decode key streams — their counters both
+    start at 0, and an unsalted collision would correlate prefill
+    first-token draws with the first decode tick's."""
+    row = logits[:, 0]
+    if sampling.greedy:
+        return jnp.argmax(row, axis=-1).astype(jnp.int32)
+    key = step_key(sampling.seed, step, salt=salt)
+    return sample_tokens(row, key, sampling.temperature, sampling.top_p)
+
+
+_PREFILL_SALT = 1  # prefill sampling stream, distinct from decode's
+
+
+def _layer_qkv(x, layer, cos, sin, c):
+    """Shared per-layer projections for the dense and paged ticks:
+    attn-norm, Q/K/V einsums, RoPE on Q and K (V unrotated). Any
+    numerics change here reaches both data planes at once — the
+    paged-on/off bit-parity contract depends on that."""
+    h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+    q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(c.dtype))
+    k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(c.dtype))
+    v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(c.dtype))
+    return (_apply_rope_batched(q, cos, sin),
+            _apply_rope_batched(k, cos, sin), v)
+
+
+def _layer_finish(x, o, layer, c):
+    """Shared per-layer tail: attention output projection + gated MLP."""
+    x = x + jnp.einsum("bhd,hde->be", o,
+                       layer["wo"].astype(c.dtype))[:, None, :]
+    h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+    gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(c.dtype))
+    up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
+    return x + jnp.einsum("bsm,me->bse", jax.nn.silu(gate) * up,
+                          layer["w_down"].astype(c.dtype))
+
+
+def _decode_tick(params, tokens, positions, cache: KVCache, step,
+                 config: llama.LlamaConfig, use_kernel: bool = False,
+                 sampling: SamplingParams = SamplingParams()):
     """One decode step for every slot: tokens [B] at per-slot absolute
-    ``positions`` [B]. Returns (logits [B, V], cache).
+    ``positions`` [B]. Returns (next_tokens [B], positions+1, cache,
+    step+1) — ``step`` is the device-resident sampling counter.
 
     ``use_kernel`` (static) routes attention through the fused pallas
     decode kernel — one pass over the KV pool in its storage dtype —
@@ -90,25 +160,14 @@ def _decode_tick(params, tokens, positions, cache: KVCache,
         layer = inputs
         ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
         cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
-        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
-        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(c.dtype))
-        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(c.dtype))
-        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(c.dtype))
-        q = _apply_rope_batched(q, cos, sin)
-        k = _apply_rope_batched(k, cos, sin)
+        q, k, v = _layer_qkv(x, layer, cos, sin, c)
         ck = _scatter_slot(ck, k[:, 0].astype(ck.dtype), positions)
         cv = _scatter_slot(cv, v[:, 0].astype(cv.dtype), positions)
         o = decode_attention(q[:, 0], ck, cv, positions, scale,
                              use_kernel=use_kernel)
         ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, li, 0)
         cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, li, 0)
-        x = x + jnp.einsum("bhd,hde->be", o,
-                           layer["wo"].astype(c.dtype))[:, None, :]
-        h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
-        gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(c.dtype))
-        up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
-        x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(gate) * up,
-                           layer["w_down"].astype(c.dtype))
+        x = _layer_finish(x, o, layer, c)
         return (x, ck_all, cv_all, li + 1), None
 
     (x, new_k, new_v, _), _ = jax.lax.scan(
@@ -117,11 +176,86 @@ def _decode_tick(params, tokens, positions, cache: KVCache,
     # lm_head in the params' storage dtype with fp32 accumulation (shared
     # with the prefill path) — bf16 params are no longer upcast in HBM.
     logits = lm_head_logits(x, params, c)
-    # Greedy selection stays ON DEVICE: the host needs 4 bytes per slot,
+    # Token selection stays ON DEVICE: the host needs 4 bytes per slot,
     # not the [B, V] logits — shipping full logits per tick was the
     # serving bottleneck on remote-attached chips (512KB x RTT per token).
-    next_tokens = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-    return next_tokens, positions + 1, KVCache(k=new_k, v=new_v)
+    next_tokens = _next_tokens(logits, step, sampling)
+    return next_tokens, positions + 1, KVCache(k=new_k, v=new_v), step + 1
+
+
+def _decode_tick_paged(params, tokens, positions, tables, limits,
+                       cache: PagedKVCache, step,
+                       config: llama.LlamaConfig, use_kernel: bool = False,
+                       sampling: SamplingParams = SamplingParams()):
+    """Paged decode step: same per-layer structure as :func:`_decode_tick`
+    but K/V scatter/attention go through the block arena + per-slot
+    block tables, so the attention streams only live blocks. ``tables``
+    [B, max_blocks] int32 (dead tail entries repeat the last live block;
+    freed slots point wholesale at the garbage block); ``limits`` [B] is
+    each slot's table-covered token count (reserved_blocks * bs)."""
+    c = config
+    quantized = cache.quantized
+    bs = cache.block_size
+    cos, sin = rope_frequencies(c.head_dim, 0, c.rope_theta,
+                                positions=positions)
+    x = params["embed"].astype(c.dtype)[tokens][:, None, :]
+    scale = c.head_dim ** -0.5
+    # This tick writes at `positions`: resolve each slot's target block
+    # through its table once (shared by every layer's scatter).
+    # Speculative ticks can OVERRUN a slot's reservation (the host
+    # detects finishes up to 2K ticks late): past ``limits`` the table
+    # tail would alias the write onto the slot's LAST LIVE block — and a
+    # later rewind would replay over the corrupted K/V. Redirect overrun
+    # writes to the garbage block instead (the dense engine's analog:
+    # overrun writes land in the slot's private tail, harmlessly).
+    gathered = jnp.take_along_axis(
+        tables, (positions // bs)[:, None], axis=1)[:, 0]        # [B]
+    block_idx = jnp.where(positions < limits, gathered, GARBAGE_BLOCK)
+    flat_pos = block_idx * bs + positions % bs                   # [B]
+
+    def layer_fn(carry, layer):
+        x, ck_all, cv_all, ks_all, vs_all, li = carry
+        ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
+        q, k, v = _layer_qkv(x, layer, cos, sin, c)
+        k_tok, v_tok = k[:, 0], v[:, 0]                  # [B, KVH, D]
+        ksl = vsl = None
+        if quantized:
+            kq, ksc = quantize_kv(k_tok)
+            vq, vsc = quantize_kv(v_tok)
+            ksl = jax.lax.dynamic_index_in_dim(ks_all, li, 0,
+                                               keepdims=False)
+            vsl = jax.lax.dynamic_index_in_dim(vs_all, li, 0,
+                                               keepdims=False)
+            ksl = _scatter_arena(ksl, ksc, flat_pos)
+            vsl = _scatter_arena(vsl, vsc, flat_pos)
+        else:
+            kq, vq = k_tok, v_tok
+        ck = _scatter_arena(ck, kq, flat_pos)
+        cv = _scatter_arena(cv, vq, flat_pos)
+        o = paged_decode_attention(q[:, 0], ck, cv, tables, positions,
+                                   scale, k_scale=ksl, v_scale=vsl,
+                                   use_kernel=use_kernel)
+        o = o.astype(x.dtype)
+        ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, li, 0)
+        cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, li, 0)
+        if quantized:
+            ks_all = jax.lax.dynamic_update_index_in_dim(ks_all, ksl,
+                                                         li, 0)
+            vs_all = jax.lax.dynamic_update_index_in_dim(vs_all, vsl,
+                                                         li, 0)
+        x = _layer_finish(x, o, layer, c)
+        return (x, ck_all, cv_all, ks_all, vs_all, li + 1), None
+
+    carry0 = (x, cache.k, cache.v, cache.k_scale, cache.v_scale,
+              jnp.int32(0))
+    (x, nk, nv, nks, nvs, _), _ = jax.lax.scan(layer_fn, carry0,
+                                               params["layers"])
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = lm_head_logits(x, params, c)
+    next_tokens = _next_tokens(logits, step, sampling)
+    new_cache = PagedKVCache(k=nk, v=nv, k_scale=nks, v_scale=nvs)
+    return next_tokens, positions + 1, new_cache, step + 1
 
 
 def _bucket(n: int, floor: int = 16) -> int:
@@ -131,12 +265,26 @@ def _bucket(n: int, floor: int = 16) -> int:
     return b
 
 
+def _resolve_paged(paged: Optional[bool]) -> bool:
+    """Engine-level paging toggle: explicit arg > RAY_TPU_PAGED_KV env >
+    on (the paged arena is the default data plane)."""
+    if paged is None:
+        paged = env_flag("RAY_TPU_PAGED_KV")
+    if paged is None:
+        return True
+    return bool(paged)
+
+
 def _resolve_decode_kernel(config: llama.LlamaConfig, max_len: int,
-                           use_decode_kernel: Optional[bool]) -> bool:
+                           use_decode_kernel: Optional[bool],
+                           paged: bool = False,
+                           block_size: int = 64) -> bool:
     """Engine-level kernel toggle: explicit arg > RAY_TPU_DECODE_KERNEL
     env > auto (fused kernel on TPU when the shapes tile; the XLA
     reference elsewhere — CPU tests opt in explicitly and run the kernel
-    in interpret mode)."""
+    in interpret mode). The paged engine dispatches the paged kernel
+    (``ops/paged_decode_attention.py``), the dense engine the dense
+    one."""
     from ray_tpu.ops.decode_attention import pltpu as _pltpu
 
     if _pltpu is None:
@@ -146,10 +294,13 @@ def _resolve_decode_kernel(config: llama.LlamaConfig, max_len: int,
     if use_decode_kernel is None:
         use_decode_kernel = env_flag("RAY_TPU_DECODE_KERNEL")
     if use_decode_kernel is None:
-        return (jax.default_backend() == "tpu"
-                and decode_applicable(max_len, config.head_dim,
-                                      config.num_heads,
-                                      config.num_kv_heads))
+        if jax.default_backend() != "tpu":
+            return False
+        if paged:
+            return paged_applicable(block_size, config.head_dim,
+                                    config.num_heads, config.num_kv_heads)
+        return decode_applicable(max_len, config.head_dim,
+                                 config.num_heads, config.num_kv_heads)
     return bool(use_decode_kernel)
 
 
@@ -162,7 +313,12 @@ class ContinuousBatcher:
                  num_slots: int = 8, max_len: int = 512, seed: int = 0,
                  eos_token: Optional[int] = None, token_callback=None,
                  sync_every: int = 1,
-                 use_decode_kernel: Optional[bool] = None):
+                 use_decode_kernel: Optional[bool] = None,
+                 paged: Optional[bool] = None,
+                 block_size: int = 64,
+                 kv_dtype: Optional[str] = None,
+                 num_blocks: Optional[int] = None,
+                 sampling=None):
         """``token_callback(rid, token)`` fires for every generated token
         as it is produced (serving streams ride this).
 
@@ -171,23 +327,58 @@ class ContinuousBatcher:
         costs a full tunnel RTT regardless of size): the engine runs K
         ticks per host synchronization, fetching token batches
         double-buffered so the transfer overlaps the next K ticks'
-        compute. Greedy decode is deterministic, so ticks run ahead of
+        compute. Decode is deterministic (greedy, and sampled decode is
+        keyed off a device-threaded step counter), so ticks run ahead of
         host bookkeeping speculatively; when a request finishes, the
         engine rewinds to host-known state and redoes ≤2K ticks (freed
-        slots need re-admission). Outputs are bit-identical to
-        ``sync_every=1``; only finish *detection* lags.
+        slots need re-admission). Greedy outputs are bit-identical to
+        ``sync_every=1``; only finish *detection* lags. Sampled outputs
+        are bit-identical for a fixed submission schedule relative to
+        buffer boundaries (e.g. everything submitted up front): a
+        MID-RUN submission can admit at a different global tick than it
+        would under ``sync_every=1``, and sampling keys are derived from
+        that global step counter.
 
         ``use_decode_kernel`` routes decode attention through the fused
-        pallas kernel (``ops/decode_attention.py``); ``None`` resolves
-        via ``RAY_TPU_DECODE_KERNEL`` then auto (TPU with tiling shapes).
-        Outputs are bit-identical kernel on/off."""
+        pallas kernel (paged or dense variant); ``None`` resolves via
+        ``RAY_TPU_DECODE_KERNEL`` then auto (TPU with tiling shapes).
+        Outputs are bit-identical kernel on/off.
+
+        PAGED KV plane (``paged``, default on; ``RAY_TPU_PAGED_KV=0``
+        reverts the default): the cache is a shared arena of
+        ``block_size``-token blocks with per-slot block tables — decode
+        reads only live blocks instead of every slot's padded ``S_max``
+        stripe, and admission reserves blocks all-or-nothing so a
+        request can also wait on arena space. ``kv_dtype`` ('bf16' |
+        'int8', or ``RAY_TPU_KV_DTYPE``) selects arena storage; int8
+        halves KV bytes with per-token/per-head scales. ``num_blocks``
+        sizes the arena (default: enough for every slot at ``max_len``,
+        plus the reserved garbage block).
+
+        ``sampling`` (:class:`~ray_tpu.models.sampling.SamplingParams`
+        or a dict) selects in-device token sampling; the default is
+        greedy argmax. Sampled decode is deterministic under a fixed
+        ``sampling.seed``."""
         self.config = config
         self.num_slots = num_slots
         self.max_len = max_len
         self.eos_token = eos_token
         self.sync_every = max(1, int(sync_every))
+        self.sampling = SamplingParams.coerce(sampling)
+        self.paged = _resolve_paged(paged)
+        self.block_size = int(block_size)
+        if self.paged and (self.block_size < 8
+                           or self.block_size & (self.block_size - 1)):
+            # Prompt padding buckets are powers of two; a non-pow2 block
+            # would make the padded length a non-multiple of the block
+            # and break the prefill block reshape.
+            raise ValueError(
+                f"block_size must be a power of two >= 8, "
+                f"got {self.block_size}")
+        self.kv_dtype = resolve_kv_dtype(kv_dtype) if self.paged else None
         self.use_decode_kernel = _resolve_decode_kernel(
-            config, max_len, use_decode_kernel)
+            config, max_len, use_decode_kernel, paged=self.paged,
+            block_size=self.block_size)
         # Prefill accounting (bench_serve.py reads these; the metric
         # counters mirror them into the TSDB).
         self.prefill_batches = 0
@@ -199,16 +390,37 @@ class ContinuousBatcher:
         self._pending: Optional[tuple] = None  # (stacked, [(slot, rid)])
         self.params = params if params is not None else llama.init_params(
             config, jax.random.PRNGKey(seed))
+        self.param_bytes = sum(
+            x.nbytes for x in jax.tree_util.tree_leaves(self.params))
         self.token_callback = token_callback
-        self.cache = KVCache.create(config, num_slots, max_len)
+        if self.paged:
+            self.max_blocks = -(-max_len // self.block_size)
+            self.num_blocks = int(
+                num_blocks if num_blocks is not None
+                else num_slots * self.max_blocks + 1)
+            self.cache = PagedKVCache.create(
+                config, self.num_blocks, self.block_size, self.kv_dtype)
+            self.allocator = BlockAllocator(self.num_blocks)
+            self._slot_blocks: Dict[int, List[int]] = {}
+            self._d_tables = None
+            self._d_limits = None
+        else:
+            self.cache = KVCache.create(config, num_slots, max_len)
         self._free: List[int] = list(range(num_slots))
         self._slots: Dict[int, Dict[str, Any]] = {}   # slot -> request
-        # Device-resident decode state: last tokens + positions live on
-        # the chip between ticks (uploaded only when slot membership
-        # changes), so a steady decode tick moves 4 bytes/slot host-ward
-        # and nothing device-ward.
+        # Device-resident decode state: last tokens + positions + the
+        # sampling step counter live on the chip between ticks (uploaded
+        # only when slot membership changes), so a steady decode tick
+        # moves 4 bytes/slot host-ward and nothing device-ward.
         self._d_tokens = None
         self._d_positions = None
+        self._d_step = None
+        self._applied_steps = 0   # host mirror of the device step counter
+        self._prefill_count = 0   # per-dispatch prefill sampling stream
+        # Buffered-mode achieved-bandwidth window: wall time and tick
+        # count between consecutive fetch syncs.
+        self._bw_window_t0 = None
+        self._bw_window_ticks = 0
         self._dirty = True
         self._waiting: deque = deque()
         self._rid = itertools.count()
@@ -222,40 +434,110 @@ class ContinuousBatcher:
         cfg = config
 
         use_kernel = self.use_decode_kernel
+        sampling_cfg = self.sampling
+        block_size_c = self.block_size
 
         # The XLA monitor dispatches per signature and audits shape
         # growth: prefill's signatures are pow-2 bucketed in N and L by
-        # design (allowed caps included — max_len/num_slots need not be
-        # powers of two), so legitimate bucket growth stays silent while
-        # a stray odd shape raises ray_tpu_xla_retraces_total. The tick
-        # has exactly ONE legitimate signature.
-        @xla_monitor.instrument(name="cb_prefill", shape_policy="bucketed",
-                                allowed_dims=(max_len, num_slots),
-                                donate_argnums=(2,))
-        def prefill(params, tokens, cache, slots, last_idx):
-            # BATCHED BUCKETED PREFILL: tokens [N, L] holds N same-bucket
-            # prompts destined for KV slots ``slots`` [N]; ``last_idx``
-            # [N] is each prompt's true_len - 1. Slot gather + write-back
-            # live INSIDE the jit with the pooled cache donated, so an
-            # admission burst is one in-place program, not N whole-cache
-            # copies. Only the N first tokens leave the device (argmax on
-            # chip), not [N, L, V] logits.
-            positions = jnp.arange(tokens.shape[1])
-            slot_cache = KVCache(k=jnp.take(cache.k, slots, axis=1),
-                                 v=jnp.take(cache.v, slots, axis=1))
-            logits, sc = _forward_cached(params, tokens, positions,
-                                         slot_cache, cfg)
-            cache = KVCache(k=cache.k.at[:, slots].set(sc.k),
-                            v=cache.v.at[:, slots].set(sc.v))
-            last = jnp.take_along_axis(
-                logits, last_idx[:, None, None], axis=1)[:, 0]   # [N, V]
-            first = jnp.argmax(last, axis=-1).astype(jnp.int32)
-            return first, cache
+        # design (allowed caps included — max_len/num_slots/block counts
+        # need not be powers of two), so legitimate bucket growth stays
+        # silent while a stray odd shape raises
+        # ray_tpu_xla_retraces_total. The tick has exactly ONE legitimate
+        # signature.
+        prefill_dims = (max_len, num_slots)
+        if self.paged:
+            prefill_dims += (self.max_blocks,
+                             self.max_blocks * self.block_size)
 
-        @xla_monitor.instrument(name="cb_tick", donate_argnums=(3,))
-        def tick(params, tokens, positions, cache):
-            return _decode_tick(params, tokens, positions, cache, cfg,
-                                use_kernel=use_kernel)
+        if self.paged:
+            @xla_monitor.instrument(name="cb_prefill",
+                                    shape_policy="bucketed",
+                                    allowed_dims=prefill_dims,
+                                    donate_argnums=(2,))
+            def prefill(params, tokens, cache, tables_w, last_idx, pstep):
+                # BATCHED BUCKETED PREFILL, paged: tokens [N, L] holds N
+                # same-bucket prompts; ``tables_w`` [N, L // bs] names
+                # the arena block each L-padded prompt block lands in
+                # (overflow entries point at the garbage block). The
+                # prompt attends only itself, so it runs over a fresh
+                # dense mini-cache and the resulting K/V are written —
+                # quantized when the arena is int8 — straight into the
+                # donated arena. Only N first tokens leave the device.
+                positions = jnp.arange(tokens.shape[1])
+                n, lp = tokens.shape
+                mini = KVCache.create(cfg, n, lp)
+                logits, mini = _forward_cached(params, tokens, positions,
+                                               mini, cfg)
+                npb = lp // block_size_c
+                flat_tables = tables_w.reshape(-1)           # [N * npb]
+
+                def to_blocks(a):
+                    # [Lyr, N, L, ...] -> [Lyr, N*npb, bs, ...]
+                    return a.reshape(a.shape[0], n * npb, block_size_c,
+                                     *a.shape[3:])
+
+                if cache.quantized:
+                    kq, ksc = quantize_kv(mini.k)
+                    vq, vsc = quantize_kv(mini.v)
+                    new_cache = PagedKVCache(
+                        k=cache.k.at[:, flat_tables].set(to_blocks(kq)),
+                        v=cache.v.at[:, flat_tables].set(to_blocks(vq)),
+                        k_scale=cache.k_scale.at[:, flat_tables].set(
+                            to_blocks(ksc)),
+                        v_scale=cache.v_scale.at[:, flat_tables].set(
+                            to_blocks(vsc)))
+                else:
+                    dt = cache.k.dtype
+                    new_cache = PagedKVCache(
+                        k=cache.k.at[:, flat_tables].set(
+                            to_blocks(mini.k.astype(dt))),
+                        v=cache.v.at[:, flat_tables].set(
+                            to_blocks(mini.v.astype(dt))))
+                last = jnp.take_along_axis(
+                    logits, last_idx[:, None, None], axis=1)  # [N, 1, V]
+                first = _next_tokens(last, pstep, sampling_cfg,
+                                     salt=_PREFILL_SALT)
+                return first, new_cache
+
+            @xla_monitor.instrument(name="cb_tick", donate_argnums=(5,))
+            def tick(params, tokens, positions, tables, limits, cache,
+                     step):
+                return _decode_tick_paged(params, tokens, positions,
+                                          tables, limits, cache, step,
+                                          cfg, use_kernel=use_kernel,
+                                          sampling=sampling_cfg)
+        else:
+            @xla_monitor.instrument(name="cb_prefill",
+                                    shape_policy="bucketed",
+                                    allowed_dims=prefill_dims,
+                                    donate_argnums=(2,))
+            def prefill(params, tokens, cache, slots, last_idx, pstep):
+                # BATCHED BUCKETED PREFILL: tokens [N, L] holds N
+                # same-bucket prompts destined for KV slots ``slots``
+                # [N]; ``last_idx`` [N] is each prompt's true_len - 1.
+                # Slot gather + write-back live INSIDE the jit with the
+                # pooled cache donated, so an admission burst is one
+                # in-place program, not N whole-cache copies. Only the N
+                # first tokens leave the device (selection on chip), not
+                # [N, L, V] logits.
+                positions = jnp.arange(tokens.shape[1])
+                slot_cache = KVCache(k=jnp.take(cache.k, slots, axis=1),
+                                     v=jnp.take(cache.v, slots, axis=1))
+                logits, sc = _forward_cached(params, tokens, positions,
+                                             slot_cache, cfg)
+                cache = KVCache(k=cache.k.at[:, slots].set(sc.k),
+                                v=cache.v.at[:, slots].set(sc.v))
+                last = jnp.take_along_axis(
+                    logits, last_idx[:, None, None], axis=1)  # [N, 1, V]
+                first = _next_tokens(last, pstep, sampling_cfg,
+                                     salt=_PREFILL_SALT)
+                return first, cache
+
+            @xla_monitor.instrument(name="cb_tick", donate_argnums=(3,))
+            def tick(params, tokens, positions, cache, step):
+                return _decode_tick(params, tokens, positions, cache,
+                                    step, cfg, use_kernel=use_kernel,
+                                    sampling=sampling_cfg)
 
         self._prefill = prefill
         self._tick = tick
@@ -276,15 +558,34 @@ class ContinuousBatcher:
         """Queue a request; returns its id. It joins the next tick with a
         free slot — no waiting for the current batch to drain."""
         assert len(prompt_tokens) + max_new_tokens <= self.max_len
-        rid = next(self._rid)
         if max_new_tokens <= 0:
-            # Nothing to generate: finish immediately, no slot occupied.
+            # Nothing to generate: finish immediately — no slot, no
+            # blocks, so arena capacity is irrelevant.
+            rid = next(self._rid)
             self._finished[rid] = []
             return rid
+        if self.paged and self._blocks_needed(
+                len(prompt_tokens), max_new_tokens) > self.num_blocks - 1:
+            # A reservation larger than the whole arena can NEVER be
+            # satisfied: admitting it to the queue would wedge the FIFO
+            # head (and every request behind it) forever.
+            raise ValueError(
+                f"request needs more KV blocks than the arena holds "
+                f"({self._blocks_needed(len(prompt_tokens), max_new_tokens)}"
+                f" > {self.num_blocks - 1}); raise num_blocks or shorten "
+                f"the request")
+        rid = next(self._rid)
         self._waiting.append({"rid": rid,
                               "prompt": list(prompt_tokens),
                               "max_new": max_new_tokens})
         return rid
+
+    def _release_slot(self, slot: int) -> None:
+        self._free.append(slot)
+        if self.paged:
+            blocks = self._slot_blocks.pop(slot, None)
+            if blocks:
+                self.allocator.free(blocks)
 
     def cancel(self, rid: int) -> bool:
         """Drop a request (client disconnected): frees its slot / queue
@@ -296,7 +597,7 @@ class ContinuousBatcher:
         for slot, st in list(self._slots.items()):
             if st["rid"] == rid:
                 del self._slots[slot]
-                self._free.append(slot)
+                self._release_slot(slot)
                 self._dirty = True
                 return True
         return self._finished.pop(rid, None) is not None
@@ -315,8 +616,18 @@ class ContinuousBatcher:
         # The prefill/tick jits donate the pooled cache; after a mid-step
         # failure the old buffers may already be deleted, so rebuild the
         # pool or every later step would raise "Array has been deleted".
-        self.cache = KVCache.create(self.config, self.num_slots,
-                                    self.max_len)
+        if self.paged:
+            self.cache = PagedKVCache.create(
+                self.config, self.num_blocks, self.block_size,
+                self.kv_dtype)
+            self.allocator.reset()
+            self._slot_blocks.clear()
+        else:
+            self.cache = KVCache.create(self.config, self.num_slots,
+                                        self.max_len)
+        self._applied_steps = 0
+        self._bw_window_t0 = None
+        self._bw_window_ticks = 0
         self._dirty = True
         return dropped
 
@@ -328,6 +639,68 @@ class ContinuousBatcher:
         return bool(self._slots or self._waiting or self._finished
                     or self._buf or self._pending)
 
+    # ------------------------------------------------------------ paged kv
+    def kv_block_stats(self) -> Dict[str, float]:
+        """Arena occupancy: blocks used/total, live tokens, and the
+        fragmentation ratio (reserved-but-unwritten fraction of used
+        blocks). Dense engines report zeros."""
+        if not self.paged:
+            return {"used": 0, "total": 0, "live_tokens": 0,
+                    "frag_ratio": 0.0}
+        used = self.allocator.used_count
+        live = sum(st["pos"] for st in self._slots.values())
+        cap = used * self.block_size
+        return {"used": used, "total": self.num_blocks - 1,
+                "live_tokens": live,
+                "frag_ratio": (1.0 - live / cap) if cap else 0.0}
+
+    def tick_bytes_estimate(self) -> int:
+        """HBM bytes one decode tick actually streams: the full parameter
+        set plus the LIVE tokens' arena traffic (paged) or every slot's
+        padded stripe (dense). This is the live-traffic figure the
+        achieved-bandwidth gauges and bench_serve report — the compiled
+        program's static cost analysis can only ever price the worst
+        case."""
+        if self.paged:
+            # The kernel streams WHOLE blocks (the run guard skips
+            # compute, not the fetch), so round each slot's live prefix
+            # up to block granularity — otherwise the figure would be
+            # block-size-invariant and the block_size sweep meaningless.
+            bs = self.block_size
+            live = sum(-(-(st["pos"] + 1) // bs) * bs
+                       for st in self._slots.values())
+            return self.param_bytes + live * self.cache.token_bytes()
+        c = self.config
+        itemsize = jnp.dtype(self.cache.k.dtype).itemsize
+        per_slot = (2 * c.num_layers * self.max_len * c.num_kv_heads
+                    * c.head_dim * itemsize)
+        return self.param_bytes + self.num_slots * per_slot
+
+    def _blocks_needed(self, prompt_len: int, max_new: int) -> int:
+        return -(-(prompt_len + max_new) // self.block_size)
+
+    def _can_admit_head(self) -> bool:
+        """True when the FIFO head could admit RIGHT NOW (free slot and,
+        when paged, enough free arena blocks). The buffered engine uses
+        this to decide whether forcing a sync boundary is worth it — an
+        arena-blocked head must not collapse speculative pipelining to
+        one tick per sync while it waits for blocks."""
+        if not (self._waiting and self._free):
+            return False
+        if not self.paged:
+            return True
+        req = self._waiting[0]
+        return (self._blocks_needed(len(req["prompt"]), req["max_new"])
+                <= self.allocator.free_count)
+
+    def _table_row(self, blocks: List[int]) -> List[int]:
+        # Dead tail entries REPEAT the last live block: pallas skips the
+        # re-fetch when consecutive grid steps map to the same block, so
+        # a slot's unreached tail costs ~zero HBM traffic. (Entries past
+        # a slot's position are masked regardless.)
+        tail = blocks[-1] if blocks else GARBAGE_BLOCK
+        return blocks + [tail] * (self.max_blocks - len(blocks))
+
     def _admit(self) -> None:
         if not (self._waiting and self._free):
             return
@@ -337,13 +710,29 @@ class ContinuousBatcher:
         # bucket (compile reuse, never beyond the cache length), so an
         # admission burst costs one prefill dispatch per bucket instead
         # of one per request. Slots are independent, so batched admission
-        # is bit-identical to the old one-at-a-time loop.
+        # is bit-identical to the old one-at-a-time loop. Paged engines
+        # also reserve each request's blocks all-or-nothing (FIFO: when
+        # the head of the queue doesn't fit the arena, admission stops).
+        bs = self.block_size
+        padded_cap = (self.max_blocks * bs if self.paged else self.max_len)
         groups: Dict[int, List] = {}
         while self._waiting and self._free:
-            req = self._waiting.popleft()
+            req = self._waiting[0]
+            blocks: List[int] = []
+            padded_len = min(_bucket(len(req["prompt"])), padded_cap)
+            if self.paged:
+                need = self._blocks_needed(len(req["prompt"]),
+                                           req["max_new"])
+                got = self.allocator.alloc(need)
+                if got is None:
+                    break
+                blocks = got
+                padded_len = max(padded_len, bs)  # at least one block
+            self._waiting.popleft()
             slot = self._free.pop()
-            padded_len = min(_bucket(len(req["prompt"])), self.max_len)
-            groups.setdefault(padded_len, []).append((req, slot))
+            if self.paged:
+                self._slot_blocks[slot] = blocks
+            groups.setdefault(padded_len, []).append((req, slot, blocks))
         for padded_len, group in groups.items():
             n = len(group)
             # The batch dim buckets to a power of two as well, so the
@@ -355,16 +744,31 @@ class ContinuousBatcher:
             tokens = np.zeros((n_pad, padded_len), np.int32)
             slots = np.zeros(n_pad, np.int32)
             last_idx = np.zeros(n_pad, np.int32)
+            npb_w = padded_len // bs if self.paged else 0
+            tables_w = np.full((n_pad, npb_w), GARBAGE_BLOCK, np.int32)
             for i in range(n_pad):
-                req, slot = group[min(i, n - 1)]
+                req, slot, blocks = group[min(i, n - 1)]
                 prompt = req["prompt"]
                 tokens[i, :len(prompt)] = prompt
                 slots[i] = slot
                 last_idx[i] = len(prompt) - 1
+                if self.paged:
+                    # Prompt blocks land in the slot's reserved blocks;
+                    # bucket-padding overflow (padded_len can exceed the
+                    # reservation) writes masked garbage to block 0.
+                    k = min(len(blocks), npb_w)
+                    tables_w[i, :k] = blocks[:k]
             t0 = time.perf_counter()
-            first, self.cache = self._prefill(
-                self.params, jnp.asarray(tokens), self.cache,
-                jnp.asarray(slots), jnp.asarray(last_idx))
+            pstep = jnp.int32(self._prefill_count)
+            self._prefill_count += 1
+            if self.paged:
+                first, self.cache = self._prefill(
+                    self.params, jnp.asarray(tokens), self.cache,
+                    jnp.asarray(tables_w), jnp.asarray(last_idx), pstep)
+            else:
+                first, self.cache = self._prefill(
+                    self.params, jnp.asarray(tokens), self.cache,
+                    jnp.asarray(slots), jnp.asarray(last_idx), pstep)
             first = np.asarray(first)            # N ints, one transfer
             # The fetch syncs the dispatch, so this interval is the real
             # prefill cost — bench_serve derives prefill tokens/s from
@@ -381,7 +785,7 @@ class ContinuousBatcher:
             self.prefill_tokens += true_tokens
             mdefs.CB_PREFILL_REQUESTS.inc(n, tags=self._mtags)
             mdefs.CB_PREFILL_TOKENS.inc(true_tokens, tags=self._mtags)
-            for (req, slot), tok in zip(group, first):
+            for (req, slot, _blocks), tok in zip(group, first):
                 tok = int(tok)
                 if self.token_callback is not None:
                     self.token_callback(req["rid"], tok)
@@ -403,7 +807,7 @@ class ContinuousBatcher:
         if done:
             self._finished[st["rid"]] = st["out"]
             del self._slots[slot]
-            self._free.append(slot)
+            self._release_slot(slot)
 
     def _upload_state(self) -> None:
         tokens = np.zeros(self.num_slots, np.int32)
@@ -413,13 +817,39 @@ class ContinuousBatcher:
             positions[slot] = st["pos"]
         self._d_tokens = jnp.asarray(tokens)
         self._d_positions = jnp.asarray(positions)
+        # The device sampling-step counter rewinds to the host-applied
+        # count: speculative ticks a rewind discarded replay the SAME
+        # step numbers, so sampled decode reproduces exactly like greedy.
+        self._d_step = jnp.int32(self._applied_steps)
+        if self.paged:
+            tables = np.zeros((self.num_slots, self.max_blocks), np.int32)
+            limits = np.zeros(self.num_slots, np.int32)
+            for slot, blocks in self._slot_blocks.items():
+                tables[slot] = self._table_row(blocks)
+                limits[slot] = len(blocks) * self.block_size
+            self._d_tables = jnp.asarray(tables)
+            self._d_limits = jnp.asarray(limits)
         self._dirty = False
+
+    def _run_tick(self):
+        if self.paged:
+            (self._d_tokens, self._d_positions, self.cache,
+             self._d_step) = self._tick(
+                self.params, self._d_tokens, self._d_positions,
+                self._d_tables, self._d_limits, self.cache, self._d_step)
+        else:
+            (self._d_tokens, self._d_positions, self.cache,
+             self._d_step) = self._tick(
+                self.params, self._d_tokens, self._d_positions,
+                self.cache, self._d_step)
+        return self._d_tokens
 
     def _apply_tokens(self, nxt_rows, membership) -> bool:
         """Book one or more fetched tick rows; returns True when any
         request finished (membership changed)."""
         finished_any = False
         applied = 0
+        self._applied_steps += len(nxt_rows)
         for row in nxt_rows:
             for slot, rid in membership:
                 st = self._slots.get(slot)
@@ -441,10 +871,7 @@ class ContinuousBatcher:
             mdefs.CB_DECODE_TOKENS.inc(applied, tags=self._mtags)
         return finished_any
 
-    def step(self) -> Dict[int, List[int]]:
-        """Admit waiting requests, run one decode tick over all active
-        slots, and return the requests that finished (with
-        ``sync_every > 1``, finish detection lags up to 2K ticks)."""
+    def _emit_gauges(self) -> None:
         from ray_tpu._private import metrics_defs as mdefs
 
         active = len(self._slots)
@@ -452,23 +879,44 @@ class ContinuousBatcher:
         mdefs.CB_WAITING_REQUESTS.set(len(self._waiting), tags=self._mtags)
         mdefs.CB_SLOT_OCCUPANCY.set(active / max(self.num_slots, 1),
                                     tags=self._mtags)
+        if self.paged:
+            kv = self.kv_block_stats()
+            mdefs.CB_KV_BLOCKS_USED.set(kv["used"], tags=self._mtags)
+            mdefs.CB_KV_BLOCKS_TOTAL.set(kv["total"], tags=self._mtags)
+            mdefs.CB_KV_FRAG_RATIO.set(kv["frag_ratio"], tags=self._mtags)
+
+    def step(self) -> Dict[int, List[int]]:
+        """Admit waiting requests, run one decode tick over all active
+        slots, and return the requests that finished (with
+        ``sync_every > 1``, finish detection lags up to 2K ticks)."""
+        from ray_tpu._private import metrics_defs as mdefs
+
+        self._emit_gauges()
         if self.sync_every == 1:
             self._admit()
             if self._slots:
                 if self._dirty:
                     self._upload_state()
                 t0 = time.perf_counter()
-                self._d_tokens, self._d_positions, self.cache = self._tick(
-                    self.params, self._d_tokens, self._d_positions,
-                    self.cache)
-                nxt = np.asarray(self._d_tokens)  # 4 bytes/slot
+                nxt_dev = self._run_tick()
+                nxt = np.asarray(nxt_dev)  # 4 bytes/slot
                 # Per-tick sync: the fetch IS the device sync, so this is
                 # the honest tick latency (dispatch + compute + fetch) —
                 # also the denominator for the tick's achieved-FLOPs/
-                # bandwidth gauges (cost_analysis over measured wall).
+                # bandwidth gauges. The bytes hint keeps achieved
+                # bandwidth priced off LIVE tokens, not the compiled
+                # worst case.
                 tick_wall = time.perf_counter() - t0
                 mdefs.CB_TICK_MS.observe(tick_wall * 1e3, tags=self._mtags)
-                self._tick.note_execution(tick_wall)
+                # Paged ticks get the live-byte hint (the compiled cost
+                # prices every table entry as live); the dense program's
+                # own cost analysis is already accurate — including the
+                # kernel-off fp32 re-read traffic a hand estimate would
+                # miss — so dense keeps it.
+                self._tick.note_execution(
+                    tick_wall,
+                    bytes_hint=(self.tick_bytes_estimate()
+                                if self.paged else None))
                 if self._apply_tokens(
                         [nxt], [(s, st["rid"])
                                 for s, st in self._slots.items()]):
@@ -482,21 +930,30 @@ class ContinuousBatcher:
         # flight): an upload mid-buffer would rewind the device sequence.
         if not self._buf and self._pending is None:
             self._admit()
+            # Clean boundary: restart the bandwidth window so idle gaps
+            # and admission prefill time never pollute the first
+            # buffered window's per-tick denominator (the achieved-BW
+            # gauges would otherwise report near-zero bandwidth after
+            # an idle period).
+            self._bw_window_t0 = None
+            self._bw_window_ticks = 0
         if self._slots:
             if self._dirty and not self._buf and self._pending is None:
                 self._upload_state()
             from ray_tpu._private import metrics_defs as mdefs
 
+            if self._bw_window_t0 is None:
+                self._bw_window_t0 = time.perf_counter()
             t0 = time.perf_counter()
-            self._d_tokens, self._d_positions, self.cache = self._tick(
-                self.params, self._d_tokens, self._d_positions, self.cache)
+            nxt_dev = self._run_tick()
             # Buffered mode overlaps fetches with compute, so this is
             # dispatch time only; steady-state backpressure still makes
             # the histogram track the real tick cadence.
             mdefs.CB_TICK_MS.observe(
                 (time.perf_counter() - t0) * 1e3, tags=self._mtags)
-            self._buf.append(self._d_tokens)
-        want_admit = bool(self._waiting and self._free)
+            self._bw_window_ticks += 1
+            self._buf.append(nxt_dev)
+        want_admit = self._can_admit_head()
         if len(self._buf) >= self.sync_every or want_admit or (
                 not self._slots and (self._buf or self._pending is not None)):
             # Non-K arms drain in-flight state early: a waiting request
@@ -516,6 +973,21 @@ class ContinuousBatcher:
             stacked, membership = self._pending
             self._pending = None
             rows = np.asarray(stacked)  # overlapped: usually ready
+            # The fetch landing IS a device sync: backpressure makes the
+            # wall time since the last sync cover the ticks dispatched in
+            # between, so window/ticks is the steady-state per-tick cost.
+            # Feed it (with the live-byte hint) to the achieved-bandwidth
+            # gauges — buffered mode is the production remote-chip path,
+            # and without this the gauges would price the paged tick at
+            # the compiled worst case instead of live tokens.
+            now = time.perf_counter()
+            if self._bw_window_t0 is not None and self._bw_window_ticks:
+                self._tick.note_execution(
+                    (now - self._bw_window_t0) / self._bw_window_ticks,
+                    bytes_hint=(self.tick_bytes_estimate()
+                                if self.paged else None))
+            self._bw_window_t0 = now
+            self._bw_window_ticks = 0
             if self._apply_tokens(list(rows), membership):
                 self._buf = []
                 self._dirty = True

@@ -1,0 +1,94 @@
+"""In-device token sampling for the decode data plane.
+
+Greedy argmax moved on-device in PR 2 (4 bytes/slot to host instead of
+``[B, V]`` logits); this module moves the REST of sampling in-device so
+temperature/top-p serving pays the same host traffic as greedy. The
+sampler runs inside the donated-cache tick jit; randomness is derived
+from a base seed and a device-threaded step counter (``fold_in``), so a
+fixed seed replays bit-identically — including across the buffered
+engine's speculative rewinds, which re-run the same step numbers with
+the same live-slot state.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Engine-level sampling configuration (static per compiled tick:
+    changing it recompiles, like any other engine knob).
+
+    ``temperature <= 0`` means greedy argmax (the default; exempt from
+    PRNG plumbing entirely). ``top_p`` keeps the smallest prefix of the
+    sorted distribution whose cumulative probability covers ``top_p``
+    (the first token always survives)."""
+
+    temperature: float = 0.0
+    top_p: float = 1.0
+    seed: int = 0
+
+    def __post_init__(self):
+        # Reject degenerate configs at construction (YAML deploy configs
+        # reach here): top_p <= 0 would mask EVERY logit to -inf and the
+        # engine would silently stream token 0 forever.
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if not self.temperature >= 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature <= 0.0
+
+    @classmethod
+    def coerce(cls, value) -> "SamplingParams":
+        """Accept SamplingParams | dict | None (deployment configs pass
+        plain dicts through serve ``init_kwargs``)."""
+        if value is None:
+            return cls()
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError(f"sampling must be SamplingParams or dict, "
+                        f"got {type(value)}")
+
+
+def sample_tokens(logits, key, temperature: float, top_p: float):
+    """logits [B, V] fp32 -> sampled token ids [B] int32 (argmax when
+    ``temperature <= 0``; ``temperature``/``top_p`` are python statics
+    baked into the compiled program)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / temperature
+    if top_p < 1.0:
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep tokens whose EXCLUSIVE cumulative mass is under top_p:
+        # the head of the distribution always survives, ties at the
+        # boundary are kept.
+        keep = (cum - probs) < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf),
+                         axis=-1, keepdims=True)
+        scaled = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+    # One key per step: categorical draws i.i.d. gumbel noise per [B, V]
+    # element, so per-row draws are independent AND a row whose logits
+    # and index repeat (speculative rewind replay) resamples the same
+    # token.
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
+def step_key(seed: int, step, salt: int = 0):
+    """Deterministic per-step PRNG key: base seed folded with the device
+    step counter (and a salt separating tick vs prefill streams)."""
+    key = jax.random.PRNGKey(seed)
+    if salt:
+        key = jax.random.fold_in(key, salt)
+    return jax.random.fold_in(key, step)

@@ -20,6 +20,11 @@ Config shape::
             init_kwargs:             # constructor overrides, merged over
               num_slots: 16          # bind() kwargs (e.g. the continuous
               sync_every: 8          # -batching engine knobs)
+              block_size: 64         # paged-KV plane knobs ride the same
+              kv_dtype: int8         # path (paged / block_size / kv_dtype
+              sampling:              # / num_blocks / sampling)
+                temperature: 0.7
+                top_p: 0.9
 """
 
 from __future__ import annotations

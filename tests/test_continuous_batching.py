@@ -310,8 +310,10 @@ def test_mixed_bucket_burst_admits_per_bucket(setup):
     rng = np.random.default_rng(14)
     short = [list(rng.integers(1, 250, size=n)) for n in (5, 9)]    # 16
     long = [list(rng.integers(1, 250, size=n)) for n in (20, 25)]   # 32
+    # block_size=16 keeps the paged engine's padding floor below both
+    # buckets (paged prompts pad to at least one block).
     eng = ContinuousBatcher(config, params=gen.params, num_slots=4,
-                            max_len=128)
+                            max_len=128, block_size=16)
     rids = [eng.submit(p, max_new_tokens=3) for p in short + long]
     eng.step()
     assert eng.prefill_batches == 2
@@ -344,6 +346,230 @@ def test_bf16_lm_head_argmax_parity():
     np.testing.assert_array_equal(
         np.asarray(jnp.argmax(new, axis=-1)),
         np.asarray(jnp.argmax(old, axis=-1)))
+
+
+# ------------------------------------------------- paged KV + sampling
+
+def test_paged_on_off_bit_identical(setup):
+    """The paged arena data plane (block tables, arena scatter, paged
+    attention) produces token-for-token identical greedy output to the
+    dense pooled cache, and to the sequential generator — across block
+    sizes and with slot churn."""
+    config, gen, _ = setup
+    rng = np.random.default_rng(21)
+    reqs = [(list(rng.integers(1, 250, size=n)), m)
+            for n, m in [(5, 7), (33, 4), (17, 9), (9, 3), (40, 6)]]
+    results = {}
+    for key, kwargs in {"dense": dict(paged=False),
+                        "paged32": dict(paged=True, block_size=32),
+                        "paged64": dict(paged=True, block_size=64)}.items():
+        eng = ContinuousBatcher(config, params=gen.params, num_slots=3,
+                                max_len=128, **kwargs)
+        assert eng.paged is kwargs["paged"]
+        rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+        out = eng.run_to_completion()
+        results[key] = [out[r] for r in rids]
+    assert results["dense"] == results["paged32"] == results["paged64"]
+    for (prompt, m), toks in zip(reqs, results["dense"]):
+        assert toks == _reference(gen, prompt, m)
+
+
+def test_paged_kernel_engine_parity(setup, pallas_interpret):
+    """Paged engine with the fused paged kernel (interpret mode on CPU)
+    == paged reference == dense engine, greedy."""
+    config, gen, _ = setup
+    rng = np.random.default_rng(22)
+    reqs = [(list(rng.integers(1, 250, size=n)), m)
+            for n, m in [(5, 7), (33, 5)]]
+    results = {}
+    for uk in (False, True):
+        eng = ContinuousBatcher(config, params=gen.params, num_slots=2,
+                                max_len=128, paged=True, block_size=32,
+                                use_decode_kernel=uk)
+        rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+        out = eng.run_to_completion()
+        results[uk] = [out[r] for r in rids]
+    assert results[True] == results[False]
+    for (prompt, m), toks in zip(reqs, results[True]):
+        assert toks == _reference(gen, prompt, m)
+
+
+def test_paged_int8_generates_plausibly(setup):
+    """int8 arena: exact greedy parity is not promised (quantization
+    perturbs logits), but generation must complete, reuse blocks, and
+    keep every token in-vocab."""
+    config, gen, _ = setup
+    rng = np.random.default_rng(23)
+    eng = ContinuousBatcher(config, params=gen.params, num_slots=2,
+                            max_len=128, paged=True, block_size=32,
+                            kv_dtype="int8")
+    assert eng.cache.quantized
+    reqs = [(list(rng.integers(1, 250, size=n)), m)
+            for n, m in [(5, 6), (20, 4), (9, 8)]]
+    rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+    out = eng.run_to_completion()
+    for rid, (_, m) in zip(rids, reqs):
+        assert len(out[rid]) == m
+        assert all(0 <= t < config.vocab_size for t in out[rid])
+    assert eng.allocator.used_count == 0, "finished slots leaked blocks"
+
+
+def test_paged_block_accounting_and_arena_exhaustion(setup):
+    """Admission reserves blocks all-or-nothing: with an arena smaller
+    than the slot pool's worst case, a request WAITS for blocks (not a
+    crash), joins when a finishing request frees them, and the free
+    count round-trips."""
+    config, gen, _ = setup
+    # 6 usable blocks of 16 => at most 96 reservable tokens.
+    eng = ContinuousBatcher(config, params=gen.params, num_slots=3,
+                            max_len=128, paged=True, block_size=16,
+                            num_blocks=7)
+    r1 = eng.submit(list(range(1, 30)), max_new_tokens=3)   # 2 blocks
+    r2 = eng.submit(list(range(1, 40)), max_new_tokens=25)  # 4 blocks
+    r3 = eng.submit([1, 2, 3], max_new_tokens=3)            # 1 block: waits
+    eng.step()
+    assert eng.allocator.free_count == 0
+    assert eng.active_count == 2, "arena-exhausted request admitted anyway"
+    out = eng.run_to_completion()
+    assert len(out[r1]) == 3 and len(out[r2]) == 25 and len(out[r3]) == 3
+    assert out[r3] == _reference(gen, [1, 2, 3], 3)
+    assert eng.allocator.free_count == 6
+    stats = eng.kv_block_stats()
+    assert stats["used"] == 0 and stats["total"] == 6
+    # A request that could NEVER be reserved (needs more blocks than the
+    # arena holds) is rejected at submit, not left wedging the FIFO.
+    with pytest.raises(ValueError, match="KV blocks"):
+        eng.submit(list(range(1, 101)), max_new_tokens=27)  # 8 > 6 blocks
+    # max_new_tokens=0 reserves nothing: it must finish immediately even
+    # when the prompt alone would exceed the arena.
+    r0 = eng.submit(list(range(1, 120)), max_new_tokens=0)
+    assert eng.run_to_completion()[r0] == []
+
+
+def test_paged_buffered_arena_wait_keeps_pipelining(setup):
+    """Buffered mode + arena-exhausted waiting request: the engine must
+    keep K-ticks-per-sync pipelining (no forced boundary every tick)
+    until blocks free, then admit and finish the waiter."""
+    config, gen, _ = setup
+    eng = ContinuousBatcher(config, params=gen.params, num_slots=3,
+                            max_len=128, paged=True, block_size=16,
+                            num_blocks=5, sync_every=4)
+    r1 = eng.submit(list(range(1, 40)), max_new_tokens=20)  # 4 blocks
+    r2 = eng.submit([1, 2, 3], max_new_tokens=3)            # waits: 0 free
+    for _ in range(4):
+        eng.step()
+    # r2 cannot admit (no blocks): the pipeline must still be buffering
+    # speculative ticks instead of syncing every step.
+    assert eng.active_count == 1
+    assert len(eng._buf) + (eng._pending is not None) > 0, \
+        "arena-blocked waiter collapsed speculative buffering"
+    out = eng.run_to_completion()
+    assert len(out[r1]) == 20
+    assert out[r2] == _reference(gen, [1, 2, 3], 3)
+
+
+def test_paged_overrun_write_lands_in_garbage_block():
+    """Speculative ticks past a slot's reservation must NOT write into
+    its last live block via the tail-repeated table (a rewind would then
+    replay over corrupted K/V): overrun writes redirect to the garbage
+    block, live blocks stay byte-identical."""
+    import jax
+
+    from ray_tpu.models.continuous_batching import _decode_tick_paged
+    from ray_tpu.models.paged_kv import PagedKVCache
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    bs = 16
+    cache = PagedKVCache.create(cfg, num_blocks=5, block_size=bs)
+    cache = cache._replace(k=cache.k.at[:, 2].set(7.7),
+                           v=cache.v.at[:, 2].set(7.7))  # sentinel
+    tables = jnp.asarray([[1, 2, 2, 2]], jnp.int32)  # 2 reserved blocks
+    limits = jnp.asarray([32], jnp.int32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    _, _, new_cache, _ = _decode_tick_paged(
+        params, jnp.asarray([3], jnp.int32),
+        jnp.asarray([33], jnp.int32),            # OVERRUN position
+        tables, limits, cache, jnp.int32(0), cfg)
+    np.testing.assert_array_equal(
+        np.asarray(new_cache.k[:, 2]),
+        np.full_like(np.asarray(new_cache.k[:, 2]), 7.7))
+    # In-reservation writes still land in the mapped block.
+    _, _, new_cache, _ = _decode_tick_paged(
+        params, jnp.asarray([3], jnp.int32),
+        jnp.asarray([17], jnp.int32), tables, limits, cache,
+        jnp.int32(0), cfg)
+    assert not np.all(np.asarray(new_cache.k[:, 2])[:, 1] == 7.7)
+
+
+def test_paged_buffered_overrun_heavy_parity(setup):
+    """sync_every>1 with requests whose reservations the device overruns
+    during speculation (finish detection lags 2K ticks): outputs stay
+    bit-identical to per-tick sync."""
+    config, gen, _ = setup
+    rng = np.random.default_rng(99)
+    pa = list(rng.integers(1, 250, size=5))   # 2 blocks of 16, ends at 30
+    pc = list(rng.integers(1, 250, size=4))   # finishes late -> rewind
+    outs = {}
+    for k in (1, 8):
+        eng = ContinuousBatcher(config, params=gen.params, num_slots=3,
+                                max_len=64, paged=True, block_size=16,
+                                sync_every=k)
+        ra = eng.submit(pa, max_new_tokens=26)
+        rc = eng.submit(pc, max_new_tokens=20)
+        o = eng.run_to_completion()
+        outs[k] = (o[ra], o[rc])
+    assert outs[1] == outs[8]
+    assert outs[1][0] == _reference(gen, pa, 26)
+
+
+def test_paged_rejects_non_pow2_block_size():
+    """Prompt padding buckets are powers of two, so a non-pow2 block
+    size would break the prefill block reshape — reject it up front
+    instead of dying on the first admission."""
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    with pytest.raises(ValueError, match="power of two"):
+        ContinuousBatcher(config, num_slots=2, max_len=128,
+                          paged=True, block_size=96)
+    with pytest.raises(ValueError, match="power of two"):
+        ContinuousBatcher(config, num_slots=2, max_len=128,
+                          paged=True, block_size=4)
+
+
+def test_sampling_deterministic_and_distinct():
+    """temperature/top-p sampling inside the tick jit: a fixed seed
+    replays bit-identically (fresh engine, same submissions), differs
+    from greedy, differs across seeds, and sync_every>1 speculative
+    buffering does not change sampled output."""
+    from ray_tpu.models.sampling import SamplingParams
+
+    config = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    gen = LlamaGenerator(config, max_len=128, seed=3)
+    rng = np.random.default_rng(31)
+    reqs = [(list(rng.integers(1, 250, size=n)), m)
+            for n, m in [(5, 8), (17, 6)]]
+
+    def run(**kwargs):
+        eng = ContinuousBatcher(config, params=gen.params, num_slots=2,
+                                max_len=128, **kwargs)
+        rids = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+        out = eng.run_to_completion()
+        return [out[r] for r in rids]
+
+    sp = SamplingParams(temperature=0.8, top_p=0.9, seed=42)
+    a = run(sampling=sp)
+    b = run(sampling=sp)
+    assert a == b, "fixed-seed sampling is not deterministic"
+    assert a == run(sampling=dict(temperature=0.8, top_p=0.9, seed=42)), \
+        "dict-coerced sampling params diverge"
+    assert a != run(), "sampled output equals greedy"
+    assert a != run(sampling=SamplingParams(temperature=0.8, top_p=0.9,
+                                            seed=43)), \
+        "seed does not steer sampling"
+    assert a == run(sampling=sp, sync_every=4), \
+        "speculative buffering changed sampled output"
+    for toks, (_, m) in zip(a, reqs):
+        assert len(toks) == m
+        assert all(0 <= t < config.vocab_size for t in toks)
 
 
 def test_buffered_admission_not_starved(setup):

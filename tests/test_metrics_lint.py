@@ -77,6 +77,24 @@ def test_xla_and_device_memory_series_are_cataloged():
             assert m.description.strip() and m.tag_keys
 
 
+def test_kv_arena_series_are_cataloged():
+    """The paged-KV arena occupancy series (continuous-batching engine)
+    ship described + tagged in the catalog — the dashboard serve panel
+    and the ISSUE-6 acceptance gauges read them."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_cb_kv_blocks_used",
+        "ray_tpu_cb_kv_blocks_total",
+        "ray_tpu_cb_kv_frag_ratio",
+    }
+    missing = required - names
+    assert not missing, (
+        f"KV-arena series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name.startswith("ray_tpu_cb_"):
+            assert m.description.strip() and m.tag_keys
+
+
 def test_checkpoint_plane_series_are_cataloged():
     """The checkpoint plane's series (ray_tpu/checkpoint/) ship described
     + tagged in the catalog, including the acceptance-criteria
@@ -135,3 +153,22 @@ def test_framework_jits_go_through_the_instrumented_wrapper():
         f"raw jax.jit call sites outside the allowlist: {offenders} — "
         f"route them through ray_tpu._private.xla_monitor.instrument "
         f"(or allowlist them with a reason in test_metrics_lint.py)")
+
+
+def test_engine_tick_and_prefill_entry_points_are_instrumented():
+    """The continuous-batching hot-loop entry points (tick + prefill,
+    paged AND dense) must stay under ``xla_monitor.instrument`` — their
+    compiles, retraces, and cost analyses feed the decode-roofline
+    regression harness, so an accidental downgrade to a raw jit is a
+    silent observability hole."""
+    import jax.numpy as jnp
+
+    from ray_tpu._private.xla_monitor import InstrumentedJit
+    from ray_tpu.models import llama
+    from ray_tpu.models.continuous_batching import ContinuousBatcher
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    for paged in (True, False):
+        eng = ContinuousBatcher(cfg, num_slots=2, max_len=64, paged=paged)
+        assert isinstance(eng._tick, InstrumentedJit), paged
+        assert isinstance(eng._prefill, InstrumentedJit), paged

@@ -1,0 +1,102 @@
+"""Large-object ``get`` stays zero-copy (ROADMAP item 3: the r03→r05
+``get_large_gb_per_s`` collapse was an extra full-buffer copy on the
+shm read path).
+
+Two invariants, bench_core-derived:
+
+* owner-local gets return the put value itself — zero copies, zero
+  serialization (the in-process store is the owner's cache);
+* node-store gets mmap the shm segment and deserialize IN PLACE — the
+  returned array is a view over the mapping (at most the kernel-side
+  copy the original put paid), never a ``read into bytes, then parse``
+  double copy.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.shm import ShmClient, ShmStore
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def cluster():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    c = Cluster(head_node_args={"num_cpus": 2})
+    ray_tpu.init(address=c.address)
+    yield c
+    ray_tpu.shutdown()
+    c.shutdown()
+
+
+def test_map_segment_view_is_zero_copy_and_owns_mapping():
+    """The mmap view reads segment bytes in place and keeps the mapping
+    alive through slices — including after the store unlinks the
+    segment (readers never race eviction)."""
+    if not ShmClient.available():
+        pytest.skip("native shm store unavailable")
+    store = ShmStore(capacity_bytes=50_000_000)
+    try:
+        data = np.arange(1_000_000, dtype=np.int64)
+        name = store.put("zc", data.tobytes())
+        view = ShmClient.map_segment_view(name, data.nbytes)
+        assert view is not None
+        arr = np.frombuffer(view[:], dtype=np.int64)
+        assert not arr.flags.owndata          # view over the map, no copy
+        tail = view[8:]
+        del view
+        store.delete("zc")                    # unlink under live readers
+        np.testing.assert_array_equal(arr, data)
+        assert bytes(tail[:8]) == data[1:2].tobytes()
+    finally:
+        store.close()
+
+
+def test_owner_local_large_get_is_identity(cluster):
+    """bench_core puts then gets in one process: that path must be an
+    in-process store hit returning the exact object — any copy here is
+    pure waste."""
+    arr = np.random.default_rng(0).integers(
+        0, 255, size=4 << 20, dtype=np.uint8)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref, timeout=60)
+    assert out is arr
+
+
+def test_node_store_large_get_does_at_most_one_copy(cluster):
+    """A non-owner-cached get (worker fetching a peer's result) maps the
+    shm segment and deserializes in place: ``read_segment`` (the
+    full-buffer copy) must not run, and the array must be a zero-copy
+    view over the mapping."""
+    if not ShmClient.available():
+        pytest.skip("native shm store unavailable")
+    from ray_tpu._private.worker import global_worker
+
+    core = global_worker().core
+    arr = np.random.default_rng(1).integers(
+        0, 255, size=4 << 20, dtype=np.uint8)
+    ref = ray_tpu.put(arr)
+    # Give the async put flusher time to seat the node-store copy, then
+    # drop the owner-local cache so the get exercises the node path.
+    deadline = __import__("time").monotonic() + 30
+    while __import__("time").monotonic() < deadline:
+        if core._is_ready(ref):
+            break
+        __import__("time").sleep(0.02)
+    core.memory.delete([ref.id()])
+
+    calls = []
+    orig = ShmClient.read_segment
+    ShmClient.read_segment = staticmethod(
+        lambda *a, **k: (calls.append(a), orig(*a, **k))[1])
+    try:
+        out = ray_tpu.get(ref, timeout=60)
+    finally:
+        ShmClient.read_segment = staticmethod(orig)
+    np.testing.assert_array_equal(out, arr)
+    assert out is not arr
+    assert not calls, "get() fell back to the copying read_segment path"
+    # Zero-copy deserialization: the array views the mapped segment.
+    assert not out.flags.owndata, "get() copied the buffer out of shm"

@@ -1,0 +1,228 @@
+"""Paged decode attention + KV arena: kernel parity, quantization
+round-trip, and block-allocator lifecycle.
+
+Tier-1 runs on CPU: the ``pallas_interpret`` fixture pins interpret mode
+so the real paged kernel (scalar-prefetch block-table gather) executes
+without TPU-only skips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.models.paged_kv import (GARBAGE_BLOCK, BlockAllocator,
+                                     PagedKVCache, quantize_kv,
+                                     resolve_kv_dtype)
+from ray_tpu.ops.decode_attention import decode_attention_reference
+from ray_tpu.ops.paged_decode_attention import (paged_applicable,
+                                                paged_attention_reference,
+                                                paged_decode_attention)
+
+
+def _paged_inputs(b=3, hq=4, hkv=2, d=16, bs=32, nb_slot=4, seed=0,
+                  dtype=jnp.float32, scramble=True):
+    """Dense K/V plus an equivalent scattered arena + block tables.
+
+    The arena places each slot's logical blocks at arbitrary physical
+    ids (permuted) so a passing test proves the TABLE gather, not a
+    lucky identity layout. Returns (q, dense_ck, dense_cv, arena_k,
+    arena_v, tables, positions)."""
+    s_max = bs * nb_slot
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, hq, d), jnp.float32).astype(dtype)
+    ck = jax.random.normal(ks[1], (b, s_max, hkv, d), jnp.float32)
+    cv = jax.random.normal(ks[2], (b, s_max, hkv, d), jnp.float32)
+    ck, cv = ck.astype(dtype), cv.astype(dtype)
+    nb_total = b * nb_slot + 1                    # + garbage block 0
+    ids = np.arange(1, nb_total)
+    if scramble:
+        ids = np.random.default_rng(seed).permutation(ids)
+    tables = ids.reshape(b, nb_slot).astype(np.int32)
+    arena_k = np.zeros((nb_total, bs, hkv, d), np.asarray(ck).dtype)
+    arena_v = np.zeros_like(arena_k)
+    for i in range(b):
+        for j in range(nb_slot):
+            arena_k[tables[i, j]] = np.asarray(ck[i, j * bs:(j + 1) * bs])
+            arena_v[tables[i, j]] = np.asarray(cv[i, j * bs:(j + 1) * bs])
+    return (q, ck, cv, jnp.asarray(arena_k), jnp.asarray(arena_v),
+            jnp.asarray(tables), None)
+
+
+# --------------------------------------------------- reference vs dense
+
+def test_paged_reference_equals_dense_reference():
+    """The paged reference (table gather -> dense attention) is exactly
+    the dense reference over the linearized blocks — the parity anchor
+    the kernel ships against."""
+    q, ck, cv, ak, av, tables, _ = _paged_inputs()
+    pos = jnp.asarray([0, 37, 127], jnp.int32)
+    np.testing.assert_array_equal(
+        np.asarray(paged_attention_reference(q, ak, av, tables, pos)),
+        np.asarray(decode_attention_reference(q, ck, cv, pos)))
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 2)])
+def test_paged_kernel_matches_reference_gqa(pallas_interpret, hq, hkv):
+    q, ck, cv, ak, av, tables, _ = _paged_inputs(hq=hq, hkv=hkv)
+    # Edge positions included: 0 (one live entry) and s_max-1 (full).
+    pos = jnp.asarray([0, 17, 127], jnp.int32)
+    ref = decode_attention_reference(q, ck, cv, pos)
+    out = paged_decode_attention(q, ak, av, tables, pos, use_kernel=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6)
+
+
+def test_paged_kernel_ragged_lengths_straddle_blocks(pallas_interpret):
+    """Live lengths landing just before/on/after block boundaries: the
+    per-block skip guard and the in-block causal mask must agree with
+    the dense mask at every straddle."""
+    q, ck, cv, ak, av, tables, _ = _paged_inputs(b=5, bs=32, nb_slot=4,
+                                                 seed=3)
+    # positions: last-in-block, first-in-next-block, mid-block, exactly
+    # one full block, and the final position.
+    pos = jnp.asarray([31, 32, 45, 63, 127], jnp.int32)
+    ref = decode_attention_reference(q, ck, cv, pos)
+    out = paged_decode_attention(q, ak, av, tables, pos, use_kernel=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-6)
+
+
+def test_paged_kernel_dead_tail_repeats_last_block(pallas_interpret):
+    """Dead table entries repeating the last live block (the no-refetch
+    bandwidth trick) must not change the output — they are masked."""
+    q, ck, cv, ak, av, tables, _ = _paged_inputs()
+    pos = jnp.asarray([5, 40, 70], jnp.int32)
+    t = np.asarray(tables).copy()
+    for i, p in enumerate([5, 40, 70]):
+        last_live = p // 32
+        t[i, last_live + 1:] = t[i, last_live]   # repeat last live block
+    out_rep = paged_decode_attention(q, ak, av, jnp.asarray(t), pos,
+                                     use_kernel=True)
+    ref = decode_attention_reference(q, ck, cv, pos)
+    np.testing.assert_allclose(np.asarray(out_rep), np.asarray(ref),
+                               atol=2e-6)
+
+
+def test_paged_kernel_bf16_arena(pallas_interpret):
+    q, ck, cv, ak, av, tables, _ = _paged_inputs(dtype=jnp.bfloat16)
+    pos = jnp.asarray([3, 50, 100], jnp.int32)
+    ref = decode_attention_reference(q, ck, cv, pos)
+    out = paged_decode_attention(q, ak, av, tables, pos, use_kernel=True)
+    np.testing.assert_allclose(
+        np.asarray(out, jnp.float32), np.asarray(ref, jnp.float32),
+        atol=2e-2)
+
+
+# ------------------------------------------------------------ int8 arena
+
+def test_int8_quantize_roundtrip_tolerance():
+    """Per-token/per-head symmetric int8: worst-case round-trip error is
+    bounded by scale/2 = amax/254 per element; zero vectors survive
+    exactly."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 64)) * 3.0
+    x = x.at[1].set(0.0)
+    q, scale = quantize_kv(x)
+    assert q.dtype == jnp.int8
+    back = q.astype(jnp.float32) * scale[..., None]
+    amax = np.asarray(jnp.max(jnp.abs(x), axis=-1, keepdims=True))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x),
+                               atol=float(amax.max()) / 254 + 1e-7)
+    np.testing.assert_array_equal(np.asarray(back[1]),
+                                  np.zeros_like(np.asarray(back[1])))
+
+
+@pytest.mark.parametrize("use_kernel", [False, True])
+def test_paged_int8_attention_close_to_fp32(pallas_interpret, use_kernel):
+    """int8 arena + per-token scales: attention output stays within
+    quantization tolerance of the fp32 dense reference, kernel and
+    reference dispatch agreeing with each other much tighter."""
+    q, ck, cv, ak, av, tables, _ = _paged_inputs(seed=5)
+    pos = jnp.asarray([9, 33, 120], jnp.int32)
+    kq, ks = quantize_kv(ak)
+    vq, vs = quantize_kv(av)
+    out = paged_decode_attention(q, kq, vq, tables, pos, k_scale=ks,
+                                 v_scale=vs, use_kernel=use_kernel)
+    ref = decode_attention_reference(q, ck, cv, pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=0.05, rtol=0.05)
+    # Kernel vs reference on identical quantized inputs: tight.
+    other = paged_decode_attention(q, kq, vq, tables, pos, k_scale=ks,
+                                   v_scale=vs, use_kernel=not use_kernel)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(other),
+                               atol=2e-6)
+
+
+def test_paged_cache_create_dtypes():
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(dtype=jnp.float32)
+    dense = PagedKVCache.create(cfg, num_blocks=9, block_size=16)
+    assert not dense.quantized and dense.k_scale is None
+    assert dense.k.shape[1:3] == (9, 16)
+    q8 = PagedKVCache.create(cfg, num_blocks=9, block_size=16,
+                             kv_dtype="int8")
+    assert q8.quantized and q8.k.dtype == jnp.int8
+    assert q8.k_scale.shape == q8.k.shape[:-1]
+    assert q8.token_bytes() < dense.token_bytes()
+    with pytest.raises(ValueError):
+        resolve_kv_dtype("fp4")
+
+
+# ------------------------------------------------------- block allocator
+
+def test_allocator_reuse_after_release():
+    """Freed blocks return to the pool and are handed out again;
+    all-or-nothing alloc leaves the pool untouched on failure."""
+    a = BlockAllocator(num_blocks=8)            # 7 usable (0 reserved)
+    first = a.alloc(4)
+    assert len(first) == 4 and GARBAGE_BLOCK not in first
+    second = a.alloc(3)
+    assert a.free_count == 0 and a.used_count == 7
+    assert a.alloc(1) is None                    # exhausted: no partial
+    a.free(first)
+    assert a.free_count == 4
+    again = a.alloc(4)
+    assert sorted(again) == sorted(first), "freed blocks not reused"
+    assert a.alloc(1) is None
+    a.free(second)
+    a.free(again)
+    assert a.free_count == 7 and a.used_count == 0
+
+
+def test_allocator_zero_and_param_validation():
+    a = BlockAllocator(num_blocks=4)
+    assert a.alloc(0) == []            # must NOT drain the free list
+    assert a.free_count == 3
+    from ray_tpu.models.sampling import SamplingParams
+    with pytest.raises(ValueError, match="top_p"):
+        SamplingParams(temperature=0.7, top_p=0.0)
+    with pytest.raises(ValueError, match="temperature"):
+        SamplingParams(temperature=-1.0)
+
+
+def test_allocator_rejects_bad_frees():
+    a = BlockAllocator(num_blocks=4)
+    got = a.alloc(2)
+    with pytest.raises(ValueError):
+        a.free([GARBAGE_BLOCK])
+    with pytest.raises(ValueError):
+        a.free([99])
+    a.free(got)
+    with pytest.raises(ValueError):
+        a.free(got)                              # double free
+
+
+def test_applicability_predicate():
+    assert paged_applicable(64, 128, 16, 16)
+    assert paged_applicable(32, 128, 32, 8)
+    assert not paged_applicable(64, 96, 16, 16)   # d % 128
+    assert not paged_applicable(64, 128, 16, 3)   # hq % hkv
+    assert not paged_applicable(24, 128, 16, 16)  # block % 32
+    # Auto mode on CPU routes to the reference (no kernel, no error).
+    q, ck, cv, ak, av, tables, _ = _paged_inputs()
+    pos = jnp.asarray([0, 1, 2], jnp.int32)
+    out = paged_decode_attention(q, ak, av, tables, pos)  # auto
+    np.testing.assert_array_equal(
+        np.asarray(out),
+        np.asarray(decode_attention_reference(q, ck, cv, pos)))

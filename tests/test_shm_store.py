@@ -41,7 +41,7 @@ def test_client_map_zero_copy_view(store):
     data = np.arange(10_000, dtype=np.float32)
     store.put("arr", data.tobytes())
     name, size = store.get("arr")
-    view = ShmClient.map_segment(name, size)
+    view = ShmClient.map_segment_view(name, size)
     arr = np.frombuffer(view, dtype=np.float32)
     np.testing.assert_array_equal(arr, data)
 
@@ -71,6 +71,6 @@ def test_reader_survives_eviction(store):
     data = b"y" * 1_000_000
     store.put("victim", data)
     name, size = store.get("victim")
-    view = ShmClient.map_segment(name, size)
+    view = ShmClient.map_segment_view(name, size)
     store.delete("victim")
     assert bytes(view[:10]) == b"y" * 10  # mapping still readable
