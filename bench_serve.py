@@ -158,6 +158,7 @@ def main() -> None:
     # TTFT (queueing included) and prefill throughput are measured here.
     ttft_s.clear()
     submit_ts.clear()
+    eng.request_breakdowns.clear()
     prefill_tokens0 = eng.prefill_tokens
     prefill_seconds0 = eng.prefill_seconds
     for _ in range(2 * num_slots):
@@ -169,6 +170,22 @@ def main() -> None:
     # Denominator is the engine's own dispatch->sync prefill interval, so
     # a decode-tick regression cannot masquerade as a prefill one.
     prefill_wall = max(eng.prefill_seconds - prefill_seconds0, 1e-9)
+    # TTFT decomposition from the engine's request-path telemetry
+    # (queue -> arena-wait -> prefill; the same records the
+    # ray_tpu_serve_request_* histograms observe): the regression
+    # baseline future routing/admission PRs are judged against — a
+    # router change should move queue_ms, not prefill_ms.
+    churn = [b for b in eng.request_breakdowns
+             if b["outcome"] == "finished" and b["ttft_s"] is not None]
+    ttft_breakdown = {}
+    for comp in ("queue", "arena_wait", "prefill", "ttft", "tpot"):
+        vals = sorted(b[f"{comp}_s"] for b in churn
+                      if b.get(f"{comp}_s") is not None)
+        ttft_breakdown[f"{comp}_p50_ms"] = round(
+            _pct(vals, 0.50) * 1e3, 2)
+        ttft_breakdown[f"{comp}_p95_ms"] = round(
+            _pct(vals, 0.95) * 1e3, 2)
+    ttft_breakdown["samples"] = len(churn)
 
     # Phase 3 — steady-state decode at full occupancy. No per-tick
     # device sync: the buffered engine's whole point is overlapping
@@ -232,6 +249,7 @@ def main() -> None:
         "ttft_p50_ms": round(_pct(ttft_sorted, 0.50) * 1e3, 2),
         "ttft_p95_ms": round(_pct(ttft_sorted, 0.95) * 1e3, 2),
         "ttft_samples": len(ttft_sorted),
+        "ttft_breakdown": ttft_breakdown,
         "prefill_tokens_per_s": round(prefill_tokens / prefill_wall, 1),
         # Live-token accounting is the headline figure (it is what the
         # achieved-BW gauges use); the static cost-analysis figure rides

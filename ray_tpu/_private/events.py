@@ -10,14 +10,54 @@ execution spans) and rendered in the chrome ``about://tracing`` JSON format.
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 _lock = threading.Lock()
 _events: List[Dict[str, Any]] = []
 _enabled = True
 MAX_EVENTS = 200_000
+
+# Overflow accounting: both event buffers here shed load silently by
+# design (events are best-effort), but SILENT shedding is an
+# observability hole — an overloaded span pipeline looks identical to a
+# quiet one. Every drop increments ray_tpu_events_dropped_total (tagged
+# by buffer) and the FIRST drop per buffer logs once per process.
+_drop_logged: set = set()
+
+
+def _count_dropped(buffer: str, n: int) -> None:
+    if n <= 0:
+        return
+    try:
+        # Lazy import: events.py is imported early in process bootstrap,
+        # before the metrics registry is guaranteed importable.
+        from ray_tpu._private import metrics_defs as mdefs
+
+        mdefs.EVENTS_DROPPED.inc(n, tags={"buffer": buffer})
+    except Exception:  # noqa: BLE001 — accounting must never break adds
+        pass
+    if buffer not in _drop_logged:
+        _drop_logged.add(buffer)
+        logger.warning(
+            "event buffer %r overflowed: dropped %d record(s) — further "
+            "drops are counted in ray_tpu_events_dropped_total but not "
+            "logged", buffer, n)
+
+
+def dropped_counts() -> Dict[str, float]:
+    """Per-buffer drop totals recorded so far by this process."""
+    try:
+        from ray_tpu._private import metrics_defs as mdefs
+
+        return {dict(key).get("buffer", "?"): v
+                for _, key, v in mdefs.EVENTS_DROPPED.samples()}
+    except Exception:  # noqa: BLE001
+        return {}
 
 
 class BufferedPublisher:
@@ -41,10 +81,14 @@ class BufferedPublisher:
                          name=f"pub-{channel}").start()
 
     def add(self, record: Any) -> None:
+        shed = 0
         with self._buf_lock:
             self._buf.append(record)
             if len(self._buf) > self._cap:
-                del self._buf[:self._cap // 2]
+                shed = self._cap // 2
+                del self._buf[:shed]
+        if shed:
+            _count_dropped(f"publisher:{self._channel}", shed)
 
     def _flush_loop(self) -> None:
         import pickle
@@ -82,9 +126,14 @@ def record(name: str, category: str, start_s: float, end_s: float,
     }
     if extra:
         ev["args"] = extra
+    dropped = False
     with _lock:
         if len(_events) < MAX_EVENTS:
             _events.append(ev)
+        else:
+            dropped = True
+    if dropped:
+        _count_dropped("timeline", 1)
 
 
 class span:

@@ -204,6 +204,15 @@ class GcsServer:
         if self._wal is not None:
             self._wal.append(record)
 
+    def wal_sync(self, timeout_s: float = 10.0) -> bool:
+        """Write barrier: True once every mutation accepted so far is
+        durable in the WAL backend (no-op True without persistence).
+        Fault-tolerance tests call this before killing the process
+        instead of sleeping past the batched writer's flush period."""
+        if self._wal is None:
+            return True
+        return self._wal.sync(timeout_s)
+
     def _state_blob(self) -> bytes:
         with self._lock:
             state = {

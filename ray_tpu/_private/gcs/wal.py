@@ -79,6 +79,10 @@ class WriteAheadLog:
         self._q: deque = deque()
         self._cv = threading.Condition()
         self._stop = False
+        # Durability watermarks for sync(): records queued vs. records
+        # acknowledged durable by the backend.
+        self._seq_queued = 0
+        self._seq_durable = 0
         self._size = len(self._backend.read_log())
         self._thread = threading.Thread(target=self._writer_loop,
                                         daemon=True, name="gcs-wal")
@@ -89,8 +93,25 @@ class WriteAheadLog:
         """Queue one record (non-blocking; the writer thread batches)."""
         with self._cv:
             self._q.append(record)
+            self._seq_queued += 1
             if len(self._q) == 1:
                 self._cv.notify()
+
+    def sync(self, timeout_s: float = 10.0) -> bool:
+        """Block until every record queued BEFORE this call is durable in
+        the backend (or the deadline passes; returns False then). The
+        fault-tolerance tests use this instead of guessing a sleep that
+        outruns the batched writer under load; a production caller can
+        use it as a write barrier before acting on persisted state."""
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            target = self._seq_queued
+            while self._seq_durable < target:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(min(remaining, 0.05))
+        return True
 
 
     def close(self) -> None:
@@ -170,6 +191,9 @@ class WriteAheadLog:
                 self._q.extendleft(reversed(batch))
             raise
         self._size += len(data)
+        with self._cv:
+            self._seq_durable += len(batch)
+            self._cv.notify_all()  # wake sync() waiters
 
     def _compact(self) -> None:
         """Snapshot-then-truncate. Mutations racing the snapshot capture

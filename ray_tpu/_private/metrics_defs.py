@@ -133,6 +133,61 @@ SERVE_QUEUE_DEPTH = Gauge(
     "In-flight requests this router currently has against a deployment",
     ("deployment",))
 
+# ------------------------------------------ serve request path (L6 + engine)
+# Per-request latency attribution emitted by the continuous-batching
+# engine at request lifecycle boundaries: TTFT decomposes into
+# queue + arena-wait + prefill (the components below sum to the TTFT
+# histogram within bookkeeping noise), and TPOT is the steady decode
+# cadence after the first token. Tagged per deployment and per tenant
+# (the multiplexed model id) so one noisy tenant is attributable.
+_REQ_TAGS = ("deployment", "tenant", "engine")
+SERVE_REQ_TTFT = Histogram(
+    "ray_tpu_serve_request_ttft_seconds",
+    "Time to first token: engine submit to first-token fetch "
+    "(= queue + arena_wait + prefill)",
+    boundaries=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0, 60.0),
+    tag_keys=_REQ_TAGS)
+SERVE_REQ_QUEUE = Histogram(
+    "ray_tpu_serve_request_queue_seconds",
+    "TTFT component: submit to admission pickup (waiting for a free "
+    "KV slot / the admission loop)",
+    boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+    tag_keys=_REQ_TAGS)
+SERVE_REQ_ARENA_WAIT = Histogram(
+    "ray_tpu_serve_request_arena_wait_seconds",
+    "TTFT component: time the request sat at the head of the admission "
+    "queue blocked on free paged-KV arena blocks (0 when never blocked)",
+    boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+    tag_keys=_REQ_TAGS)
+SERVE_REQ_PREFILL = Histogram(
+    "ray_tpu_serve_request_prefill_seconds",
+    "TTFT component: prefill dispatch to first-token fetch for the "
+    "request's admission batch",
+    boundaries=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0),
+    tag_keys=_REQ_TAGS)
+SERVE_REQ_TPOT = Histogram(
+    "ray_tpu_serve_request_tpot_seconds",
+    "Time per output token after the first (first token to finish over "
+    "generated-token count): the steady decode cadence one request saw",
+    boundaries=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0),
+    tag_keys=_REQ_TAGS)
+SERVE_REQ_OUTCOMES = Counter(
+    "ray_tpu_serve_request_outcomes_total",
+    "Engine request terminations by outcome "
+    "(finished/evicted/aborted)",
+    _REQ_TAGS + ("outcome",))
+
+# ------------------------------------------------ event/span buffer drops
+EVENTS_DROPPED = Counter(
+    "ray_tpu_events_dropped_total",
+    "Task-event/span records shed by a full buffer, by buffer "
+    "(timeline ring, per-channel BufferedPublisher) — a non-zero rate "
+    "means traces have holes",
+    ("buffer",))
+
 # ---------------------------------------------------------------- train (L6)
 TRAIN_REPORTS = Counter(
     "ray_tpu_train_reports_total",

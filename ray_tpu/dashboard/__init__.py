@@ -88,6 +88,9 @@ _INDEX_HTML = """<!doctype html>
 <h1>ray_tpu dashboard <span id="status"></span></h1>
 <h2>Cluster</h2><div id="cluster"></div>
 <h2>Serve / KV arena</h2><div id="serve"></div>
+<h2>Serve / request latency breakdown (TTFT = queue + arena-wait +
+prefill; TPOT)</h2><div id="reqlat"></div>
+<h2>Serve / replica pressure</h2><table id="pressure"></table>
 <h2>Metrics (last 5 min)</h2><div id="metrics"></div>
 <h2>XLA programs (compiles / retraces / achieved)</h2>
 <table id="xla"></table>
@@ -144,6 +147,29 @@ async function metricsPanel(){
   const data=await j("/api/v1/metrics/query?since=300&agg=avg&step=3&limit=80");
   document.getElementById("metrics").innerHTML=
     sparkRows(data,80)||"(no series)";
+}
+async function requestLatencyPanel(){
+  // TTFT attribution sparklines: the ray_tpu_serve_request_* histogram
+  // _sum/_count series per (deployment, tenant). Queue vs arena-wait vs
+  // prefill drifting apart points at WHERE a latency regression lives
+  // before anyone opens a trace.
+  const data=await j("/api/v1/metrics/query?series=ray_tpu_serve_request_*"+
+                     "&since=300&agg=avg&step=3&limit=40");
+  document.getElementById("reqlat").innerHTML=
+    sparkRows(data,40)||"(no request telemetry)";
+  const p=await j("/api/v1/serve/pressure");
+  const rows=[];
+  for(const [dep,reps] of Object.entries(p.deployments||{}))
+    for(const r of reps)
+      rows.push({deployment:dep,replica:r.replica,
+        ongoing:r.ongoing??"",queue:r.queue_depth??"",
+        slots:(r.active_slots??"")+"/"+(r.num_slots??""),
+        "kv free":(r.kv_blocks_free??"")+"/"+(r.kv_blocks_total??""),
+        "prefill tok":r.inflight_prefill_tokens??"",
+        state:r.unreachable?"unreachable":"ok"});
+  table(document.getElementById("pressure"),rows,
+    ["deployment","replica","ongoing","queue","slots","kv free",
+     "prefill tok","state"]);
 }
 async function servePanel(){
   // Serving hot-loop vitals: slot occupancy, decode rate, and the paged
@@ -209,6 +235,7 @@ async function refresh(){
       .map(l=>`[${l.worker} ${l.pid}] ${l.line}`).join("\\n");
     await metricsPanel();
     await servePanel();
+    await requestLatencyPanel();
     await xlaPanel();
     document.getElementById("status").textContent=
       "updated "+new Date().toLocaleTimeString();
@@ -393,6 +420,17 @@ class Dashboard:
 
             return list_manifests_kv(gcs)
 
+        def serve_pressure():
+            """Per-replica serve pressure (queue depth, KV blocks free,
+            in-flight prefill tokens) mirrored into the GCS KV by the
+            serve controller's reconcile loop — the future
+            prefix-affinity/KV-pressure router reads the same signal."""
+            reply = gcs.KvGet(pb.KvRequest(ns="__serve__",
+                                           key="pressure"))
+            if not reply.found:
+                return {"ts": 0, "deployments": {}}
+            return json.loads(reply.value)
+
         def metrics_query(params):
             """Translate HTTP query params into a TSDB query served by the
             GCS ``__metrics__`` KV namespace: ``series`` (exact name, or
@@ -464,6 +502,9 @@ class Dashboard:
                         ctype = "application/json"
                     elif path == "/api/v1/checkpoints":
                         body = json.dumps(checkpoints()).encode()
+                        ctype = "application/json"
+                    elif path == "/api/v1/serve/pressure":
+                        body = json.dumps(serve_pressure()).encode()
                         ctype = "application/json"
                     else:
                         route = {

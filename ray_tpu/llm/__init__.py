@@ -163,13 +163,42 @@ class ContinuousLlamaDeployment:
                 for q in queues.values():
                     q.put(e)
 
+    @staticmethod
+    def _request_trace() -> Optional[Dict[str, Any]]:
+        """The serve request context of the CALLING request (set by the
+        replica before user code runs; rides the contextvar through the
+        sync executor hop), normalized into the engine's trace dict. The
+        tenant falls back to the multiplexed model id so per-tenant
+        TTFT/TPOT attribution works even for callers that built their
+        own context."""
+        from ray_tpu.serve import multiplex
+        from ray_tpu.serve.context import get_request_context
+
+        rctx = get_request_context()
+        if rctx is None:
+            return None
+        trace = dict(rctx)
+        trace.setdefault("tenant", multiplex.get_request_tenant())
+        return trace
+
+    def pressure(self) -> Dict[str, Any]:
+        """Live engine pressure for the serve pressure endpoint (queue
+        depth, KV blocks free, in-flight prefill tokens — the
+        prefix/KV-pressure router's input). Under the engine lock: the
+        snapshot iterates the waiting queue, which the tick thread
+        mutates."""
+        with self._lock:
+            return self.batcher.pressure_snapshot()
+
     def generate(self, prompt_token_ids: List[int],
                  max_tokens: int = 16):
         """Streaming generator of token ids (serve stream=True surface)."""
         q = self._queue_mod.Queue()
+        trace = self._request_trace()
         with self._lock:
             rid = self.batcher.submit(list(prompt_token_ids),
-                                      max_new_tokens=int(max_tokens))
+                                      max_new_tokens=int(max_tokens),
+                                      trace=trace)
             self._queues[rid] = q
         self._work.set()
         done = False

@@ -52,6 +52,7 @@ from ray_tpu.ops.decode_attention import (decode_applicable,
 from ray_tpu.ops.paged_decode_attention import (paged_applicable,
                                                 paged_decode_attention)
 from ray_tpu.ops.rope import rope_frequencies
+from ray_tpu.util import tracing
 
 
 def _apply_rope_batched(x, cos, sin):
@@ -425,6 +426,17 @@ class ContinuousBatcher:
         self._waiting: deque = deque()
         self._rid = itertools.count()
         self._finished: Dict[int, List[int]] = {}
+        # Request-path telemetry: one lifecycle record per live request
+        # (submit/admit/prefill/first-token/finish timestamps + the
+        # caller's trace context). TTFT decomposition histograms are
+        # always on (host bookkeeping only); per-window decode spans are
+        # recorded only for traced requests, so with tracing disabled
+        # the decode loop pays one integer check per fetch.
+        self._req_meta: Dict[int, Dict[str, Any]] = {}
+        self._traced_live = 0            # live requests carrying a trace
+        self._window_t0: Optional[float] = None  # decode-window start
+        self.request_breakdowns: deque = deque(maxlen=4096)
+        self._MAX_WINDOWS = 64           # per-request span cap (tail merges)
         # Observability: engine label for the slot-occupancy / decode-rate
         # series (continuous-batching is the serving hot loop the decode
         # roofline work tunes — the TSDB needs its history). The instance
@@ -552,11 +564,133 @@ class ContinuousBatcher:
             return cache_size()
         return len(self._prefill_shapes)
 
+    # ------------------------------------------ request-path telemetry
+    def _req_tags(self, rec: Dict[str, Any]) -> Dict[str, str]:
+        t = rec.get("trace") or {}
+        return {"deployment": str(t.get("deployment", "")),
+                "tenant": str(t.get("tenant", "")),
+                "engine": self._mtags["engine"]}
+
+    def _span_common(self, rec: Dict[str, Any]) -> Dict[str, Any]:
+        t = rec.get("trace") or {}
+        return {"trace_id": t.get("trace_id", ""),
+                "parent_span_id": t.get("parent_span_id", ""),
+                "kind": "engine",
+                "request_id": t.get("request_id", ""),
+                "rid": rec["rid"]}
+
+    def _note_first_token(self, rec: Dict[str, Any], prefill_t0: float,
+                          first_tok_ts: float) -> None:
+        """First token just landed for ``rec``'s request: close the TTFT
+        decomposition (queue -> arena-wait -> prefill) and emit the
+        component histograms + spans. By construction the components sum
+        to TTFT up to the admission loop's group-assembly gap."""
+        from ray_tpu._private import metrics_defs as mdefs
+
+        blocked = rec.get("arena_blocked",
+                          rec.get("admit", rec["submit"]))
+        admit = rec.get("admit", blocked)
+        rec["first_token"] = first_tok_ts
+        rec["queue_s"] = max(blocked - rec["submit"], 0.0)
+        rec["arena_wait_s"] = max(admit - blocked, 0.0)
+        rec["prefill_s"] = max(first_tok_ts - prefill_t0, 0.0)
+        rec["ttft_s"] = max(first_tok_ts - rec["submit"], 0.0)
+        tags = self._req_tags(rec)
+        mdefs.SERVE_REQ_TTFT.observe(rec["ttft_s"], tags=tags)
+        mdefs.SERVE_REQ_QUEUE.observe(rec["queue_s"], tags=tags)
+        mdefs.SERVE_REQ_ARENA_WAIT.observe(rec["arena_wait_s"], tags=tags)
+        mdefs.SERVE_REQ_PREFILL.observe(rec["prefill_s"], tags=tags)
+        if rec["traced"]:
+            common = self._span_common(rec)
+            tracing.emit_span("engine.queue", ts=rec["submit"],
+                              dur=rec["queue_s"], **common)
+            if rec["arena_wait_s"] > 0:
+                tracing.emit_span("engine.arena_wait", ts=blocked,
+                                  dur=rec["arena_wait_s"],
+                                  blocks=rec.get("blocks", 0), **common)
+            tracing.emit_span("engine.prefill", ts=prefill_t0,
+                              dur=rec["prefill_s"],
+                              prompt_tokens=rec["prompt_len"], **common)
+
+    def _finish_request(self, rid: int, outcome: str,
+                        tokens: int = 0) -> None:
+        """Terminal lifecycle edge (finished / evicted / aborted): emit
+        TPOT + outcome metrics, the request's decode-window spans, and
+        push a breakdown record for bench/CLI consumers."""
+        rec = self._req_meta.pop(rid, None)
+        if rec is None:
+            return
+        from ray_tpu._private import metrics_defs as mdefs
+
+        now = time.time()
+        if rec["traced"]:
+            self._traced_live -= 1
+        tags = self._req_tags(rec)
+        mdefs.SERVE_REQ_OUTCOMES.inc(tags={**tags, "outcome": outcome})
+        tpot = None
+        first = rec.get("first_token")
+        if first is not None and tokens > 1:
+            tpot = max(now - first, 0.0) / (tokens - 1)
+            mdefs.SERVE_REQ_TPOT.observe(tpot, tags=tags)
+        trace = rec.get("trace") or {}
+        self.request_breakdowns.append({
+            "rid": rid, "outcome": outcome, "tokens": tokens,
+            "queue_s": rec.get("queue_s"),
+            "arena_wait_s": rec.get("arena_wait_s"),
+            "prefill_s": rec.get("prefill_s"),
+            "ttft_s": rec.get("ttft_s"), "tpot_s": tpot,
+            "trace_id": trace.get("trace_id"),
+            "request_id": trace.get("request_id")})
+        if not rec["traced"]:
+            return
+        common = self._span_common(rec)
+        if first is None:
+            # Evicted/aborted before admission completed: the queue span
+            # (normally closed at first token) still needs to exist for
+            # the trace to show where the request died.
+            tracing.emit_span("engine.queue", ts=rec["submit"],
+                              dur=max(now - rec["submit"], 0.0),
+                              outcome=outcome, **common)
+        for i, (w0, w1, n) in enumerate(rec.get("windows", ())):
+            tracing.emit_span("engine.decode_window", ts=w0,
+                              dur=max(w1 - w0, 0.0), tokens=n,
+                              window=i, **common)
+        tail = rec.get("window_tail")
+        if tail is not None:
+            tracing.emit_span("engine.decode_tail", ts=tail[0],
+                              dur=max(tail[1] - tail[0], 0.0),
+                              tokens=tail[2], windows=tail[3], **common)
+        tracing.emit_span(f"engine.{outcome}", ts=now, dur=0.0,
+                          tokens=tokens, **common)
+
+    def pressure_snapshot(self) -> Dict[str, Any]:
+        """Live engine pressure — the router/autoscaler input: queue
+        depth, slot occupancy, free KV arena blocks, and the prefill
+        token backlog still waiting for admission."""
+        free_blocks = self.allocator.free_count if self.paged else 0
+        return {
+            "queue_depth": len(self._waiting),
+            "active_slots": len(self._slots),
+            "num_slots": self.num_slots,
+            "kv_blocks_free": free_blocks,
+            "kv_blocks_total": (self.num_blocks - 1 if self.paged else 0),
+            "inflight_prefill_tokens": sum(
+                len(r["prompt"]) for r in self._waiting),
+        }
+
     # ---------------------------------------------------------------- api
     def submit(self, prompt_tokens: List[int],
-               max_new_tokens: int = 32) -> int:
+               max_new_tokens: int = 32,
+               trace: Optional[Dict[str, Any]] = None) -> int:
         """Queue a request; returns its id. It joins the next tick with a
-        free slot — no waiting for the current batch to drain."""
+        free slot — no waiting for the current batch to drain.
+
+        ``trace`` carries the serve request context
+        (``request_id``/``trace_id``/``parent_span_id``/``deployment``/
+        ``tenant``): lifecycle spans (queue, arena-wait, prefill, decode
+        windows) are emitted into that trace when ``RAY_TPU_TRACING=1``,
+        and the TTFT/TPOT histograms are tagged with its
+        deployment/tenant either way."""
         assert len(prompt_tokens) + max_new_tokens <= self.max_len
         if max_new_tokens <= 0:
             # Nothing to generate: finish immediately — no slot, no
@@ -575,6 +709,13 @@ class ContinuousBatcher:
                 f" > {self.num_blocks - 1}); raise num_blocks or shorten "
                 f"the request")
         rid = next(self._rid)
+        traced = trace is not None and tracing.enabled()
+        self._req_meta[rid] = {
+            "rid": rid, "submit": time.time(),
+            "prompt_len": len(prompt_tokens),
+            "trace": trace, "traced": traced, "windows": []}
+        if traced:
+            self._traced_live += 1
         self._waiting.append({"rid": rid,
                               "prompt": list(prompt_tokens),
                               "max_new": max_new_tokens})
@@ -593,12 +734,15 @@ class ContinuousBatcher:
         for i, req in enumerate(self._waiting):
             if req["rid"] == rid:
                 del self._waiting[i]
+                self._finish_request(rid, "evicted")
                 return True
         for slot, st in list(self._slots.items()):
             if st["rid"] == rid:
                 del self._slots[slot]
                 self._release_slot(slot)
                 self._dirty = True
+                self._finish_request(rid, "evicted",
+                                     tokens=len(st["out"]))
                 return True
         return self._finished.pop(rid, None) is not None
 
@@ -607,6 +751,14 @@ class ContinuousBatcher:
         request ids that were dropped."""
         dropped = [st["rid"] for st in self._slots.values()]
         dropped += [r["rid"] for r in self._waiting]
+        tokens_by_rid = {st["rid"]: len(st["out"])
+                         for st in self._slots.values()}
+        for rid in dropped:
+            self._finish_request(rid, "aborted",
+                                 tokens=tokens_by_rid.get(rid, 0))
+        self._req_meta.clear()
+        self._traced_live = 0
+        self._window_t0 = None
         self._slots.clear()
         self._waiting.clear()
         self._free = list(range(self.num_slots))
@@ -720,15 +872,24 @@ class ContinuousBatcher:
             req = self._waiting[0]
             blocks: List[int] = []
             padded_len = min(_bucket(len(req["prompt"])), padded_cap)
+            meta = self._req_meta.get(req["rid"])
             if self.paged:
                 need = self._blocks_needed(len(req["prompt"]),
                                            req["max_new"])
                 got = self.allocator.alloc(need)
                 if got is None:
+                    # Head blocked on arena space with a slot free: from
+                    # here until admission the wait is ARENA wait, not
+                    # queue wait — the TTFT decomposition splits there.
+                    if meta is not None and "arena_blocked" not in meta:
+                        meta["arena_blocked"] = time.time()
                     break
                 blocks = got
                 padded_len = max(padded_len, bs)  # at least one block
             self._waiting.popleft()
+            if meta is not None:
+                meta["admit"] = time.time()
+                meta["blocks"] = len(blocks)
             slot = self._free.pop()
             if self.paged:
                 self._slot_blocks[slot] = blocks
@@ -759,6 +920,7 @@ class ContinuousBatcher:
                     k = min(len(blocks), npb_w)
                     tables_w[i, :k] = blocks[:k]
             t0 = time.perf_counter()
+            pt0 = time.time()  # wall-clock anchor for the prefill span
             pstep = jnp.int32(self._prefill_count)
             self._prefill_count += 1
             if self.paged:
@@ -785,8 +947,12 @@ class ContinuousBatcher:
             self.prefill_tokens += true_tokens
             mdefs.CB_PREFILL_REQUESTS.inc(n, tags=self._mtags)
             mdefs.CB_PREFILL_TOKENS.inc(true_tokens, tags=self._mtags)
+            first_ts = time.time()  # the fetch above synced the device
             for (req, slot, _blocks), tok in zip(group, first):
                 tok = int(tok)
+                meta = self._req_meta.get(req["rid"])
+                if meta is not None:
+                    self._note_first_token(meta, pt0, first_ts)
                 if self.token_callback is not None:
                     self.token_callback(req["rid"], tok)
                 self._slots[slot] = {
@@ -808,6 +974,8 @@ class ContinuousBatcher:
             self._finished[st["rid"]] = st["out"]
             del self._slots[slot]
             self._release_slot(slot)
+            self._finish_request(st["rid"], "finished",
+                                 tokens=len(st["out"]))
 
     def _upload_state(self) -> None:
         tokens = np.zeros(self.num_slots, np.int32)
@@ -844,11 +1012,48 @@ class ContinuousBatcher:
                 self.cache, self._d_step)
         return self._d_tokens
 
-    def _apply_tokens(self, nxt_rows, membership) -> bool:
+    def _record_window_token(self, rid: int, entries: Dict[int, list],
+                             w0: float, w1: float) -> None:
+        """Attribute one applied token to the current sync window of a
+        TRACED request (span emission is deferred to finish). Past the
+        per-request window cap the tail merges into one aggregate so a
+        long generation can't flood the span buffer."""
+        ent = entries.get(rid)
+        if ent is not None:
+            ent[2] += 1
+            return
+        rec = self._req_meta.get(rid)
+        if rec is None or not rec["traced"]:
+            return
+        wins = rec["windows"]
+        if len(wins) < self._MAX_WINDOWS:
+            ent = [w0, w1, 1]
+            wins.append(ent)
+        else:
+            ent = rec.get("window_tail")
+            if ent is None:
+                ent = rec["window_tail"] = [w0, w1, 0, 0]
+            ent[1] = w1
+            ent[3] += 1
+            ent[2] += 1
+            entries[rid] = ent
+            return
+        entries[rid] = ent
+
+    def _apply_tokens(self, nxt_rows, membership, window=None) -> bool:
         """Book one or more fetched tick rows; returns True when any
-        request finished (membership changed)."""
+        request finished (membership changed). ``window`` is the
+        (wall_start, wall_end) of the sync window these rows cover —
+        recorded per traced request for the decode-window spans (windows
+        must attach BEFORE ``_maybe_finish`` pops the record, so this
+        rides the apply loop, not a post-pass)."""
         finished_any = False
         applied = 0
+        track = window is not None and self._traced_live > 0
+        if track:
+            w1 = window[1]
+            w0 = window[0] if window[0] is not None else w1
+            entries: Dict[int, list] = {}
         self._applied_steps += len(nxt_rows)
         for row in nxt_rows:
             for slot, rid in membership:
@@ -862,6 +1067,8 @@ class ContinuousBatcher:
                 st["last"] = tok
                 st["pos"] += 1
                 applied += 1
+                if track:
+                    self._record_window_token(rid, entries, w0, w1)
                 self._maybe_finish(slot)
                 if slot not in self._slots:
                     finished_any = True
@@ -897,6 +1104,7 @@ class ContinuousBatcher:
             if self._slots:
                 if self._dirty:
                     self._upload_state()
+                w0 = time.time() if self._traced_live else None
                 t0 = time.perf_counter()
                 nxt_dev = self._run_tick()
                 nxt = np.asarray(nxt_dev)  # 4 bytes/slot
@@ -919,7 +1127,9 @@ class ContinuousBatcher:
                                 if self.paged else None))
                 if self._apply_tokens(
                         [nxt], [(s, st["rid"])
-                                for s, st in self._slots.items()]):
+                                for s, st in self._slots.items()],
+                        window=(w0, time.time())
+                        if w0 is not None else None):
                     self._dirty = True
             out, self._finished = self._finished, {}
             return out
@@ -942,6 +1152,12 @@ class ContinuousBatcher:
                 self._upload_state()
             from ray_tpu._private import metrics_defs as mdefs
 
+            if not self._buf and self._traced_live:
+                # A fresh speculative buffer starts: its ticks form ONE
+                # sync window for the decode-window spans (the host only
+                # observes tokens at the next fetch, so finer-grained
+                # timing would be fiction).
+                self._window_t0 = time.time()
             if self._bw_window_t0 is None:
                 self._bw_window_t0 = time.perf_counter()
             t0 = time.perf_counter()
@@ -970,7 +1186,7 @@ class ContinuousBatcher:
         # the current buffer is stale speculation over freed slots:
         # discard it and rewind (re-upload host state next step).
         if self._pending is not None:
-            stacked, membership = self._pending
+            stacked, membership, win0 = self._pending
             self._pending = None
             rows = np.asarray(stacked)  # overlapped: usually ready
             # The fetch landing IS a device sync: backpressure makes the
@@ -988,7 +1204,8 @@ class ContinuousBatcher:
                                 if self.paged else None))
             self._bw_window_t0 = now
             self._bw_window_ticks = 0
-            if self._apply_tokens(list(rows), membership):
+            if self._apply_tokens(list(rows), membership,
+                                  window=(win0, time.time())):
                 self._buf = []
                 self._dirty = True
                 return
@@ -999,7 +1216,9 @@ class ContinuousBatcher:
             rows = np.asarray(jnp.stack(self._buf))
             membership = [(s, st["rid"]) for s, st in self._slots.items()]
             self._buf = []
-            self._apply_tokens(list(rows), membership)
+            win0, self._window_t0 = self._window_t0, None
+            self._apply_tokens(list(rows), membership,
+                               window=(win0, time.time()))
             self._dirty = True
             return
         if not self._buf:
@@ -1013,7 +1232,10 @@ class ContinuousBatcher:
         except Exception:  # noqa: BLE001 — platform without async copy
             pass
         self._pending = (stacked,
-                         [(s, st["rid"]) for s, st in self._slots.items()])
+                         [(s, st["rid"])
+                          for s, st in self._slots.items()],
+                         self._window_t0)
+        self._window_t0 = None
 
     def run_to_completion(self) -> Dict[int, List[int]]:
         """Drive ticks until every submitted request finished."""

@@ -194,6 +194,57 @@ def cmd_timeline(args):
     print(f"wrote {len(events)} trace events to {out}")
 
 
+def cmd_trace(args):
+    """Request-path tracing: reconstruct ONE serve request's life as a
+    chrome-trace timeline (``ray-tpu trace request <id>``).
+
+    The id can be the request id (``x-request-id`` header, or minted at
+    ingress and carried on every span of the request) or a trace id.
+    Matching finds the request's trace, then pulls EVERY span sharing
+    its trace id — ingress, route, replica dispatch, engine queue /
+    arena-wait / prefill, and per-sync-window decode spans — and prints
+    an offset-ordered summary plus a chrome://tracing / perfetto JSON
+    file. Spans exist only when the cluster ran with RAY_TPU_TRACING=1."""
+    _connect(args)
+    from ray_tpu.util import state
+    from ray_tpu.util.tracing import spans_to_chrome_events
+
+    spans = [e for e in state.list_tasks(limit=100000, include_spans=True)
+             if e.get("state") == "SPAN"]
+    want = args.id
+    trace_ids = {e["trace_id"] for e in spans
+                 if want in (e.get("request_id"), e.get("trace_id"))}
+    if not trace_ids:
+        raise SystemExit(
+            f"no spans found for request/trace id {want!r} — was the "
+            f"cluster started with RAY_TPU_TRACING=1, and has the span "
+            f"buffer flushed (reporters flush every 0.2s)? Drops are "
+            f"counted in ray_tpu_events_dropped_total.")
+    if len(trace_ids) > 1:
+        raise SystemExit(
+            f"id {want!r} matches {len(trace_ids)} traces — pass the "
+            f"full request id from the x-request-id header")
+    trace_id = trace_ids.pop()
+    mine = sorted((e for e in spans if e["trace_id"] == trace_id),
+                  key=lambda e: e["ts"])
+    out = args.output or f"ray-tpu-trace-{want[:16]}.json"
+    with open(out, "w") as f:
+        json.dump(spans_to_chrome_events(mine), f)
+    t0 = mine[0]["ts"]
+    print(f"trace {trace_id} ({len(mine)} spans):")
+    for e in mine:
+        off_ms = (e["ts"] - t0) * 1e3
+        dur_ms = e.get("dur", 0.0) * 1e3
+        extra = ""
+        if e.get("tokens") is not None:
+            extra = f"  tokens={e['tokens']}"
+        print(f"  +{off_ms:9.2f}ms {dur_ms:9.2f}ms  {e['name']:24} "
+              f"[{e.get('kind', '')}] worker={e.get('worker_id', '')}"
+              f"{extra}")
+    print(f"wrote chrome trace to {out} "
+          f"(open in chrome://tracing or https://ui.perfetto.dev)")
+
+
 def cmd_list(args):
     """State CLI (reference: ``ray list tasks|actors|...``,
     ``ray/util/state/state_cli.py``)."""
@@ -767,6 +818,20 @@ def main(argv=None):
     p.add_argument("--address")
     p.add_argument("--output", "-o")
     p.set_defaults(fn=cmd_timeline)
+
+    p = sub.add_parser("trace",
+                       help="request-path traces: 'trace request <id>' "
+                            "dumps one serve request's chrome-trace "
+                            "timeline (requires RAY_TPU_TRACING=1)")
+    p.add_argument("kind", choices=["request"],
+                   help="what to trace (currently: one serve request)")
+    p.add_argument("id",
+                   help="request id (x-request-id) or trace id")
+    p.add_argument("--address")
+    p.add_argument("--output", "-o",
+                   help="chrome-trace JSON path (default: "
+                        "ray-tpu-trace-<id>.json)")
+    p.set_defaults(fn=cmd_trace)
 
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("kind", choices=["nodes", "actors", "tasks", "objects",

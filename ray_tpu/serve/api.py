@@ -139,16 +139,23 @@ class Replica:
         return getattr(self.instance, method or "__call__")
 
     async def handle_request(self, method: str, args, kwargs,
-                             multiplexed_model_id: str = ""):
+                             multiplexed_model_id: str = "",
+                             request_ctx: Optional[Dict[str, Any]] = None):
         import asyncio
         import contextvars
 
+        from ray_tpu.serve import context as serve_context
         from ray_tpu.serve import multiplex
 
         with self._m_lock:
             self._ongoing += 1
             self._total += 1
         token = multiplex._set_model_id(multiplexed_model_id)
+        # The request context (request id + trace linkage) must be set
+        # BEFORE copy_context() below so sync user code sees it in the
+        # executor thread — same mechanism as the model id.
+        rtoken = (serve_context._set_request_context(request_ctx)
+                  if request_ctx is not None else None)
         try:
             target = self._target(method)
             if self._inspect.iscoroutinefunction(target):
@@ -159,22 +166,29 @@ class Replica:
             return await asyncio.get_running_loop().run_in_executor(
                 self._sync_pool, lambda: ctx.run(target, *args, **kwargs))
         finally:
+            if rtoken is not None:
+                serve_context._reset_request_context(rtoken)
             multiplex._reset_model_id(token)
             with self._m_lock:
                 self._ongoing -= 1
 
     async def handle_request_streaming(self, method: str, args, kwargs,
-                                       multiplexed_model_id: str = ""):
+                                       multiplexed_model_id: str = "",
+                                       request_ctx: Optional[Dict[str,
+                                                                  Any]] = None):
         """Streaming variant: each yield of the user method becomes one
         streamed item when called with num_returns="streaming" (reference:
         DeploymentResponseGenerator / RayServeHandle stream=True). Accepts
         sync and async generators."""
+        from ray_tpu.serve import context as serve_context
         from ray_tpu.serve import multiplex
 
         with self._m_lock:
             self._ongoing += 1
             self._total += 1
         token = multiplex._set_model_id(multiplexed_model_id)
+        rtoken = (serve_context._set_request_context(request_ctx)
+                  if request_ctx is not None else None)
         try:
             result = self._target(method)(*args, **kwargs)
             if hasattr(result, "__aiter__"):
@@ -210,6 +224,8 @@ class Replica:
                     f"{method or '__call__'!r} returned "
                     f"{type(result).__name__}")
         finally:
+            if rtoken is not None:
+                serve_context._reset_request_context(rtoken)
             multiplex._reset_model_id(token)
             with self._m_lock:
                 self._ongoing -= 1
@@ -219,6 +235,22 @@ class Replica:
         replica metrics pushed to the controller, autoscaling_policy.py)."""
         with self._m_lock:
             return {"ongoing": self._ongoing, "total": self._total}
+
+    def pressure(self):
+        """Pressure snapshot for the serve pressure endpoint: router
+        in-flight counts plus whatever the hosted callable reports (the
+        continuous-batching deployments expose queue depth / KV blocks
+        free / in-flight prefill tokens through their own ``pressure()``)."""
+        with self._m_lock:
+            out = {"ongoing": self._ongoing, "total": self._total}
+        if not self.is_function:
+            probe = getattr(self.instance, "pressure", None)
+            if callable(probe):
+                try:
+                    out.update(probe() or {})
+                except Exception:  # noqa: BLE001 — monitoring must not
+                    pass           # fail requests' host process
+        return out
 
     def health(self):
         return True
@@ -233,6 +265,8 @@ class ServeController:
         self._route_version: Dict[str, int] = {}
         # Shared router loads: name -> (ts, [ongoing per replica]).
         self._loads_cache: Dict[str, Any] = {}
+        # Pressure snapshots: name -> (ts, [per-replica dicts]).
+        self._pressure_cache: Dict[str, Any] = {}
         # autoscaler intent: name -> (desired, first_seen_monotonic)
         self._scale_intent: Dict[str, Any] = {}
         self._pg_cleanups: Dict[str, list] = {}
@@ -397,6 +431,72 @@ class ServeController:
             pass
         self._loads_cache[name] = (now, loads)
         return loads
+
+    PRESSURE_TTL_S = 0.5
+
+    def get_replica_pressure(self, name: str):
+        """Per-replica pressure snapshots (queue depth, KV blocks free,
+        in-flight prefill tokens from engine-backed replicas; router
+        in-flight counts from every replica), aligned with get_routes
+        order and TTL-cached — the prefix/KV-pressure router and the
+        dashboard pressure endpoint both read this."""
+        now = time.monotonic()
+        cached = self._pressure_cache.get(name)
+        if cached is not None and now - cached[0] < self.PRESSURE_TTL_S:
+            return cached[1]
+        replicas = list(self.replicas.get(name, []))
+        refs = [r.pressure.remote() for r in replicas]
+        # Shared deadline across the fan-out (same rationale as
+        # get_replica_loads: dying replicas must not serialize stalls).
+        out = [{"replica": i, "unreachable": True}
+               for i in range(len(refs))]
+        try:
+            ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                    timeout=1.0)
+            ready_ids = {r.id().binary() for r in ready}
+            for i, ref in enumerate(refs):
+                if ref.id().binary() not in ready_ids:
+                    continue
+                try:
+                    snap = ray_tpu.get(ref, timeout=0.1)
+                    out[i] = {"replica": i, **(snap or {})}
+                except Exception:  # noqa: BLE001 — died mid-probe
+                    pass
+        except Exception:  # noqa: BLE001 — wait itself failed
+            pass
+        self._pressure_cache[name] = (now, out)
+        return out
+
+    def get_pressure(self):
+        """Pressure for every deployment: {name: [per-replica dicts]}."""
+        return {name: self.get_replica_pressure(name)
+                for name in list(self.deployments)}
+
+    def _publish_pressure(self) -> None:
+        """Mirror the pressure snapshot into the GCS KV (``__serve__`` /
+        ``pressure``) so the dashboard — which talks to the GCS, not to
+        actors — can serve ``/api/v1/serve/pressure`` without a runtime."""
+        core = _core()
+        if not hasattr(core, "gcs") or not self.deployments:
+            return
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        pressure = self.get_pressure()
+        body = json.dumps(pressure, sort_keys=True)
+        now = time.monotonic()
+        last_body, last_ts = getattr(self, "_pressure_published",
+                                     (None, 0.0))
+        # Unchanged data still republishes every few seconds so the
+        # snapshot's ts stays a usable controller-liveness signal, but
+        # an idle cluster doesn't churn the GCS KV (and its WAL) at the
+        # reconcile cadence.
+        if body == last_body and now - last_ts < 5.0:
+            return
+        self._pressure_published = (body, now)
+        snap = {"ts": time.time(), "deployments": pressure}
+        core.gcs.KvPut(pb.KvRequest(
+            ns="__serve__", key="pressure",
+            value=json.dumps(snap).encode(), overwrite=True))
 
     def list_deployments(self):
         return {name: {"num_replicas": spec["num_replicas"]}
@@ -598,6 +698,10 @@ class ServeController:
                     self._reconcile_once(name)
                 except Exception:  # noqa: BLE001
                     pass
+            try:
+                self._publish_pressure()
+            except Exception:  # noqa: BLE001
+                pass
 
     def shutdown(self):
         self._stop = True
@@ -699,11 +803,17 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, method_name: Optional[str] = None,
                  _router: Optional["_RouterState"] = None,
-                 _stream: bool = False, _model_id: str = ""):
+                 _stream: bool = False, _model_id: str = "",
+                 _request_ctx: Optional[Dict[str, Any]] = None):
         self._name = deployment_name
         self._method = method_name
         self._stream = _stream
         self._model_id = _model_id
+        # Per-call request context (request id + trace linkage, minted
+        # at the ingress): ships to the replica so engine lifecycle
+        # spans connect to the caller's trace. None = mint on demand
+        # when tracing is enabled.
+        self._request_ctx = _request_ctx
         # Router state (replica table, in-flight counts, subscription) is
         # SHARED across options()/method clones: one subscription per
         # logical handle, not per call.
@@ -719,7 +829,8 @@ class DeploymentHandle:
 
     def options(self, method_name: Optional[str] = None, *,
                 stream: Optional[bool] = None,
-                multiplexed_model_id: Optional[str] = None
+                multiplexed_model_id: Optional[str] = None,
+                request_context: Optional[Dict[str, Any]] = None
                 ) -> "DeploymentHandle":
         return DeploymentHandle(
             self._name,
@@ -727,7 +838,9 @@ class DeploymentHandle:
             _router=self._router,
             _stream=self._stream if stream is None else stream,
             _model_id=(self._model_id if multiplexed_model_id is None
-                       else multiplexed_model_id))
+                       else multiplexed_model_id),
+            _request_ctx=(self._request_ctx if request_context is None
+                          else request_context))
 
     @property
     def _replicas(self):
@@ -854,6 +967,34 @@ class DeploymentHandle:
                                     tags={"deployment": self._name})
 
     def remote(self, *args, **kwargs):
+        from ray_tpu.util import tracing
+
+        if not tracing.enabled():
+            # Hot path with tracing off: one env check, no context work.
+            return self._remote_impl(args, kwargs, self._request_ctx)
+        rctx = self._request_ctx
+        if rctx is None:
+            # Direct handle call (no ingress): mint the request identity
+            # here, continuing the caller's trace when one is active.
+            cur = tracing.current()
+            rctx = {"request_id": tracing.gen_id(),
+                    "trace_id": cur[0] if cur else tracing.gen_id(),
+                    "parent_span_id": cur[1] if cur else "",
+                    "deployment": self._name, "tenant": self._model_id}
+        parent = rctx.get("parent_span_id", "")
+        # Pre-allocate the route span id so the engine's lifecycle spans
+        # (emitted from the replica long after this returns) can parent
+        # to it; the span itself closes when dispatch completes.
+        route_span = tracing.gen_id()
+        rctx = {**rctx, "parent_span_id": route_span}
+        with tracing.explicit_span(
+                "serve.route", trace_id=rctx.get("trace_id", ""),
+                span_id=route_span, parent_span_id=parent, kind="route",
+                request_id=rctx.get("request_id", ""),
+                deployment=self._name):
+            return self._remote_impl(args, kwargs, rctx)
+
+    def _remote_impl(self, args, kwargs, request_ctx):
         from ray_tpu._private import metrics_defs as mdefs
 
         idx, replica = self._choose(self._model_id)
@@ -864,7 +1005,7 @@ class DeploymentHandle:
         if self._stream:
             gen = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(
-                self._method, args, kwargs, self._model_id)
+                self._method, args, kwargs, self._model_id, request_ctx)
 
             def _sdone(_fut):
                 with self._lock:
@@ -878,7 +1019,7 @@ class DeploymentHandle:
                 _sdone(None)
             return DeploymentResponseGenerator(gen)
         ref = replica.handle_request.remote(self._method, args, kwargs,
-                                            self._model_id)
+                                            self._model_id, request_ctx)
 
         def _done(_fut):
             with self._lock:

@@ -36,6 +36,17 @@ def _reset_model_id(token) -> None:
     _model_id_ctx.reset(token)
 
 
+def get_request_tenant() -> str:
+    """The in-flight request's TENANT for telemetry attribution: the
+    multiplexed model id ('' for single-tenant deployments). The
+    ``ray_tpu_serve_request_*`` histograms carry this as their
+    ``tenant`` tag so one noisy tenant's TTFT/TPOT is separable from
+    the deployment aggregate. Delegates to
+    :func:`get_multiplexed_model_id` — one source of truth if a
+    default-tenant rule ever lands."""
+    return get_multiplexed_model_id()
+
+
 def multiplexed(func: Optional[Callable] = None, *,
                 max_num_models_per_replica: int = 3):
     """Decorate a model-loader method ``(self, model_id) -> model``: calls

@@ -24,8 +24,11 @@ import asyncio
 import json
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.util import tracing
 
 logger = logging.getLogger(__name__)
 
@@ -57,20 +60,55 @@ class _Router:
             raise LookupError("method not found")
 
     def call(self, name: str, method: Optional[str], payload,
-             model_id: str = "", timeout_s: float = 60.0):
+             model_id: str = "", timeout_s: float = 60.0,
+             request_ctx: Optional[Dict[str, Any]] = None):
         self._check_public(method)
         h = self.handle(name).options(method,
-                                      multiplexed_model_id=model_id)
+                                      multiplexed_model_id=model_id,
+                                      request_context=request_ctx)
         return h.remote(payload).result(timeout_s=timeout_s)
 
     def stream(self, name: str, method: Optional[str], payload,
-               model_id: str = ""):
+               model_id: str = "",
+               request_ctx: Optional[Dict[str, Any]] = None):
         self._check_public(method)
         h = self.handle(name).options(method, stream=True,
-                                      multiplexed_model_id=model_id)
+                                      multiplexed_model_id=model_id,
+                                      request_context=request_ctx)
         gen = h.remote(payload)
         gen._timeout = 60.0  # per-item bound, like result()
         return iter(gen)
+
+
+def ingress_request_context(deployment: str, tenant: str = "",
+                            request_id: str = "") -> Optional[Dict[str, Any]]:
+    """Mint the serve request context at an INGRESS: a fresh trace id
+    plus a pre-allocated ingress span id the ingress closes when the
+    response completes. Returns None when tracing is disabled (the data
+    plane then pays one env check per request and nothing else). An
+    ``x-request-id`` supplied by the client is honored so external
+    systems can correlate."""
+    if not tracing.enabled():
+        return None
+    return {"request_id": request_id or tracing.gen_id(),
+            "trace_id": tracing.gen_id(),
+            "parent_span_id": tracing.gen_id(),  # = the ingress span id
+            "deployment": deployment, "tenant": tenant}
+
+
+def _close_ingress_span(rctx: Optional[Dict[str, Any]], t0: float,
+                        status: Any, path: str) -> None:
+    """Emit the root serve.ingress span retrospectively (the span covers
+    parse -> route -> full response write, so its id must exist before
+    its duration does)."""
+    if rctx is None:
+        return
+    tracing.emit_span("serve.ingress", trace_id=rctx["trace_id"],
+                      span_id=rctx["parent_span_id"], ts=t0,
+                      dur=time.time() - t0, kind="ingress",
+                      request_id=rctx["request_id"],
+                      deployment=rctx.get("deployment", ""),
+                      http_path=path, status=str(status))
 
 
 class AsyncHttpProxy:
@@ -260,21 +298,38 @@ class AsyncHttpProxy:
             return True
         model_id = headers.get("serve_multiplexed_model_id", "")
         payload = json.loads(body) if body else {}
+        # Request-path tracing starts HERE: the ingress mints the trace
+        # context (one trace per request) and every downstream hop —
+        # route decision, replica dispatch, engine admission, prefill,
+        # decode windows — parents into it.
+        rctx = ingress_request_context(
+            name, tenant=model_id,
+            request_id=headers.get("x-request-id", ""))
+        ing_t0 = time.time()
 
         if not stream:
-            result = await loop.run_in_executor(
-                self._pool, self.router.call, name, call_method, payload,
-                model_id)
+            try:
+                result = await loop.run_in_executor(
+                    self._pool, self.router.call, name, call_method,
+                    payload, model_id, 60.0, rctx)
+            except Exception:
+                _close_ingress_span(rctx, ing_t0, "error", path)
+                raise
             writer.write(self._response(
                 200, json.dumps(result).encode(), keep_alive=keep_alive))
             await writer.drain()
+            _close_ingress_span(rctx, ing_t0, 200, path)
             return True
 
         # Streaming: pull the first item BEFORE committing to 200 so
         # pre-stream failures surface as errors, not empty streams.
-        items = await loop.run_in_executor(
-            self._pool, self.router.stream, name, call_method, payload,
-            model_id)
+        try:
+            items = await loop.run_in_executor(
+                self._pool, self.router.stream, name, call_method,
+                payload, model_id, rctx)
+        except Exception:
+            _close_ingress_span(rctx, ing_t0, "error", path)
+            raise
 
         def pull():
             try:
@@ -282,7 +337,11 @@ class AsyncHttpProxy:
             except StopIteration:
                 return _STREAM_END
 
-        first = await loop.run_in_executor(self._pool, pull)
+        try:
+            first = await loop.run_in_executor(self._pool, pull)
+        except Exception:
+            _close_ingress_span(rctx, ing_t0, "error", path)
+            raise
         conn = "keep-alive" if keep_alive else "close"
         writer.write((f"HTTP/1.1 200 OK\r\n"
                       f"Content-Type: application/x-ndjson\r\n"
@@ -298,11 +357,13 @@ class AsyncHttpProxy:
                 item = await loop.run_in_executor(self._pool, pull)
             writer.write(b"0\r\n\r\n")
             await writer.drain()
+            _close_ingress_span(rctx, ing_t0, 200, path)
             return True
         except Exception:  # noqa: BLE001 — mid-stream failure: abort the
             # connection so the client sees truncation, not completion.
             logger.exception("streaming response for %s failed mid-stream",
                              name)
+            _close_ingress_span(rctx, ing_t0, "aborted", path)
             return False
 
     def stop(self):
@@ -346,14 +407,19 @@ class GrpcProxy:
     def Predict(self, request, context):
         from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
+        rctx = ingress_request_context(
+            request.deployment, tenant=request.multiplexed_model_id)
+        t0 = time.time()
         try:
             payload = json.loads(request.payload) if request.payload else {}
             result = self.router.call(
                 request.deployment, request.method or None, payload,
-                request.multiplexed_model_id)
+                request.multiplexed_model_id, request_ctx=rctx)
+            _close_ingress_span(rctx, t0, "ok", "grpc:Predict")
             return pb.ServeReply(ok=True,
                                  payload=json.dumps(result).encode())
         except Exception as e:  # noqa: BLE001
+            _close_ingress_span(rctx, t0, "error", "grpc:Predict")
             return pb.ServeReply(ok=False, error=str(e))
 
     def PredictStream(self, request, context):
@@ -361,23 +427,31 @@ class GrpcProxy:
 
         from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
-        try:
+        rctx = ingress_request_context(
+            request.deployment, tenant=request.multiplexed_model_id)
+        t0 = time.time()
+        status = "aborted"  # client cancellation raises GeneratorExit,
+        try:                # which except Exception would never see
             payload = json.loads(request.payload) if request.payload else {}
             items = self.router.stream(
                 request.deployment, request.method or None, payload,
-                request.multiplexed_model_id)
+                request.multiplexed_model_id, request_ctx=rctx)
             for item in items:
                 yield pb.ServeReply(ok=True,
                                     payload=json.dumps(item).encode())
+            status = "ok"
         except Exception as e:  # noqa: BLE001
             # Terminate with an RPC error, NOT a trailing ok=False item:
             # consumers filtering on ok would read a truncated stream as a
             # successful short one (the HTTP plane aborts the connection
             # for the same reason).
+            status = "error"
             context.abort(_grpc.StatusCode.INTERNAL, str(e))
+        finally:
+            _close_ingress_span(rctx, t0, status, "grpc:PredictStream")
 
     def stop(self):
         self._server.stop(grace=0.5)
 
 
-__all__ = ["AsyncHttpProxy", "GrpcProxy"]
+__all__ = ["AsyncHttpProxy", "GrpcProxy", "ingress_request_context"]

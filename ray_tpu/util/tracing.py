@@ -67,6 +67,65 @@ def _ids() -> str:
     return uuid.uuid4().hex[:16]
 
 
+def gen_id() -> str:
+    """A fresh 16-hex trace/span/request id (public: the serve plane
+    mints request ids and pre-allocates span ids with it)."""
+    return _ids()
+
+
+def emit_span(name: str, *, trace_id: str, ts: float, dur: float,
+              span_id: Optional[str] = None, parent_span_id: str = "",
+              kind: str = "task", **attrs) -> str:
+    """Record a span RETROSPECTIVELY with an explicit start/duration.
+
+    The serve request path needs this because its phases are measured by
+    bookkeeping (a request's queue wait ends when the admission loop
+    picks it up, in a different thread than the one that submitted it),
+    so a context manager around the work is impossible. Returns the span
+    id ('' when tracing is disabled)."""
+    if not enabled():
+        return ""
+    span_id = span_id or _ids()
+    ids = _process_ids()
+    _get_reporter().add({
+        "state": "SPAN", "name": name, "kind": kind,
+        "task_id": span_id,
+        "trace_id": trace_id, "span_id": span_id,
+        "parent_span_id": parent_span_id or "",
+        "ts": ts, "dur": max(dur, 0.0), **ids, **attrs})
+    return span_id
+
+
+@contextmanager
+def explicit_span(name: str, *, trace_id: str,
+                  span_id: Optional[str] = None,
+                  parent_span_id: str = "", kind: str = "task", **attrs):
+    """Like :func:`span` but with a CALLER-CHOSEN span id, so the caller
+    can hand that id to other processes as a parent BEFORE the span
+    closes (the serve route span does this: engine lifecycle spans in
+    the replica parent to it while the route call is still running).
+    Sets the thread-local context so task submissions inside inherit
+    the trace."""
+    if not enabled():
+        yield None
+        return
+    span_id = span_id or _ids()
+    prev = current()
+    set_context(trace_id, span_id)
+    t0 = time.time()
+    try:
+        yield span_id
+    finally:
+        _local.ctx = prev
+        ids = _process_ids()
+        _get_reporter().add({
+            "state": "SPAN", "name": name, "kind": kind,
+            "task_id": span_id,
+            "trace_id": trace_id, "span_id": span_id,
+            "parent_span_id": parent_span_id or "",
+            "ts": t0, "dur": time.time() - t0, **ids, **attrs})
+
+
 @contextmanager
 def span(name: str, kind: str = "task",
          trace_id: Optional[str] = None,
@@ -182,4 +241,5 @@ def spans_to_chrome_events(records: List[Dict[str, Any]]) \
 
 
 __all__ = ["enabled", "span", "execute_span", "inject_context",
-           "current", "set_context", "spans_to_chrome_events"]
+           "current", "set_context", "spans_to_chrome_events",
+           "gen_id", "emit_span", "explicit_span"]

@@ -38,6 +38,27 @@ def _double(x):
     return 2 * x
 
 
+def _wait_for(fn, timeout_s: float = 30.0, interval_s: float = 0.2):
+    """Deadline/retry on a restore condition: ``fn`` returns a truthy
+    value (returned) or raises/returns falsy (retried until deadline).
+    Under tier-1 load the post-restart paths (node re-register, actor
+    resolution through the fresh GCS) can take seconds — a fixed sleep
+    is either too short (flake) or always-paid latency."""
+    deadline = time.monotonic() + timeout_s
+    last_exc = None
+    while time.monotonic() < deadline:
+        try:
+            out = fn()
+            if out:
+                return out
+        except Exception as e:  # noqa: BLE001 — retried until deadline
+            last_exc = e
+        time.sleep(interval_s)
+    if last_exc is not None:
+        raise last_exc
+    raise AssertionError("condition not met before deadline")
+
+
 def _wait_alive_nodes(address: str, want: int, timeout_s: float = 15.0):
     gcs = rpc.get_stub("GcsService", address)
     deadline = time.monotonic() + timeout_s
@@ -167,14 +188,27 @@ def test_head_loss_recovers_from_external_wal(tmp_path, monkeypatch):
                                overwrite=True))
         a = Stateful.options(name="ha_actor", lifetime="detached").remote()
         assert ray_tpu.get(a.inc.remote(), timeout=60) == 1
-        time.sleep(0.5)  # WAL flush period is 50ms; let appends land
+        # Write barrier instead of a fixed sleep: the batched WAL writer
+        # flushes every 50ms UNLOADED, but under tier-1 suite load the
+        # drain can lag far past any guessed sleep (the documented
+        # restore flake). sync() returns once the appends are durable in
+        # the external log server.
+        assert c.gcs.wal_sync(30.0), "WAL appends not durable in time"
 
         # The replacement head recovers purely from the log server.
         c.restart_gcs()
         assert _wait_alive_nodes(c.address, 1), "node did not re-register"
-        reply = gcs.KvGet(pb.KvRequest(ns="ha", key="k"))
-        assert reply.found and reply.value == b"remote"
-        b = ray_tpu.get_actor("ha_actor")
+        # Restore waits are deadline/retried: recovery replays the log
+        # synchronously at construction, but the stub's first RPCs can
+        # race the fresh server's socket under load.
+        def _kv_restored():
+            r = gcs.KvGet(pb.KvRequest(ns="ha", key="k"))
+            return r if r.found else None
+
+        reply = _wait_for(_kv_restored, timeout_s=30.0)
+        assert reply.value == b"remote"
+        b = _wait_for(lambda: ray_tpu.get_actor("ha_actor"),
+                      timeout_s=30.0)
         assert ray_tpu.get(b.inc.remote(), timeout=60) == 2
         assert ray_tpu.get(_double.remote(21), timeout=60) == 42
         # No local persistence was written next to the head.

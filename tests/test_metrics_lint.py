@@ -95,6 +95,60 @@ def test_kv_arena_series_are_cataloged():
             assert m.description.strip() and m.tag_keys
 
 
+def test_serve_request_series_are_cataloged():
+    """The request-path observability series (TTFT decomposition, TPOT,
+    outcomes, event-buffer drops) ship described + tagged in the catalog
+    — the dashboard latency-breakdown panel and bench_serve's
+    ttft_breakdown baseline read them."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_serve_request_ttft_seconds",
+        "ray_tpu_serve_request_queue_seconds",
+        "ray_tpu_serve_request_arena_wait_seconds",
+        "ray_tpu_serve_request_prefill_seconds",
+        "ray_tpu_serve_request_tpot_seconds",
+        "ray_tpu_serve_request_outcomes_total",
+        "ray_tpu_events_dropped_total",
+    }
+    missing = required - names
+    assert not missing, (
+        f"request-path series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name.startswith("ray_tpu_serve_request_"):
+            assert m.description.strip() and m.tag_keys
+            if m.name != "ray_tpu_serve_request_latency_seconds":
+                # Attribution tags: per-deployment AND per-tenant.
+                assert {"deployment", "tenant"} <= set(m.tag_keys), m.name
+
+
+def test_serve_ingress_and_engine_admission_emit_spans():
+    """The request-path trace is only connected if BOTH ends emit: the
+    serve ingresses must mint the request context + close the ingress
+    span, and the engine admission path must record the lifecycle
+    (queue/arena-wait/prefill spans + TTFT decomposition). A refactor
+    that drops either silently severs every request trace, so lint the
+    entry points."""
+    import pathlib
+
+    import ray_tpu
+    from ray_tpu.models.continuous_batching import ContinuousBatcher
+    from ray_tpu.serve import proxy
+
+    root = pathlib.Path(ray_tpu.__file__).parent
+    proxy_src = (root / "serve" / "proxy.py").read_text()
+    # Every ingress (HTTP route + both gRPC handlers) goes through the
+    # shared mint/close helpers.
+    assert proxy_src.count("ingress_request_context(") >= 4
+    assert '"serve.ingress"' in proxy_src
+    engine_src = (root / "models" / "continuous_batching.py").read_text()
+    for marker in ('"engine.queue"', '"engine.prefill"',
+                   '"engine.decode_window"', "_note_first_token("):
+        assert marker in engine_src, marker
+    # And the engine API actually exposes the lifecycle surface.
+    assert hasattr(ContinuousBatcher, "pressure_snapshot")
+    assert callable(getattr(proxy, "ingress_request_context"))
+
+
 def test_checkpoint_plane_series_are_cataloged():
     """The checkpoint plane's series (ray_tpu/checkpoint/) ship described
     + tagged in the catalog, including the acceptance-criteria
