@@ -78,6 +78,100 @@ def _tick_cost_stats() -> tuple:
             int(stats.get("flops") or 0))
 
 
+def _breakdown_pcts(breakdowns) -> dict:
+    """p50/p95 of the TTFT decomposition from engine request records."""
+    churn = [b for b in breakdowns
+             if b["outcome"] == "finished" and b["ttft_s"] is not None]
+    out = {}
+    for comp in ("queue", "arena_wait", "prefill", "ttft", "tpot"):
+        vals = sorted(b[f"{comp}_s"] for b in churn
+                      if b.get(f"{comp}_s") is not None)
+        out[f"{comp}_p50_ms"] = round(_pct(vals, 0.50) * 1e3, 2)
+        out[f"{comp}_p95_ms"] = round(_pct(vals, 0.95) * 1e3, 2)
+    out["samples"] = len(churn)
+    return out
+
+
+def _prefix_phase(config, params, num_slots, max_len, sync_every,
+                  block_size, shared_blocks, tail_len, rounds,
+                  shared_frac=0.75) -> dict:
+    """Prefix-reuse churn: ``shared_frac`` of requests share one system
+    prompt (``shared_blocks`` full KV blocks) ahead of a unique tail —
+    the chat-fleet traffic shape prefix caching exists for. Runs the
+    same schedule with the prefix cache ON and OFF and reports
+    ``prefix_hit_rate``, ``prefill_tokens_saved``, effective prefill
+    throughput (tokens the clients asked prefilled over the engine's own
+    prefill wall time — cached tokens cost ~0), and the
+    ``ttft_breakdown`` each way. The routing analog (affinity keeps a
+    prefix's requests on the replica holding it) rides the same engine
+    counters per replica."""
+    import numpy as _np
+
+    from ray_tpu.models.continuous_batching import ContinuousBatcher
+
+    rng = _np.random.default_rng(17)
+    shared = list(map(int, rng.integers(1, config.vocab_size,
+                                        size=shared_blocks * block_size)))
+    sched = []
+    for i in range(rounds * num_slots):
+        if (i % 4) < int(round(shared_frac * 4)):
+            prompt = shared + list(map(int, rng.integers(
+                1, config.vocab_size, size=tail_len)))
+        else:
+            prompt = list(map(int, rng.integers(
+                1, config.vocab_size,
+                size=shared_blocks * block_size + tail_len)))
+        sched.append(prompt)
+    out = {"shared_frac": shared_frac,
+           "shared_prefix_tokens": len(shared)}
+    for on in (True, False):
+        eng = ContinuousBatcher(config, params=params,
+                                num_slots=num_slots, max_len=max_len,
+                                sync_every=sync_every, paged=True,
+                                block_size=block_size, prefix_cache=on)
+        # Warm-up = the steady state of a serving replica: the system
+        # prompt is resident AND both prefill program shapes (cold full
+        # prompt, warm suffix-after-match) are compiled before timing.
+        for _ in range(2):
+            eng.submit(list(sched[0]), max_new_tokens=2)
+            while eng.has_work():
+                eng.step()
+        eng.request_breakdowns.clear()
+        hit0, miss0 = eng.prefix_hit_tokens, eng.prefix_miss_tokens
+        prefill0, pwall0 = eng.prefill_tokens, eng.prefill_seconds
+        t0 = time.perf_counter()
+        for prompt in sched:
+            eng.submit(list(prompt), max_new_tokens=4)
+            eng.step()
+        while eng.has_work():
+            eng.step()
+        wall = time.perf_counter() - t0
+        hits = eng.prefix_hit_tokens - hit0
+        misses = eng.prefix_miss_tokens - miss0
+        prefilled = eng.prefill_tokens - prefill0
+        asked = (hits + misses) if on else prefilled
+        prefill_wall = max(eng.prefill_seconds - pwall0, 1e-9)
+        key = "cache_on" if on else "cache_off"
+        out[key] = {
+            "prefix_hit_rate": round(hits / max(hits + misses, 1), 4),
+            "prefill_tokens": prefilled,
+            "prefill_tokens_saved": hits,
+            "effective_prefill_tokens_per_s": round(
+                asked / prefill_wall, 1),
+            "wall_s": round(wall, 3),
+            "ttft_breakdown": _breakdown_pcts(eng.request_breakdowns),
+        }
+    on_d, off_d = out["cache_on"], out["cache_off"]
+    out["prefill_tokens_saved_frac"] = round(
+        on_d["prefill_tokens_saved"]
+        / max(on_d["prefill_tokens_saved"] + on_d["prefill_tokens"], 1),
+        4)
+    out["effective_prefill_speedup"] = round(
+        on_d["effective_prefill_tokens_per_s"]
+        / max(off_d["effective_prefill_tokens_per_s"], 1e-9), 3)
+    return out
+
+
 def _measure_decode(eng, num_slots, max_len, prompt_len, ticks):
     """Steady-state decode tokens/s at full occupancy (compile warm-up
     included). Returns (tokens_per_s, mean_tick_s, live_bytes)."""
@@ -173,19 +267,28 @@ def main() -> None:
     # TTFT decomposition from the engine's request-path telemetry
     # (queue -> arena-wait -> prefill; the same records the
     # ray_tpu_serve_request_* histograms observe): the regression
-    # baseline future routing/admission PRs are judged against — a
-    # router change should move queue_ms, not prefill_ms.
-    churn = [b for b in eng.request_breakdowns
-             if b["outcome"] == "finished" and b["ttft_s"] is not None]
-    ttft_breakdown = {}
-    for comp in ("queue", "arena_wait", "prefill", "ttft", "tpot"):
-        vals = sorted(b[f"{comp}_s"] for b in churn
-                      if b.get(f"{comp}_s") is not None)
-        ttft_breakdown[f"{comp}_p50_ms"] = round(
-            _pct(vals, 0.50) * 1e3, 2)
-        ttft_breakdown[f"{comp}_p95_ms"] = round(
-            _pct(vals, 0.95) * 1e3, 2)
-    ttft_breakdown["samples"] = len(churn)
+    # baseline routing/admission changes are judged against — a router
+    # change should move queue_ms, not prefill_ms. This churn phase has
+    # NO shared prefixes, so it also guards the affinity-routing
+    # acceptance bound (queue/prefill p95 must not regress when traffic
+    # has nothing to share).
+    ttft_breakdown = _breakdown_pcts(eng.request_breakdowns)
+
+    # Phase 2c — prefix-reuse churn (ISSUE-8 tentpole): 75% of requests
+    # share a block-aligned system prompt; the radix cache must turn
+    # their prefills into table splices. Acceptance: >=2x effective
+    # prefill tokens/s (or >=50% prefill_tokens_saved) at 75% shared
+    # traffic.
+    if on_tpu:
+        prefix_phase = _prefix_phase(config, eng.params, num_slots,
+                                     max_len, sync_every, block_size=64,
+                                     shared_blocks=4, tail_len=16,
+                                     rounds=4)
+    else:
+        prefix_phase = _prefix_phase(config, eng.params, num_slots,
+                                     max_len=64, sync_every=1,
+                                     block_size=8, shared_blocks=4,
+                                     tail_len=4, rounds=2)
 
     # Phase 3 — steady-state decode at full occupancy. No per-tick
     # device sync: the buffered engine's whole point is overlapping
@@ -250,6 +353,7 @@ def main() -> None:
         "ttft_p95_ms": round(_pct(ttft_sorted, 0.95) * 1e3, 2),
         "ttft_samples": len(ttft_sorted),
         "ttft_breakdown": ttft_breakdown,
+        "prefix_phase": prefix_phase,
         "prefill_tokens_per_s": round(prefill_tokens / prefill_wall, 1),
         # Live-token accounting is the headline figure (it is what the
         # achieved-BW gauges use); the static cost-analysis figure rides

@@ -132,6 +132,12 @@ SERVE_QUEUE_DEPTH = Gauge(
     "ray_tpu_serve_queue_depth",
     "In-flight requests this router currently has against a deployment",
     ("deployment",))
+SERVE_ROUTER_AFFINITY = Counter(
+    "ray_tpu_serve_router_affinity_total",
+    "Prefix-affinity routing decisions: affinity (request landed on its "
+    "fingerprint's home replica), overflow (home too pressured — spilled "
+    "to the second rendezvous choice)",
+    ("deployment", "decision"))
 
 # ------------------------------------------ serve request path (L6 + engine)
 # Per-request latency attribution emitted by the continuous-batching
@@ -247,6 +253,26 @@ CB_KV_FRAG_RATIO = Gauge(
     "ray_tpu_cb_kv_frag_ratio",
     "Reserved-but-unwritten fraction of used paged-KV blocks "
     "(internal fragmentation of the arena)",
+    ("engine",))
+CB_PREFIX_HIT_TOKENS = Counter(
+    "ray_tpu_cb_prefix_hit_tokens_total",
+    "Prompt tokens served from cached prefix blocks instead of being "
+    "prefilled (radix prefix cache hits, block-aligned)",
+    ("engine",))
+CB_PREFIX_MISS_TOKENS = Counter(
+    "ray_tpu_cb_prefix_miss_tokens_total",
+    "Prompt tokens actually prefilled (novel suffixes; the whole prompt "
+    "on a cold miss) — hit/(hit+miss) is the prefix hit rate",
+    ("engine",))
+CB_KV_BLOCKS_CACHED = Gauge(
+    "ray_tpu_cb_kv_blocks_cached",
+    "Refcount-0 prefix blocks parked in the radix LRU: revivable by a "
+    "prefix match, reclaimed before admission blocks on the arena",
+    ("engine",))
+CB_KV_BLOCKS_SHARED = Gauge(
+    "ray_tpu_cb_kv_blocks_shared",
+    "Indexed prefix blocks pinned (refcounted) by at least one live "
+    "slot — never reclaimed while referenced",
     ("engine",))
 
 # ------------------------------------------------- XLA plane (_private/

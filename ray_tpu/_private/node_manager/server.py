@@ -32,6 +32,16 @@ from ray_tpu.protobuf import ray_tpu_pb2 as pb
 logger = logging.getLogger(__name__)
 
 HEARTBEAT_PERIOD_S = 0.5
+
+
+def _heartbeat_period_s() -> float:
+    """Env-tunable (RAY_TPU_HEARTBEAT_PERIOD_S) together with the GCS
+    side's RAY_TPU_HEARTBEAT_TTL_S: co-tenant-loaded test boxes widen
+    both instead of flaking on missed 3s liveness windows."""
+    import os
+
+    return float(os.environ.get("RAY_TPU_HEARTBEAT_PERIOD_S",
+                                HEARTBEAT_PERIOD_S))
 CLUSTER_VIEW_TTL_S = 1.0
 IDLE_WORKER_TTL_S = 60.0
 CHUNK_SIZE = 8 * 1024 * 1024
@@ -339,7 +349,7 @@ class NodeManager:
 
     def _heartbeat_loop(self):
         seq = 0
-        while not self._stop.wait(HEARTBEAT_PERIOD_S):
+        while not self._stop.wait(_heartbeat_period_s()):
             seq += 1
             req = pb.HeartbeatRequest(node_id=self.node_id, seq=seq)
             with self._res_lock:

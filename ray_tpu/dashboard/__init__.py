@@ -88,6 +88,7 @@ _INDEX_HTML = """<!doctype html>
 <h1>ray_tpu dashboard <span id="status"></span></h1>
 <h2>Cluster</h2><div id="cluster"></div>
 <h2>Serve / KV arena</h2><div id="serve"></div>
+<h2>Serve / prefix cache &amp; affinity routing</h2><div id="prefix"></div>
 <h2>Serve / request latency breakdown (TTFT = queue + arena-wait +
 prefill; TPOT)</h2><div id="reqlat"></div>
 <h2>Serve / replica pressure</h2><table id="pressure"></table>
@@ -181,6 +182,27 @@ async function servePanel(){
   document.getElementById("serve").innerHTML=
     sparkRows(data,60)||"(no serve engines)";
 }
+async function prefixPanel(){
+  // Prefix-cache effectiveness + router affinity: hit vs miss prompt
+  // tokens, cached/refcounted arena blocks, and the affinity/overflow
+  // decision counters. Hit tokens flatlining while miss tokens climb
+  // means the radix cache is being evicted (arena too small) or traffic
+  // stopped sharing prefixes; overflow spiking means a hot prefix's
+  // home replica is saturated.
+  const pc=await j("/api/v1/metrics/query?series=ray_tpu_cb_prefix_*"+
+                   "&since=300&agg=avg&step=3&limit=20");
+  const blocks=await j("/api/v1/metrics/query?"+
+                   "series=ray_tpu_cb_kv_blocks_*&since=300&agg=avg"+
+                   "&step=3&limit=20");
+  const aff=await j("/api/v1/metrics/query?"+
+                   "series=ray_tpu_serve_router_affinity_total"+
+                   "&since=300&agg=avg&step=3&limit=10");
+  const rows=pc.concat(
+    blocks.filter(s=>s.name.endsWith("cached")||s.name.endsWith("shared")),
+    aff);
+  document.getElementById("prefix").innerHTML=
+    sparkRows(rows,40)||"(no prefix-cache telemetry)";
+}
 async function xlaPanel(){
   // Compile/retrace table per (node, program) from the xla series the
   // push plane lands in the TSDB, plus the registered profiler captures.
@@ -235,6 +257,7 @@ async function refresh(){
       .map(l=>`[${l.worker} ${l.pid}] ${l.line}`).join("\\n");
     await metricsPanel();
     await servePanel();
+    await prefixPanel();
     await requestLatencyPanel();
     await xlaPanel();
     document.getElementById("status").textContent=

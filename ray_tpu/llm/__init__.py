@@ -93,6 +93,7 @@ class ContinuousLlamaDeployment:
                  paged: Optional[bool] = None, block_size: int = 64,
                  kv_dtype: Optional[str] = None,
                  num_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
                  sampling=None,
                  checkpoint_path: Optional[str] = None):
         """Engine knobs (``num_slots``, ``max_len``, ``sync_every``,
@@ -125,7 +126,8 @@ class ContinuousLlamaDeployment:
             token_callback=self._on_token, sync_every=sync_every,
             use_decode_kernel=use_decode_kernel, paged=paged,
             block_size=block_size, kv_dtype=kv_dtype,
-            num_blocks=num_blocks, sampling=sampling)
+            num_blocks=num_blocks, prefix_cache=prefix_cache,
+            sampling=sampling)
         threading.Thread(target=self._tick_loop, daemon=True,
                          name="llm-ticks").start()
 
@@ -235,6 +237,7 @@ def build_continuous_llama_app(config: Optional[llama.LlamaConfig] = None,
                                block_size: int = 64,
                                kv_dtype: Optional[str] = None,
                                num_blocks: Optional[int] = None,
+                               prefix_cache: Optional[bool] = None,
                                sampling=None,
                                checkpoint_path: Optional[str] = None):
     dep = ContinuousLlamaDeployment.options(num_replicas=num_replicas)
@@ -244,7 +247,8 @@ def build_continuous_llama_app(config: Optional[llama.LlamaConfig] = None,
                     sync_every=sync_every,
                     use_decode_kernel=use_decode_kernel, paged=paged,
                     block_size=block_size, kv_dtype=kv_dtype,
-                    num_blocks=num_blocks, sampling=sampling,
+                    num_blocks=num_blocks, prefix_cache=prefix_cache,
+                    sampling=sampling,
                     checkpoint_path=checkpoint_path)
 
 

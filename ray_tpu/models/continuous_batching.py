@@ -24,6 +24,21 @@ bytes-per-token again. ``paged=False`` keeps the dense pooled cache
 (one private ``[S_max]`` stripe per slot). Sampling (temperature/top-p)
 runs in-device inside the tick jit either way; only token ids cross to
 the host.
+
+CROSS-REQUEST PREFIX CACHING (default on for paged engines,
+``prefix_cache`` / ``RAY_TPU_PREFIX_CACHE``): admission matches each
+prompt's longest block-aligned prefix against a radix index of blocks
+already resident in the arena (``paged_kv.RadixBlockIndex``), splices
+the matched blocks into the slot's table READ-ONLY (decode writes start
+at the prompt tail, and speculative overruns redirect to the garbage
+block — a shared block is never a write target), and prefills ONLY the
+suffix — prefill compute and HBM traffic scale with *novel* tokens, not
+total tokens. Released prompt blocks park in an LRU "cached" state that
+arena pressure reclaims before admission ever blocks. Greedy outputs
+are bit-identical with the prefix cache on or off (bf16 and int8
+arenas, paged kernel on or off): int8 prefill quantizes K/V IN-LOOP and
+attends the dequantized values, so a later prefix-sharer reading the
+arena back attends exactly what the original prefill attended.
 """
 
 from __future__ import annotations
@@ -39,10 +54,12 @@ import numpy as np
 
 from ray_tpu._private import xla_monitor
 from ray_tpu.models import llama
-from ray_tpu.models.inference import KVCache, _forward_cached, lm_head_logits
+from ray_tpu.models.inference import (KVCache, _attend_cached,
+                                      _forward_cached, lm_head_logits)
 from ray_tpu.models.llama import rms_norm
 from ray_tpu.models.paged_kv import (GARBAGE_BLOCK, BlockAllocator,
-                                     PagedKVCache, quantize_kv,
+                                     PagedKVCache, RadixBlockIndex,
+                                     prompt_chunks, quantize_kv,
                                      resolve_kv_dtype)
 from ray_tpu.models.sampling import SamplingParams, sample_tokens, step_key
 from ray_tpu.ops.decode_attention import (decode_applicable,
@@ -51,7 +68,7 @@ from ray_tpu.ops.decode_attention import (decode_applicable,
                                           env_flag)
 from ray_tpu.ops.paged_decode_attention import (paged_applicable,
                                                 paged_decode_attention)
-from ray_tpu.ops.rope import rope_frequencies
+from ray_tpu.ops.rope import apply_rope, rope_frequencies
 from ray_tpu.util import tracing
 
 
@@ -259,11 +276,81 @@ def _decode_tick_paged(params, tokens, positions, tables, limits,
     return next_tokens, positions + 1, new_cache, step + 1
 
 
+def _prefill_forward_paged(params, tokens, positions, pk, pv, config,
+                           quantized):
+    """Prefill forward over ``[shared prefix ++ suffix]``.
+
+    ``tokens`` [N, S] are the suffix at absolute ``positions`` [S]
+    (= P + arange(S), shared by the group — admission groups rows by
+    matched-prefix length); ``pk``/``pv`` [L, N, P, KVH, D] hold the
+    prefix K/V exactly as attention must read them (the dequantized
+    arena storage). Returns ``(logits [N, S, V], stored)`` where
+    ``stored`` is the suffix K/V in ARENA form — int8 arenas quantize
+    IN-LOOP and attention reads the dequantized values, so what a later
+    prefix-sharer gathers back from the arena is bit-identical to what
+    this prefill attended: the prefix-cache on/off parity contract.
+    With P=0 and no quantization this computes exactly what the dense
+    mini-cache prefill (:func:`~ray_tpu.models.inference._forward_cached`)
+    computed — same ops in the same order — so paged-vs-dense parity is
+    untouched."""
+    c = config
+    cos, sin = rope_frequencies(c.head_dim, tokens.shape[1], c.rope_theta,
+                                positions=positions)
+    x = params["embed"].astype(c.dtype)[tokens]
+    scale = c.head_dim ** -0.5
+
+    def layer_fn(x, inputs):
+        layer, pk_l, pv_l = inputs
+        h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(c.dtype))
+        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(c.dtype))
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(c.dtype))
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        if quantized:
+            kq, ksc = quantize_kv(k)
+            vq, vsc = quantize_kv(v)
+            k_att = (kq.astype(jnp.float32)
+                     * ksc[..., None]).astype(c.dtype)
+            v_att = (vq.astype(jnp.float32)
+                     * vsc[..., None]).astype(c.dtype)
+            stored = (kq, vq, ksc, vsc)
+        else:
+            k_att, v_att = k, v
+            stored = (k, v)
+        ck = jnp.concatenate([pk_l, k_att], axis=1)   # [N, P+S, KVH, D]
+        cv = jnp.concatenate([pv_l, v_att], axis=1)
+        o = _attend_cached(q, ck, cv, positions, scale)
+        x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(c.dtype))
+        h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+        gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(c.dtype))
+        up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
+        x = x + jnp.einsum("bsm,me->bse", jax.nn.silu(gate) * up,
+                           layer["w_down"].astype(c.dtype))
+        return x, stored
+
+    x, stored = jax.lax.scan(layer_fn, x, (params["layers"], pk, pv))
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = lm_head_logits(x, params, c)
+    return logits, stored
+
+
 def _bucket(n: int, floor: int = 16) -> int:
     b = floor
     while b < n:
         b *= 2
     return b
+
+
+def _bucket_floor(n: int) -> int:
+    """Largest power of two <= n (0 for 0). Matched-prefix block counts
+    bucket DOWN through this: compiled prefill programs specialize on
+    the prefix-table width m, so exact match lengths would compile one
+    program per distinct length seen — a retrace storm under mixed
+    system-prompt traffic. Bucketing keeps the program count
+    log-bounded; the discarded match tail simply re-prefills with the
+    suffix (bit-identical either way, just redundant compute)."""
+    return 0 if n <= 0 else 1 << (n.bit_length() - 1)
 
 
 def _resolve_paged(paged: Optional[bool]) -> bool:
@@ -274,6 +361,18 @@ def _resolve_paged(paged: Optional[bool]) -> bool:
     if paged is None:
         return True
     return bool(paged)
+
+
+def _resolve_prefix_cache(prefix_cache: Optional[bool]) -> bool:
+    """Cross-request prefix reuse toggle: explicit arg >
+    RAY_TPU_PREFIX_CACHE env > on. Only meaningful on paged engines —
+    the radix index shares arena blocks, which the dense per-slot
+    stripes cannot."""
+    if prefix_cache is None:
+        prefix_cache = env_flag("RAY_TPU_PREFIX_CACHE")
+    if prefix_cache is None:
+        return True
+    return bool(prefix_cache)
 
 
 def _resolve_decode_kernel(config: llama.LlamaConfig, max_len: int,
@@ -319,6 +418,7 @@ class ContinuousBatcher:
                  block_size: int = 64,
                  kv_dtype: Optional[str] = None,
                  num_blocks: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
                  sampling=None):
         """``token_callback(rid, token)`` fires for every generated token
         as it is produced (serving streams ride this).
@@ -356,6 +456,15 @@ class ContinuousBatcher:
         sizes the arena (default: enough for every slot at ``max_len``,
         plus the reserved garbage block).
 
+        ``prefix_cache`` (default on for paged engines;
+        ``RAY_TPU_PREFIX_CACHE`` env) enables CROSS-REQUEST PREFIX
+        REUSE: a radix index over block-aligned prompt chunks lets a
+        new request splice blocks another request already prefilled
+        into its table read-only and prefill only its novel suffix;
+        released prompt blocks park in an LRU "cached" state reclaimed
+        under arena pressure. Greedy outputs are bit-identical with the
+        cache on or off.
+
         ``sampling`` (:class:`~ray_tpu.models.sampling.SamplingParams`
         or a dict) selects in-device token sampling; the default is
         greedy argmax. Sampled decode is deterministic under a fixed
@@ -377,14 +486,21 @@ class ContinuousBatcher:
                 f"block_size must be a power of two >= 8, "
                 f"got {self.block_size}")
         self.kv_dtype = resolve_kv_dtype(kv_dtype) if self.paged else None
+        self.prefix_cache = self.paged and _resolve_prefix_cache(
+            prefix_cache)
         self.use_decode_kernel = _resolve_decode_kernel(
             config, max_len, use_decode_kernel, paged=self.paged,
             block_size=self.block_size)
         # Prefill accounting (bench_serve.py reads these; the metric
-        # counters mirror them into the TSDB).
+        # counters mirror them into the TSDB). With the prefix cache on,
+        # ``prefill_tokens`` counts only NOVEL (suffix) tokens; the
+        # hit/miss counters below split total prompt traffic.
         self.prefill_batches = 0
         self.prefill_requests = 0
         self.prefill_tokens = 0
+        self.prefix_hit_tokens = 0      # prompt tokens served from cache
+        self.prefix_miss_tokens = 0     # prompt tokens actually prefilled
+        self.prefix_hit_requests = 0    # requests with >=1 matched block
         self.prefill_seconds = 0.0          # dispatch->first-token sync
         self._prefill_shapes: set = set()   # (N_pad, L_pad) compiled
         self._buf: List[Any] = []       # unstacked device token vectors
@@ -403,9 +519,17 @@ class ContinuousBatcher:
                 config, self.num_blocks, self.block_size, self.kv_dtype)
             self.allocator = BlockAllocator(self.num_blocks)
             self._slot_blocks: Dict[int, List[int]] = {}
+            # Radix index over block-aligned prompt chunks -> resident
+            # arena blocks (None with the prefix cache off). Slots track
+            # their pinned index nodes so release can deref instead of
+            # freeing shared blocks.
+            self._prefix = RadixBlockIndex() if self.prefix_cache else None
+            self._slot_nodes: Dict[int, List[Any]] = {}
             self._d_tables = None
             self._d_limits = None
         else:
+            self._prefix = None
+            self._slot_nodes = {}
             self.cache = KVCache.create(config, num_slots, max_len)
         self._free: List[int] = list(range(num_slots))
         self._slots: Dict[int, Dict[str, Any]] = {}   # slot -> request
@@ -458,39 +582,73 @@ class ContinuousBatcher:
         # signature.
         prefill_dims = (max_len, num_slots)
         if self.paged:
-            prefill_dims += (self.max_blocks,
-                             self.max_blocks * self.block_size)
+            # Prefix-aware suffix groups add legitimate non-pow2 dims:
+            # suffix buckets clamped to the table capacity left after a
+            # matched prefix. Matched-block counts themselves bucket to
+            # powers of two in admission (_bucket_floor) — already
+            # silent under the bucketed policy — so the clamp takes
+            # only log-many values, and this whitelist ENFORCES that
+            # bound: an exact-m regression would raise
+            # ray_tpu_xla_retraces_total.
+            ms = {0}
+            m = 1
+            while m <= self.max_blocks:
+                ms.add(m)
+                m *= 2
+            prefill_dims += (0,)
+            prefill_dims += tuple(self.block_size * (self.max_blocks - v)
+                                  for v in sorted(ms))
 
         if self.paged:
             @xla_monitor.instrument(name="cb_prefill",
                                     shape_policy="bucketed",
                                     allowed_dims=prefill_dims,
                                     donate_argnums=(2,))
-            def prefill(params, tokens, cache, tables_w, last_idx, pstep):
-                # BATCHED BUCKETED PREFILL, paged: tokens [N, L] holds N
-                # same-bucket prompts; ``tables_w`` [N, L // bs] names
-                # the arena block each L-padded prompt block lands in
-                # (overflow entries point at the garbage block). The
-                # prompt attends only itself, so it runs over a fresh
-                # dense mini-cache and the resulting K/V are written —
-                # quantized when the arena is int8 — straight into the
-                # donated arena. Only N first tokens leave the device.
-                positions = jnp.arange(tokens.shape[1])
-                n, lp = tokens.shape
-                mini = KVCache.create(cfg, n, lp)
-                logits, mini = _forward_cached(params, tokens, positions,
-                                               mini, cfg)
-                npb = lp // block_size_c
+            def prefill(params, tokens, cache, ptables, tables_w,
+                        last_idx, pstep):
+                # BATCHED BUCKETED PREFILL, paged + prefix-aware: tokens
+                # [N, S] holds N same-group SUFFIXES (prompt tokens not
+                # covered by matched prefix blocks; the whole prompt
+                # when nothing matched); ``ptables`` [N, m] names the
+                # shared arena blocks holding each row's m-block prefix
+                # (READ-ONLY — gathered, dequantized when int8, never
+                # written); ``tables_w`` [N, S // bs] names the blocks
+                # the suffix K/V land in (overflow entries point at the
+                # garbage block). Only N first tokens leave the device.
+                n, s_pad = tokens.shape
+                m = ptables.shape[1]
+                positions = m * block_size_c + jnp.arange(s_pad)
+                flat_p = ptables.reshape(-1)                 # [N * m]
+                pk = cache.k[:, flat_p]
+                pv = cache.v[:, flat_p]
+                if cache.quantized:
+                    pk = (pk.astype(jnp.float32)
+                          * cache.k_scale[:, flat_p][..., None]
+                          ).astype(cfg.dtype)
+                    pv = (pv.astype(jnp.float32)
+                          * cache.v_scale[:, flat_p][..., None]
+                          ).astype(cfg.dtype)
+
+                def to_ctx(a):
+                    # [Lyr, N*m, bs, ...] -> [Lyr, N, m*bs, ...]
+                    return a.reshape(a.shape[0], n, m * block_size_c,
+                                     *a.shape[3:])
+
+                logits, stored = _prefill_forward_paged(
+                    params, tokens, positions,
+                    to_ctx(pk.astype(cfg.dtype)),
+                    to_ctx(pv.astype(cfg.dtype)),
+                    cfg, cache.quantized)
+                npb = s_pad // block_size_c
                 flat_tables = tables_w.reshape(-1)           # [N * npb]
 
                 def to_blocks(a):
-                    # [Lyr, N, L, ...] -> [Lyr, N*npb, bs, ...]
+                    # [Lyr, N, S, ...] -> [Lyr, N*npb, bs, ...]
                     return a.reshape(a.shape[0], n * npb, block_size_c,
                                      *a.shape[3:])
 
                 if cache.quantized:
-                    kq, ksc = quantize_kv(mini.k)
-                    vq, vsc = quantize_kv(mini.v)
+                    kq, vq, ksc, vsc = stored
                     new_cache = PagedKVCache(
                         k=cache.k.at[:, flat_tables].set(to_blocks(kq)),
                         v=cache.v.at[:, flat_tables].set(to_blocks(vq)),
@@ -499,12 +657,13 @@ class ContinuousBatcher:
                         v_scale=cache.v_scale.at[:, flat_tables].set(
                             to_blocks(vsc)))
                 else:
+                    k_s, v_s = stored
                     dt = cache.k.dtype
                     new_cache = PagedKVCache(
                         k=cache.k.at[:, flat_tables].set(
-                            to_blocks(mini.k.astype(dt))),
+                            to_blocks(k_s.astype(dt))),
                         v=cache.v.at[:, flat_tables].set(
-                            to_blocks(mini.v.astype(dt))))
+                            to_blocks(v_s.astype(dt))))
                 last = jnp.take_along_axis(
                     logits, last_idx[:, None, None], axis=1)  # [N, 1, V]
                 first = _next_tokens(last, pstep, sampling_cfg,
@@ -639,6 +798,8 @@ class ContinuousBatcher:
             "arena_wait_s": rec.get("arena_wait_s"),
             "prefill_s": rec.get("prefill_s"),
             "ttft_s": rec.get("ttft_s"), "tpot_s": tpot,
+            "prefix_tokens": rec.get("prefix_tokens", 0),
+            "prompt_tokens": rec.get("prompt_len", 0),
             "trace_id": trace.get("trace_id"),
             "request_id": trace.get("request_id")})
         if not rec["traced"]:
@@ -668,11 +829,17 @@ class ContinuousBatcher:
         depth, slot occupancy, free KV arena blocks, and the prefill
         token backlog still waiting for admission."""
         free_blocks = self.allocator.free_count if self.paged else 0
+        cached = (self._prefix.cached_count
+                  if self.paged and self._prefix is not None else 0)
         return {
             "queue_depth": len(self._waiting),
             "active_slots": len(self._slots),
             "num_slots": self.num_slots,
             "kv_blocks_free": free_blocks,
+            # Reclaimable-on-demand prefix blocks: admission-available
+            # capacity is free + cached, which the router/shedding
+            # thresholds should use instead of raw free.
+            "kv_blocks_cached": cached,
             "kv_blocks_total": (self.num_blocks - 1 if self.paged else 0),
             "inflight_prefill_tokens": sum(
                 len(r["prompt"]) for r in self._waiting),
@@ -725,6 +892,15 @@ class ContinuousBatcher:
         self._free.append(slot)
         if self.paged:
             blocks = self._slot_blocks.pop(slot, None)
+            nodes = self._slot_nodes.pop(slot, None)
+            if nodes:
+                # Indexed (shared/shareable) blocks: deref — refcount 0
+                # parks them in the LRU "cached" state instead of the
+                # free list, so a later prefix match revives them and
+                # arena pressure reclaims them before admission blocks.
+                self._prefix.release(nodes)
+                shared = {nd.block for nd in nodes}
+                blocks = [b for b in (blocks or []) if b not in shared]
             if blocks:
                 self.allocator.free(blocks)
 
@@ -774,6 +950,11 @@ class ContinuousBatcher:
                 self.kv_dtype)
             self.allocator.reset()
             self._slot_blocks.clear()
+            self._slot_nodes.clear()
+            if self._prefix is not None:
+                # The rebuilt arena holds zeros: every cached prefix
+                # entry would alias garbage, so the index restarts cold.
+                self._prefix.clear()
         else:
             self.cache = KVCache.create(self.config, self.num_slots,
                                         self.max_len)
@@ -787,24 +968,42 @@ class ContinuousBatcher:
     def active_count(self) -> int:
         return len(self._slots)
 
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Fraction of admitted prompt tokens served from cached prefix
+        blocks (0.0 with the prefix cache off or before any admission)."""
+        total = self.prefix_hit_tokens + self.prefix_miss_tokens
+        return self.prefix_hit_tokens / total if total else 0.0
+
     def has_work(self) -> bool:
         return bool(self._slots or self._waiting or self._finished
                     or self._buf or self._pending)
 
     # ------------------------------------------------------------ paged kv
     def kv_block_stats(self) -> Dict[str, float]:
-        """Arena occupancy: blocks used/total, live tokens, and the
+        """Arena occupancy: live blocks used/total, LRU-cached and
+        refcount-shared prefix blocks, live tokens, and the
         fragmentation ratio (reserved-but-unwritten fraction of used
         blocks). Dense engines report zeros."""
         if not self.paged:
-            return {"used": 0, "total": 0, "live_tokens": 0,
-                    "frag_ratio": 0.0}
-        used = self.allocator.used_count
+            return {"used": 0, "total": 0, "cached": 0, "shared": 0,
+                    "live_tokens": 0, "frag_ratio": 0.0}
+        cached = self._prefix.cached_count if self._prefix is not None \
+            else 0
+        shared = self._prefix.shared_count if self._prefix is not None \
+            else 0
+        # Parked (cached) blocks are still on the allocator's books —
+        # they hold revivable prefix K/V — but they are not LIVE demand.
+        used = self.allocator.used_count - cached
         live = sum(st["pos"] for st in self._slots.values())
         cap = used * self.block_size
+        # Prefix sharing lets per-slot live tokens exceed the distinct
+        # block capacity (two slots counting one shared prefix), so the
+        # fragmentation ratio clamps at 0.
         return {"used": used, "total": self.num_blocks - 1,
+                "cached": cached, "shared": shared,
                 "live_tokens": live,
-                "frag_ratio": (1.0 - live / cap) if cap else 0.0}
+                "frag_ratio": max(1.0 - live / cap, 0.0) if cap else 0.0}
 
     def tick_bytes_estimate(self) -> int:
         """HBM bytes one decode tick actually streams: the full parameter
@@ -833,17 +1032,64 @@ class ContinuousBatcher:
 
     def _can_admit_head(self) -> bool:
         """True when the FIFO head could admit RIGHT NOW (free slot and,
-        when paged, enough free arena blocks). The buffered engine uses
-        this to decide whether forcing a sync boundary is worth it — an
-        arena-blocked head must not collapse speculative pipelining to
-        one tick per sync while it waits for blocks."""
+        when paged, enough free arena blocks — counting LRU-cached
+        blocks the allocator can reclaim and prefix blocks a radix
+        match would cover). The buffered engine uses this to decide
+        whether forcing a sync boundary is worth it — an arena-blocked
+        head must not collapse speculative pipelining to one tick per
+        sync while it waits for blocks."""
         if not (self._waiting and self._free):
             return False
         if not self.paged:
             return True
         req = self._waiting[0]
-        return (self._blocks_needed(len(req["prompt"]), req["max_new"])
-                <= self.allocator.free_count)
+        need = self._blocks_needed(len(req["prompt"]), req["max_new"])
+        avail = self.allocator.free_count
+        if self._prefix is not None:
+            nodes = self._prefix.match_nodes(
+                self._req_chunks(req)[:self._match_cap(req)])
+            m = _bucket_floor(len(nodes))   # admission buckets the same
+            need -= m
+            # A parked matched block must not count twice: the match
+            # will revive it from the LRU (covering part of ``need``)
+            # WITHOUT freeing anything, so it is no longer evictable
+            # for the novel blocks — an optimistic probe here makes the
+            # buffered engine force sync boundaries for an admission
+            # that then fails, exactly the pipelining collapse this
+            # probe exists to avoid.
+            parked = sum(1 for nd in nodes[:m] if nd.refs == 0)
+            avail += self._prefix.cached_count - parked
+        return need <= avail
+
+    def _match_cap(self, req: Dict[str, Any]) -> int:
+        """Blocks a prefix MATCH may cover: full prompt blocks, capped
+        so at least one prompt token remains to prefill (the first
+        generated token samples from the last prompt position's logits,
+        which the KV cache does not store)."""
+        return (len(req["prompt"]) - 1) // self.block_size
+
+    def _req_chunks(self, req: Dict[str, Any]) -> List[tuple]:
+        """Block-aligned chunk keys for a queued request, memoized on
+        the request: the buffered engine's per-tick admission probe and
+        the eventual admission itself would otherwise re-tuple the
+        whole prompt each time a request waits on the arena."""
+        chunks = req.get("chunks")
+        if chunks is None:
+            chunks = req["chunks"] = prompt_chunks(req["prompt"],
+                                                   self.block_size)
+        return chunks
+
+    def _alloc_blocks(self, n: int) -> Optional[List[int]]:
+        """All-or-nothing reservation with LRU reclaim: when the free
+        list can't cover ``n``, refcount-0 cached prefix blocks are
+        evicted (leaf-first, oldest-first) before the request is left
+        blocking on the arena — cached state never wins over
+        admission. Live (refcounted) shared blocks are untouchable."""
+        if self._prefix is not None and n > self.allocator.free_count:
+            evicted = self._prefix.evict(n - self.allocator.free_count)
+            if evicted:
+                self.allocator.free(evicted)
+        return self.allocator.alloc(n)
 
     def _table_row(self, blocks: List[int]) -> List[int]:
         # Dead tail entries REPEAT the last live block: pallas skips the
@@ -858,67 +1104,110 @@ class ContinuousBatcher:
             return
         from ray_tpu._private import metrics_defs as mdefs
 
-        # Drain every admissible request FIRST, grouped by power-of-two
-        # bucket (compile reuse, never beyond the cache length), so an
-        # admission burst costs one prefill dispatch per bucket instead
-        # of one per request. Slots are independent, so batched admission
-        # is bit-identical to the old one-at-a-time loop. Paged engines
-        # also reserve each request's blocks all-or-nothing (FIFO: when
-        # the head of the queue doesn't fit the arena, admission stops).
+        # Drain every admissible request FIRST, grouped by (pow-2 suffix
+        # bucket, matched-prefix blocks) — compile reuse, never beyond
+        # the cache length — so an admission burst costs one prefill
+        # dispatch per group instead of one per request. Slots are
+        # independent, so batched admission is bit-identical to the old
+        # one-at-a-time loop. Paged engines reserve each request's NOVEL
+        # blocks all-or-nothing (FIFO: when the head of the queue
+        # doesn't fit the arena even after LRU reclaim, admission
+        # stops); matched prefix blocks are pinned read-only instead of
+        # allocated, so prefill cost and arena demand both scale with
+        # novel tokens.
         bs = self.block_size
         padded_cap = (self.max_blocks * bs if self.paged else self.max_len)
-        groups: Dict[int, List] = {}
+        groups: Dict[tuple, List] = {}
         while self._waiting and self._free:
             req = self._waiting[0]
             blocks: List[int] = []
-            padded_len = min(_bucket(len(req["prompt"])), padded_cap)
+            matched: List[Any] = []
+            chunks: List[tuple] = []
+            m = 0
+            suffix = req["prompt"]
             meta = self._req_meta.get(req["rid"])
             if self.paged:
+                if self._prefix is not None:
+                    chunks = self._req_chunks(req)
+                    matched = self._prefix.match(
+                        chunks[:self._match_cap(req)])
+                    # Bucket the match DOWN to a power of two so the
+                    # compiled prefill program count stays log-bounded
+                    # in m (see _bucket_floor); the released tail
+                    # parks young in the LRU, still resident for the
+                    # next matcher and evictable by _alloc_blocks.
+                    m = _bucket_floor(len(matched))
+                    if m < len(matched):
+                        self._prefix.release(matched[m:])
+                        matched = matched[:m]
                 need = self._blocks_needed(len(req["prompt"]),
-                                           req["max_new"])
-                got = self.allocator.alloc(need)
+                                           req["max_new"]) - m
+                got = self._alloc_blocks(need)
                 if got is None:
                     # Head blocked on arena space with a slot free: from
                     # here until admission the wait is ARENA wait, not
                     # queue wait — the TTFT decomposition splits there.
+                    if matched:
+                        self._prefix.release(matched)
                     if meta is not None and "arena_blocked" not in meta:
                         meta["arena_blocked"] = time.time()
                     break
-                blocks = got
+                blocks = [nd.block for nd in matched] + got
+                suffix = req["prompt"][m * bs:]
+                padded_len = min(_bucket(len(suffix)),
+                                 padded_cap - m * bs)
                 padded_len = max(padded_len, bs)  # at least one block
+                if self._prefix is not None:
+                    self.prefix_hit_tokens += m * bs
+                    self.prefix_miss_tokens += len(suffix)
+                    if m:
+                        self.prefix_hit_requests += 1
+                        mdefs.CB_PREFIX_HIT_TOKENS.inc(m * bs,
+                                                       tags=self._mtags)
+                    mdefs.CB_PREFIX_MISS_TOKENS.inc(len(suffix),
+                                                    tags=self._mtags)
+            else:
+                padded_len = min(_bucket(len(req["prompt"])), padded_cap)
             self._waiting.popleft()
             if meta is not None:
                 meta["admit"] = time.time()
                 meta["blocks"] = len(blocks)
+                meta["prefix_tokens"] = m * bs
             slot = self._free.pop()
             if self.paged:
                 self._slot_blocks[slot] = blocks
-            groups.setdefault(padded_len, []).append((req, slot, blocks))
-        for padded_len, group in groups.items():
+            groups.setdefault((padded_len, m), []).append(
+                (req, slot, blocks, matched, suffix, chunks))
+        for (padded_len, m), group in groups.items():
             n = len(group)
             # The batch dim buckets to a power of two as well, so the
             # compiled prefill program count stays log(N) x log(L).
             # Padding rows REPEAT the last request: a duplicate slot
             # index in the scatter writes byte-identical KV twice, which
             # is well-defined; the duplicate's first token is dropped.
+            # (Duplicated prefix gathers are reads — trivially safe.)
             n_pad = min(_bucket(n, floor=1), self.num_slots)
             tokens = np.zeros((n_pad, padded_len), np.int32)
             slots = np.zeros(n_pad, np.int32)
             last_idx = np.zeros(n_pad, np.int32)
             npb_w = padded_len // bs if self.paged else 0
             tables_w = np.full((n_pad, npb_w), GARBAGE_BLOCK, np.int32)
+            ptables = np.full((n_pad, m), GARBAGE_BLOCK, np.int32)
             for i in range(n_pad):
-                req, slot, blocks = group[min(i, n - 1)]
-                prompt = req["prompt"]
-                tokens[i, :len(prompt)] = prompt
+                req, slot, blocks, matched, suffix, chunks = \
+                    group[min(i, n - 1)]
+                tokens[i, :len(suffix)] = suffix
                 slots[i] = slot
-                last_idx[i] = len(prompt) - 1
+                last_idx[i] = len(suffix) - 1
                 if self.paged:
-                    # Prompt blocks land in the slot's reserved blocks;
-                    # bucket-padding overflow (padded_len can exceed the
-                    # reservation) writes masked garbage to block 0.
-                    k = min(len(blocks), npb_w)
-                    tables_w[i, :k] = blocks[:k]
+                    # Suffix K/V land in the slot's NEW blocks (the
+                    # matched prefix is read-only); bucket-padding
+                    # overflow past the reservation writes masked
+                    # garbage to block 0.
+                    new_blocks = blocks[m:]
+                    k = min(len(new_blocks), npb_w)
+                    tables_w[i, :k] = new_blocks[:k]
+                    ptables[i, :m] = blocks[:m]
             t0 = time.perf_counter()
             pt0 = time.time()  # wall-clock anchor for the prefill span
             pstep = jnp.int32(self._prefill_count)
@@ -926,7 +1215,8 @@ class ContinuousBatcher:
             if self.paged:
                 first, self.cache = self._prefill(
                     self.params, jnp.asarray(tokens), self.cache,
-                    jnp.asarray(tables_w), jnp.asarray(last_idx), pstep)
+                    jnp.asarray(ptables), jnp.asarray(tables_w),
+                    jnp.asarray(last_idx), pstep)
             else:
                 first, self.cache = self._prefill(
                     self.params, jnp.asarray(tokens), self.cache,
@@ -948,11 +1238,24 @@ class ContinuousBatcher:
             mdefs.CB_PREFILL_REQUESTS.inc(n, tags=self._mtags)
             mdefs.CB_PREFILL_TOKENS.inc(true_tokens, tags=self._mtags)
             first_ts = time.time()  # the fetch above synced the device
-            for (req, slot, _blocks), tok in zip(group, first):
+            for (req, slot, blocks, matched, _sfx, chunks), tok in \
+                    zip(group, first):
                 tok = int(tok)
                 meta = self._req_meta.get(req["rid"])
                 if meta is not None:
                     self._note_first_token(meta, pt0, first_ts)
+                if self._prefix is not None and chunks:
+                    # Index this prompt's full blocks now that the
+                    # dispatch above ordered their arena writes (the
+                    # donated-cache dependency chain sequences any later
+                    # prefill's gather after them). A chunk already
+                    # indexed under another block — a cold twin admitted
+                    # this same round — stops the walk and leaves the
+                    # remaining blocks exclusive.
+                    created = self._prefix.insert(chunks, blocks,
+                                                  start=len(matched))
+                    if matched or created:
+                        self._slot_nodes[slot] = matched + created
                 if self.token_callback is not None:
                     self.token_callback(req["rid"], tok)
                 self._slots[slot] = {
@@ -1091,6 +1394,11 @@ class ContinuousBatcher:
             mdefs.CB_KV_BLOCKS_USED.set(kv["used"], tags=self._mtags)
             mdefs.CB_KV_BLOCKS_TOTAL.set(kv["total"], tags=self._mtags)
             mdefs.CB_KV_FRAG_RATIO.set(kv["frag_ratio"], tags=self._mtags)
+            if self._prefix is not None:
+                mdefs.CB_KV_BLOCKS_CACHED.set(kv["cached"],
+                                              tags=self._mtags)
+                mdefs.CB_KV_BLOCKS_SHARED.set(kv["shared"],
+                                              tags=self._mtags)
 
     def step(self) -> Dict[int, List[int]]:
         """Admit waiting requests, run one decode tick over all active

@@ -18,12 +18,29 @@ scale state that travels with its block through the same table
 indirection (``RAY_TPU_KV_DTYPE=int8`` or the engine's ``kv_dtype``
 knob). Block 0 is a reserved GARBAGE block: freed slots' masked lanes
 keep scattering somewhere harmless without branching in the tick.
+
+CROSS-REQUEST PREFIX REUSE (ROADMAP item 2, SGLang RadixAttention /
+vLLM automatic-prefix-caching analog): :class:`RadixBlockIndex` maps
+block-aligned token-id chunks to the arena blocks already holding their
+K/V, so a chat fleet's shared system prompts prefill once per replica
+and every later request splices the cached blocks into its table
+read-only. A block is then in one of three states:
+
+* **free** — on the :class:`BlockAllocator` free list;
+* **live** — referenced by ≥1 slot; indexed blocks carry a per-node
+  refcount (two requests sharing a system prompt both pin its blocks)
+  and are NEVER reclaimed while any reference is live;
+* **cached** — refcount dropped to 0 on slot release, but the block is
+  parked in the index's LRU instead of freed: a later prefix match
+  revives it for free, and arena pressure reclaims it (leaf-first,
+  oldest-first) before admission ever blocks on the arena.
 """
 
 from __future__ import annotations
 
 import os
-from typing import List, NamedTuple, Optional
+from collections import OrderedDict, deque
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 
@@ -149,3 +166,194 @@ class BlockAllocator:
     def reset(self) -> None:
         self._free = list(range(self.num_blocks - 1, 0, -1))
         self._allocated.clear()
+
+
+class _RadixNode:
+    """One block-aligned chunk in the prefix tree. ``refs`` counts the
+    slots currently reading this block through their tables; 0 parks the
+    node in the index LRU (block content stays valid in the arena)."""
+
+    __slots__ = ("chunk", "block", "parent", "children", "refs")
+
+    def __init__(self, chunk: Optional[Tuple[int, ...]], block: int,
+                 parent: Optional["_RadixNode"]):
+        self.chunk = chunk
+        self.block = block
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.refs = 0
+
+
+class RadixBlockIndex:
+    """Radix index over block-aligned token-id chunks → arena block ids.
+
+    Keys are EXACT token tuples (dict equality, no lossy hashing — a
+    hash collision would silently serve another prompt's K/V), chained
+    parent→child so chunk ``i``'s node is reachable only through the
+    full token prefix ``[0, (i+1)·bs)`` that determines its K/V content
+    (causal attention: position ``p`` depends on tokens ``[0..p]``).
+
+    Refcount/eviction rules (the engine's shared-block contract):
+
+    * :meth:`match` pins every matched node (``refs += 1``; revived out
+      of the LRU) — matched blocks are spliced into a slot's table
+      READ-ONLY and must never be reclaimed or written while pinned;
+    * :meth:`insert` indexes a slot's newly-prefilled full-prompt blocks
+      (pinned, refs=1); a chunk already indexed under a different block
+      — two cold twins racing one admission round — stops the walk and
+      leaves the loser's remaining blocks exclusive (freed on release);
+    * :meth:`release` unpins; refs==0 parks the node at the LRU's young
+      end instead of freeing its block;
+    * :meth:`evict` reclaims parked blocks LEAF-FIRST in LRU order, so
+      a popular prefix's root chunks outlive its cold tails. Every
+      slot pins a contiguous root-chain, so a parked node can never
+      have a pinned descendant — leaf-first eviction never strands a
+      live reader.
+    """
+
+    def __init__(self):
+        self._root = _RadixNode(None, GARBAGE_BLOCK, None)
+        self._lru: "OrderedDict[_RadixNode, None]" = OrderedDict()
+        self._live = 0          # nodes with refs >= 1
+        self._by_block: Dict[int, _RadixNode] = {}
+
+    # ------------------------------------------------------------ stats
+    @property
+    def cached_count(self) -> int:
+        """Parked refcount-0 blocks the arena can reclaim."""
+        return len(self._lru)
+
+    @property
+    def shared_count(self) -> int:
+        """Indexed blocks currently pinned by at least one slot."""
+        return self._live
+
+    @property
+    def indexed_count(self) -> int:
+        return len(self._by_block)
+
+    # ------------------------------------------------------------- read
+    def match_nodes(self,
+                    chunks: Sequence[Tuple[int, ...]]) -> List[_RadixNode]:
+        """Longest indexed prefix, read-only (NO pinning): the
+        admission-feasibility probe inspects the nodes' refcounts — a
+        parked (refs==0) matched block covers part of the request's
+        need, but pinning it revives it from the LRU without freeing
+        anything, so the probe must not also count it as evictable."""
+        node, out = self._root, []
+        for chunk in chunks:
+            node = node.children.get(chunk)
+            if node is None:
+                break
+            out.append(node)
+        return out
+
+    def match_len(self, chunks: Sequence[Tuple[int, ...]]) -> int:
+        """Longest indexed prefix, in blocks — read-only (no pinning)."""
+        return len(self.match_nodes(chunks))
+
+    # ------------------------------------------------------------ write
+    def match(self, chunks: Sequence[Tuple[int, ...]]) -> List[_RadixNode]:
+        """Longest indexed prefix, PINNED: each matched node's refcount
+        rises (reviving it from the LRU), so the caller may splice the
+        blocks into a live table. Pair with :meth:`release`."""
+        node, out = self._root, []
+        for chunk in chunks:
+            node = node.children.get(chunk)
+            if node is None:
+                break
+            self._pin(node)
+            out.append(node)
+        return out
+
+    def insert(self, chunks: Sequence[Tuple[int, ...]],
+               blocks: Sequence[int], start: int = 0) -> List[_RadixNode]:
+        """Index ``blocks[start:]`` under ``chunks[start:]`` (the chunks
+        before ``start`` were matched — their nodes already exist and are
+        pinned by this caller). Returns the nodes CREATED (pinned,
+        refs=1). A chunk already indexed under a *different* block stops
+        the walk: the caller's remaining blocks stay exclusive."""
+        node = self._root
+        for chunk in chunks[:start]:
+            node = node.children[chunk]   # matched path must exist
+        created: List[_RadixNode] = []
+        for i in range(start, len(chunks)):
+            child = node.children.get(chunks[i])
+            if child is not None:
+                if child.block != blocks[i]:
+                    break                 # cold twin lost the race
+                node = child
+                continue
+            child = _RadixNode(chunks[i], blocks[i], node)
+            node.children[chunks[i]] = child
+            self._by_block[blocks[i]] = child
+            self._pin(child)
+            created.append(child)
+            node = child
+        return created
+
+    def release(self, nodes: Sequence[_RadixNode]) -> None:
+        """Unpin (slot released its table): refcount 0 parks the node at
+        the LRU young end — the block stays resident until reclaimed."""
+        for node in nodes:
+            node.refs -= 1
+            assert node.refs >= 0, "prefix node over-released"
+            if node.refs == 0:
+                self._live -= 1
+                self._lru[node] = None
+
+    def evict(self, want: int) -> List[int]:
+        """Reclaim up to ``want`` parked blocks, leaf-first in LRU order;
+        returns their ids (the caller hands them back to the
+        allocator's free list). Pinned nodes are untouchable — a parked
+        node never has pinned descendants (contiguous root-chain pins),
+        so every parked block is reachable leaf-first. A parent joins
+        the candidate queue the moment its last child drops, keeping a
+        deep parked chain O(evicted) instead of one full LRU rescan per
+        tree level (this runs synchronously on the admission path)."""
+        out: List[int] = []
+        ready = deque(nd for nd in self._lru if not nd.children)
+        while len(out) < want and ready:
+            node = ready.popleft()
+            if node.children or node not in self._lru:
+                continue                  # defensive: invariant violated
+            parent = node.parent
+            self._drop(node)
+            out.append(node.block)
+            if (parent is not None and not parent.children
+                    and parent in self._lru):
+                ready.append(parent)
+        return out
+
+    def clear(self) -> None:
+        self._root = _RadixNode(None, GARBAGE_BLOCK, None)
+        self._lru.clear()
+        self._by_block.clear()
+        self._live = 0
+
+    # ---------------------------------------------------------- helpers
+    def _pin(self, node: _RadixNode) -> None:
+        if node.refs == 0:
+            self._live += 1
+            self._lru.pop(node, None)
+        node.refs += 1
+
+    def _drop(self, node: _RadixNode) -> None:
+        self._lru.pop(node, None)
+        self._by_block.pop(node.block, None)
+        if node.parent is not None:
+            node.parent.children.pop(node.chunk, None)
+
+
+def prompt_chunks(prompt_tokens: Sequence[int],
+                  block_size: int) -> List[Tuple[int, ...]]:
+    """Block-aligned chunk keys for the SHAREABLE region of a prompt:
+    only blocks filled entirely by prompt tokens are deterministic
+    across requests (the tail block mixes prompt and generated tokens),
+    and a matcher must leave ≥1 prompt token to prefill — the first
+    token is sampled from the last prompt position's logits, which the
+    KV cache does not store — so matching is additionally capped at
+    ``(len(prompt) - 1) // block_size`` by the engine."""
+    n = len(prompt_tokens) // block_size
+    return [tuple(prompt_tokens[i * block_size:(i + 1) * block_size])
+            for i in range(n)]

@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import logging
 import math
+import os
 import random
 import threading
 import time
@@ -792,6 +793,78 @@ class _RouterState:
         # (other callers' traffic); local inflight rides on top.
         self.shared_loads: List[int] = []
         self.loads_ts = 0.0
+        # Controller-published per-replica PRESSURE snapshots (engine
+        # queue depth, KV blocks free/cached, in-flight prefill tokens),
+        # TTL-cached per router: the prefix-affinity policy and the
+        # ingress admission gate read the cached copy instead of paying
+        # the controller's poll per request.
+        self.shared_pressure: List[Dict[str, Any]] = []
+        self.pressure_ts = 0.0
+
+
+def _affinity_candidates(prefix_key: str, n: int) -> List[int]:
+    """Rendezvous (highest-random-weight) hashing of a prefix
+    fingerprint over the replica set: a stable per-key preference order
+    that barely reshuffles when the replica count changes. The top TWO
+    candidates are the key's home and spill replicas — a hot prefix
+    concentrates on at most two KV caches instead of melting one.
+    blake2b, not crc32: CRC is affine, so keys differing in a suffix
+    byte order the replicas identically and every home collapses onto
+    one replica."""
+    import hashlib
+
+    def weight(i: int) -> bytes:
+        return hashlib.blake2b(f"{prefix_key}:{i}".encode(),
+                               digest_size=8).digest()
+
+    order = sorted(range(n), key=weight, reverse=True)
+    return order[:2] if n >= 2 else order
+
+
+def _pressure_cost(snap: Optional[Dict[str, Any]], local_inflight: int,
+                   hot: float) -> float:
+    """Congestion score for one replica: router in-flight + engine queue
+    depth, plus a hot-sized penalty when the paged-KV arena has nothing
+    left to admit with (free or reclaimable) — an arena-starved replica
+    is as bad as a deep queue even when its router counters look calm.
+    Unreachable/missing snapshots fall back to the local view only."""
+    cost = float(local_inflight)
+    if not snap or snap.get("unreachable"):
+        return cost
+    cost += float(snap.get("queue_depth") or 0)
+    cost += float(snap.get("ongoing") or 0)
+    total = snap.get("kv_blocks_total") or 0
+    if total:
+        avail = ((snap.get("kv_blocks_free") or 0)
+                 + (snap.get("kv_blocks_cached") or 0))
+        if avail <= 0:
+            cost += hot
+    return cost
+
+
+def _affinity_pick(prefix_key: str, n: int,
+                   pressure: List[Dict[str, Any]],
+                   inflight: Dict[int, int],
+                   hot: Optional[float] = None) -> tuple:
+    """Choose a replica for a prefix-keyed request: stay on the key's
+    rendezvous home while it is healthy (below the ``hot`` congestion
+    threshold, or no worse than the spill candidate), else spill to the
+    second rendezvous choice. Returns ``(index, decision)`` with
+    decision in {"affinity", "overflow"}."""
+    if hot is None:
+        hot = float(os.environ.get("RAY_TPU_AFFINITY_HOT_COST", "8"))
+    cands = _affinity_candidates(prefix_key, n)
+    if len(cands) == 1:
+        return cands[0], "affinity"
+    c0, c1 = cands
+
+    def cost(i):
+        return _pressure_cost(pressure[i] if i < len(pressure) else None,
+                              inflight.get(i, 0), hot)
+
+    if cost(c0) < hot or cost(c0) <= cost(c1):
+        return c0, "affinity"
+    return c1, "overflow"
 
 
 class DeploymentHandle:
@@ -804,11 +877,17 @@ class DeploymentHandle:
     def __init__(self, deployment_name: str, method_name: Optional[str] = None,
                  _router: Optional["_RouterState"] = None,
                  _stream: bool = False, _model_id: str = "",
-                 _request_ctx: Optional[Dict[str, Any]] = None):
+                 _request_ctx: Optional[Dict[str, Any]] = None,
+                 _prefix_key: str = ""):
         self._name = deployment_name
         self._method = method_name
         self._stream = _stream
         self._model_id = _model_id
+        # Prefix fingerprint (hash of the first block-aligned prompt
+        # chunks, minted at the ingress): routes the call to the replica
+        # most likely to hold the prefix in its radix KV cache, tempered
+        # by replica pressure. "" = no affinity (pow-2 balancing).
+        self._prefix_key = _prefix_key
         # Per-call request context (request id + trace linkage, minted
         # at the ingress): ships to the replica so engine lifecycle
         # spans connect to the caller's trace. None = mint on demand
@@ -830,8 +909,8 @@ class DeploymentHandle:
     def options(self, method_name: Optional[str] = None, *,
                 stream: Optional[bool] = None,
                 multiplexed_model_id: Optional[str] = None,
-                request_context: Optional[Dict[str, Any]] = None
-                ) -> "DeploymentHandle":
+                request_context: Optional[Dict[str, Any]] = None,
+                prefix_key: Optional[str] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self._name,
             method_name if method_name is not None else self._method,
@@ -840,7 +919,9 @@ class DeploymentHandle:
             _model_id=(self._model_id if multiplexed_model_id is None
                        else multiplexed_model_id),
             _request_ctx=(self._request_ctx if request_context is None
-                          else request_context))
+                          else request_context),
+            _prefix_key=(self._prefix_key if prefix_key is None
+                         else prefix_key))
 
     @property
     def _replicas(self):
@@ -897,11 +978,19 @@ class DeploymentHandle:
             st.inflight = {}
             st.dirty = not st.replicas
 
-    def _choose(self, model_id: str = ""):
+    def _choose(self, model_id: str = "", prefix_key: str = ""):
         """Power-of-two-choices over in-flight counts; multiplexed calls
         instead hash the model id over the replica set so one model's
         requests keep hitting the replica whose LRU already holds it
-        (reference: model-locality routing in serve/_private/multiplex)."""
+        (reference: model-locality routing in serve/_private/multiplex).
+        Prefix-keyed calls route by rendezvous-hashed PREFIX AFFINITY
+        tempered by replica pressure: the request lands on the replica
+        most likely to hold its prompt prefix in the radix KV cache,
+        unless that replica is congested — then it spills to the key's
+        second rendezvous choice so a hot prefix cannot melt one
+        replica."""
+        from ray_tpu._private import metrics_defs as mdefs
+
         self._refresh()
         if not self._replicas:
             # A fresh deployment may still be starting replicas.
@@ -912,8 +1001,12 @@ class DeploymentHandle:
         if not self._replicas:
             raise RuntimeError(f"deployment {self._name!r} has no replicas")
         shared: List[int] = []
+        pressure: List[Dict[str, Any]] = []
         if not model_id and len(self._replicas) > 1:
-            shared = self._fetch_shared_loads()
+            if prefix_key:
+                pressure = self._fetch_shared_pressure()
+            else:
+                shared = self._fetch_shared_loads()
         with self._lock:
             if model_id:
                 import zlib
@@ -921,6 +1014,12 @@ class DeploymentHandle:
                 idx = zlib.crc32(model_id.encode()) % len(self._replicas)
             elif len(self._replicas) == 1:
                 idx = 0
+            elif prefix_key:
+                idx, decision = _affinity_pick(
+                    prefix_key, len(self._replicas), pressure,
+                    self._inflight)
+                mdefs.SERVE_ROUTER_AFFINITY.inc(
+                    tags={"deployment": self._name, "decision": decision})
             else:
                 # Pow-2 over shared (cluster-wide) + local in-flight: N
                 # independent ingress processes see each other's load
@@ -957,6 +1056,31 @@ class DeploymentHandle:
         with st.lock:
             st.shared_loads = loads
         return loads
+
+    PRESSURE_TTL_S = 0.5
+
+    def _fetch_shared_pressure(self) -> List[Dict[str, Any]]:
+        """Per-replica pressure snapshots (engine queue depth, KV blocks
+        free/cached, in-flight prefill tokens), TTL-cached per router —
+        the freshness path: routing and ingress admission read the
+        CACHED copy; only one call per TTL pays the controller round
+        trip (which itself serves from its own 0.5s probe cache), so
+        per-request cost is a clock read and a dict lookup."""
+        st = self._router
+        now = time.monotonic()
+        if now - st.pressure_ts < self.PRESSURE_TTL_S:
+            return st.shared_pressure
+        st.pressure_ts = now  # claim first: no thundering herd
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            snaps = list(ray_tpu.get(
+                controller.get_replica_pressure.remote(self._name),
+                timeout=5))
+        except Exception:  # noqa: BLE001 — no controller: empty view
+            snaps = []
+        with st.lock:
+            st.shared_pressure = snaps
+        return snaps
 
     def _observe_done(self, start: float) -> None:
         from ray_tpu._private import metrics_defs as mdefs
@@ -997,7 +1121,7 @@ class DeploymentHandle:
     def _remote_impl(self, args, kwargs, request_ctx):
         from ray_tpu._private import metrics_defs as mdefs
 
-        idx, replica = self._choose(self._model_id)
+        idx, replica = self._choose(self._model_id, self._prefix_key)
         mdefs.SERVE_REQUESTS.inc(tags={"deployment": self._name})
         mdefs.SERVE_QUEUE_DEPTH.set(_queue_depth_delta(self._name, +1),
                                     tags={"deployment": self._name})

@@ -15,8 +15,11 @@ from __future__ import annotations
 import contextvars
 import functools
 import inspect
+import os
+import threading
+import time
 from collections import OrderedDict
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional, Tuple
 
 _model_id_ctx: contextvars.ContextVar[str] = contextvars.ContextVar(
     "serve_multiplexed_model_id", default="")
@@ -94,3 +97,99 @@ def multiplexed(func: Optional[Callable] = None, *,
     if func is not None:
         return decorate(func)
     return decorate
+
+
+# --------------------------------------------------- per-tenant rate limits
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill up to ``burst``.
+    ``try_acquire`` is non-blocking — on refusal it returns the seconds
+    until the next token, which the ingress turns into a Retry-After
+    header instead of queueing the request."""
+
+    __slots__ = ("rate", "burst", "tokens", "ts")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = max(float(burst), 1.0)
+        # A zero-rate bucket is a hard-disabled tenant: it must refuse
+        # from the first request, not grant one burst token.
+        self.tokens = self.burst if self.rate > 0 else 0.0
+        self.ts = time.monotonic()
+
+    def try_acquire(self, now: Optional[float] = None) -> Optional[float]:
+        now = time.monotonic() if now is None else now
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.ts) * self.rate)
+        self.ts = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return None
+        if self.rate <= 0:
+            return 60.0          # hard-disabled tenant: long back-off
+        return (1.0 - self.tokens) / self.rate
+
+
+class TenantRateLimiter:
+    """Per-tenant token buckets for the ingress admission gate (tenant =
+    the multiplexed model id; '' is the anonymous tenant). Limits come
+    from ``set_limit`` per tenant, falling back to the
+    ``RAY_TPU_TENANT_RPS`` / ``RAY_TPU_TENANT_BURST`` env defaults
+    (unset/0 RPS = unlimited). Rejections are tagged into
+    ``ray_tpu_serve_request_outcomes_total`` by the gate."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._limits: Dict[str, Tuple[float, float]] = {}
+        self._buckets: Dict[str, TokenBucket] = {}
+
+    def set_limit(self, tenant: str, rps: float,
+                  burst: Optional[float] = None) -> None:
+        """Override one tenant's budget (rps <= 0 disables the tenant;
+        burst defaults to max(rps, 1))."""
+        with self._lock:
+            self._limits[tenant] = (float(rps),
+                                    float(burst) if burst is not None
+                                    else max(float(rps), 1.0))
+            self._buckets.pop(tenant, None)   # rebuild on next acquire
+
+    def clear_limit(self, tenant: str) -> None:
+        with self._lock:
+            self._limits.pop(tenant, None)
+            self._buckets.pop(tenant, None)
+
+    def _default_limit(self) -> Optional[Tuple[float, float]]:
+        rps = float(os.environ.get("RAY_TPU_TENANT_RPS", "0") or 0)
+        if rps <= 0:
+            return None          # unlimited by default
+        burst = float(os.environ.get("RAY_TPU_TENANT_BURST", "0") or 0)
+        return rps, (burst if burst > 0 else max(rps, 1.0))
+
+    def try_acquire(self, tenant: str) -> Optional[float]:
+        """None = admitted; else seconds until this tenant's next token
+        (the Retry-After the ingress should advertise)."""
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                limit = self._limits.get(tenant)
+                explicit = limit is not None
+                if limit is None:
+                    limit = self._default_limit()
+                if limit is None:
+                    return None  # unlimited tenant: no bucket at all
+                if not explicit and limit[0] <= 0:
+                    return None
+                bucket = self._buckets[tenant] = TokenBucket(*limit)
+            return bucket.try_acquire()
+
+
+_rate_limiter: Optional[TenantRateLimiter] = None
+_rate_limiter_lock = threading.Lock()
+
+
+def tenant_rate_limiter() -> TenantRateLimiter:
+    """Process-wide limiter shared by every ingress (HTTP + gRPC)."""
+    global _rate_limiter
+    with _rate_limiter_lock:
+        if _rate_limiter is None:
+            _rate_limiter = TenantRateLimiter()
+        return _rate_limiter

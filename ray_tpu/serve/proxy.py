@@ -23,10 +23,12 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import threading
 import time
+import zlib
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu.util import tracing
 
@@ -36,12 +38,128 @@ _MAX_BODY = 64 << 20
 _STREAM_END = object()
 
 
+def prefix_fingerprint(payload: Any) -> str:
+    """Prefix fingerprint of an LLM request: a hash of the first k
+    block-aligned chunks of ``prompt_token_ids`` (chunk size
+    ``RAY_TPU_PREFIX_FP_CHUNK``, default 64 — the engine's default KV
+    block size — over at most ``RAY_TPU_PREFIX_FP_CHUNKS`` chunks).
+    Requests sharing a system prompt hash identically, so the router
+    can keep them on the replica whose radix cache already holds the
+    prefix. Returns "" for non-LLM payloads and prompts shorter than
+    one chunk (nothing block-aligned to share). Collisions only cost
+    routing locality — the engine's radix index matches exact token
+    tuples, never hashes."""
+    if not isinstance(payload, dict):
+        return ""
+    ids = payload.get("prompt_token_ids")
+    if not isinstance(ids, (list, tuple)):
+        return ""
+    chunk = int(os.environ.get("RAY_TPU_PREFIX_FP_CHUNK", "64"))
+    max_chunks = int(os.environ.get("RAY_TPU_PREFIX_FP_CHUNKS", "4"))
+    k = min(max_chunks, len(ids) // max(chunk, 1))
+    if k <= 0:
+        return ""
+    try:
+        head = ",".join(str(int(t)) for t in ids[:k * chunk])
+    except (TypeError, ValueError):
+        return ""
+    return f"{zlib.crc32(head.encode()):08x}"
+
+
+class AdmissionGate:
+    """Ingress admission control: per-tenant token buckets + pressure-
+    thresholded load shedding. At saturation the fabric answers 429 +
+    Retry-After (gRPC: RESOURCE_EXHAUSTED) instead of queueing
+    unboundedly — clients get an honest back-off signal while admitted
+    traffic keeps its latency. Pressure comes from the router handle's
+    TTL-cached controller snapshots, so the per-request cost is a clock
+    read and a few dict lookups.
+
+    Thresholds (env, read per decision so tests and operators can
+    retune live):
+
+    * ``RAY_TPU_SHED_QUEUE_DEPTH`` — shed when EVERY reachable replica's
+      congestion (engine queue depth + router ongoing, plus an
+      arena-exhausted penalty) is at/above this. 0 disables pressure
+      shedding (default 32).
+    * ``RAY_TPU_SHED_RETRY_AFTER_S`` — advertised back-off (default 1).
+    """
+
+    def __init__(self, router: "_Router"):
+        self._router = router
+
+    @staticmethod
+    def _congestion(snap: Dict[str, Any]) -> float:
+        cost = float(snap.get("queue_depth") or 0)
+        cost += float(snap.get("ongoing") or 0)
+        total = snap.get("kv_blocks_total") or 0
+        if total:
+            avail = ((snap.get("kv_blocks_free") or 0)
+                     + (snap.get("kv_blocks_cached") or 0))
+            if avail <= 0:
+                # Nothing to admit with even after LRU reclaim: the
+                # next request can only queue.
+                cost = max(cost, 1e9)
+        return cost
+
+    def check(self, deployment: str,
+              tenant: str = "") -> Optional[Tuple[float, str]]:
+        """None = admit; else ``(retry_after_s, reason)`` with reason in
+        {"tenant_rate_limit", "pressure"} — the caller turns it into
+        429 + Retry-After / RESOURCE_EXHAUSTED and the rejection is
+        tagged into ``ray_tpu_serve_request_outcomes_total``."""
+        from ray_tpu._private import metrics_defs as mdefs
+        from ray_tpu.serve import multiplex
+
+        # Pressure first: a pressure shed is the FABRIC's fault, so it
+        # must not consume the tenant's bucket — otherwise a saturated
+        # window drains every tenant's quota and their honest retries
+        # bounce on tenant_rate_limit after pressure clears.
+        shed = self._pressure_shed(deployment)
+        if shed is not None:
+            mdefs.SERVE_REQ_OUTCOMES.inc(tags={
+                "deployment": deployment, "tenant": tenant,
+                "engine": "ingress", "outcome": "shed_pressure"})
+            return shed, "pressure"
+        wait = multiplex.tenant_rate_limiter().try_acquire(tenant)
+        if wait is not None:
+            mdefs.SERVE_REQ_OUTCOMES.inc(tags={
+                "deployment": deployment, "tenant": tenant,
+                "engine": "ingress", "outcome": "shed_tenant"})
+            return max(wait, 0.05), "tenant_rate_limit"
+        return None
+
+    def _pressure_shed(self, deployment: str) -> Optional[float]:
+        """Retry-after seconds when EVERY reachable replica is at/above
+        the shed threshold; None (admit) otherwise — failing open
+        whenever pressure data is off, missing, or unreachable."""
+        threshold = float(os.environ.get("RAY_TPU_SHED_QUEUE_DEPTH",
+                                         "32") or 0)
+        if threshold <= 0:
+            return None
+        try:
+            snaps = self._router.handle(deployment)._fetch_shared_pressure()
+        except Exception:  # noqa: BLE001 — no controller: fail open
+            return None
+        reachable = [s for s in snaps
+                     if s and not s.get("unreachable")]
+        if not reachable:
+            return None          # no pressure data: fail open
+        if all(self._congestion(s) >= threshold for s in reachable):
+            return float(os.environ.get("RAY_TPU_SHED_RETRY_AFTER_S",
+                                        "1.0"))
+        return None
+
+
 class _Router:
     """Shared deployment-handle cache for every ingress."""
 
     def __init__(self):
         self._handles: Dict[str, object] = {}
         self._lock = threading.Lock()
+        # One admission gate per router: HTTP and gRPC ingresses share
+        # its (handle-cached) pressure view and tenant buckets.
+        self.gate = AdmissionGate(self)
 
     def handle(self, name: str):
         from ray_tpu.serve.api import DeploymentHandle
@@ -63,18 +181,20 @@ class _Router:
              model_id: str = "", timeout_s: float = 60.0,
              request_ctx: Optional[Dict[str, Any]] = None):
         self._check_public(method)
-        h = self.handle(name).options(method,
-                                      multiplexed_model_id=model_id,
-                                      request_context=request_ctx)
+        h = self.handle(name).options(
+            method, multiplexed_model_id=model_id,
+            request_context=request_ctx,
+            prefix_key=prefix_fingerprint(payload))
         return h.remote(payload).result(timeout_s=timeout_s)
 
     def stream(self, name: str, method: Optional[str], payload,
                model_id: str = "",
                request_ctx: Optional[Dict[str, Any]] = None):
         self._check_public(method)
-        h = self.handle(name).options(method, stream=True,
-                                      multiplexed_model_id=model_id,
-                                      request_context=request_ctx)
+        h = self.handle(name).options(
+            method, stream=True, multiplexed_model_id=model_id,
+            request_context=request_ctx,
+            prefix_key=prefix_fingerprint(payload))
         gen = h.remote(payload)
         gen._timeout = 60.0  # per-item bound, like result()
         return iter(gen)
@@ -201,7 +321,8 @@ class AsyncHttpProxy:
     @staticmethod
     def _response(status: int, body: bytes,
                   content_type: str = "application/json",
-                  keep_alive: bool = True) -> bytes:
+                  keep_alive: bool = True,
+                  extra_headers: Optional[Dict[str, str]] = None) -> bytes:
         import http as _http
 
         try:
@@ -209,9 +330,12 @@ class AsyncHttpProxy:
         except ValueError:
             reason = "Unknown"
         conn = "keep-alive" if keep_alive else "close"
+        extra = "".join(f"{k}: {v}\r\n"
+                        for k, v in (extra_headers or {}).items())
         return (f"HTTP/1.1 {status} {reason}\r\n"
                 f"Content-Type: {content_type}\r\n"
                 f"Content-Length: {len(body)}\r\n"
+                f"{extra}"
                 f"Connection: {conn}\r\n\r\n").encode() + body
 
     # ---------------------------------------------------------- connection
@@ -298,6 +422,23 @@ class AsyncHttpProxy:
             return True
         model_id = headers.get("serve_multiplexed_model_id", "")
         payload = json.loads(body) if body else {}
+        # ADMISSION GATE before any dispatch work: per-tenant token
+        # buckets + pressure-thresholded load shedding. A saturated
+        # fabric answers 429 + Retry-After so clients back off honestly
+        # instead of piling into an unbounded queue.
+        shed = await loop.run_in_executor(
+            self._pool, self.router.gate.check, name, model_id)
+        if shed is not None:
+            retry_after, reason = shed
+            writer.write(self._response(
+                429,
+                json.dumps({"error": f"overloaded: {reason}",
+                            "retry_after_s": retry_after}).encode(),
+                keep_alive=keep_alive,
+                extra_headers={"Retry-After":
+                               f"{max(retry_after, 0.05):.3f}"}))
+            await writer.drain()
+            return True
         # Request-path tracing starts HERE: the ingress mints the trace
         # context (one trace per request) and every downstream hop —
         # route decision, replica dispatch, engine admission, prefill,
@@ -404,9 +545,24 @@ class GrpcProxy:
                                             host=host)
 
     # ------------------------------------------------------------ handlers
+    @staticmethod
+    def _shed(context, shed) -> None:
+        """Reject with RESOURCE_EXHAUSTED + the advertised back-off (the
+        gRPC analog of 429 + Retry-After)."""
+        import grpc as _grpc
+
+        retry_after, reason = shed
+        context.abort(_grpc.StatusCode.RESOURCE_EXHAUSTED,
+                      f"overloaded: {reason}; retry after "
+                      f"{retry_after:.3f}s")
+
     def Predict(self, request, context):
         from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
+        shed = self.router.gate.check(request.deployment,
+                                      request.multiplexed_model_id)
+        if shed is not None:
+            self._shed(context, shed)
         rctx = ingress_request_context(
             request.deployment, tenant=request.multiplexed_model_id)
         t0 = time.time()
@@ -427,6 +583,10 @@ class GrpcProxy:
 
         from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
+        shed = self.router.gate.check(request.deployment,
+                                      request.multiplexed_model_id)
+        if shed is not None:
+            self._shed(context, shed)
         rctx = ingress_request_context(
             request.deployment, tenant=request.multiplexed_model_id)
         t0 = time.time()
@@ -454,4 +614,5 @@ class GrpcProxy:
         self._server.stop(grace=0.5)
 
 
-__all__ = ["AsyncHttpProxy", "GrpcProxy", "ingress_request_context"]
+__all__ = ["AdmissionGate", "AsyncHttpProxy", "GrpcProxy",
+           "ingress_request_context", "prefix_fingerprint"]

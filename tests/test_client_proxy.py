@@ -19,6 +19,12 @@ from ray_tpu.cluster_utils import Cluster
 def proxy_cluster():
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
+    # Widened node-liveness TTL: client subprocesses spawning under
+    # co-tenant CPU load can starve the 0.5s heartbeats past the default
+    # 3s threshold and get the (healthy) node reaped mid-test (flaky
+    # since PR 1). Driver subprocesses inherit the env.
+    old_ttl = os.environ.get("RAY_TPU_HEARTBEAT_TTL_S")
+    os.environ["RAY_TPU_HEARTBEAT_TTL_S"] = "15"
     c = Cluster(head_node_args={"num_cpus": 4})
     c.wait_for_nodes()
     ray_tpu.init(address=c.address)  # the proxy shares this runtime
@@ -29,6 +35,10 @@ def proxy_cluster():
     proxy._server.close()
     ray_tpu.shutdown()
     c.shutdown()
+    if old_ttl is None:
+        os.environ.pop("RAY_TPU_HEARTBEAT_TTL_S", None)
+    else:
+        os.environ["RAY_TPU_HEARTBEAT_TTL_S"] = old_ttl
 
 
 CLIENT_SCRIPT = textwrap.dedent("""

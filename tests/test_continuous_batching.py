@@ -420,10 +420,13 @@ def test_paged_block_accounting_and_arena_exhaustion(setup):
     crash), joins when a finishing request frees them, and the free
     count round-trips."""
     config, gen, _ = setup
-    # 6 usable blocks of 16 => at most 96 reservable tokens.
+    # 6 usable blocks of 16 => at most 96 reservable tokens. Prefix
+    # caching off: this test pins the BASE all-or-nothing reservation
+    # arithmetic (with it on, finished prompts park blocks in the radix
+    # LRU instead of freeing them — covered by test_prefix_cache.py).
     eng = ContinuousBatcher(config, params=gen.params, num_slots=3,
                             max_len=128, paged=True, block_size=16,
-                            num_blocks=7)
+                            num_blocks=7, prefix_cache=False)
     r1 = eng.submit(list(range(1, 30)), max_new_tokens=3)   # 2 blocks
     r2 = eng.submit(list(range(1, 40)), max_new_tokens=25)  # 4 blocks
     r3 = eng.submit([1, 2, 3], max_new_tokens=3)            # 1 block: waits

@@ -95,6 +95,30 @@ def test_kv_arena_series_are_cataloged():
             assert m.description.strip() and m.tag_keys
 
 
+def test_prefix_cache_series_are_cataloged():
+    """The prefix-cache + affinity-routing series (radix KV-block reuse,
+    cached/refcounted block gauges, router decision counters) ship
+    described + tagged in the catalog — the dashboard prefix panel and
+    bench_serve's prefix phase read them."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_cb_prefix_hit_tokens_total",
+        "ray_tpu_cb_prefix_miss_tokens_total",
+        "ray_tpu_cb_kv_blocks_cached",
+        "ray_tpu_cb_kv_blocks_shared",
+        "ray_tpu_serve_router_affinity_total",
+    }
+    missing = required - names
+    assert not missing, (
+        f"prefix-cache/affinity series missing from the catalog: "
+        f"{missing}")
+    for m in _framework_metrics():
+        if m.name.startswith("ray_tpu_cb_prefix_"):
+            assert m.description.strip() and "engine" in m.tag_keys
+        if m.name == "ray_tpu_serve_router_affinity_total":
+            assert {"deployment", "decision"} <= set(m.tag_keys)
+
+
 def test_serve_request_series_are_cataloged():
     """The request-path observability series (TTFT decomposition, TPOT,
     outcomes, event-buffer drops) ship described + tagged in the catalog

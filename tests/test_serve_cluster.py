@@ -2,6 +2,7 @@
 pubsub (reference: serve long-poll over the GCS) and autoscaling works
 against real replica actors in worker processes."""
 
+import os
 import time
 
 import pytest
@@ -13,6 +14,12 @@ from ray_tpu.cluster_utils import Cluster
 
 @pytest.fixture(scope="module", autouse=True)
 def serve_cluster():
+    # Co-tenant CPU load (other suites, CI neighbors) can stall the 0.5s
+    # node heartbeats past the default 3s liveness TTL and get healthy
+    # nodes reaped mid-test (flaky since PR 1) — widen the TTL for this
+    # multi-node harness; the in-process GCS reads it per health tick.
+    old_ttl = os.environ.get("RAY_TPU_HEARTBEAT_TTL_S")
+    os.environ["RAY_TPU_HEARTBEAT_TTL_S"] = "15"
     c = Cluster(head_node_args={"num_cpus": 8})
     c.wait_for_nodes()
     ray_tpu.init(address=c.address)
@@ -20,6 +27,10 @@ def serve_cluster():
     serve.shutdown()
     ray_tpu.shutdown()
     c.shutdown()
+    if old_ttl is None:
+        os.environ.pop("RAY_TPU_HEARTBEAT_TTL_S", None)
+    else:
+        os.environ["RAY_TPU_HEARTBEAT_TTL_S"] = old_ttl
 
 
 @serve.deployment(num_replicas=2)
