@@ -207,6 +207,25 @@ TRAIN_TOKENS_PER_S = Gauge(
     "ray_tpu_train_tokens_per_s",
     "Training throughput as last reported by rank 0 (tokens_per_s key)",
     ("trainer",))
+TRAIN_INPUT_STALL = Histogram(
+    "ray_tpu_train_input_stall_seconds",
+    "Per-batch time the train loop sat blocked on an empty device-"
+    "prefetch buffer (the input pipeline couldn't keep up) — the "
+    "histogram _sum over wall time is the run's input-stall fraction",
+    boundaries=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                1.0, 5.0),
+    tag_keys=("iterator",))
+TRAIN_PREFETCH_OCCUPANCY = Gauge(
+    "ray_tpu_train_prefetch_buffer_occupancy",
+    "Device-prefetch buffer fill fraction (0 = consumer starved, "
+    "1 = producer a full depth ahead) sampled at each put/get",
+    ("iterator",))
+TRAIN_INGEST_BYTES = Counter(
+    "ray_tpu_train_ingest_bytes_total",
+    "Host bytes staged onto the device mesh by the ingest prefetcher "
+    "(decode output, pre-device_put) — its rate is the training "
+    "data-plane bytes/s",
+    ("iterator",))
 
 # --------------------------------------------- continuous batching / LLM (L6)
 CB_SLOT_OCCUPANCY = Gauge(

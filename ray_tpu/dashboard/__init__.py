@@ -92,6 +92,8 @@ _INDEX_HTML = """<!doctype html>
 <h2>Serve / request latency breakdown (TTFT = queue + arena-wait +
 prefill; TPOT)</h2><div id="reqlat"></div>
 <h2>Serve / replica pressure</h2><table id="pressure"></table>
+<h2>Train / input pipeline (stall, prefetch occupancy, bytes/s)</h2>
+<div id="ingest"></div>
 <h2>Metrics (last 5 min)</h2><div id="metrics"></div>
 <h2>XLA programs (compiles / retraces / achieved)</h2>
 <table id="xla"></table>
@@ -203,6 +205,17 @@ async function prefixPanel(){
   document.getElementById("prefix").innerHTML=
     sparkRows(rows,40)||"(no prefix-cache telemetry)";
 }
+async function ingestPanel(){
+  // Train input pipeline: input-stall seconds vs step seconds says
+  // whether the data plane or the device is the bottleneck; prefetch
+  // occupancy flatlining at 0 with stalls climbing means the producer
+  // (host decode / object store) can't keep up; the ingest bytes
+  // counter's slope is the training data-plane bytes/s.
+  const data=await j("/api/v1/metrics/query?series=ray_tpu_train_*"+
+                     "&since=300&agg=avg&step=3&limit=30");
+  document.getElementById("ingest").innerHTML=
+    sparkRows(data,30)||"(no training ingest telemetry)";
+}
 async function xlaPanel(){
   // Compile/retrace table per (node, program) from the xla series the
   // push plane lands in the TSDB, plus the registered profiler captures.
@@ -259,6 +272,7 @@ async function refresh(){
     await servePanel();
     await prefixPanel();
     await requestLatencyPanel();
+    await ingestPanel();
     await xlaPanel();
     document.getElementById("status").textContent=
       "updated "+new Date().toLocaleTimeString();

@@ -91,8 +91,26 @@ def _table_from_rows(rows: List[Any]) -> pa.Table:
         rows = [{"item": r} for r in rows]
     if not rows:
         return pa.table({})
-    cols = {k: [r.get(k) for r in rows] for k in rows[0]}
-    return pa.table(cols)
+    arrays, fields = [], []
+    for k in rows[0]:
+        vals = [r.get(k) for r in rows]
+        first = vals[0]
+        if (isinstance(first, np.ndarray) and first.ndim >= 1
+                and all(isinstance(v, np.ndarray)
+                        and v.shape == first.shape
+                        and v.dtype == first.dtype for v in vals)):
+            # Rectangular per-row ndarrays (LM tokens, images) become a
+            # TENSOR column: a bare pa.array would store variable-length
+            # lists, and batch_format="numpy" would then hand back
+            # object-dtype arrays that jax.device_put rejects — the
+            # train-ingest path needs the exact [B, ...] ndarray back.
+            col, meta = _tensor_column(np.stack(vals))
+            fields.append(pa.field(k, col.type, metadata=meta))
+        else:
+            col = pa.array(vals)
+            fields.append(pa.field(k, col.type))
+        arrays.append(col)
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
 
 
 def _tensor_fields(table: pa.Table):
@@ -760,11 +778,14 @@ class Dataset:
         refs = self.repartition(n)._block_refs
         return [Dataset([r]) for r in refs]
 
-    def streaming_split(self, n: int) -> List["DataIterator"]:
+    def streaming_split(self, n: int,
+                        name: Optional[str] = None) -> List["DataIterator"]:
         """Per-consumer iterators for Train ingest (reference:
-        ``Dataset.streaming_split`` feeding ray.train workers)."""
+        ``Dataset.streaming_split`` feeding ray.train workers).
+        ``name`` tags each shard's ingest telemetry (JaxTrainer passes
+        its ``datasets=`` key)."""
         parts = self.split(n)
-        return [DataIterator(p) for p in parts]
+        return [DataIterator(p, name=name) for p in parts]
 
     def iterator(self) -> "DataIterator":
         return DataIterator(self)
@@ -1052,11 +1073,36 @@ class GroupedData:
 class DataIterator:
     """Reference: ``ray.data.DataIterator`` handed to train workers."""
 
-    def __init__(self, ds: Dataset):
+    def __init__(self, ds: Dataset, name: Optional[str] = None):
         self._ds = ds
+        # Ingest-telemetry tag (streaming_split passes JaxTrainer's
+        # datasets= key) so train/eval pipelines don't alias onto one
+        # iterator label.
+        self._name = name
 
     def iter_batches(self, **kw) -> Iterator[Batch]:
         return self._ds.iter_batches(**kw)
+
+    def iter_device_batches(self, sharding=None, *, prefetch: int = 2,
+                            decode_fn=None, name: Optional[str] = None,
+                            **kw):
+        """Mesh-staged batches with background prefetch ON BY DEFAULT:
+        host decode + sharded ``jax.device_put`` run on a prefetch
+        thread through a ``prefetch``-deep buffer, so batch N+1's H2D
+        transfer overlaps step N (see
+        :class:`ray_tpu.train.ingest.DevicePrefetcher`). ``sharding``
+        is a NamedSharding or anything carrying ``batch_sharding``
+        (e.g. a ShardedTrainer); remaining kwargs go to
+        :meth:`iter_batches`. ``drop_last`` defaults to True HERE
+        (unlike host iter_batches): the jitted train_step holds one
+        compiled signature, so a ragged tail batch would retrace — or
+        fail the microbatch-divisibility check outright."""
+        from ray_tpu.train.ingest import DevicePrefetcher
+
+        kw.setdefault("drop_last", True)
+        return DevicePrefetcher(self.iter_batches(**kw), sharding,
+                                depth=prefetch, decode_fn=decode_fn,
+                                name=name or self._name or "train")
 
     def iter_rows(self):
         return self._ds.iter_rows()

@@ -26,6 +26,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
+from ray_tpu.util import jax_compat as _jax_compat  # noqa: F401 - pins
+# partitionable threefry BEFORE any param init traces: sharded init must
+# produce the same values on every mesh layout (see jax_compat docstring).
 from ray_tpu.ops.attention import flash_attention, mha_reference
 from ray_tpu.ops.norms import rms_norm
 from ray_tpu.ops.ring_attention import ring_attention
@@ -166,8 +169,9 @@ def _attend(q, k, v, config: LlamaConfig, mesh: Optional[Mesh]):
     if mode == "reference":
         return mha_reference(q, k, v, causal=True)
     if mode == "ring":
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from ray_tpu.util.jax_compat import shard_map
 
         qspec = P(("data", "fsdp"), "seq", "tensor", None)
         kvspec = P(("data", "fsdp"), "seq", "tensor", None)

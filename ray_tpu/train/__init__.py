@@ -24,22 +24,26 @@ from ray_tpu.train.config import (
     RunConfig,
     ScalingConfig,
 )
+from ray_tpu.train.ingest import DevicePrefetcher, prefetch_to_device
+from ray_tpu.train.loop import AsyncStepLoop
 from ray_tpu.train.session import (
     get_checkpoint,
     get_checkpoint_plane,
     get_context,
+    get_dataset_shard,
     report,
 )
 from ray_tpu.train.storage import AsyncCheckpointer, StorageContext
 from ray_tpu.train.trainer import ControllerState, JaxTrainer
 
 __all__ = [
-    "AsyncCheckpointer", "BackendExecutor", "Checkpoint",
+    "AsyncCheckpointer", "AsyncStepLoop", "BackendExecutor", "Checkpoint",
     "CheckpointConfig", "CheckpointManager", "ControllerState",
-    "FailureConfig", "JaxBackend", "JaxTrainer", "Result", "RunConfig",
-    "ScalingConfig", "StorageContext", "TrainWorker", "WorkerGroup",
-    "get_checkpoint", "get_checkpoint_plane", "get_context",
-    "load_pytree", "report", "save_pytree",
+    "DevicePrefetcher", "FailureConfig", "JaxBackend", "JaxTrainer",
+    "Result", "RunConfig", "ScalingConfig", "StorageContext",
+    "TrainWorker", "WorkerGroup", "get_checkpoint",
+    "get_checkpoint_plane", "get_context", "get_dataset_shard",
+    "load_pytree", "prefetch_to_device", "report", "save_pytree",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

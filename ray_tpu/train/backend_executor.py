@@ -90,11 +90,13 @@ class TrainWorker:
 
     def run(self, fn: Callable, config: Optional[Dict[str, Any]],
             restore_checkpoint_path: Optional[str],
-            run_dir: Optional[str] = None):
+            run_dir: Optional[str] = None,
+            dataset_shards: Optional[Dict[str, Any]] = None):
         """Run the user train loop to completion (blocking actor call)."""
         ckpt = (Checkpoint(restore_checkpoint_path)
                 if restore_checkpoint_path else None)
-        s = session_mod._Session(self._ctx, ckpt, run_dir=run_dir)
+        s = session_mod._Session(self._ctx, ckpt, run_dir=run_dir,
+                                 dataset_shards=dataset_shards)
         with self._lock:
             self._session = s
         session_mod._set_session(s)
@@ -215,10 +217,20 @@ class BackendExecutor:
     def start_training(self, train_fn: Callable,
                        config: Optional[Dict[str, Any]],
                        restore_checkpoint_path: Optional[str],
-                       run_dir: Optional[str] = None) -> List[Any]:
+                       run_dir: Optional[str] = None,
+                       datasets: Optional[List[Dict[str, Any]]] = None
+                       ) -> List[Any]:
+        """``datasets`` is PER-RANK: element ``i`` is rank i's
+        ``{name: DataIterator}`` map of disjoint streaming_split shards
+        (every other start_training arg is identical across ranks)."""
         assert self.worker_group is not None
-        return self.worker_group.execute_async(
-            "run", train_fn, config, restore_checkpoint_path, run_dir)
+        refs = []
+        for rank, w in enumerate(self.worker_group.workers):
+            shards = datasets[rank] if datasets else None
+            refs.append(w.run.remote(train_fn, config,
+                                     restore_checkpoint_path, run_dir,
+                                     shards))
+        return refs
 
     def poll(self) -> List[Dict[str, Any]]:
         assert self.worker_group is not None

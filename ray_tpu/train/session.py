@@ -53,10 +53,12 @@ class TrainContext:
 class _Session:
     def __init__(self, context: TrainContext,
                  checkpoint: Optional[Checkpoint] = None,
-                 run_dir: Optional[str] = None):
+                 run_dir: Optional[str] = None,
+                 dataset_shards: Optional[Dict[str, Any]] = None):
         self.context = context
         self.restore_checkpoint = checkpoint
         self.run_dir = run_dir
+        self.dataset_shards = dataset_shards or {}
         self.checkpoint_plane = None  # lazily built, one per session
         self.reports: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
@@ -101,6 +103,30 @@ def get_checkpoint() -> Optional[Checkpoint]:
     """Checkpoint to restore from (set when recovering from failure)."""
     s = _get_session()
     return s.restore_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's disjoint :class:`~ray_tpu.data.DataIterator` shard of
+    the dataset passed as ``JaxTrainer(datasets={name: ds})`` (reference:
+    ``ray.train.get_dataset_shard`` over ``Dataset.streaming_split``).
+
+    Each worker sees only its own rows. ``iter_batches()`` yields host
+    batches; ``iter_device_batches(trainer_or_sharding)`` stages them
+    onto the mesh with background prefetch ON BY DEFAULT (depth 2) —
+    the intended train-loop spelling::
+
+        it = rt_train.get_dataset_shard()
+        for batch in it.iter_device_batches(trainer, batch_size=8):
+            loop.step(batch)
+    """
+    s = _get_session()
+    shard = s.dataset_shards.get(name)
+    if shard is None:
+        have = sorted(s.dataset_shards) or "(none)"
+        raise KeyError(
+            f"no dataset shard named {name!r} in this session — pass "
+            f"datasets={{{name!r}: ds}} to JaxTrainer (have: {have})")
+    return shard
 
 
 def get_checkpoint_plane(run: str = "train"):

@@ -54,6 +54,7 @@ class JaxTrainer:
         run_config: Optional[RunConfig] = None,
         backend: Optional[JaxBackend] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
     ):
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config
@@ -61,6 +62,11 @@ class JaxTrainer:
         self.run_config = run_config or RunConfig()
         self.backend = backend
         self.resume_from_checkpoint = resume_from_checkpoint
+        # Train ingest (reference: DataParallelTrainer datasets= +
+        # ray.train.get_dataset_shard): each named ray_tpu.data.Dataset
+        # is streaming_split into DISJOINT per-worker shards at (re)start
+        # — elastic restarts re-split over the surviving worker count.
+        self.datasets = datasets
         self.controller_state = ControllerState.INITIALIZING
         self.state_history: List[str] = [ControllerState.INITIALIZING]
 
@@ -150,9 +156,19 @@ class JaxTrainer:
                 scaling = _dc.replace(scaling, num_workers=target)
             executor = BackendExecutor(scaling, self.backend)
             executor.start()
+            worker_datasets = None
+            if self.datasets:
+                worker_datasets = [
+                    {} for _ in range(scaling.num_workers)]
+                for ds_name, ds in self.datasets.items():
+                    shards = ds.streaming_split(scaling.num_workers,
+                                                name=ds_name)
+                    for rank, it in enumerate(shards):
+                        worker_datasets[rank][ds_name] = it
             run_refs = executor.start_training(
                 self.train_loop, self.train_loop_config,
-                restore.path if restore else None, run_dir=exp_dir)
+                restore.path if restore else None, run_dir=exp_dir,
+                datasets=worker_datasets)
             self._set_state(ControllerState.RUNNING)
             try:
                 self._drive(executor, run_refs, manager, history)

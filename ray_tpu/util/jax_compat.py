@@ -4,11 +4,26 @@
 top-level ``jax.shard_map`` export; the framework supports both ends of
 that migration (the pinned CI jax still ships only the experimental
 path). Import it from here, never from ``jax`` directly.
+
+This module also pins ``jax_threefry_partitionable``: with the legacy
+non-partitionable threefry, the SPMD partitioner generates
+DIFFERENT random values for the same key depending on the output
+sharding (a ``jax.random.normal`` jitted with a sharded out_sharding
+diverges from its unsharded twin), so model init was a function of the
+mesh layout — cross-layout loss parity is impossible under that
+regime. Partitionable threefry makes RNG output sharding-invariant.
 """
 
 from __future__ import annotations
 
 import inspect
+
+import jax
+
+try:
+    jax.config.update("jax_threefry_partitionable", True)
+except Exception:  # pragma: no cover - flag removed once it's the default
+    pass
 
 try:
     from jax import shard_map as _sm

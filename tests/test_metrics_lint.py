@@ -145,6 +145,25 @@ def test_serve_request_series_are_cataloged():
                 assert {"deployment", "tenant"} <= set(m.tag_keys), m.name
 
 
+def test_train_ingest_series_are_cataloged():
+    """The training input-pipeline series (prefetch stall/occupancy,
+    data-plane bytes) ship described + tagged in the catalog — the
+    dashboard 'Train / input pipeline' panel and bench.py's input-stall
+    fraction read them."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_train_input_stall_seconds",
+        "ray_tpu_train_prefetch_buffer_occupancy",
+        "ray_tpu_train_ingest_bytes_total",
+    }
+    missing = required - names
+    assert not missing, (
+        f"train-ingest series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name in required:
+            assert m.description.strip() and "iterator" in m.tag_keys
+
+
 def test_serve_ingress_and_engine_admission_emit_spans():
     """The request-path trace is only connected if BOTH ends emit: the
     serve ingresses must mint the request context + close the ingress
