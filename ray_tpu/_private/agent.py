@@ -183,7 +183,16 @@ class NodeAgent:
         # nodes that opted in via RAY_TPU_AGENT_DEVICE_VITALS=1); on TPU
         # the workers' own xla_monitor publishes the per-device series.
         force_dev = os.environ.get("RAY_TPU_AGENT_DEVICE_VITALS") == "1"
+        from ray_tpu._private import chaos
+
         while not self._stop_vitals.wait(interval):
+            # Chaos site: ``drop_agent_vitals`` skips one publish cycle —
+            # the node's vitals gauges go stale exactly as they would
+            # under an agent stall.
+            directive = chaos.inject("agent_vitals",
+                                     node=self.node_id) or {}
+            if directive.get("drop"):
+                continue
             try:
                 xla_monitor.sample_device_memory(node_id=self.node_id,
                                                  force=force_dev)

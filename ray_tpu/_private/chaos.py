@@ -1,0 +1,344 @@
+"""Deterministic chaos-injection harness for fault-tolerance testing.
+
+Elastic training (``ray_tpu/train/trainer.py``) promises to survive worker
+death, hung collectives, lapsed heartbeats, preemption and shard-write
+failures — promises that rot unless every recovery path is driven by a
+*real* injected fault rather than a mock. This module is the single place
+such faults come from: framework code calls :func:`inject` at named
+injection **sites** (TrainWorker step/report boundary, the train heartbeat
+thread, the node-manager heartbeat loop, the node-agent vitals loop, the
+checkpoint plane's shard writer), and an installed :class:`ChaosPlan`
+decides — **deterministically** — whether a fault fires there.
+
+Determinism contract: a plan is a seed plus an ordered rule list. Rules
+matched by exact coordinates (``rank=1,step=3``) fire wherever the
+coordinates match; probabilistic rules (``p=0.25``) flip a coin that is a
+pure function of ``(seed, rule id, site, coordinates)`` — so the same seed
+replays the same fault sequence, and a different seed explores a different
+one. Every firing is appended to an in-process injection log
+(:func:`injection_log`) that tests assert on.
+
+Activation: programmatic (``chaos.configure("kill_worker:rank=1,step=3",
+seed=7)``) or by environment — ``RAY_TPU_CHAOS`` holds the spec string and
+``RAY_TPU_CHAOS_SEED`` the seed, so a fault plan can ride into worker
+processes through normal env plumbing. With no plan installed,
+:func:`inject` is a single attribute check.
+
+Spec grammar (semicolon-separated rules)::
+
+    RAY_TPU_CHAOS="kill_worker:rank=1,step=3,resize=2;slow_step:rank=0,step=5,secs=2.0"
+
+Actions:
+
+=================  =========================================================
+``kill_worker``     uncooperative worker death at a step boundary. In real
+                    multi-process workers (``RAY_TPU_CHAOS_HARD_EXIT=1``)
+                    the process ``os._exit``\\ s; in the in-process runtime
+                    it raises :class:`SimulatedProcessDeath`, which the
+                    local runtime converts into genuine actor death
+                    (``ActorDiedError`` on every pending call — the same
+                    thing the controller would see from a dead process).
+                    Optional ``resize=N`` publishes a world-target hint on
+                    the preemption channel first (models losing a node the
+                    cluster cannot replace).
+``slow_step``       sleeps ``secs`` at the step boundary — a hung/slow
+                    collective; the controller's step watchdog should fire.
+``drop_heartbeat``  the train worker's heartbeat thread skips a beat
+                    (``times=N`` beats total) — drives lapsed-heartbeat
+                    detection without stopping step progress.
+``delay_heartbeat`` delays a beat by ``secs`` before it lands.
+``drop_node_hb``    the node manager skips one GCS heartbeat send — drives
+                    GCS node-liveness reaping.
+``drop_agent_vitals``  the node agent skips one vitals publish cycle.
+``fail_shard_write``   the checkpoint plane's shard write raises ``OSError``
+                    (``times=N``) — exercises crash-mid-write invisibility.
+``corrupt_shard``   flips a byte in the shard ``.npz`` after it is written
+                    (the save still commits) — exercises crc32 verification
+                    and previous-manifest fallback on restore.
+``resize``          publishes a ``world_target=N`` resize hint on the
+                    preemption pubsub channel at a step boundary (no
+                    death) — drives controller-side mesh re-formation.
+=================  =========================================================
+
+Matching keys (all optional): ``rank``, ``step``, ``proc``, ``node``,
+``run``. ``times`` caps firings (default 1); ``p`` makes the rule
+probabilistic. Rules fire at the site their action belongs to; firing
+state is process-local (in the in-process runtime this means a rule fired
+before a simulated death stays fired across the restart, exactly like a
+fault that already happened).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "ChaosPlan", "ChaosRule", "SimulatedProcessDeath", "configure",
+    "current_plan", "enabled", "inject", "injection_log", "process_dying",
+    "reset",
+]
+
+
+class SimulatedProcessDeath(BaseException):
+    """Raised by the ``kill_worker`` action in in-process runtimes.
+
+    Deliberately a ``BaseException``: user train loops catching
+    ``Exception`` must not swallow a simulated process kill. The local
+    runtime (``_private/runtime/local.py``) converts it into genuine
+    actor death instead of a task error."""
+
+    def __init__(self, reason: str = "chaos: worker killed"):
+        self.reason = reason
+        super().__init__(reason)
+
+
+# Site each action fires at.
+_ACTION_SITES = {
+    "kill_worker": "train_step",
+    "slow_step": "train_step",
+    "resize": "train_step",
+    "drop_heartbeat": "train_heartbeat",
+    "delay_heartbeat": "train_heartbeat",
+    "drop_node_hb": "node_heartbeat",
+    "drop_agent_vitals": "agent_vitals",
+    "fail_shard_write": "ckpt_shard_write",
+    "corrupt_shard": "ckpt_shard_file",
+}
+_MATCH_KEYS = ("rank", "step", "proc", "node", "run")
+_INT_PARAMS = ("rank", "step", "proc", "times", "resize", "world")
+_FLOAT_PARAMS = ("secs", "p")
+
+
+class ChaosRule:
+    def __init__(self, action: str, params: Dict[str, Any], rule_id: str):
+        if action not in _ACTION_SITES:
+            raise ValueError(
+                f"unknown chaos action {action!r} "
+                f"(known: {sorted(_ACTION_SITES)})")
+        self.action = action
+        self.site = _ACTION_SITES[action]
+        self.id = rule_id
+        self.params = params
+        self.match = {k: params[k] for k in _MATCH_KEYS if k in params}
+        self.times = int(params.get("times", 1))
+        self.p = params.get("p")
+
+    def matches(self, site: str, coords: Dict[str, Any]) -> bool:
+        if site != self.site:
+            return False
+        for key, want in self.match.items():
+            if key not in coords or coords[key] != want:
+                return False
+        return True
+
+    def __repr__(self):
+        kv = ",".join(f"{k}={v}" for k, v in sorted(self.params.items()))
+        return f"ChaosRule({self.action}:{kv})"
+
+
+class ChaosPlan:
+    """A parsed spec: ordered rules + the seed that makes them replayable."""
+
+    def __init__(self, rules: List[ChaosRule], seed: int = 0):
+        self.rules = rules
+        self.seed = int(seed)
+
+    @classmethod
+    def parse(cls, spec: str, seed: int = 0) -> "ChaosPlan":
+        rules = []
+        for i, part in enumerate(p for p in spec.split(";") if p.strip()):
+            action, _, rest = part.strip().partition(":")
+            params: Dict[str, Any] = {}
+            for kv in (x for x in rest.split(",") if x.strip()):
+                key, _, val = kv.partition("=")
+                key = key.strip()
+                val = val.strip()
+                if key in _INT_PARAMS:
+                    params[key] = int(val)
+                elif key in _FLOAT_PARAMS:
+                    params[key] = float(val)
+                else:
+                    params[key] = val
+            rules.append(ChaosRule(action.strip(), params,
+                                   rule_id=f"{action.strip()}#{i}"))
+        return cls(rules, seed=seed)
+
+
+# ----------------------------------------------------------- module state
+_lock = threading.Lock()
+_plan: Optional[ChaosPlan] = None
+_env_checked = False
+_fired: Dict[str, int] = {}
+_log: List[Dict[str, Any]] = []
+_MAX_LOG = 1000
+_tls = threading.local()
+
+
+def configure(spec: Optional[str] = None, seed: int = 0,
+              plan: Optional[ChaosPlan] = None) -> Optional[ChaosPlan]:
+    """Install a chaos plan programmatically (tests). ``spec=None`` and
+    ``plan=None`` clears it. Clears the firing state and injection log."""
+    global _plan, _env_checked
+    with _lock:
+        _plan = plan if plan is not None else (
+            ChaosPlan.parse(spec, seed=seed) if spec else None)
+        _env_checked = True  # programmatic config wins over env
+        _fired.clear()
+        del _log[:]
+    return _plan
+
+
+def reset() -> None:
+    """Drop any installed plan and firing state; env is re-read lazily."""
+    global _plan, _env_checked
+    with _lock:
+        _plan = None
+        _env_checked = False
+        _fired.clear()
+        del _log[:]
+    _tls.dying = False
+
+
+def current_plan() -> Optional[ChaosPlan]:
+    global _plan, _env_checked
+    if not _env_checked:
+        with _lock:
+            if not _env_checked:
+                spec = os.environ.get("RAY_TPU_CHAOS", "")
+                if spec:
+                    try:
+                        _plan = ChaosPlan.parse(
+                            spec,
+                            seed=int(os.environ.get(
+                                "RAY_TPU_CHAOS_SEED", "0")))
+                    except Exception:  # noqa: BLE001 — bad spec: no chaos
+                        logger.exception("invalid RAY_TPU_CHAOS spec %r",
+                                         spec)
+                        _plan = None
+                _env_checked = True
+    return _plan
+
+
+def enabled() -> bool:
+    return current_plan() is not None
+
+
+def injection_log() -> List[Dict[str, Any]]:
+    with _lock:
+        return list(_log)
+
+
+def process_dying() -> bool:
+    """True on the thread currently unwinding a simulated process kill —
+    cleanup code (checkpoint-plane close, heartbeat flush) consults this
+    to behave like the process really vanished."""
+    return bool(getattr(_tls, "dying", False))
+
+
+def _clear_dying() -> None:
+    _tls.dying = False
+
+
+def _coin(plan: ChaosPlan, rule: ChaosRule,
+          site: str, coords: Dict[str, Any]) -> bool:
+    """Deterministic Bernoulli draw: pure function of (seed, rule, site,
+    coords) so a replay with the same seed injects the same sequence."""
+    key = f"{plan.seed}:{rule.id}:{site}:" + ",".join(
+        f"{k}={coords[k]}" for k in sorted(coords)
+        if isinstance(coords[k], (int, str)))
+    h = zlib.crc32(key.encode())
+    return random.Random(h).random() < float(rule.p)
+
+
+def inject(site: str, **coords: Any) -> Optional[Dict[str, Any]]:
+    """Consult the plan at an injection site.
+
+    Returns a directive dict for cooperative actions (``{"drop": True}``,
+    ``{"delay_s": x}``), ``None`` when nothing fires. Disruptive actions
+    act directly: ``slow_step`` sleeps here, ``fail_shard_write`` raises
+    ``OSError``, ``corrupt_shard`` flips a byte of ``coords["path"]``,
+    ``kill_worker`` raises :class:`SimulatedProcessDeath` (or hard-exits
+    under ``RAY_TPU_CHAOS_HARD_EXIT=1``)."""
+    plan = current_plan()
+    if plan is None:
+        return None
+    directives: Dict[str, Any] = {}
+    for rule in plan.rules:
+        if not rule.matches(site, coords):
+            continue
+        with _lock:
+            if _fired.get(rule.id, 0) >= rule.times:
+                continue
+            if rule.p is not None and not _coin(plan, rule, site, coords):
+                continue
+            _fired[rule.id] = _fired.get(rule.id, 0) + 1
+            if len(_log) < _MAX_LOG:
+                _log.append({
+                    "seq": len(_log), "action": rule.action, "site": site,
+                    "rule": rule.id, "ts": time.time(),
+                    "coords": {k: v for k, v in coords.items()
+                               if isinstance(v, (int, float, str))}})
+        _apply(rule, site, coords, directives)
+    return directives or None
+
+
+def _apply(rule: ChaosRule, site: str, coords: Dict[str, Any],
+           directives: Dict[str, Any]) -> None:
+    action = rule.action
+    logger.warning("chaos: injecting %s at %s %s", action, site, coords)
+    if action == "kill_worker":
+        resize = rule.params.get("resize")
+        if resize:
+            _publish_resize(int(resize), reason="chaos-node-lost")
+        if os.environ.get("RAY_TPU_CHAOS_HARD_EXIT") == "1":
+            os._exit(17)  # real worker process: die like a killed host
+        _tls.dying = True
+        raise SimulatedProcessDeath(
+            f"chaos kill_worker at {site} {coords}")
+    if action == "slow_step":
+        time.sleep(float(rule.params.get("secs", 1.0)))
+    elif action == "resize":
+        _publish_resize(int(rule.params["world"]), reason="chaos-resize")
+    elif action == "fail_shard_write":
+        raise OSError(f"chaos fail_shard_write at {coords}")
+    elif action == "corrupt_shard":
+        path = coords.get("path")
+        if path:
+            _corrupt_file(str(path))
+    elif action in ("drop_heartbeat", "drop_node_hb",
+                    "drop_agent_vitals"):
+        directives["drop"] = True
+    elif action == "delay_heartbeat":
+        directives["delay_s"] = float(rule.params.get("secs", 1.0))
+
+
+def _publish_resize(world_target: int, reason: str) -> None:
+    try:
+        from ray_tpu.checkpoint.preempt import publish_preempt
+
+        publish_preempt(reason=reason, world_target=world_target)
+    except Exception:  # noqa: BLE001 — chaos must not mask the fault
+        logger.exception("chaos: resize publish failed")
+
+
+def _corrupt_file(path: str) -> None:
+    """Flip one byte in the middle of ``path`` (after the zip local-file
+    headers, so the file still *looks* like a checkpoint shard)."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+        logger.warning("chaos: corrupted one byte of %s", path)
+    except OSError:
+        logger.exception("chaos: failed to corrupt %s", path)

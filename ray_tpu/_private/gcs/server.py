@@ -494,6 +494,7 @@ class GcsServer:
     def _health_loop(self):
         """Reference: GcsHealthCheckManager (gcs_health_check_manager.h:45)."""
         tick = 0
+        prev_capacity = None
         while not self._stop.wait(HEALTH_CHECK_PERIOD_S):
             tick += 1
             now = time.monotonic()
@@ -517,6 +518,22 @@ class GcsServer:
                         stale_drivers.append(hid)
             for node_id in dead:
                 self._mark_dead(node_id, "missed heartbeats")
+            # Elastic grow hints: when the alive capacity total rises (a
+            # node registered, re-registered, or grew), publish a
+            # ``kind="capacity"`` notice on the PREEMPT channel — elastic
+            # trainers' ResizeGuards latch it and re-check grow-back
+            # feasibility immediately instead of waiting for their
+            # periodic probe (ray_tpu/train/elastic.py).
+            with self._lock:
+                capacity = sum(
+                    sum(n.resources.values())
+                    for n in self._nodes.values() if n.alive)
+            if prev_capacity is not None and capacity > prev_capacity:
+                self._publish("PREEMPT", pickle.dumps(
+                    {"reason": "cluster-capacity-grew", "node": "*",
+                     "kind": "capacity", "ts": time.time(),
+                     "source": "gcs"}))
+            prev_capacity = capacity
             if stale_drivers:
                 logger.warning("reaping %d stale driver holder(s)",
                                len(stale_drivers))

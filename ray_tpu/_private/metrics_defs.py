@@ -207,6 +207,25 @@ TRAIN_TOKENS_PER_S = Gauge(
     "ray_tpu_train_tokens_per_s",
     "Training throughput as last reported by rank 0 (tokens_per_s key)",
     ("trainer",))
+TRAIN_RESTARTS = Counter(
+    "ray_tpu_train_restarts_total",
+    "Elastic trainer restarts by failure cause (worker_lost/hang/"
+    "preemption/resize/user) — fatal errors end the run and are not "
+    "counted",
+    ("trainer", "cause"))
+TRAIN_WORLD_SIZE = Gauge(
+    "ray_tpu_train_world_size",
+    "Worker count the current training attempt was scheduled with "
+    "(moves on elastic shrink/grow restarts)",
+    ("trainer",))
+TRAIN_RECOVERY_SECONDS = Histogram(
+    "ray_tpu_train_recovery_seconds",
+    "Failure detection to the restarted attempt's first report: group "
+    "teardown + backoff + re-acquisition + mesh re-formation + manifest "
+    "restore + first step",
+    boundaries=(0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 180.0, 600.0,
+                1800.0),
+    tag_keys=("trainer",))
 TRAIN_INPUT_STALL = Histogram(
     "ray_tpu_train_input_stall_seconds",
     "Per-batch time the train loop sat blocked on an empty device-"

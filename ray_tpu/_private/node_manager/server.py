@@ -348,30 +348,41 @@ class NodeManager:
         raise ValueError(f"unknown fastpath frame kind {kind}")
 
     def _heartbeat_loop(self):
+        from ray_tpu._private import chaos
+
         seq = 0
         while not self._stop.wait(_heartbeat_period_s()):
             seq += 1
-            req = pb.HeartbeatRequest(node_id=self.node_id, seq=seq)
-            with self._res_lock:
-                for k, v in self.available.items():
-                    req.available[k] = v
-            try:
-                reply = self.gcs.Heartbeat(req, timeout=2)
-                if not reply.ok:
-                    # GCS restarted / lost us: re-register.
-                    info = pb.NodeInfo(node_id=self.node_id,
-                                       address=self.address, alive=True,
-                                       fast_address=self.fast_address)
-                    for k, v in self.total.items():
-                        info.resources[k] = v
-                    with self._res_lock:
-                        for k, v in self.available.items():
-                            info.available[k] = v
-                    for k, v in self.labels.items():
-                        info.labels[k] = v
-                    self.gcs.RegisterNode(pb.RegisterNodeRequest(info=info))
-            except Exception:  # noqa: BLE001
-                pass
+            # Chaos site: ``drop_node_hb`` skips this tick's GCS send —
+            # the local bookkeeping below still runs, so the injected
+            # fault is exactly a lost heartbeat, driving GCS liveness
+            # reaping without wedging the node.
+            directive = chaos.inject("node_heartbeat",
+                                     node=self.node_id) or {}
+            if not directive.get("drop"):
+                req = pb.HeartbeatRequest(node_id=self.node_id, seq=seq)
+                with self._res_lock:
+                    for k, v in self.available.items():
+                        req.available[k] = v
+                try:
+                    reply = self.gcs.Heartbeat(req, timeout=2)
+                    if not reply.ok:
+                        # GCS restarted / lost us: re-register.
+                        info = pb.NodeInfo(node_id=self.node_id,
+                                           address=self.address,
+                                           alive=True,
+                                           fast_address=self.fast_address)
+                        for k, v in self.total.items():
+                            info.resources[k] = v
+                        with self._res_lock:
+                            for k, v in self.available.items():
+                                info.available[k] = v
+                        for k, v in self.labels.items():
+                            info.labels[k] = v
+                        self.gcs.RegisterNode(
+                            pb.RegisterNodeRequest(info=info))
+                except Exception:  # noqa: BLE001
+                    pass
             self._reap_idle_workers()
             self._check_dead_workers()
             self._check_agent()

@@ -283,11 +283,31 @@ class _LocalActor:
             self.runtime._store_results(None, return_ids)
             self.terminate()
         except BaseException as e:  # noqa: BLE001
+            if self._maybe_simulated_death(e, return_ids):
+                return
             err = exceptions.RayTaskError.from_exception(
                 e, f"{self.cls.__name__}.{method_name}", task_id)
             self.runtime._store_error(err, return_ids)
         finally:
             _context.reset(token)
+
+    def _maybe_simulated_death(self, e: BaseException, return_ids) -> bool:
+        """Chaos-injected process kill: the in-process runtime cannot lose
+        a real OS process, so the harness raises SimulatedProcessDeath and
+        this converts it into genuine actor death — ActorDiedError on the
+        in-flight call and every queued one, exactly what a controller
+        polling a worker whose host died would observe."""
+        from ray_tpu._private import chaos
+
+        if not isinstance(e, chaos.SimulatedProcessDeath):
+            return False
+        err = exceptions.ActorDiedError(
+            self.actor_id,
+            f"Actor {self.actor_id.hex()} died: {e.reason}")
+        self.runtime._store_error(err, return_ids)
+        self._die(err)
+        chaos._clear_dying()
+        return True
 
     async def _execute_async(self, method_name, args, kwargs, return_ids,
                              task_id, streaming: bool = False):
@@ -324,6 +344,8 @@ class _LocalActor:
             self.runtime._store_results(None, return_ids)
             self.terminate()
         except BaseException as e:  # noqa: BLE001
+            if self._maybe_simulated_death(e, return_ids):
+                return
             err = exceptions.RayTaskError.from_exception(
                 e, f"{self.cls.__name__}.{method_name}", task_id)
             self.runtime._store_error(err, return_ids)

@@ -12,11 +12,22 @@ background write; Gemini-style just-in-time checkpoints on preemption):
 * **two-phase commit** — every participant writes
   ``shard-<proc>-of-<n>.npz`` + a spec into the step directory and
   registers its shard set under the ``__ckpt__`` KV namespace
-  (``<run>/<step>/shard/<proc>``); the LAST arrival flips the atomic
-  ``MANIFEST`` record (KV put with ``overwrite=False`` — exactly one
-  winner — mirrored to ``MANIFEST.json`` in the step dir). Readers only
-  ever see committed manifests; a crash mid-write leaves an invisible
-  directory that :meth:`gc` (and the GCS manifest sweep) collects.
+  (``<run>@<dirhash>/<step>/shard/<nprocs>/<proc>`` — the run segment is
+  scoped by the run directory's identity so same-named concurrent runs
+  don't collide in the cluster KV, and registration/commit are scoped by
+  topology, so an elastic restart re-saving a step at a new world size
+  never counts a dead attempt's stragglers toward its
+  quorum); the LAST arrival flips the atomic ``MANIFEST`` record (KV put
+  with ``overwrite=False`` — exactly one winner — mirrored to
+  ``MANIFEST.json`` in the step dir). Readers only ever see committed
+  manifests; a crash mid-write leaves an invisible directory that
+  :meth:`gc` (and the GCS manifest sweep) collects.
+* **shard integrity** — each spec records the crc32 of its shard file;
+  :func:`_assemble` verifies before deserializing, and
+  :meth:`CheckpointPlane.restore` / :func:`load_latest` fall back to the
+  previous committed manifest (with a logged warning) when a committed
+  step's data turns out corrupt, instead of crashing the recovery they
+  exist to serve.
 * **elastic restore** — :meth:`CheckpointPlane.restore` reassembles every
   leaf from the shard files of *any* committed manifest and re-shards it
   onto the caller's target shardings via ``jax.device_put``, so state
@@ -30,6 +41,7 @@ pickling restrictions.
 
 from __future__ import annotations
 
+import io
 import json
 import logging
 import os
@@ -38,16 +50,25 @@ import re
 import shutil
 import threading
 import time
+import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from ray_tpu.exceptions import CheckpointCorruptError
 
 logger = logging.getLogger(__name__)
 
 # Reserved-by-convention KV namespace for checkpoint coordination records.
 CKPT_KV_NS = "__ckpt__"
 _STEP_RE = re.compile(r"^step-(\d+)$")
+
+# Errors that mean a committed step's data cannot be trusted or read:
+# crc32 mismatch, truncated/missing shard files, undecodable
+# spec/npz/treedef. Restore paths fall back past them.
+_CORRUPTION_ERRORS = (CheckpointCorruptError, OSError, ValueError,
+                      KeyError, EOFError, pickle.UnpicklingError)
 
 
 def _kv():
@@ -147,7 +168,8 @@ class CheckpointPlane:
     def __init__(self, root: str, run: str = "train", *,
                  process_index: Optional[int] = None,
                  process_count: Optional[int] = None,
-                 keep: Optional[int] = None):
+                 keep: Optional[int] = None,
+                 fence: Optional[Callable[[], bool]] = None):
         if "/" in run:
             raise ValueError(f"run name must not contain '/': {run!r}")
         self.root = os.path.abspath(root)
@@ -163,6 +185,21 @@ class CheckpointPlane:
         self.process_index = int(process_index)
         self.process_count = max(int(process_count), 1)
         self.keep = keep
+        # KV coordination records are scoped by the run's filesystem
+        # location (crc32 of the absolute run_dir rides in the key's run
+        # segment): concurrent runs that share a run NAME — every
+        # JaxTrainer-managed plane is "train" — must not see each
+        # other's registrations or manifests through the cluster KV.
+        # Participants of one run coordinate over the same storage path,
+        # so they agree on the scope.
+        self._kv_run = (f"{run}@"
+                        f"{zlib.crc32(self.run_dir.encode()):08x}")
+        # Save-time fence (e.g. the train session's stop flag): an
+        # abandoned in-process loop that outlives its bounded teardown
+        # join must not write into the next attempt's stream — at an
+        # unchanged world size its shard paths and 2PC keys would be
+        # identical to the new generation's.
+        self._fence = fence
         self._mtags = {"run": run}
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="ckpt-writer")
@@ -184,7 +221,7 @@ class CheckpointPlane:
                 f"-of-{self.process_count:05d}")
 
     def _kv_key(self, step: int, suffix: str) -> str:
-        return f"{self.run}/{int(step):010d}/{suffix}"
+        return f"{self._kv_run}/{int(step):010d}/{suffix}"
 
     # -------------------------------------------------------------- save
     def save(self, step: int, state: Any) -> Dict[str, Any]:
@@ -200,6 +237,12 @@ class CheckpointPlane:
 
         if self._closed:
             raise RuntimeError("CheckpointPlane is closed")
+        if self._fence is not None and self._fence():
+            from ray_tpu.exceptions import WorkerStoppedError
+
+            raise WorkerStoppedError(
+                "checkpoint plane fenced: this session is being torn "
+                "down (elastic restart/resize)")
         self.flush()  # one persist in flight, in submission order
         import jax
 
@@ -241,15 +284,37 @@ class CheckpointPlane:
     # background leg without touching the snapshot path.
     def _write_shard_files(self, d: str, spec: Dict[str, Any],
                            entries: Dict[str, np.ndarray]) -> None:
+        from ray_tpu._private import chaos
+
         stem = self._shard_stem()
+        # Chaos site: a ``fail_shard_write`` rule raises OSError here —
+        # the shard never lands, the step never commits, readers keep
+        # seeing the previous manifest.
+        chaos.inject("ckpt_shard_write", proc=self.process_index,
+                     step=int(spec["step"]), run=self.run)
         tmp_npz = os.path.join(d, f".{stem}.npz.tmp")
+        # Serialize to memory first: the crc covers the exact bytes
+        # renamed into place (verified by _assemble before any
+        # deserialization) without re-reading the file — one transient
+        # in-RAM copy of this process's shard buys a single sequential
+        # write. (Streaming the crc through the write is not an option:
+        # zipfile seeks back to patch local headers.)
+        buf = io.BytesIO()
+        np.savez(buf, **entries)
+        payload = buf.getvalue()
+        spec["crc32"] = zlib.crc32(payload) & 0xFFFFFFFF
         with open(tmp_npz, "wb") as f:
-            np.savez(f, **entries)
-        os.replace(tmp_npz, os.path.join(d, f"{stem}.npz"))
+            f.write(payload)
+        npz_path = os.path.join(d, f"{stem}.npz")
+        os.replace(tmp_npz, npz_path)
         tmp_spec = os.path.join(d, f".{stem}.json.tmp")
         with open(tmp_spec, "w") as f:
             json.dump(spec, f)
         os.replace(tmp_spec, os.path.join(d, f"{stem}.json"))
+        # Chaos site: ``corrupt_shard`` flips a byte of the durable file
+        # (the save still commits) — models silent media corruption.
+        chaos.inject("ckpt_shard_file", proc=self.process_index,
+                     step=int(spec["step"]), run=self.run, path=npz_path)
 
     def _persist(self, step: int, treedef, spec_leaves, shard_sets,
                  t_start: float) -> Dict[str, Any]:
@@ -314,16 +379,22 @@ class CheckpointPlane:
                   "spec": f"{self._shard_stem()}.json",
                   "bytes": spec["bytes"], "dir": d, "ts": time.time()}
         kv = _kv()
+        # Registrations (and the quorum below) are scoped by topology:
+        # an elastic restart re-saving this step at a different world
+        # size must not count a dead attempt's straggler shards.
         if kv is not None:
             kv.internal_kv_put(
-                self._kv_key(step, f"shard/{self.process_index:05d}"),
+                self._kv_key(step, f"shard/{self.process_count:05d}"
+                                   f"/{self.process_index:05d}"),
                 json.dumps(record).encode(), overwrite=True,
                 namespace=CKPT_KV_NS)
             present = kv.internal_kv_list(
-                self._kv_key(step, "shard/"), namespace=CKPT_KV_NS)
+                self._kv_key(step, f"shard/{self.process_count:05d}/"),
+                namespace=CKPT_KV_NS)
         else:
             present = [f for f in os.listdir(d)
-                       if f.startswith("shard-") and f.endswith(".json")]
+                       if f.startswith("shard-") and
+                       f.endswith(f"-of-{self.process_count:05d}.json")]
         if len(present) < self.process_count:
             return False  # not the last arrival; a peer commits
         return self._commit_manifest(step)
@@ -333,9 +404,12 @@ class CheckpointPlane:
         Exactly one participant wins; everyone returns True once a
         manifest exists."""
         d = self.step_dir(step)
+        # Only this topology's shard set: stale shards from an attempt
+        # at another world size may share the directory.
         shard_specs = sorted(
             f for f in os.listdir(d)
-            if f.startswith("shard-") and f.endswith(".json"))
+            if f.startswith("shard-") and
+            f.endswith(f"-of-{self.process_count:05d}.json"))
         manifest = {
             "run": self.run, "step": step, "dir": d,
             "nprocs": self.process_count,
@@ -380,7 +454,7 @@ class CheckpointPlane:
         found = set()
         kv = _kv()
         if kv is not None:
-            for key in kv.internal_kv_list(f"{self.run}/",
+            for key in kv.internal_kv_list(f"{self._kv_run}/",
                                            namespace=CKPT_KV_NS):
                 parts = key.split("/")
                 if len(parts) == 3 and parts[2] == "MANIFEST":
@@ -418,21 +492,47 @@ class CheckpointPlane:
         ``target`` is a pytree of ``jax.sharding.Sharding`` matching the
         saved structure (each leaf is ``jax.device_put`` onto its
         sharding — the elastic re-shard), or ``None`` for host numpy
-        arrays. ``step`` defaults to the newest committed step."""
+        arrays. ``step`` defaults to the newest committed step — and in
+        that default mode a committed step whose shard data fails
+        integrity verification is skipped (logged warning) in favor of
+        the previous committed manifest; an explicitly requested step
+        raises instead."""
         from ray_tpu._private import metrics_defs as mdefs
 
         t0 = time.perf_counter()
-        if step is None:
-            step = self.latest_step()
-            if step is None:
+        if step is not None:
+            candidates = [int(step)]
+        else:
+            candidates = list(reversed(self.steps()))
+            if not candidates:
                 raise FileNotFoundError(
                     f"no committed checkpoint for run {self.run!r} "
                     f"under {self.run_dir}")
-        manifest = self.manifest(step)
-        d = manifest.get("dir") or self.step_dir(step)
-        if not os.path.isdir(d):
-            d = self.step_dir(step)
-        host_leaves, treedef = _assemble(d, manifest)
+        host_leaves = treedef = None
+        last_err: Optional[BaseException] = None
+        for cand in candidates:
+            try:
+                manifest = self.manifest(cand)
+                d = manifest.get("dir") or self.step_dir(cand)
+                if not os.path.isdir(d):
+                    d = self.step_dir(cand)
+                host_leaves, treedef = _assemble(d, manifest)
+                step = cand
+                break
+            except _CORRUPTION_ERRORS as e:
+                last_err = e
+                if cand != candidates[-1]:
+                    logger.warning(
+                        "checkpoint step %d of run %r failed integrity "
+                        "verification (%s); falling back to the previous "
+                        "committed manifest", cand, self.run, e)
+        if host_leaves is None:
+            if len(candidates) == 1:
+                raise last_err
+            raise CheckpointCorruptError(
+                f"every committed checkpoint of run {self.run!r} failed "
+                f"integrity verification (steps {candidates}); last "
+                f"error: {last_err}") from last_err
         total = sum(a.nbytes for a in host_leaves)
         out_leaves: List[Any] = host_leaves
         if target is not None:
@@ -552,10 +652,20 @@ def _assemble(d: str, manifest: Dict[str, Any]):
         spec_path = os.path.join(d, fname[:-len(".npz")] + ".json")
         with open(spec_path) as f:
             spec = json.load(f)
+        # One read serves both the integrity check and deserialization.
+        with open(os.path.join(d, fname), "rb") as f:
+            raw = f.read()
+        want_crc = spec.get("crc32")
+        if want_crc is not None:
+            got_crc = zlib.crc32(raw) & 0xFFFFFFFF
+            if got_crc != int(want_crc):
+                raise CheckpointCorruptError(
+                    f"shard {fname} in {d}: crc32 {got_crc:#010x} != "
+                    f"recorded {int(want_crc):#010x}")
         if leaves_meta is None:
             leaves_meta = spec["leaves"]
             buffers = [None] * len(leaves_meta)
-        data = np.load(os.path.join(d, fname))
+        data = np.load(io.BytesIO(raw))
         for entry in spec["entries"]:
             li = entry["leaf"]
             meta = leaves_meta[li]
@@ -612,19 +722,22 @@ def load_latest(root: str, run: Optional[str] = None,
     """Filesystem-only restore (no cluster needed): newest committed
     manifest under ``root`` (one run's dir, or a root holding runs) as
     host numpy arrays. Serve engines use this to cold-start from a
-    training run's output."""
+    training run's output.
+
+    A committed step whose shard data fails crc32 verification is
+    skipped (logged warning) in favor of the next-newest committed
+    manifest."""
     root = os.path.abspath(root)
-    candidates: List[Tuple[str, str]] = []  # (run, run_dir)
+    run_dirs: List[Tuple[str, str]] = []  # (run, run_dir)
     if run is not None:
-        candidates = [(run, os.path.join(root, run))]
+        run_dirs = [(run, os.path.join(root, run))]
     elif any(_STEP_RE.match(n) for n in _safe_ls(root)):
-        candidates = [(os.path.basename(root), root)]
-        root = os.path.dirname(root)
+        run_dirs = [(os.path.basename(root), root)]
     else:
-        candidates = [(n, os.path.join(root, n)) for n in _safe_ls(root)
-                      if os.path.isdir(os.path.join(root, n))]
-    best: Optional[Tuple[float, str, str, int]] = None
-    for run_name, run_dir in candidates:
+        run_dirs = [(n, os.path.join(root, n)) for n in _safe_ls(root)
+                    if os.path.isdir(os.path.join(root, n))]
+    found: List[Tuple[int, float, str]] = []  # (step, manifest mtime, dir)
+    for _run_name, run_dir in run_dirs:
         for name in _safe_ls(run_dir):
             m = _STEP_RE.match(name)
             mpath = os.path.join(run_dir, name, "MANIFEST.json")
@@ -633,22 +746,34 @@ def load_latest(root: str, run: Optional[str] = None,
             s = int(m.group(1))
             if step is not None and s != step:
                 continue
-            ts = os.path.getmtime(mpath)
-            key = (s, ts)
-            if best is None or key > (best[3], best[0]):
-                best = (ts, run_name, run_dir, s)
-    if best is None:
+            found.append((s, os.path.getmtime(mpath),
+                          os.path.join(run_dir, name)))
+    if not found:
         raise FileNotFoundError(
             f"no committed checkpoint under {root!r}"
             + (f" for run {run!r}" if run else ""))
-    _, run_name, run_dir, s = best
-    d = os.path.join(run_dir, f"step-{s:010d}")
-    with open(os.path.join(d, "MANIFEST.json")) as f:
-        manifest = json.load(f)
+    found.sort(reverse=True)
     import jax
 
-    leaves, treedef = _assemble(d, manifest)
-    return jax.tree.unflatten(treedef, leaves)
+    last_err: Optional[BaseException] = None
+    for _s, _ts, d in found:
+        try:
+            with open(os.path.join(d, "MANIFEST.json")) as f:
+                manifest = json.load(f)
+            leaves, treedef = _assemble(d, manifest)
+            return jax.tree.unflatten(treedef, leaves)
+        except _CORRUPTION_ERRORS as e:
+            last_err = e
+            if d != found[-1][2]:
+                logger.warning(
+                    "checkpoint %s failed integrity verification (%s); "
+                    "falling back to the previous committed manifest",
+                    d, e)
+    if len(found) == 1:
+        raise last_err
+    raise CheckpointCorruptError(
+        f"every committed checkpoint under {root!r} failed integrity "
+        f"verification; last error: {last_err}") from last_err
 
 
 def _safe_ls(path: str) -> List[str]:

@@ -80,14 +80,27 @@ def _gcs_stub(gcs_address: Optional[str]):
 
 def publish_preempt(reason: str = "preempted", node: str = "*",
                     gcs_address: Optional[str] = None,
-                    deadline_s: Optional[float] = None) -> Dict[str, Any]:
+                    deadline_s: Optional[float] = None,
+                    world_target: Optional[int] = None,
+                    kind: Optional[str] = None) -> Dict[str, Any]:
     """Publish a preemption notice cluster-wide (GCS PREEMPT channel);
     without a reachable GCS the notice fires locally instead. ``node``
-    scopes delivery (``*`` = every subscriber)."""
+    scopes delivery (``*`` = every subscriber).
+
+    The channel doubles as the elastic-resize signal plane:
+    ``world_target=N`` asks running trainers to re-form their worker
+    groups at N workers (``ray_tpu.train.elastic.request_resize``), and
+    ``kind="capacity"`` is the GCS health loop's cluster-grew hint —
+    both are latched by :class:`ray_tpu.train.elastic.ResizeGuard`
+    rather than the JIT-save guards."""
     notice = {"reason": reason, "node": node or "*", "ts": time.time(),
               "source": "publish"}
     if deadline_s is not None:
         notice["deadline_s"] = float(deadline_s)
+    if world_target is not None:
+        notice["world_target"] = int(world_target)
+    if kind is not None:
+        notice["kind"] = str(kind)
     gcs = _gcs_stub(gcs_address)
     if gcs is not None:
         import pickle
@@ -113,6 +126,30 @@ def start_preempt_listener(gcs_address: str,
     threading.Thread(target=_listener_loop,
                      args=(gcs_address, node_id or "", stop),
                      daemon=True, name="preempt-listener").start()
+
+
+def ensure_listener(gcs_address: Optional[str] = None,
+                    node_id: Optional[str] = None) -> None:
+    """Subscribe this process to PREEMPT notices, resolving the GCS
+    address from the connected worker when not given. No-op without a
+    reachable GCS — local publishes still reach registered callbacks —
+    and a failed subscribe is logged, never raised (shared bootstrap for
+    :class:`PreemptionGuard` and ``train.elastic.ResizeGuard``)."""
+    address = gcs_address
+    if address is None:
+        try:
+            from ray_tpu._private import worker as worker_mod
+
+            w = worker_mod.global_worker_or_none()
+            address = getattr(w.core, "gcs_address", None) \
+                if w is not None else None
+        except Exception:  # noqa: BLE001
+            address = None
+    if address:
+        try:
+            start_preempt_listener(address, node_id=node_id)
+        except Exception:  # noqa: BLE001 — guard still works locally
+            logger.exception("preempt listener failed to start")
 
 
 def stop_listeners() -> None:
@@ -176,25 +213,18 @@ class PreemptionGuard:
         self._notice: Optional[Dict[str, Any]] = None
 
         def on_notice(notice: Dict[str, Any]) -> None:
+            # Elastic control signals (world-target asks, GCS capacity
+            # hints) ride this channel but are ResizeGuard's to latch —
+            # they must not trigger a JIT save + PreemptedError in every
+            # running train loop.
+            if notice.get("kind") == "capacity" or \
+                    notice.get("world_target") is not None:
+                return
             self._notice = notice
             self._event.set()
 
         self._cb = register_preempt_callback(on_notice)
-        address = gcs_address
-        if address is None:
-            try:
-                from ray_tpu._private import worker as worker_mod
-
-                w = worker_mod.global_worker_or_none()
-                address = getattr(w.core, "gcs_address", None) \
-                    if w is not None else None
-            except Exception:  # noqa: BLE001
-                address = None
-        if address:
-            try:
-                start_preempt_listener(address, node_id=node_id)
-            except Exception:  # noqa: BLE001 — guard still works locally
-                logger.exception("preempt listener failed to start")
+        ensure_listener(gcs_address, node_id=node_id)
 
     @property
     def triggered(self) -> bool:

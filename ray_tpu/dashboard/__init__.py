@@ -94,6 +94,8 @@ prefill; TPOT)</h2><div id="reqlat"></div>
 <h2>Serve / replica pressure</h2><table id="pressure"></table>
 <h2>Train / input pipeline (stall, prefetch occupancy, bytes/s)</h2>
 <div id="ingest"></div>
+<h2>Train / elasticity (restarts by cause, world size, recovery time)</h2>
+<div id="elastic"></div>
 <h2>Metrics (last 5 min)</h2><div id="metrics"></div>
 <h2>XLA programs (compiles / retraces / achieved)</h2>
 <table id="xla"></table>
@@ -216,6 +218,21 @@ async function ingestPanel(){
   document.getElementById("ingest").innerHTML=
     sparkRows(data,30)||"(no training ingest telemetry)";
 }
+async function elasticPanel(){
+  // Elastic-trainer vitals: restarts_total{cause} stepping up says WHAT
+  // keeps ending attempts (worker_lost vs hang vs preemption vs
+  // resize); world_size moving shows shrink/grow-back re-formations;
+  // recovery_seconds (histogram _sum/_count) is the failure-detection →
+  // first-report-after-restart wall time.
+  const restarts=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_train_restarts_total&since=300&agg=last&step=3&limit=20");
+  const world=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_train_world_size&since=300&agg=last&step=3&limit=10");
+  const rec=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_train_recovery_*&since=300&agg=avg&step=3&limit=10");
+  document.getElementById("elastic").innerHTML=
+    sparkRows(restarts.concat(world,rec),40)||"(no elastic trainers)";
+}
 async function xlaPanel(){
   // Compile/retrace table per (node, program) from the xla series the
   // push plane lands in the TSDB, plus the registered profiler captures.
@@ -273,6 +290,7 @@ async function refresh(){
     await prefixPanel();
     await requestLatencyPanel();
     await ingestPanel();
+    await elasticPanel();
     await xlaPanel();
     document.getElementById("status").textContent=
       "updated "+new Date().toLocaleTimeString();

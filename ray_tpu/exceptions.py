@@ -166,6 +166,52 @@ class NodeDiedError(RayTpuError):
     """The node running the task/actor died."""
 
 
+class WorkerHangError(RayTpuError):
+    """A training worker stopped making progress while staying reachable:
+    either its per-step watchdog lapsed (hung collective / wedged step)
+    or its heartbeats stopped arriving. Retryable — the elastic trainer
+    tears the group down and re-forms it (restart budget, not
+    ``max_failures``)."""
+
+    def __init__(self, reason: str = "worker hang detected",
+                 rank=None, kind: str = "watchdog"):
+        self.reason = reason
+        self.rank = rank
+        self.kind = kind  # "watchdog" | "heartbeat"
+        super().__init__(reason)
+
+
+class WorkerStoppedError(RayTpuError):
+    """Cooperative stop: the controller is tearing this worker group down
+    (elastic restart/resize) and the session's stop flag is set. Raised
+    out of ``train.report()`` so in-process zombie loops unwind instead
+    of racing the next attempt's checkpoint writes."""
+
+
+class NaNLossError(RayTpuError):
+    """The training loss was non-finite for too many consecutive reports.
+    Classified FATAL: restarting from the same checkpoint would replay
+    the same divergence, so no retry budget is consumed."""
+
+    def __init__(self, reason: str = "non-finite training loss",
+                 reports: int = 0):
+        self.reports = reports
+        super().__init__(f"{reason} ({reports} consecutive reports)")
+
+
+class JaxDistributedBootstrapError(RayTpuError):
+    """Forming the multi-process ``jax.distributed`` group failed after
+    coordinator port-rebind retries — the environment cannot run
+    multi-process jax (fatal, not retryable)."""
+
+
+class CheckpointCorruptError(RayTpuError):
+    """A committed checkpoint's shard data failed integrity verification
+    (crc32 mismatch against the spec, unreadable/truncated shard file).
+    ``CheckpointPlane.restore``/``load_latest`` fall back to the previous
+    committed manifest instead of surfacing this."""
+
+
 class RaySystemError(RayTpuError):
     """Internal framework failure (deserialization, protocol, ...)."""
 

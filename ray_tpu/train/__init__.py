@@ -24,6 +24,7 @@ from ray_tpu.train.config import (
     RunConfig,
     ScalingConfig,
 )
+from ray_tpu.train.elastic import ResizeGuard, request_resize
 from ray_tpu.train.ingest import DevicePrefetcher, prefetch_to_device
 from ray_tpu.train.loop import AsyncStepLoop
 from ray_tpu.train.session import (
@@ -40,10 +41,11 @@ __all__ = [
     "AsyncCheckpointer", "AsyncStepLoop", "BackendExecutor", "Checkpoint",
     "CheckpointConfig", "CheckpointManager", "ControllerState",
     "DevicePrefetcher", "FailureConfig", "JaxBackend", "JaxTrainer",
-    "Result", "RunConfig", "ScalingConfig", "StorageContext",
-    "TrainWorker", "WorkerGroup", "get_checkpoint",
+    "ResizeGuard", "Result", "RunConfig", "ScalingConfig",
+    "StorageContext", "TrainWorker", "WorkerGroup", "get_checkpoint",
     "get_checkpoint_plane", "get_context", "get_dataset_shard",
-    "load_pytree", "prefetch_to_device", "report", "save_pytree",
+    "load_pytree", "prefetch_to_device", "report", "request_resize",
+    "save_pytree",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

@@ -56,9 +56,26 @@ class ScalingConfig:
 
 @dataclasses.dataclass
 class FailureConfig:
-    """Reference: ``air/config.py::FailureConfig``."""
+    """Reference: ``air/config.py::FailureConfig``.
+
+    ``max_failures`` governs USER exceptions only (the train loop
+    raising). Infrastructure failures — worker death, hung collectives,
+    lapsed heartbeats — have their own budget (``RAY_TPU_MAX_RESTARTS``),
+    preemptions theirs (``RAY_TPU_MAX_PREEMPTIONS``), and worker-set
+    resizes theirs (``RAY_TPU_MAX_RESIZES``); see
+    ``ray_tpu/train/elastic.py`` for the full taxonomy.
+    """
 
     max_failures: int = 0  # 0 = no retries, -1 = infinite
+    # Per-step watchdog: if no worker reports for this long after the
+    # first report, the attempt is declared hung (retryable under the
+    # restart budget). None reads RAY_TPU_STEP_WATCHDOG_S; 0 disables.
+    # Before the first report the deadline is 10x (compile headroom).
+    watchdog_s: Optional[float] = None
+    # Fatal-NaN guard: this many CONSECUTIVE reports with a non-finite
+    # "loss" ends the run as FATAL (restarting would replay the same
+    # divergence). None reads RAY_TPU_NAN_FATAL_REPORTS; 0 disables.
+    nan_fatal_reports: Optional[int] = None
 
 
 @dataclasses.dataclass

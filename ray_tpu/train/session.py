@@ -11,7 +11,9 @@ from __future__ import annotations
 import contextvars
 import queue
 import threading
-from typing import Any, Dict, Optional
+import time
+import weakref
+from typing import Any, Dict, List, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
 
@@ -54,16 +56,76 @@ class _Session:
     def __init__(self, context: TrainContext,
                  checkpoint: Optional[Checkpoint] = None,
                  run_dir: Optional[str] = None,
-                 dataset_shards: Optional[Dict[str, Any]] = None):
+                 dataset_shards: Optional[Dict[str, Any]] = None,
+                 group_id: str = ""):
         self.context = context
         self.restore_checkpoint = checkpoint
         self.run_dir = run_dir
         self.dataset_shards = dataset_shards or {}
+        self.group_id = group_id  # worker-group generation (elastic fence)
         self.checkpoint_plane = None  # lazily built, one per session
         self.reports: "queue.Queue" = queue.Queue()
         self.finished = threading.Event()
+        # Cooperative teardown: the controller flips this when it re-forms
+        # the group; report() raises WorkerStoppedError so in-process
+        # zombie loops unwind instead of racing the next attempt.
+        self.stop = threading.Event()
+        # Liveness surfaced through TrainWorker.poll(): progress_ts moves
+        # on every report, last_step mirrors the loop's step counter.
+        self.progress_ts: float = time.monotonic()
+        self.last_step: int = -1
+        self.report_seq: int = 0
         self.error: Optional[BaseException] = None
         self.result: Any = None
+        with _registry_lock:
+            _active_sessions.add(self)
+
+
+# Process-local registry of live sessions, keyed for stop/join by worker-
+# group id. Only meaningful in the in-process runtime, where "killing" a
+# worker actor cannot kill its (shared-process) thread: the executor flags
+# the old generation's sessions to stop and waits for them to finish so
+# zombie loops never race the next attempt's checkpoint stream. In
+# cluster mode worker processes really die, and the controller-side
+# registry is simply empty.
+_registry_lock = threading.Lock()
+_active_sessions: "weakref.WeakSet[_Session]" = weakref.WeakSet()
+
+
+def _sessions_for_group(group_id: str) -> List[_Session]:
+    with _registry_lock:
+        return [s for s in _active_sessions
+                if s.group_id == group_id and not s.finished.is_set()]
+
+
+def stop_local_sessions(group_id: str) -> int:
+    """Flag every unfinished in-process session of one worker group to
+    stop at its next report. Returns how many were flagged."""
+    sessions = _sessions_for_group(group_id)
+    for s in sessions:
+        s.stop.set()
+    return len(sessions)
+
+
+def join_local_sessions(group_id: str, timeout_s: float = 5.0) -> bool:
+    """Wait for flagged sessions to unwind (bounded). False (with a
+    warning) if a loop is still running — e.g. wedged inside a long
+    sleep; when it wakes, its next ``plane.save`` or ``report`` raises
+    ``WorkerStoppedError`` (the plane's save-time fence / the report
+    stop check), so it cannot write into the next attempt's stream."""
+    import logging
+
+    deadline = time.monotonic() + timeout_s
+    ok = True
+    for s in _sessions_for_group(group_id):
+        remaining = deadline - time.monotonic()
+        if not s.finished.wait(max(remaining, 0.0)):
+            ok = False
+            logging.getLogger(__name__).warning(
+                "train session (rank %d, group %s) still running %.1fs "
+                "after teardown — a wedged step is being abandoned",
+                s.context.get_world_rank(), group_id or "?", timeout_s)
+    return ok
 
 
 _session_var: contextvars.ContextVar[Optional[_Session]] = contextvars.ContextVar(
@@ -89,8 +151,26 @@ def report(metrics: Dict[str, Any],
 
     Reference semantics (``ray.train.report``): all workers should call it at
     the same cadence; only rank-0's checkpoint is persisted by default.
+
+    This is also the per-step boundary the elastic control loop hooks:
+    the cooperative stop flag is honored here, and the chaos harness's
+    ``train_step`` injection site fires here (kill/slow faults land at a
+    step boundary, like a real mid-step host loss would be observed).
     """
+    from ray_tpu import exceptions as _exc
+    from ray_tpu._private import chaos
+
     s = _get_session()
+    if s.stop.is_set():
+        raise _exc.WorkerStoppedError(
+            "worker group torn down (elastic restart in progress)")
+    step = metrics.get("step")
+    if not isinstance(step, int):
+        step = s.report_seq
+    chaos.inject("train_step", rank=s.context.get_world_rank(), step=step)
+    s.report_seq += 1
+    s.progress_ts = time.monotonic()
+    s.last_step = step
     s.reports.put({"metrics": dict(metrics), "checkpoint": checkpoint})
 
 
@@ -150,5 +230,10 @@ def get_checkpoint_plane(run: str = "train"):
         s.checkpoint_plane = CheckpointPlane(
             os.path.join(s.run_dir, "ckpt_plane"), run=run,
             process_index=ctx.get_world_rank(),
-            process_count=ctx.get_world_size())
+            process_count=ctx.get_world_size(),
+            # Once the controller flags this session for teardown, saves
+            # raise WorkerStoppedError: an abandoned loop that outlives
+            # the bounded join writes to the SAME shard paths / 2PC keys
+            # as the next attempt when the world size is unchanged.
+            fence=s.stop.is_set)
     return s.checkpoint_plane

@@ -7,11 +7,36 @@ start worker group → run ``train_loop_per_worker`` on every worker → poll th
 session queues for reported metrics/checkpoints → persist checkpoints (top-k)
 → on worker failure, restart the group from the latest checkpoint while
 ``FailureConfig.max_failures`` allows (reference ``backend_executor.py:705``).
+
+Elastic fault tolerance (reference: Train v2 elastic worker groups): every
+attempt-ending exception is classified (``ray_tpu/train/elastic.py``) and
+charged to the matching budget —
+
+* **worker_lost / hang** (actor death, lapsed heartbeats, step-watchdog
+  timeout): retried under ``RAY_TPU_MAX_RESTARTS`` with exponential
+  backoff (``RAY_TPU_RESTART_BACKOFF_S`` base, doubling per consecutive
+  zero-progress attempt, capped at ``RAY_TPU_RESTART_BACKOFF_MAX_S``);
+* **preemption**: ``RAY_TPU_MAX_PREEMPTIONS``, immediate restart;
+* **resize** (world-target hints on the preemption pubsub channel, or a
+  grow-back opening detected via the periodic ``RAY_TPU_GROW_CHECK_S``
+  feasibility probe / the GCS capacity-grew hint): ``RAY_TPU_MAX_RESIZES``,
+  immediate restart at the new world size;
+* **user** exceptions: ``FailureConfig.max_failures``, unchanged;
+* **fatal** (repeated-NaN loss, jax.distributed bootstrap failure): the
+  run errors out without consuming any retry budget.
+
+Each restart re-acquires workers (fewer or more), re-forms the mesh at the
+new world size (the loop reads ``get_context().get_world_size()``), and
+resumes from the newest committed checkpoint-plane manifest. Every
+recovery is appended to ``JaxTrainer.recovery_log`` and mirrored to the
+``ray_tpu_train_restarts_total{cause}`` / ``ray_tpu_train_world_size`` /
+``ray_tpu_train_recovery_seconds`` metrics.
 """
 
 from __future__ import annotations
 
 import logging
+import math
 import os
 import tempfile
 import time
@@ -19,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu import exceptions
+from ray_tpu.train import elastic
 from ray_tpu.train.backend_executor import BackendExecutor, JaxBackend
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import (
@@ -42,6 +68,14 @@ class ControllerState:
     RESTARTING = "RESTARTING"
     FINISHED = "FINISHED"
     ERRORED = "ERRORED"
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, default))
 
 
 class JaxTrainer:
@@ -69,6 +103,12 @@ class JaxTrainer:
         self.datasets = datasets
         self.controller_state = ControllerState.INITIALIZING
         self.state_history: List[str] = [ControllerState.INITIALIZING]
+        # One entry per elastic recovery: cause, next world size, planned
+        # backoff, budget line, and (once the next attempt reports) the
+        # failure→first-report recovery time.
+        self.recovery_log: List[Dict[str, Any]] = []
+        self._failure_ts: Optional[float] = None
+        self._attempt_reported = False
 
     def _set_state(self, state: str) -> None:
         if state != self.controller_state:
@@ -77,13 +117,15 @@ class JaxTrainer:
             self.controller_state = state
             self.state_history.append(state)
 
-    def _elastic_worker_target(self) -> int:
-        """How many workers to (re)start with: the full ask when rigid, or
-        whatever the cluster can currently supply down to ``min_workers``
-        when elastic (reference: Train v2 elastic resizing on recovery)."""
+    def _elastic_worker_target(self, explicit: Optional[int] = None) -> int:
+        """How many workers to (re)start with: an explicit resize target
+        when one is latched, else the full ask when rigid, or whatever the
+        cluster can currently supply down to ``min_workers`` when elastic
+        (reference: Train v2 elastic resizing on recovery)."""
         sc = self.scaling_config
-        want = sc.num_workers
+        want = max(int(explicit), 1) if explicit else sc.num_workers
         floor = sc.min_workers if sc.min_workers is not None else want
+        floor = min(floor, want)
         if floor >= want:
             return want
         try:
@@ -100,6 +142,8 @@ class JaxTrainer:
         return max(min(want, feasible), floor)
 
     def fit(self) -> Result:
+        from ray_tpu._private import metrics_defs as mdefs
+
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         rc = self.run_config
@@ -131,103 +175,154 @@ class JaxTrainer:
         )
 
         failure_cfg: FailureConfig = rc.failure_config
-        failures = 0
-        preemptions = 0
-        # Preemptions are routine on TPU pods, not failures: they get
-        # their own (generous) budget instead of consuming max_failures.
-        max_preemptions = int(os.environ.get(
-            "RAY_TPU_MAX_PREEMPTIONS", 64))
+        # Per-cause budgets (elastic.py taxonomy). Preemptions/resizes are
+        # routine on TPU pods, not failures: each gets its own budget
+        # instead of consuming max_failures; infrastructure loss gets the
+        # restart budget.
+        budgets = {
+            elastic.USER: failure_cfg.max_failures,
+            elastic.WORKER_LOST: _env_int("RAY_TPU_MAX_RESTARTS", 16),
+            elastic.HANG: _env_int("RAY_TPU_MAX_RESTARTS", 16),
+            elastic.PREEMPTION: _env_int("RAY_TPU_MAX_PREEMPTIONS", 64),
+            elastic.RESIZE: _env_int("RAY_TPU_MAX_RESIZES", 64),
+        }
+        counts = {k: 0 for k in budgets}
+        # worker_lost and hang share the restart budget.
+        shared_restart = (elastic.WORKER_LOST, elastic.HANG)
+        backoff_base = _env_float("RAY_TPU_RESTART_BACKOFF_S", 1.0)
+        backoff_cap = _env_float("RAY_TPU_RESTART_BACKOFF_MAX_S", 30.0)
+        backoff_streak = 0
+
         restore: Optional[Checkpoint] = self.resume_from_checkpoint
         latest_metrics: Optional[Dict[str, Any]] = None
         history: List[Dict[str, Any]] = []
         error: Optional[BaseException] = None
+        resize_target: Optional[int] = None
+        mtags = {"trainer": type(self).__name__}
+        guard = elastic.ResizeGuard()
 
-        while True:
-            self._set_state(ControllerState.SCHEDULING)
-            target = self._elastic_worker_target()
-            scaling = self.scaling_config
-            if target != scaling.num_workers:
-                import dataclasses as _dc
+        try:
+            while True:
+                self._set_state(ControllerState.SCHEDULING)
+                resize_target = guard.target or resize_target
+                target = self._elastic_worker_target(resize_target)
+                mdefs.TRAIN_WORLD_SIZE.set(float(target), tags=mtags)
+                scaling = self.scaling_config
+                if target != scaling.num_workers:
+                    import dataclasses as _dc
 
-                logger.warning(
-                    "elastic training: starting with %d/%d workers "
-                    "(min_workers=%s)", target, scaling.num_workers,
-                    scaling.min_workers)
-                scaling = _dc.replace(scaling, num_workers=target)
-            executor = BackendExecutor(scaling, self.backend)
-            executor.start()
-            worker_datasets = None
-            if self.datasets:
-                worker_datasets = [
-                    {} for _ in range(scaling.num_workers)]
-                for ds_name, ds in self.datasets.items():
-                    shards = ds.streaming_split(scaling.num_workers,
-                                                name=ds_name)
-                    for rank, it in enumerate(shards):
-                        worker_datasets[rank][ds_name] = it
-            run_refs = executor.start_training(
-                self.train_loop, self.train_loop_config,
-                restore.path if restore else None, run_dir=exp_dir,
-                datasets=worker_datasets)
-            self._set_state(ControllerState.RUNNING)
-            try:
-                self._drive(executor, run_refs, manager, history)
-                latest_metrics = history[-1]["metrics"] if history else None
-                error = None
-                executor.shutdown()
-                self._set_state(ControllerState.FINISHED)
-                break
-            except exceptions.PreemptedError as e:
-                # A worker host is going away (SIGTERM / maintenance
-                # event): the loop already ran its just-in-time save, so
-                # restart and resume from the newest COMMITTED manifest
-                # — the checkpoint plane guarantees readers never see the
-                # half-written one (see ray_tpu/checkpoint/plane.py).
-                executor.shutdown()
-                preemptions += 1
-                if preemptions > max_preemptions:
-                    error = e
-                    latest_metrics = history[-1]["metrics"] if history else None
-                    self._set_state(ControllerState.ERRORED)
-                    break
-                self._set_state(ControllerState.RESTARTING)
+                    logger.warning(
+                        "elastic training: starting with %d/%d workers "
+                        "(min_workers=%s)", target, scaling.num_workers,
+                        scaling.min_workers)
+                    scaling = _dc.replace(scaling, num_workers=target)
+                executor = BackendExecutor(scaling, self.backend)
+                self._attempt_reported = False
                 try:
-                    manager.flush()
-                except Exception as persist_err:  # noqa: BLE001
-                    logger.warning("checkpoint persist failed (%s); "
-                                   "restoring from the previous one",
-                                   persist_err)
-                restore = manager.latest or restore
-                logger.warning(
-                    "worker preempted (%s); resuming from the newest "
-                    "committed checkpoint (preemption %d/%d)",
-                    e.reason, preemptions, max_preemptions)
-            except (exceptions.RayTaskError, exceptions.ActorDiedError,
-                    exceptions.WorkerCrashedError) as e:
-                executor.shutdown()
-                failures += 1
-                recoverable = (failure_cfg.max_failures < 0
-                               or failures <= failure_cfg.max_failures)
-                if not recoverable:
-                    error = e
-                    latest_metrics = history[-1]["metrics"] if history else None
-                    self._set_state(ControllerState.ERRORED)
+                    executor.start()
+                    # Clear the ask this attempt serves — at its exact
+                    # value, even when capacity only allowed a smaller
+                    # world (an unsatisfiable ask must not re-trigger a
+                    # zero-backoff resize loop; the periodic grow probe
+                    # finishes the job when capacity appears). A newer
+                    # ask that raced in stays latched.
+                    guard.clear_target(resize_target
+                                       if resize_target is not None
+                                       else target)
+                    worker_datasets = None
+                    if self.datasets:
+                        worker_datasets = [
+                            {} for _ in range(scaling.num_workers)]
+                        for ds_name, ds in self.datasets.items():
+                            shards = ds.streaming_split(
+                                scaling.num_workers, name=ds_name)
+                            for rank, it in enumerate(shards):
+                                worker_datasets[rank][ds_name] = it
+                    run_refs = executor.start_training(
+                        self.train_loop, self.train_loop_config,
+                        restore.path if restore else None, run_dir=exp_dir,
+                        datasets=worker_datasets)
+                    self._set_state(ControllerState.RUNNING)
+                    self._drive(executor, run_refs, manager, history,
+                                guard, scaling.num_workers, resize_target)
+                    latest_metrics = (history[-1]["metrics"]
+                                      if history else None)
+                    error = None
+                    executor.shutdown()
+                    self._set_state(ControllerState.FINISHED)
                     break
-                self._set_state(ControllerState.RESTARTING)
-                try:
-                    # Restore only from fully-persisted dirs; a failed
-                    # async persist drops its entry and must not abort
-                    # the recovery it exists to serve.
-                    manager.flush()
-                except Exception as persist_err:  # noqa: BLE001
-                    logger.warning("checkpoint persist failed (%s); "
-                                   "restoring from the previous one",
-                                   persist_err)
-                restore = manager.latest or restore
-                logger.warning(
-                    "Training attempt %d failed (%s); restarting from %s",
-                    failures, e,
-                    restore.path if restore else "scratch")
+                except BaseException as e:  # noqa: BLE001 — classified below
+                    executor.shutdown()
+                    if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                        raise
+                    cause = elastic.classify_failure(e)
+                    # A graceful drain raced a resize ask: workers that
+                    # preempt-out while a world-target is latched are the
+                    # resize happening, not a preemption.
+                    if cause == elastic.PREEMPTION and \
+                            guard.target is not None:
+                        cause = elastic.RESIZE
+                    if isinstance(e, elastic.ResizeRequested):
+                        resize_target = e.world_target
+                    if self._attempt_reported:
+                        backoff_streak = 0
+                    if cause == elastic.FATAL:
+                        error = e
+                        latest_metrics = (history[-1]["metrics"]
+                                          if history else None)
+                        self._set_state(ControllerState.ERRORED)
+                        break
+                    counts[cause] += 1
+                    if cause in shared_restart:
+                        used = sum(counts[k] for k in shared_restart)
+                        budget = budgets[elastic.WORKER_LOST]
+                    else:
+                        used = counts[cause]
+                        budget = budgets[cause]
+                    recoverable = budget < 0 or used <= budget
+                    if not recoverable:
+                        error = e
+                        latest_metrics = (history[-1]["metrics"]
+                                          if history else None)
+                        self._set_state(ControllerState.ERRORED)
+                        break
+                    self._set_state(ControllerState.RESTARTING)
+                    mdefs.TRAIN_RESTARTS.inc(tags={**mtags,
+                                                   "cause": cause})
+                    try:
+                        # Restore only from fully-persisted dirs; a failed
+                        # async persist drops its entry and must not abort
+                        # the recovery it exists to serve.
+                        manager.flush()
+                    except Exception as persist_err:  # noqa: BLE001
+                        logger.warning("checkpoint persist failed (%s); "
+                                       "restoring from the previous one",
+                                       persist_err)
+                    restore = manager.latest or restore
+                    if cause in (elastic.PREEMPTION, elastic.RESIZE):
+                        backoff = 0.0  # the host is going / capacity moved
+                    else:
+                        backoff = min(
+                            backoff_base * math.pow(2, backoff_streak),
+                            backoff_cap)
+                        backoff_streak += 1
+                    self._failure_ts = time.monotonic()
+                    self.recovery_log.append({
+                        "cause": cause, "error": str(e)[:200],
+                        "rank": getattr(e, "failed_rank", None),
+                        "backoff_s": backoff,
+                        "budget": f"{used}/{budget}",
+                        "world_target": resize_target, "ts": time.time()})
+                    logger.warning(
+                        "training attempt ended (%s: %s); restarting from "
+                        "%s in %.2fs (budget %d/%s)", cause, e,
+                        restore.path if restore else
+                        "the newest committed manifest", backoff, used,
+                        budget)
+                    if backoff:
+                        time.sleep(backoff)
+        finally:
+            guard.close()
 
         try:
             manager.close()
@@ -243,13 +338,41 @@ class JaxTrainer:
         )
 
     # ------------------------------------------------------------------
+    def _watchdog_s(self) -> float:
+        w = self.run_config.failure_config.watchdog_s
+        if w is None:
+            w = _env_float("RAY_TPU_STEP_WATCHDOG_S", 0.0)
+        return float(w)
+
+    def _nan_fatal_reports(self) -> int:
+        n = self.run_config.failure_config.nan_fatal_reports
+        if n is None:
+            n = _env_int("RAY_TPU_NAN_FATAL_REPORTS", 0)
+        return int(n)
+
     def _drive(self, executor: BackendExecutor, run_refs,
-               manager: CheckpointManager, history: List[Dict[str, Any]]):
-        """Poll session queues until every worker's run() completes."""
+               manager: CheckpointManager, history: List[Dict[str, Any]],
+               guard: elastic.ResizeGuard, current_world: int,
+               explicit_world: Optional[int] = None):
+        """Poll session queues until every worker's run() completes.
+
+        Also the detection loop: the per-step watchdog, the fatal-NaN
+        guard, and resize triggers (explicit world-target hints; periodic
+        grow-back feasibility probes) all run off this poll cadence —
+        ``executor.poll()`` itself raises on actor death and heartbeat
+        lapses."""
         from ray_tpu._private import metrics_defs as mdefs
 
         mtags = {"trainer": type(self).__name__}
         last_report_ts = 0.0
+        watchdog_s = self._watchdog_s()
+        nan_fatal = self._nan_fatal_reports()
+        nan_streak = 0
+        grow_check_s = _env_float("RAY_TPU_GROW_CHECK_S", 30.0)
+        started = time.monotonic()
+        last_progress = started
+        next_grow_check = started + grow_check_s
+        first_report_seen = False
 
         def observe_round(metrics, nreports):
             """Per-step observability: report cadence is the step cadence
@@ -293,8 +416,71 @@ class JaxTrainer:
                         Checkpoint(ckpt_path), metrics or {})
                     entry["checkpoint"] = persisted
                 history.append(entry)
+                # Fatal-NaN guard: consecutive non-finite losses mean a
+                # restart would replay the same divergence.
+                loss = (metrics or {}).get("loss")
+                if isinstance(loss, (int, float)):
+                    if not math.isfinite(float(loss)):
+                        nan_streak += 1
+                        if nan_fatal and nan_streak >= nan_fatal:
+                            raise exceptions.NaNLossError(
+                                reports=nan_streak)
+                    else:
+                        nan_streak = 0
             if max_reports:
                 observe_round(metrics, max_reports)
+                now = time.monotonic()
+                last_progress = now
+                self._attempt_reported = True
+                if not first_report_seen:
+                    first_report_seen = True
+                    if self._failure_ts is not None:
+                        recovery_s = now - self._failure_ts
+                        mdefs.TRAIN_RECOVERY_SECONDS.observe(
+                            recovery_s, tags=mtags)
+                        if self.recovery_log:
+                            self.recovery_log[-1]["recovery_s"] = \
+                                recovery_s
+                        self._failure_ts = None
+            # Per-step watchdog: a hung collective stalls every worker's
+            # report stream while heartbeats keep flowing. Before the
+            # first report the deadline is 10x (compile headroom).
+            if watchdog_s > 0:
+                deadline = watchdog_s if first_report_seen \
+                    else watchdog_s * 10.0
+                stalled = time.monotonic() - last_progress
+                if stalled > deadline:
+                    raise exceptions.WorkerHangError(
+                        f"step watchdog: no report for {stalled:.1f}s "
+                        f"(deadline {deadline:.1f}s)", kind="watchdog")
+            # Resize triggers: explicit world-target hints win; otherwise
+            # a periodic feasibility probe grows a shrunk group back when
+            # capacity returns (the GCS capacity-grew pubsub hint makes
+            # the probe immediate).
+            wt = guard.target
+            if wt is not None:
+                if wt != current_world:
+                    raise elastic.ResizeRequested(
+                        wt, reason="world-target hint")
+                # A no-op ask (already at this world) must unlatch, or a
+                # later genuine preemption would be reclassified as a
+                # resize by fit()'s latched-target check.
+                guard.clear_target(wt)
+            now = time.monotonic()
+            if guard.take_grow_hint():
+                next_grow_check = now
+            if grow_check_s > 0 and now >= next_grow_check:
+                next_grow_check = now + grow_check_s
+                # Grow back toward the full ask when capacity returns —
+                # but never undo an operator's explicit shrink: a world
+                # size the operator asked for by name is not a
+                # capacity-driven degradation.
+                if current_world < self.scaling_config.num_workers and \
+                        current_world != explicit_world:
+                    feasible = self._elastic_worker_target(None)
+                    if feasible > current_world:
+                        raise elastic.ResizeRequested(
+                            feasible, reason="capacity returned")
 
             done, _ = ray_tpu.wait(run_refs, num_returns=len(run_refs),
                                    timeout=0.02)

@@ -37,6 +37,10 @@ def pytest_configure(config):
         "markers",
         "slow: expensive test excluded from the tier-1 window "
         "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection test driven by the deterministic chaos "
+        "harness (ray_tpu/_private/chaos.py); fast ones stay in tier-1")
 
 
 @pytest.fixture

@@ -192,6 +192,29 @@ def test_serve_ingress_and_engine_admission_emit_spans():
     assert callable(getattr(proxy, "ingress_request_context"))
 
 
+def test_train_elasticity_series_are_cataloged():
+    """The elastic-trainer series (restarts by cause, current world
+    size, failure-to-first-report recovery time) ship described + tagged
+    in the catalog — the dashboard 'Train / elasticity' panel and the
+    ISSUE-10 acceptance criteria read them."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_train_restarts_total",
+        "ray_tpu_train_world_size",
+        "ray_tpu_train_recovery_seconds",
+    }
+    missing = required - names
+    assert not missing, (
+        f"train-elasticity series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name in required:
+            assert m.description.strip() and "trainer" in m.tag_keys
+        if m.name == "ray_tpu_train_restarts_total":
+            # The failure taxonomy rides the cause tag
+            # (worker_lost/hang/preemption/resize/user).
+            assert "cause" in m.tag_keys
+
+
 def test_checkpoint_plane_series_are_cataloged():
     """The checkpoint plane's series (ray_tpu/checkpoint/) ship described
     + tagged in the catalog, including the acceptance-criteria
