@@ -41,8 +41,16 @@ Actions:
                     Optional ``resize=N`` publishes a world-target hint on
                     the preemption channel first (models losing a node the
                     cluster cannot replace).
-``slow_step``       sleeps ``secs`` at the step boundary — a hung/slow
-                    collective; the controller's step watchdog should fire.
+``slow_step``       sleeps ``secs`` at the step boundary. One firing
+                    (the default ``times=1``) models a hung/slow
+                    collective the step watchdog should catch;
+                    ``times=-1`` makes the rule UNLIMITED — a
+                    persistently slow rank, the fault that drives
+                    straggler detection. ``jitter=J`` scales each delay
+                    by a seed-deterministic factor in ``[1, 1+J)`` (a
+                    pure function of seed/rule/coordinates, so replays
+                    see identical delays); the applied delay is
+                    returned as ``{"slept_s": x}`` and logged.
 ``drop_heartbeat``  the train worker's heartbeat thread skips a beat
                     (``times=N`` beats total) — drives lapsed-heartbeat
                     detection without stopping step progress.
@@ -61,8 +69,8 @@ Actions:
 =================  =========================================================
 
 Matching keys (all optional): ``rank``, ``step``, ``proc``, ``node``,
-``run``. ``times`` caps firings (default 1); ``p`` makes the rule
-probabilistic. Rules fire at the site their action belongs to; firing
+``run``. ``times`` caps firings (default 1; ``-1`` = unlimited); ``p``
+makes the rule probabilistic. Rules fire at the site their action belongs to; firing
 state is process-local (in the in-process runtime this means a rule fired
 before a simulated death stays fired across the restart, exactly like a
 fault that already happened).
@@ -114,7 +122,7 @@ _ACTION_SITES = {
 }
 _MATCH_KEYS = ("rank", "step", "proc", "node", "run")
 _INT_PARAMS = ("rank", "step", "proc", "times", "resize", "world")
-_FLOAT_PARAMS = ("secs", "p")
+_FLOAT_PARAMS = ("secs", "p", "jitter")
 
 
 class ChaosRule:
@@ -247,15 +255,22 @@ def _clear_dying() -> None:
     _tls.dying = False
 
 
-def _coin(plan: ChaosPlan, rule: ChaosRule,
-          site: str, coords: Dict[str, Any]) -> bool:
-    """Deterministic Bernoulli draw: pure function of (seed, rule, site,
-    coords) so a replay with the same seed injects the same sequence."""
+def _unit(plan: ChaosPlan, rule: ChaosRule,
+          site: str, coords: Dict[str, Any]) -> float:
+    """Deterministic unit draw in [0, 1): pure function of (seed, rule,
+    site, coords) so a replay with the same seed sees the same values —
+    the basis for both Bernoulli rules and jittered delays."""
     key = f"{plan.seed}:{rule.id}:{site}:" + ",".join(
         f"{k}={coords[k]}" for k in sorted(coords)
         if isinstance(coords[k], (int, str)))
     h = zlib.crc32(key.encode())
-    return random.Random(h).random() < float(rule.p)
+    return random.Random(h).random()
+
+
+def _coin(plan: ChaosPlan, rule: ChaosRule,
+          site: str, coords: Dict[str, Any]) -> bool:
+    """Deterministic Bernoulli draw (see :func:`_unit`)."""
+    return _unit(plan, rule, site, coords) < float(rule.p)
 
 
 def inject(site: str, **coords: Any) -> Optional[Dict[str, Any]]:
@@ -275,7 +290,9 @@ def inject(site: str, **coords: Any) -> Optional[Dict[str, Any]]:
         if not rule.matches(site, coords):
             continue
         with _lock:
-            if _fired.get(rule.id, 0) >= rule.times:
+            # times=-1 = unlimited (a persistently slow rank for the
+            # straggler suite); otherwise cap firings.
+            if rule.times >= 0 and _fired.get(rule.id, 0) >= rule.times:
                 continue
             if rule.p is not None and not _coin(plan, rule, site, coords):
                 continue
@@ -286,12 +303,12 @@ def inject(site: str, **coords: Any) -> Optional[Dict[str, Any]]:
                     "rule": rule.id, "ts": time.time(),
                     "coords": {k: v for k, v in coords.items()
                                if isinstance(v, (int, float, str))}})
-        _apply(rule, site, coords, directives)
+        _apply(plan, rule, site, coords, directives)
     return directives or None
 
 
-def _apply(rule: ChaosRule, site: str, coords: Dict[str, Any],
-           directives: Dict[str, Any]) -> None:
+def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
+           coords: Dict[str, Any], directives: Dict[str, Any]) -> None:
     action = rule.action
     logger.warning("chaos: injecting %s at %s %s", action, site, coords)
     if action == "kill_worker":
@@ -304,7 +321,19 @@ def _apply(rule: ChaosRule, site: str, coords: Dict[str, Any],
         raise SimulatedProcessDeath(
             f"chaos kill_worker at {site} {coords}")
     if action == "slow_step":
-        time.sleep(float(rule.params.get("secs", 1.0)))
+        delay = float(rule.params.get("secs", 1.0))
+        jitter = rule.params.get("jitter")
+        if jitter:
+            # Seed-deterministic latency: scale by [1, 1+jitter) drawn
+            # purely from (seed, rule, coords) — replays see the exact
+            # same per-step delays. The draw key is SALTED so a rule
+            # that also uses p= gets an independent value (reusing the
+            # Bernoulli draw would confine fired delays to [1, 1+J*p)).
+            delay *= 1.0 + float(jitter) * _unit(plan, rule,
+                                                 site + ":jitter",
+                                                 coords)
+        time.sleep(delay)
+        directives["slept_s"] = delay
     elif action == "resize":
         _publish_resize(int(rule.params["world"]), reason="chaos-resize")
     elif action == "fail_shard_write":

@@ -226,6 +226,34 @@ TRAIN_RECOVERY_SECONDS = Histogram(
     boundaries=(0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 180.0, 600.0,
                 1800.0),
     tag_keys=("trainer",))
+TRAIN_GOODPUT_SECONDS = Counter(
+    "ray_tpu_train_goodput_seconds_total",
+    "Attempt wall clock attributed by the goodput ledger, by component: "
+    "step (productive: dispatching / free-running ahead of the device), "
+    "input_stall (empty prefetch buffer), sync (windowed metric fetch), "
+    "ckpt_block (checkpoint device->host snapshot), recovery (elastic "
+    "recovery dead time + restore) — rank-0 ledger deltas plus the "
+    "controller's inter-session recovery time",
+    ("trainer", "component"))
+TRAIN_GOODPUT_FRACTION = Gauge(
+    "ray_tpu_train_goodput_fraction",
+    "Fraction of the current attempt's wall clock per goodput-ledger "
+    "component (components sum to 1; the dashboard stacks them)",
+    ("trainer", "component"))
+TRAIN_RANK_STEP_SECONDS = Histogram(
+    "ray_tpu_train_rank_step_seconds",
+    "Per-rank step wall time (dispatch->report gap recorded by each "
+    "worker's session) — the controller's window merge of these feeds "
+    "rank-skew scoring and straggler detection",
+    boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0,
+                120.0),
+    tag_keys=("trainer", "rank"))
+TRAIN_STRAGGLER = Gauge(
+    "ray_tpu_train_straggler",
+    "1 while a rank is flagged as a straggler (mean step time over "
+    "RAY_TPU_STRAGGLER_FACTOR x the window median for "
+    "RAY_TPU_STRAGGLER_WINDOWS consecutive windows), 0 once cleared",
+    ("trainer", "rank"))
 TRAIN_INPUT_STALL = Histogram(
     "ray_tpu_train_input_stall_seconds",
     "Per-batch time the train loop sat blocked on an empty device-"

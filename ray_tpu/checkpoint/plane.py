@@ -258,6 +258,12 @@ class CheckpointPlane:
             shard_sets.append(recs)
         blocked_ms = (time.perf_counter() - t0) * 1000.0
         mdefs.CKPT_BLOCK_MS.observe(blocked_ms, tags=self._mtags)
+        # Goodput attribution: the device→host snapshot is the only leg
+        # that blocks the step loop — inside a training session it lands
+        # in the attempt ledger's ckpt_block component (no-op elsewhere).
+        from ray_tpu.train import goodput
+
+        goodput.note_ambient("ckpt_block", blocked_ms / 1e3)
         future = self._executor.submit(
             self._persist, int(step), treedef, spec_leaves, shard_sets,
             time.perf_counter())
@@ -545,10 +551,17 @@ class CheckpointPlane:
                     f"step {step} has {len(host_leaves)}")
             out_leaves = [jax.device_put(a, s)
                           for a, s in zip(host_leaves, shardings)]
-        mdefs.CKPT_RESTORE_SECONDS.observe(time.perf_counter() - t0,
-                                           tags=self._mtags)
+        restore_s = time.perf_counter() - t0
+        mdefs.CKPT_RESTORE_SECONDS.observe(restore_s, tags=self._mtags)
         mdefs.CKPT_BYTES.inc(total, tags={**self._mtags,
                                           "direction": "restore"})
+        # The worker-side restore leg of an elastic recovery spends this
+        # attempt's wall clock: attribute it to the ledger's recovery
+        # component (the controller-side recovery metric/trace covers
+        # the full detection→first-step pipeline).
+        from ray_tpu.train import goodput
+
+        goodput.note_ambient("recovery", restore_s)
         import jax
 
         return jax.tree.unflatten(treedef, out_leaves)

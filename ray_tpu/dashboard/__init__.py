@@ -94,6 +94,8 @@ prefill; TPOT)</h2><div id="reqlat"></div>
 <h2>Serve / replica pressure</h2><table id="pressure"></table>
 <h2>Train / input pipeline (stall, prefetch occupancy, bytes/s)</h2>
 <div id="ingest"></div>
+<h2>Train / goodput &amp; stragglers (wall-clock attribution, per-rank
+step skew)</h2><div id="goodput"></div>
 <h2>Train / elasticity (restarts by cause, world size, recovery time)</h2>
 <div id="elastic"></div>
 <h2>Metrics (last 5 min)</h2><div id="metrics"></div>
@@ -212,11 +214,58 @@ async function ingestPanel(){
   // whether the data plane or the device is the bottleneck; prefetch
   // occupancy flatlining at 0 with stalls climbing means the producer
   // (host decode / object store) can't keep up; the ingest bytes
-  // counter's slope is the training data-plane bytes/s.
-  const data=await j("/api/v1/metrics/query?series=ray_tpu_train_*"+
-                     "&since=300&agg=avg&step=3&limit=30");
+  // counter's slope is the training data-plane bytes/s. Queried by
+  // family (not the bare ray_tpu_train_* prefix) so the goodput/
+  // straggler and elasticity series stay in their own panels.
+  const parts=await Promise.all([
+    j("/api/v1/metrics/query?series=ray_tpu_train_input_stall_*"+
+      "&since=300&agg=avg&step=3&limit=10"),
+    j("/api/v1/metrics/query?series=ray_tpu_train_prefetch_*"+
+      "&since=300&agg=avg&step=3&limit=10"),
+    j("/api/v1/metrics/query?series=ray_tpu_train_ingest_bytes_total"+
+      "&since=300&agg=avg&step=3&limit=10"),
+    j("/api/v1/metrics/query?series=ray_tpu_train_step_seconds*"+
+      "&since=300&agg=avg&step=3&limit=10"),
+    j("/api/v1/metrics/query?series=ray_tpu_train_tokens_per_s"+
+      "&since=300&agg=avg&step=3&limit=10"),
+    j("/api/v1/metrics/query?series=ray_tpu_train_reports_total"+
+      "&since=300&agg=last&step=3&limit=10")]);
   document.getElementById("ingest").innerHTML=
-    sparkRows(data,30)||"(no training ingest telemetry)";
+    sparkRows([].concat(...parts),30)||"(no training ingest telemetry)";
+}
+async function goodputPanel(){
+  // Goodput ledger: one stacked bar of the current attempt's wall-clock
+  // attribution (step green = productive; stalls/sync/ckpt/recovery are
+  // the badput the ledger names), plus per-rank step-time sparklines —
+  // one rank's line drifting above the others IS the straggler, and the
+  // straggler flag gauge stepping to 1 is the detector agreeing.
+  const GCOL={step:"#7c6",input_stall:"#e66",sync:"#8cf",
+              ckpt_block:"#fc6",recovery:"#c6f"};
+  const frac=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_train_goodput_fraction&since=300&agg=last&step=3"+
+    "&limit=12");
+  let bar="",legend="";
+  for(const s of frac){
+    const c=s.labels.component||"?";
+    const v=s.points.length?s.points[s.points.length-1][1]:0;
+    if(v<=0)continue;
+    bar+=`<div style="display:inline-block;height:14px;`+
+      `width:${(v*100).toFixed(2)}%;background:${GCOL[c]||"#555"}" `+
+      `title="${esc(c)} ${(v*100).toFixed(1)}%"></div>`;
+    legend+=`<span style="color:${GCOL[c]||"#555"}">&#9632;</span> `+
+      `${esc(c)} ${(v*100).toFixed(1)}% &nbsp;`;
+  }
+  const rank=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_train_rank_step_seconds*&since=300&agg=avg&step=3"+
+    "&limit=20");
+  const strag=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_train_straggler&since=300&agg=last&step=3&limit=10");
+  document.getElementById("goodput").innerHTML=
+    (bar?`<div style="border:1px solid #333;line-height:0">${bar}</div>`+
+         `<div style="font-size:.72rem;margin:.15rem 0">${legend}</div>`
+        :"")+
+    (sparkRows(rank.concat(strag),30)||
+     (bar?"":"(no train goodput telemetry)"));
 }
 async function elasticPanel(){
   // Elastic-trainer vitals: restarts_total{cause} stepping up says WHAT
@@ -290,6 +339,7 @@ async function refresh(){
     await prefixPanel();
     await requestLatencyPanel();
     await ingestPanel();
+    await goodputPanel();
     await elasticPanel();
     await xlaPanel();
     document.getElementById("status").textContent=

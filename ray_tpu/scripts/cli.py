@@ -195,16 +195,26 @@ def cmd_timeline(args):
 
 
 def cmd_trace(args):
-    """Request-path tracing: reconstruct ONE serve request's life as a
-    chrome-trace timeline (``ray-tpu trace request <id>``).
+    """Path tracing: reconstruct ONE serve request's — or ONE training
+    run's — life as a chrome-trace timeline.
 
-    The id can be the request id (``x-request-id`` header, or minted at
-    ingress and carried on every span of the request) or a trace id.
-    Matching finds the request's trace, then pulls EVERY span sharing
-    its trace id — ingress, route, replica dispatch, engine queue /
-    arena-wait / prefill, and per-sync-window decode spans — and prints
-    an offset-ordered summary plus a chrome://tracing / perfetto JSON
-    file. Spans exist only when the cluster ran with RAY_TPU_TRACING=1."""
+    ``ray-tpu trace request <id>``: the id can be the request id
+    (``x-request-id`` header, or minted at ingress and carried on every
+    span of the request) or a trace id. Matching finds the request's
+    trace, then pulls EVERY span sharing its trace id — ingress, route,
+    replica dispatch, engine queue / arena-wait / prefill, and
+    per-sync-window decode spans.
+
+    ``ray-tpu trace train <run>``: the id is the run name
+    (``RunConfig.name``) or a trace id; the trace spans the whole run —
+    ``train.run`` → per-attempt ``train.attempt`` → scored
+    ``train.step_window`` spans, plus a ``train.recovery`` tree
+    (teardown / backoff / reacquire / restore_first_step) per elastic
+    recovery. Multiple runs may share a name; the newest is shown.
+
+    Both print an offset-ordered summary plus a chrome://tracing /
+    perfetto JSON file. Spans exist only when the cluster ran with
+    RAY_TPU_TRACING=1."""
     _connect(args)
     from ray_tpu.util import state
     from ray_tpu.util.tracing import spans_to_chrome_events
@@ -212,19 +222,40 @@ def cmd_trace(args):
     spans = [e for e in state.list_tasks(limit=100000, include_spans=True)
              if e.get("state") == "SPAN"]
     want = args.id
-    trace_ids = {e["trace_id"] for e in spans
-                 if want in (e.get("request_id"), e.get("trace_id"))}
-    if not trace_ids:
-        raise SystemExit(
-            f"no spans found for request/trace id {want!r} — was the "
-            f"cluster started with RAY_TPU_TRACING=1, and has the span "
-            f"buffer flushed (reporters flush every 0.2s)? Drops are "
-            f"counted in ray_tpu_events_dropped_total.")
-    if len(trace_ids) > 1:
-        raise SystemExit(
-            f"id {want!r} matches {len(trace_ids)} traces — pass the "
-            f"full request id from the x-request-id header")
-    trace_id = trace_ids.pop()
+    if args.kind == "train":
+        matched = [e for e in spans
+                   if e["name"].startswith("train.")
+                   and want in (e.get("run"), e.get("trace_id"))]
+        if not matched:
+            raise SystemExit(
+                f"no train spans found for run/trace id {want!r} — was "
+                f"the trainer started with RAY_TPU_TRACING=1, and has "
+                f"the span buffer flushed (reporters flush every 0.2s)? "
+                f"Drops are counted in ray_tpu_events_dropped_total.")
+        by_trace = {}
+        for e in matched:
+            by_trace.setdefault(e["trace_id"], []).append(e["ts"])
+        # Several runs can share a name (restarted experiments): show
+        # the newest and say so.
+        trace_id = max(by_trace, key=lambda t: max(by_trace[t]))
+        if len(by_trace) > 1:
+            print(f"note: {len(by_trace)} runs named {want!r} have "
+                  f"spans; showing the newest (trace {trace_id}) — "
+                  f"pass a trace id to pick another")
+    else:
+        trace_ids = {e["trace_id"] for e in spans
+                     if want in (e.get("request_id"), e.get("trace_id"))}
+        if not trace_ids:
+            raise SystemExit(
+                f"no spans found for request/trace id {want!r} — was the "
+                f"cluster started with RAY_TPU_TRACING=1, and has the "
+                f"span buffer flushed (reporters flush every 0.2s)? "
+                f"Drops are counted in ray_tpu_events_dropped_total.")
+        if len(trace_ids) > 1:
+            raise SystemExit(
+                f"id {want!r} matches {len(trace_ids)} traces — pass the "
+                f"full request id from the x-request-id header")
+        trace_id = trace_ids.pop()
     mine = sorted((e for e in spans if e["trace_id"] == trace_id),
                   key=lambda e: e["ts"])
     out = args.output or f"ray-tpu-trace-{want[:16]}.json"
@@ -238,6 +269,10 @@ def cmd_trace(args):
         extra = ""
         if e.get("tokens") is not None:
             extra = f"  tokens={e['tokens']}"
+        for k in ("attempt", "world", "window", "cause", "outcome",
+                  "max_skew", "stragglers"):
+            if e.get(k) not in (None, ""):
+                extra += f"  {k}={e[k]}"
         print(f"  +{off_ms:9.2f}ms {dur_ms:9.2f}ms  {e['name']:24} "
               f"[{e.get('kind', '')}] worker={e.get('worker_id', '')}"
               f"{extra}")
@@ -820,13 +855,17 @@ def main(argv=None):
     p.set_defaults(fn=cmd_timeline)
 
     p = sub.add_parser("trace",
-                       help="request-path traces: 'trace request <id>' "
-                            "dumps one serve request's chrome-trace "
-                            "timeline (requires RAY_TPU_TRACING=1)")
-    p.add_argument("kind", choices=["request"],
-                   help="what to trace (currently: one serve request)")
+                       help="path traces: 'trace request <id>' dumps one "
+                            "serve request's chrome-trace timeline, "
+                            "'trace train <run>' one training run's "
+                            "(attempts, step windows, elastic "
+                            "recoveries); requires RAY_TPU_TRACING=1")
+    p.add_argument("kind", choices=["request", "train"],
+                   help="what to trace: one serve request, or one "
+                        "training run")
     p.add_argument("id",
-                   help="request id (x-request-id) or trace id")
+                   help="request id (x-request-id) / trace id, or the "
+                        "training run name (RunConfig.name)")
     p.add_argument("--address")
     p.add_argument("--output", "-o",
                    help="chrome-trace JSON path (default: "

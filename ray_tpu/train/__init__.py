@@ -25,6 +25,7 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train.elastic import ResizeGuard, request_resize
+from ray_tpu.train.goodput import GoodputLedger, StragglerDetector
 from ray_tpu.train.ingest import DevicePrefetcher, prefetch_to_device
 from ray_tpu.train.loop import AsyncStepLoop
 from ray_tpu.train.session import (
@@ -40,9 +41,10 @@ from ray_tpu.train.trainer import ControllerState, JaxTrainer
 __all__ = [
     "AsyncCheckpointer", "AsyncStepLoop", "BackendExecutor", "Checkpoint",
     "CheckpointConfig", "CheckpointManager", "ControllerState",
-    "DevicePrefetcher", "FailureConfig", "JaxBackend", "JaxTrainer",
-    "ResizeGuard", "Result", "RunConfig", "ScalingConfig",
-    "StorageContext", "TrainWorker", "WorkerGroup", "get_checkpoint",
+    "DevicePrefetcher", "FailureConfig", "GoodputLedger", "JaxBackend",
+    "JaxTrainer", "ResizeGuard", "Result", "RunConfig", "ScalingConfig",
+    "StorageContext", "StragglerDetector", "TrainWorker", "WorkerGroup",
+    "get_checkpoint",
     "get_checkpoint_plane", "get_context", "get_dataset_shard",
     "load_pytree", "prefetch_to_device", "report", "request_resize",
     "save_pytree",

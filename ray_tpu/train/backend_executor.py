@@ -217,6 +217,7 @@ class TrainWorker:
                     s.checkpoint_plane.close()
                 except Exception:  # noqa: BLE001 — loop outcome wins
                     logger.exception("checkpoint plane close failed")
+            s.ledger.close()  # freeze the attempt's goodput wall clock
             s.finished.set()
             session_mod._set_session(None)
 
@@ -238,7 +239,11 @@ class TrainWorker:
             reports.append(r)
         return {"reports": reports, "finished": s.finished.is_set(),
                 "heartbeat_ts": self._hb_ts,
-                "progress_ts": s.progress_ts, "last_step": s.last_step}
+                "progress_ts": s.progress_ts, "last_step": s.last_step,
+                # Goodput ledger snapshot (components sum to wall_s):
+                # the controller differences consecutive snapshots into
+                # ray_tpu_train_goodput_seconds_total{component}.
+                "ledger": s.ledger.snapshot()}
 
 
 class WorkerGroup:

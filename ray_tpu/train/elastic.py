@@ -38,7 +38,9 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Dict, Optional
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu import exceptions
 
@@ -103,6 +105,75 @@ def request_resize(num_workers: int, reason: str = "operator-resize",
 
     return publish_preempt(reason=reason, gcs_address=gcs_address,
                            world_target=int(num_workers))
+
+
+class RecoveryTrace:
+    """Controller-side bookkeeping for ONE elastic recovery, emitted as
+    a connected trace when the restarted attempt's first report lands.
+
+    The controller walks the restart path phase by phase — teardown
+    (group stop + kill + zombie join), backoff sleep, re-acquire
+    (worker actors + backend ``on_start`` = jax.distributed mesh
+    re-formation) — and :meth:`close` turns them into retrospective
+    spans: one ``train.recovery`` parent whose children tile its
+    duration exactly, the tail (``restore_first_step``: restore from
+    the newest intact manifest through the first post-restore report)
+    being the residual. The parent's duration is the SAME value
+    observed into ``ray_tpu_train_recovery_seconds``, so the trace and
+    the metric can never drift apart."""
+
+    def __init__(self, trace_id: str, parent_span_id: str, run: str,
+                 cause: str, attempt: int):
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+        self.run = run
+        self.cause = cause
+        self.attempt = attempt
+        self.t0_wall = time.time()
+        self.phases: List[Tuple[str, float]] = []  # ordered (name, dur)
+
+    def phase(self, name: str, dur_s: float) -> None:
+        self.phases.append((name, max(float(dur_s), 0.0)))
+
+    @contextmanager
+    def timed_phase(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phase(name, time.perf_counter() - t0)
+
+    def close(self, recovery_s: float,
+              outcome: str = "recovered") -> str:
+        """Emit the recovery span tree; returns the parent span id
+        ('' with tracing off). ``outcome="failed"`` marks a recovery
+        whose restarted attempt died before its first report (the next
+        recovery's trace then covers the follow-up)."""
+        from ray_tpu.util import tracing
+
+        if not tracing.enabled():
+            return ""
+        rid = tracing.gen_id()
+        tracing.emit_span(
+            "train.recovery", trace_id=self.trace_id, ts=self.t0_wall,
+            dur=recovery_s, span_id=rid,
+            parent_span_id=self.parent_span_id, kind="train",
+            run=self.run, cause=self.cause, attempt=self.attempt,
+            outcome=outcome)
+        cursor, used = self.t0_wall, 0.0
+        for name, dur in self.phases:
+            dur = min(dur, max(recovery_s - used, 0.0))
+            tracing.emit_span(
+                f"train.recovery.{name}", trace_id=self.trace_id,
+                ts=cursor, dur=dur, parent_span_id=rid, kind="train",
+                run=self.run)
+            cursor += dur
+            used += dur
+        tracing.emit_span(
+            "train.recovery.restore_first_step", trace_id=self.trace_id,
+            ts=cursor, dur=max(recovery_s - used, 0.0),
+            parent_span_id=rid, kind="train", run=self.run)
+        return rid
 
 
 class ResizeGuard:

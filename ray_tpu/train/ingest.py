@@ -67,11 +67,15 @@ class DevicePrefetcher:
     def __init__(self, source: Iterable[Any], sharding=None, *,
                  depth: int = 2,
                  decode_fn: Optional[Callable[[Any], Any]] = None,
-                 name: str = "train"):
+                 name: str = "train", ledger=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.name = name
         self.depth = depth
+        # Goodput attribution: consumer-side stalls land in a ledger —
+        # the one passed explicitly (benches/tests), else the ambient
+        # training session's (resolved per get; no-op outside one).
+        self._ledger = ledger
         self._source = iter(source)
         self._sharding = _resolve_sharding(sharding)
         self._decode = decode_fn
@@ -150,6 +154,12 @@ class DevicePrefetcher:
         stall = time.perf_counter() - t0
         tags = {"iterator": self.name}
         mdefs.TRAIN_INPUT_STALL.observe(stall, tags=tags)
+        from ray_tpu.train import goodput
+
+        if self._ledger is not None:
+            self._ledger.note("input_stall", stall)
+        else:
+            goodput.note_ambient("input_stall", stall)
         mdefs.TRAIN_PREFETCH_OCCUPANCY.set(
             self._q.qsize() / self.depth, tags=tags)
         with self._lock:

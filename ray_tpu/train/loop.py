@@ -30,13 +30,17 @@ class AsyncStepLoop:
     """
 
     def __init__(self, trainer, state, *, sync_every: int = 4,
-                 name: str = "async_loop"):
+                 name: str = "async_loop", ledger=None):
         if sync_every < 1:
             raise ValueError(f"sync_every must be >= 1, got {sync_every}")
         self.trainer = trainer
         self.state = state
         self.sync_every = sync_every
         self.name = name
+        # Goodput attribution: the windowed fetch's host-blocked wall
+        # time is the ledger's "sync" component — explicit ledger wins,
+        # else the ambient training session's (resolved per sync).
+        self._ledger = ledger
         self.history: List[Dict[str, float]] = []
         self.steps = 0
         self._pending: List[Dict[str, Any]] = []
@@ -64,10 +68,18 @@ class AsyncStepLoop:
         import jax
 
         from ray_tpu._private import metrics_defs as mdefs
+        from ray_tpu.train import goodput
 
         n = len(self._pending)
+        t_fetch = time.perf_counter()
         fetched = jax.device_get(self._pending)
         now = time.perf_counter()
+        # "sync" = host blocked in the windowed fetch. Under sync_every=1
+        # this is where device compute drains (the honest reading is
+        # "syncing too often"), with steps in flight it is pure overhead.
+        ledger = self._ledger or goodput.current_ledger()
+        if ledger is not None:
+            ledger.note("sync", now - t_fetch)
         wall = now - self._window_t0
         # Windows are CONTIGUOUS: the next one starts here, not at its
         # first step(), so the stall fetching a window's first batch —

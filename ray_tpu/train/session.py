@@ -16,6 +16,7 @@ import weakref
 from typing import Any, Dict, List, Optional
 
 from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.goodput import GoodputLedger
 
 
 class TrainContext:
@@ -75,6 +76,11 @@ class _Session:
         self.progress_ts: float = time.monotonic()
         self.last_step: int = -1
         self.report_seq: int = 0
+        # Training-path observability: one goodput ledger per attempt
+        # (instrumented sites attribute through goodput.note_ambient);
+        # each report also records the step's dispatch→report wall time
+        # for the controller's per-window rank-skew / straggler scoring.
+        self.ledger = GoodputLedger()
         self.error: Optional[BaseException] = None
         self.result: Any = None
         with _registry_lock:
@@ -169,9 +175,23 @@ def report(metrics: Dict[str, Any],
         step = s.report_seq
     chaos.inject("train_step", rank=s.context.get_world_rank(), step=step)
     s.report_seq += 1
-    s.progress_ts = time.monotonic()
+    # Per-step timeline record: this step's wall time is the gap since
+    # the previous report (its "dispatch"); a chaos slow_step delay
+    # above lands inside it, exactly like a genuinely slow rank. The
+    # record rides the report queue (one report == one step), so the
+    # controller's poll merge sees rank-attributed timings for free.
+    # The FIRST report's gap runs from session start — user-fn setup,
+    # jit compile, checkpoint restore — not a dispatch→report gap, so
+    # it is marked and excluded from rank-skew scoring.
+    now_mono = time.monotonic()
+    step_dur = now_mono - s.progress_ts
+    s.progress_ts = now_mono
     s.last_step = step
-    s.reports.put({"metrics": dict(metrics), "checkpoint": checkpoint})
+    timing = {"step": step, "ts": time.time(), "dur": step_dur}
+    if s.report_seq == 1:
+        timing["first"] = True
+    s.reports.put({"metrics": dict(metrics), "checkpoint": checkpoint,
+                   "step_timing": timing})
 
 
 def get_context() -> TrainContext:

@@ -31,6 +31,23 @@ resumes from the newest committed checkpoint-plane manifest. Every
 recovery is appended to ``JaxTrainer.recovery_log`` and mirrored to the
 ``ray_tpu_train_restarts_total{cause}`` / ``ray_tpu_train_world_size`` /
 ``ray_tpu_train_recovery_seconds`` metrics.
+
+Training-path observability (the train-side twin of the serve request
+plane, ``ray_tpu/train/goodput.py``):
+
+* **goodput ledger** — every attempt's wall clock, partitioned into
+  step / input_stall / sync / ckpt_block / recovery worker-side;
+  controller differences rank-0 snapshots into
+  ``ray_tpu_train_goodput_seconds_total{component}`` and keeps exact
+  per-attempt entries in ``JaxTrainer.goodput_log``;
+* **per-rank step timelines** — each report carries its step's wall
+  time; the controller merges them into fixed-size windows, feeds
+  ``ray_tpu_train_rank_step_seconds{rank}``, and flags stragglers
+  (``ray_tpu_train_straggler{rank}``, GCS ``__train__`` KV, log);
+* **one connected trace per run** (``RAY_TPU_TRACING=1``) —
+  ``train.run`` → ``train.attempt`` → ``train.step_window`` spans plus
+  a ``train.recovery`` tree per elastic recovery whose duration equals
+  the recovery metric; ``ray-tpu trace train <run>`` reconstructs it.
 """
 
 from __future__ import annotations
@@ -44,8 +61,13 @@ from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu import exceptions
-from ray_tpu.train import elastic
-from ray_tpu.train.backend_executor import BackendExecutor, JaxBackend
+from ray_tpu.train import elastic, goodput
+from ray_tpu.train.backend_executor import (
+    TRAIN_KV_NS,
+    BackendExecutor,
+    JaxBackend,
+)
+from ray_tpu.train.goodput import _env_float, _env_int
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
 from ray_tpu.train.config import (
     CheckpointConfig,
@@ -68,14 +90,6 @@ class ControllerState:
     RESTARTING = "RESTARTING"
     FINISHED = "FINISHED"
     ERRORED = "ERRORED"
-
-
-def _env_int(name: str, default: int) -> int:
-    return int(os.environ.get(name, default))
-
-
-def _env_float(name: str, default: float) -> float:
-    return float(os.environ.get(name, default))
 
 
 class JaxTrainer:
@@ -109,6 +123,18 @@ class JaxTrainer:
         self.recovery_log: List[Dict[str, Any]] = []
         self._failure_ts: Optional[float] = None
         self._attempt_reported = False
+        # Training-path observability state: one goodput entry per
+        # attempt ({attempt, world, wall_s, components, per_rank}),
+        # currently-flagged straggler ranks, and the run trace ids.
+        self.goodput_log: List[Dict[str, Any]] = []
+        self.stragglers: set = set()
+        self._trace_id = ""
+        self._run_span = ""
+        self._run_name = ""
+        self._detector: Optional[goodput.StragglerDetector] = None
+        self._pending_recovery: Optional[elastic.RecoveryTrace] = None
+        self._ledger_prev: Dict[str, float] = {}
+        self._last_ledgers: List[Dict[str, Any]] = []
 
     def _set_state(self, state: str) -> None:
         if state != self.controller_state:
@@ -143,6 +169,7 @@ class JaxTrainer:
 
     def fit(self) -> Result:
         from ray_tpu._private import metrics_defs as mdefs
+        from ray_tpu.util import tracing
 
         if not ray_tpu.is_initialized():
             ray_tpu.init()
@@ -150,6 +177,13 @@ class JaxTrainer:
         storage_path = rc.storage_path or os.path.join(
             tempfile.gettempdir(), "ray_tpu_results")
         name = rc.name or f"JaxTrainer_{int(time.time())}"
+        # One trace per run: every attempt, step window, and elastic
+        # recovery parents (transitively) to this root span, all
+        # carrying run=<name> so `ray-tpu trace train <name>` finds it.
+        self._run_name = name
+        self._trace_id = tracing.gen_id()
+        self._run_span = tracing.gen_id()
+        run_t0_wall = time.time()
         storage = None
         if "://" in storage_path:
             # Cloud-fs persistence (reference StorageContext): the run's
@@ -200,6 +234,7 @@ class JaxTrainer:
         resize_target: Optional[int] = None
         mtags = {"trainer": type(self).__name__}
         guard = elastic.ResizeGuard()
+        attempt_idx = 0
 
         try:
             while True:
@@ -218,8 +253,32 @@ class JaxTrainer:
                     scaling = _dc.replace(scaling, num_workers=target)
                 executor = BackendExecutor(scaling, self.backend)
                 self._attempt_reported = False
+                attempt_idx += 1
+                attempt_span = tracing.gen_id()
+                attempt_t0_wall = time.time()
+                # Fresh per-attempt observability state: a new straggler
+                # detector at this world size, cleared flags (a restart
+                # re-forms the mesh — old rank identities are void), and
+                # a zeroed goodput-delta cursor.
+                self._detector = goodput.StragglerDetector(
+                    scaling.num_workers)
+                for r in sorted(self.stragglers):
+                    mdefs.TRAIN_STRAGGLER.set(
+                        0.0, tags={**mtags, "rank": str(r)})
+                    self._publish_straggler(r, None)
+                self.stragglers.clear()
+                self._ledger_prev = {}
+                self._last_ledgers = []
                 try:
-                    executor.start()
+                    if self._pending_recovery is not None:
+                        # Worker re-acquisition + backend on_start (the
+                        # jax.distributed mesh re-formation) is one
+                        # recovery phase of the trace.
+                        with self._pending_recovery.timed_phase(
+                                "reacquire"):
+                            executor.start()
+                    else:
+                        executor.start()
                     # Clear the ask this attempt serves — at its exact
                     # value, even when capacity only allowed a smaller
                     # world (an unsatisfiable ask must not re-trigger a
@@ -244,15 +303,26 @@ class JaxTrainer:
                         datasets=worker_datasets)
                     self._set_state(ControllerState.RUNNING)
                     self._drive(executor, run_refs, manager, history,
-                                guard, scaling.num_workers, resize_target)
+                                guard, scaling.num_workers, resize_target,
+                                attempt_span)
                     latest_metrics = (history[-1]["metrics"]
                                       if history else None)
                     error = None
                     executor.shutdown()
+                    self._record_goodput(attempt_idx, scaling.num_workers)
+                    self._emit_attempt_span(
+                        attempt_span, attempt_t0_wall, attempt=attempt_idx,
+                        world=scaling.num_workers, outcome="finished")
                     self._set_state(ControllerState.FINISHED)
                     break
                 except BaseException as e:  # noqa: BLE001 — classified below
+                    # Detection stamp BEFORE teardown: recovery time is
+                    # documented as covering group teardown, and the
+                    # trace's teardown phase must live inside it.
+                    t_detect = time.monotonic()
+                    detect_wall = time.time()
                     executor.shutdown()
+                    teardown_s = time.monotonic() - t_detect
                     if isinstance(e, (KeyboardInterrupt, SystemExit)):
                         raise
                     cause = elastic.classify_failure(e)
@@ -264,6 +334,10 @@ class JaxTrainer:
                         cause = elastic.RESIZE
                     if isinstance(e, elastic.ResizeRequested):
                         resize_target = e.world_target
+                    self._record_goodput(attempt_idx, scaling.num_workers)
+                    self._emit_attempt_span(
+                        attempt_span, attempt_t0_wall, attempt=attempt_idx,
+                        world=scaling.num_workers, outcome=cause)
                     if self._attempt_reported:
                         backoff_streak = 0
                     if cause == elastic.FATAL:
@@ -306,7 +380,27 @@ class JaxTrainer:
                             backoff_base * math.pow(2, backoff_streak),
                             backoff_cap)
                         backoff_streak += 1
-                    self._failure_ts = time.monotonic()
+                    # Recovery clock starts at DETECTION (so teardown is
+                    # inside it, as the recovery metric documents); the
+                    # trace phases accumulated here close into one
+                    # train.recovery span tree at the restarted
+                    # attempt's first report (_drive). A recovery still
+                    # pending here means the RESTARTED attempt died
+                    # before reporting: close its trace as failed (span
+                    # length = detect A -> detect B) instead of
+                    # silently dropping it.
+                    if self._pending_recovery is not None and \
+                            self._failure_ts is not None:
+                        self._pending_recovery.close(
+                            t_detect - self._failure_ts,
+                            outcome="failed")
+                        self._pending_recovery = None
+                    self._failure_ts = t_detect
+                    rec = elastic.RecoveryTrace(
+                        self._trace_id, self._run_span, self._run_name,
+                        cause, attempt_idx + 1)
+                    rec.t0_wall = detect_wall
+                    rec.phase("teardown", teardown_s)
                     self.recovery_log.append({
                         "cause": cause, "error": str(e)[:200],
                         "rank": getattr(e, "failed_rank", None),
@@ -321,8 +415,29 @@ class JaxTrainer:
                         budget)
                     if backoff:
                         time.sleep(backoff)
+                        rec.phase("backoff", backoff)
+                    self._pending_recovery = rec
         finally:
             guard.close()
+            # The run is over: the straggler GAUGE must not report an
+            # active straggler for a training run that no longer exists.
+            # The KV record stays (ts-stamped, marked ended) as the
+            # post-mortem surface, like `JaxTrainer.stragglers`.
+            for r in sorted(self.stragglers):
+                mdefs.TRAIN_STRAGGLER.set(0.0,
+                                          tags={**mtags, "rank": str(r)})
+                det = self._detector
+                info = (det.flagged.get(r, {}) if det else {})
+                self._publish_straggler(
+                    r, {**info, "run": self._run_name,
+                        "run_ended": True})
+            if tracing.enabled():
+                tracing.emit_span(
+                    "train.run", trace_id=self._trace_id,
+                    ts=run_t0_wall, dur=time.time() - run_t0_wall,
+                    span_id=self._run_span, kind="train",
+                    run=self._run_name, attempts=attempt_idx,
+                    outcome=self.controller_state)
 
         try:
             manager.close()
@@ -336,6 +451,166 @@ class JaxTrainer:
             error=error,
             metrics_history=history,
         )
+
+    # ------------------------------------- training-path observability
+    def _emit_attempt_span(self, span_id: str, t0_wall: float, *,
+                           attempt: int, world: int, outcome: str) -> None:
+        from ray_tpu.util import tracing
+
+        if not tracing.enabled():
+            return
+        tracing.emit_span(
+            "train.attempt", trace_id=self._trace_id, ts=t0_wall,
+            dur=time.time() - t0_wall, span_id=span_id,
+            parent_span_id=self._run_span, kind="train",
+            run=self._run_name, attempt=attempt, world=world,
+            outcome=outcome)
+
+    def _record_goodput(self, attempt: int, world: int) -> None:
+        """Freeze the attempt's goodput entry from the last ledger
+        snapshots the poll loop saw (rank 0 is the headline; per-rank
+        snapshots ride along)."""
+        if not self._last_ledgers:
+            return
+        lead = next((led for led in self._last_ledgers
+                     if led.get("rank") == 0), self._last_ledgers[0])
+        self.goodput_log.append({
+            "attempt": attempt, "world": world,
+            "wall_s": lead["wall_s"],
+            "components": dict(lead["components"]),
+            "per_rank": list(self._last_ledgers)})
+
+    def goodput_summary(self) -> Dict[str, Any]:
+        """Run-level goodput rollup: per-component seconds summed over
+        every attempt's ledger (exact per-attempt partitions), plus the
+        controller-side recovery total (detection→first report; it
+        overlaps each young attempt's restore/first-step wall, so it is
+        reported beside the components, not inside them)."""
+        comps: Dict[str, float] = {}
+        wall = 0.0
+        for e in self.goodput_log:
+            wall += e["wall_s"]
+            for c, v in e["components"].items():
+                comps[c] = comps.get(c, 0.0) + v
+        rec = sum(r.get("recovery_s", 0.0) for r in self.recovery_log)
+        return {
+            "attempts": len(self.goodput_log),
+            "wall_s": wall,
+            "components": comps,
+            "controller_recovery_s": rec,
+            "fractions": ({c: v / wall for c, v in comps.items()}
+                          if wall > 0 else {}),
+        }
+
+    def _publish_straggler(self, rank: int,
+                           info: Optional[Dict[str, Any]]) -> None:
+        """Mirror a straggler flag into the GCS ``__train__`` KV
+        (``straggler/<run>/<rank>``); ``info=None`` clears it.
+        Best-effort like the worker heartbeat mirror."""
+        try:
+            import json
+
+            from ray_tpu.experimental import internal_kv as kv
+
+            key = f"straggler/{self._run_name}/{rank:05d}"
+            if info is None:
+                kv.internal_kv_del(key, namespace=TRAIN_KV_NS)
+            else:
+                kv.internal_kv_put(key, json.dumps(info).encode(),
+                                   overwrite=True, namespace=TRAIN_KV_NS)
+        except Exception:  # noqa: BLE001 — KV mirror is best-effort
+            pass
+
+    def _handle_window(self, win: Dict[str, Any], attempt_span: str,
+                       world: int, mtags: Dict[str, str]) -> None:
+        """One scored step window: emit its trace span and apply
+        straggler flag transitions (gauge + KV + controller log)."""
+        from ray_tpu._private import metrics_defs as mdefs
+        from ray_tpu.util import tracing
+
+        if tracing.enabled() and win.get("start_ts") is not None:
+            tracing.emit_span(
+                "train.step_window", trace_id=self._trace_id,
+                ts=win["start_ts"],
+                dur=max(win["end_ts"] - win["start_ts"], 0.0),
+                parent_span_id=attempt_span, kind="train",
+                run=self._run_name, window=win["window"], world=world,
+                median_s=round(win["median_s"], 6),
+                max_skew=round(win["max_skew"], 3),
+                stragglers=",".join(map(str, win["flagged"])))
+        det = self._detector
+        for r in win["newly_flagged"]:
+            self.stragglers.add(r)
+            info = det.flagged.get(r, {}) if det else {}
+            mdefs.TRAIN_STRAGGLER.set(1.0, tags={**mtags,
+                                                 "rank": str(r)})
+            self._publish_straggler(r, {**info, "run": self._run_name})
+            logger.warning(
+                "straggler: rank %d mean step %.4fs is %.1fx the window "
+                "median %.4fs for %d consecutive windows (run %s, "
+                "window %d)", r, info.get("mean_s", 0.0),
+                info.get("skew", 0.0), win["median_s"],
+                info.get("streak", 0), self._run_name, win["window"])
+        for r in win["cleared"]:
+            self.stragglers.discard(r)
+            mdefs.TRAIN_STRAGGLER.set(0.0, tags={**mtags,
+                                                 "rank": str(r)})
+            self._publish_straggler(r, None)
+            logger.info("straggler cleared: rank %d back under the "
+                        "skew threshold (run %s, window %d)",
+                        r, self._run_name, win["window"])
+
+    def _feed_step_timings(self, polls: List[Dict[str, Any]],
+                           mtags: Dict[str, str], attempt_span: str,
+                           current_world: int) -> None:
+        """Per-rank step timelines off one poll round: rank histogram +
+        straggler detector, then act on windows that completed. Shared
+        by the live poll loop and the end-of-run drain (windows that
+        complete only in the final reports must still score, or a rank
+        that recovered at the end would finish the run flagged)."""
+        from ray_tpu._private import metrics_defs as mdefs
+
+        completed = []
+        for rank, p in enumerate(polls):
+            for r in p["reports"]:
+                t = r.get("step_timing")
+                if not t or self._detector is None:
+                    continue
+                if t.get("first"):
+                    # Session-start → first report: setup/compile/
+                    # restore, not a step — would pollute window means.
+                    continue
+                mdefs.TRAIN_RANK_STEP_SECONDS.observe(
+                    t["dur"], tags={**mtags, "rank": str(rank)})
+                completed += self._detector.observe(
+                    rank, t["step"], t["dur"], ts=t.get("ts"))
+        for win in completed:
+            self._handle_window(win, attempt_span, current_world, mtags)
+
+    def _account_goodput(self, polls: List[Dict[str, Any]],
+                         mtags: Dict[str, str]) -> None:
+        """Difference rank-0's ledger snapshot into the goodput counter
+        family and refresh the fraction gauges. The counters are
+        monotone (a shrinking step residual between two snapshots is
+        skipped), so they approximate the exact per-attempt partition
+        kept in ``goodput_log``."""
+        from ray_tpu._private import metrics_defs as mdefs
+
+        ledgers = [p.get("ledger") for p in polls]
+        self._last_ledgers = [dict(led, rank=rank)
+                              for rank, led in enumerate(ledgers) if led]
+        lead = ledgers[0] if ledgers else None
+        if not lead:
+            return
+        wall = max(lead["wall_s"], 1e-9)
+        for comp, val in lead["components"].items():
+            delta = val - self._ledger_prev.get(comp, 0.0)
+            if delta > 0:
+                mdefs.TRAIN_GOODPUT_SECONDS.inc(
+                    delta, tags={**mtags, "component": comp})
+                self._ledger_prev[comp] = val
+            mdefs.TRAIN_GOODPUT_FRACTION.set(
+                val / wall, tags={**mtags, "component": comp})
 
     # ------------------------------------------------------------------
     def _watchdog_s(self) -> float:
@@ -353,7 +628,8 @@ class JaxTrainer:
     def _drive(self, executor: BackendExecutor, run_refs,
                manager: CheckpointManager, history: List[Dict[str, Any]],
                guard: elastic.ResizeGuard, current_world: int,
-               explicit_world: Optional[int] = None):
+               explicit_world: Optional[int] = None,
+               attempt_span: str = ""):
         """Poll session queues until every worker's run() completes.
 
         Also the detection loop: the per-step watchdog, the fatal-NaN
@@ -396,6 +672,11 @@ class JaxTrainer:
 
         while True:
             polls = executor.poll()
+            # Per-rank step timelines: every report carries its step's
+            # wall time; feed the rank histogram and the straggler
+            # detector, then act on any windows that completed.
+            self._feed_step_timings(polls, mtags, attempt_span,
+                                    current_world)
             # Merge this round's reports: workers report at the same cadence;
             # rank 0's metrics win, any rank's checkpoint is persisted
             # (reference keeps rank-0 checkpoints by default).
@@ -429,6 +710,7 @@ class JaxTrainer:
                         nan_streak = 0
             if max_reports:
                 observe_round(metrics, max_reports)
+                self._account_goodput(polls, mtags)
                 now = time.monotonic()
                 last_progress = now
                 self._attempt_reported = True
@@ -438,9 +720,28 @@ class JaxTrainer:
                         recovery_s = now - self._failure_ts
                         mdefs.TRAIN_RECOVERY_SECONDS.observe(
                             recovery_s, tags=mtags)
+                        # The goodput counter family gets only the
+                        # INTER-session dead time (detection → the new
+                        # session's start): the tail of the recovery
+                        # (restore + first step) already flows in
+                        # through the young attempt's own ledger, and
+                        # the counters must not book it twice.
+                        lead = polls[0].get("ledger") if polls else None
+                        dead_s = recovery_s - (lead["wall_s"] if lead
+                                               else 0.0)
+                        if dead_s > 0:
+                            mdefs.TRAIN_GOODPUT_SECONDS.inc(
+                                dead_s,
+                                tags={**mtags, "component": "recovery"})
                         if self.recovery_log:
                             self.recovery_log[-1]["recovery_s"] = \
                                 recovery_s
+                        if self._pending_recovery is not None:
+                            # Same recovery_s closes the trace: the
+                            # train.recovery span and the metric can
+                            # never disagree.
+                            self._pending_recovery.close(recovery_s)
+                            self._pending_recovery = None
                         self._failure_ts = None
             # Per-step watchdog: a hung collective stalls every worker's
             # report stream while heartbeats keep flowing. Before the
@@ -487,8 +788,13 @@ class JaxTrainer:
             if len(done) == len(run_refs):
                 # Raises through to fit() on worker failure.
                 ray_tpu.get(run_refs)
-                # Final drain.
+                # Final drain: reports AND step timings (windows that
+                # complete only here must still score — a straggler
+                # that recovered in the last windows gets its cleared
+                # transition, not a stale flag).
                 final = executor.poll()
+                self._feed_step_timings(final, mtags, attempt_span,
+                                        current_world)
                 for rank, p in enumerate(final):
                     for r in p["reports"]:
                         entry = {"metrics": r["metrics"]}
@@ -497,4 +803,7 @@ class JaxTrainer:
                                 Checkpoint(r["checkpoint_path"]),
                                 r["metrics"] or {})
                         history.append(entry)
+                # Closing ledger snapshots (wall frozen at session end)
+                # become the attempt's goodput_log entry.
+                self._account_goodput(final, mtags)
                 return

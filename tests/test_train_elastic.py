@@ -355,7 +355,8 @@ def test_chaos_env_activation(monkeypatch):
     chaos.reset()  # force the env to be re-read
     plan = chaos.current_plan()
     assert plan is not None and plan.seed == 13
-    assert chaos.inject("train_step", rank=0, step=1) is None  # acted
+    # slow_step acts in place (sleeps) and reports the applied delay.
+    assert chaos.inject("train_step", rank=0, step=1) == {"slept_s": 0.0}
     assert [e["action"] for e in chaos.injection_log()] == ["slow_step"]
 
 

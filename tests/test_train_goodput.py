@@ -1,0 +1,454 @@
+"""Training-path observability: goodput ledger, per-rank step timelines
+with straggler detection, and connected recovery traces (ISSUE 12).
+
+The acceptance bars mirror PR 7's request-path plane: ledger components
+must sum to the measured attempt wall clock (within 1%), a chaos-injected
+persistently-slow rank must be flagged in <= K scored windows while a
+healthy run never flags, and one kill→shrink→restore run must yield ONE
+connected trace whose recovery span duration equals the value observed
+into ``ray_tpu_train_recovery_seconds``.
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import train as rt_train
+from ray_tpu._private import chaos
+from ray_tpu._private import metrics_defs as mdefs
+from ray_tpu.train.goodput import GoodputLedger, StragglerDetector
+from ray_tpu.util import tracing
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _chaos_reset():
+    yield
+    chaos.reset()
+
+
+@pytest.fixture
+def goodput_ray(monkeypatch):
+    """In-process runtime + tight knobs so windows score in ~seconds."""
+    monkeypatch.setenv("RAY_TPU_RESTART_BACKOFF_S", "0.05")
+    monkeypatch.setenv("RAY_TPU_RESTART_BACKOFF_MAX_S", "0.2")
+    monkeypatch.setenv("RAY_TPU_STRAGGLER_WINDOW_STEPS", "2")
+    monkeypatch.setenv("RAY_TPU_STRAGGLER_WINDOWS", "2")
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+class _FakeReporter:
+    """Captures span records in-process (same pattern as the serve
+    request-tracing suite)."""
+
+    def __init__(self):
+        self.records = []
+
+    def add(self, record):
+        self.records.append(record)
+
+
+@pytest.fixture()
+def span_capture(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_TRACING", "1")
+    rep = _FakeReporter()
+    monkeypatch.setattr(tracing, "_reporter", rep)
+    yield rep
+
+
+def _loop(total, step_sleep=0.03, save=True):
+    def loop(config):
+        plane = rt_train.get_checkpoint_plane() if save else None
+        w = np.zeros(4)
+        start = 0
+        if plane is not None and plane.latest_step() is not None:
+            st = plane.restore()
+            w, start = st["w"], int(st["step"]) + 1
+        for step in range(start, total):
+            time.sleep(step_sleep)
+            w = w + (step + 1)
+            if plane is not None:
+                plane.save(step, {"w": w, "step": np.asarray(step)})
+            rt_train.report({"step": step, "loss": float(w.sum())})
+        return float(w.sum())
+
+    return loop
+
+
+def _fit(loop, tmp_path, name, num_workers=2, min_workers=1):
+    trainer = rt_train.JaxTrainer(
+        loop, train_loop_config={},
+        scaling_config=rt_train.ScalingConfig(num_workers=num_workers,
+                                              min_workers=min_workers),
+        run_config=rt_train.RunConfig(name=name,
+                                      storage_path=str(tmp_path)))
+    return trainer, trainer.fit()
+
+
+# ------------------------------------------------------------- ledger
+def test_ledger_components_sum_exactly_and_step_is_residual():
+    led = GoodputLedger()
+    led.note("input_stall", 0.02)
+    with led.component("sync"):
+        time.sleep(0.01)
+    time.sleep(0.03)
+    led.close()
+    snap = led.snapshot()
+    comps = snap["components"]
+    assert set(comps) == {"step", "input_stall", "sync", "ckpt_block",
+                          "recovery"}
+    assert sum(comps.values()) == pytest.approx(snap["wall_s"], abs=1e-9)
+    assert comps["input_stall"] == pytest.approx(0.02)
+    assert comps["sync"] >= 0.01
+    assert comps["step"] > 0  # the residual covers the bare sleep
+    # close() froze the wall: a later snapshot is identical.
+    assert led.snapshot()["wall_s"] == snap["wall_s"]
+    assert sum(led.fractions().values()) == pytest.approx(1.0)
+
+
+def test_ledger_rejects_unknown_component():
+    led = GoodputLedger()
+    with pytest.raises(ValueError, match="step.*residual"):
+        led.note("step", 1.0)  # step cannot be noted — it IS the residual
+    with pytest.raises(ValueError):
+        led.note("coffee", 1.0)
+
+
+def test_ledger_double_booking_breaks_the_sum_invariant():
+    """The residual makes the sum identity hold BY CONSTRUCTION — but a
+    double-booked interval still shows: step goes negative, which is
+    what the e2e assertions (step >= 0) would catch."""
+    led = GoodputLedger()
+    time.sleep(0.01)
+    led.note("input_stall", 5.0)  # 5s booked in a ~10ms attempt
+    led.close()
+    assert led.snapshot()["components"]["step"] < 0
+
+
+class _FakeTrainer:
+    """Minimal AsyncStepLoop target: jnp metrics so device_get is real."""
+
+    def __init__(self):
+        import jax.numpy as jnp
+
+        self._jnp = jnp
+
+    def train_step(self, state, batch):
+        m = self._jnp.asarray(batch["x"]).sum()
+        return state, {"loss": m}
+
+
+@pytest.mark.parametrize("sync_every", [1, 4])
+def test_loop_ledger_sums_to_measured_wall(sync_every):
+    """Acceptance: drive the real AsyncStepLoop + DevicePrefetcher with
+    a stuttering host source; the ledger's components must sum to the
+    externally measured wall within 1%, with the injected source delay
+    visible as input_stall and the windowed fetch as sync."""
+    import jax.numpy as jnp
+
+    from ray_tpu.train.ingest import DevicePrefetcher
+    from ray_tpu.train.loop import AsyncStepLoop
+
+    def slow_source():
+        for i in range(12):
+            if i and i % 4 == 0:
+                time.sleep(0.05)  # stuttering producer -> consumer stall
+            yield {"x": np.full((4,), i, np.float32)}
+
+    # Warm jax (device transfers + the tiny reduce) so cold-start
+    # compile time doesn't dominate the measured window.
+    jnp.asarray(np.zeros(4, np.float32)).sum().block_until_ready()
+    t0 = time.perf_counter()
+    led = GoodputLedger()  # ledger clock == the externally measured one
+    pf = DevicePrefetcher(slow_source(), depth=1, ledger=led,
+                          name=f"gp{sync_every}")
+    loop = AsyncStepLoop(_FakeTrainer(), jnp.zeros(()),
+                         sync_every=sync_every, ledger=led)
+    loop.run(pf)
+    led.close()
+    wall = time.perf_counter() - t0
+    pf.close()
+    snap = led.snapshot()
+    comps = snap["components"]
+    assert sum(comps.values()) == pytest.approx(snap["wall_s"],
+                                                abs=1e-9)
+    # The ledger clock started with the external one: within 1%.
+    assert snap["wall_s"] == pytest.approx(wall, rel=0.01, abs=2e-3)
+    assert comps["input_stall"] > 0.03  # the producer stutters landed
+    assert comps["sync"] > 0            # windowed fetches blocked
+    assert comps["step"] >= 0           # no double-booked interval
+
+
+# -------------------------------------------------- straggler detector
+def test_straggler_detector_flags_in_k_windows_and_clears():
+    det = StragglerDetector(4, factor=2.0, consecutive=3, window_steps=2)
+    events = []
+    for step in range(14):
+        for rank in range(4):
+            dur = 0.5 if (rank == 2 and step < 10) else 0.01
+            events += det.observe(rank, step, dur, ts=float(step))
+    flagged_at = [e["window"] for e in events if e["newly_flagged"]]
+    # Slow from step 0, K=3 consecutive windows of 2 steps: flagged at
+    # window 2 (the third scored window) — i.e. within K windows.
+    assert flagged_at == [2]
+    assert all(e["flagged"] == [2] for e in events
+               if e["window"] in (2, 3))
+    cleared_at = [e["window"] for e in events if e["cleared"]]
+    assert cleared_at == [5]  # recovered at step 10 -> cleared
+    assert det.flagged == {}
+    # Healthy ranks never built a streak.
+    assert all(not e["newly_flagged"] for e in events
+               if e["window"] > 2)
+
+
+def test_straggler_detector_uniform_ranks_never_flag():
+    det = StragglerDetector(3, factor=2.0, consecutive=2, window_steps=2)
+    events = []
+    rng = np.random.default_rng(0)
+    for step in range(20):
+        for rank in range(3):
+            events += det.observe(rank, step,
+                                  0.02 + rng.uniform(0, 0.005))
+    assert det.windows_scored >= 8
+    assert det.flagged == {}
+    assert all(not e["newly_flagged"] for e in events)
+
+
+def test_straggler_windows_score_only_when_every_rank_passed():
+    """A finished rank must not be compared against a straggler's
+    PARTIAL window — scoring waits until every rank moved past it."""
+    det = StragglerDetector(2, factor=2.0, consecutive=1, window_steps=2)
+    out = []
+    for step in range(6):
+        out += det.observe(0, step, 0.01, ts=float(step))
+    assert out == []  # rank 1 has not reported at all
+    for step in range(4):
+        out += det.observe(1, step, 0.3, ts=float(step))
+    # Rank 1 finished window 1 (steps 2-3) but has not moved PAST it:
+    # only window 0 may score (window 1 might still get more steps).
+    assert [w["window"] for w in out] == [0]
+    assert det.flagged and 1 in det.flagged
+    out += det.observe(1, 4, 0.3, ts=4.0)  # rank 1 enters window 2...
+    assert [w["window"] for w in out] == [0, 1]  # ...so window 1 scores
+
+
+# ------------------------------------------------------- chaos harness
+def test_chaos_slow_step_unlimited_and_deterministic_jitter():
+    """times=-1 fires on every matching step (the persistent straggler
+    fault), and jitter draws a seed-deterministic delay: same seed →
+    identical delays, different seed → a different sequence."""
+
+    def run(seed):
+        chaos.configure(
+            "slow_step:rank=1,times=-1,secs=0.001,jitter=1.0", seed=seed)
+        out = []
+        for step in range(6):
+            d0 = chaos.inject("train_step", rank=0, step=step)
+            d1 = chaos.inject("train_step", rank=1, step=step)
+            assert d0 is None
+            out.append(d1["slept_s"])
+        return out
+
+    a, b, c = run(7), run(7), run(11)
+    assert len(a) == 6 and len(set(a)) > 1  # fired EVERY step, jittered
+    assert a == b       # deterministic replay
+    assert a != c       # a different seed explores different delays
+    assert all(0.001 <= x < 0.002 for x in a)  # secs * [1, 1+jitter)
+
+
+# ------------------------------------------------------------- e2e
+def test_chaos_slow_rank_is_flagged_and_healthy_run_is_not(
+        goodput_ray, tmp_path):
+    """Acceptance: a chaos-injected persistently slow rank is flagged by
+    the straggler detector (controller state, gauge, GCS __train__ KV)
+    within K scored windows, while an uninjected run never flags."""
+    # Healthy run first: equal ranks, no flag ever.
+    trainer, result = _fit(_loop(8, step_sleep=0.04), tmp_path,
+                           "healthy")
+    assert result.error is None
+    assert trainer.stragglers == set()
+    assert trainer._detector.windows_scored >= 2
+    assert trainer._detector.flagged == {}
+
+    chaos.configure("slow_step:rank=1,times=-1,secs=0.3", seed=5)
+    trainer, result = _fit(_loop(8, step_sleep=0.04), tmp_path, "dragged")
+    assert result.error is None
+    assert trainer.stragglers == {1}
+    info = trainer._detector.flagged[1]
+    # Flagged after exactly K consecutive slow windows (K=2 fixture) —
+    # the detector did not need more evidence than configured.
+    assert info["streak"] == 2 and info["window"] <= 2
+    assert info["skew"] > 2.0
+    # The gauge was flagged during the run and cleared at run end (a
+    # finished run must not report an active straggler); rank 0 never
+    # moved off 0.
+    by_rank = {dict(k).get("rank"): v
+               for _n, k, v in mdefs.TRAIN_STRAGGLER.samples()}
+    assert by_rank.get("1") == 0.0  # series exists => it WAS set
+    assert by_rank.get("0", 0.0) == 0.0
+    # The KV record persists as the post-mortem surface, marked ended.
+    from ray_tpu.experimental import internal_kv as kv
+
+    raw = kv.internal_kv_get("straggler/dragged/00001",
+                             namespace="__train__")
+    rec = json.loads(raw)
+    assert rec["rank"] == 1 and rec["skew"] > 2.0
+    assert rec["run_ended"] is True
+    # Per-rank step-time histogram saw both ranks.
+    ranks = {dict(k).get("rank")
+             for _n, k, _v in mdefs.TRAIN_RANK_STEP_SECONDS.samples()}
+    assert {"0", "1"} <= ranks
+
+
+def test_goodput_ledger_through_trainer_sums_and_feeds_metrics(
+        goodput_ray, tmp_path):
+    """Every attempt's goodput_log entry partitions its session wall
+    exactly (step stays non-negative = nothing double-booked), the
+    ckpt_block component is attributed from the checkpoint plane, and
+    the counter family advanced."""
+    before = {dict(k).get("component"): v for _n, k, v
+              in mdefs.TRAIN_GOODPUT_SECONDS.samples()}
+    trainer, result = _fit(_loop(6, step_sleep=0.02), tmp_path, "ledger")
+    assert result.error is None
+    assert len(trainer.goodput_log) == 1
+    entry = trainer.goodput_log[0]
+    comps = entry["components"]
+    assert sum(comps.values()) == pytest.approx(entry["wall_s"],
+                                                rel=0.01)
+    assert comps["step"] >= 0
+    assert comps["ckpt_block"] > 0  # plane.save snapshots attributed
+    assert len(entry["per_rank"]) == entry["world"] == 2
+    for snap in entry["per_rank"]:
+        assert sum(snap["components"].values()) == pytest.approx(
+            snap["wall_s"], rel=0.01)
+    summary = trainer.goodput_summary()
+    assert summary["attempts"] == 1
+    assert summary["fractions"]["step"] > 0.5  # mostly productive
+    after = {dict(k).get("component"): v for _n, k, v
+             in mdefs.TRAIN_GOODPUT_SECONDS.samples()}
+    assert after.get("step", 0.0) > before.get("step", 0.0)
+    assert after.get("ckpt_block", 0.0) > before.get("ckpt_block", 0.0)
+
+
+def test_recovery_yields_one_connected_trace_matching_the_metric(
+        goodput_ray, tmp_path, span_capture):
+    """Acceptance: a chaos kill → shrink → restore run emits ONE trace:
+    train.run at the root, both attempts and their step windows under
+    it, and a train.recovery tree whose children tile the parent and
+    whose duration equals ray_tpu_train_recovery_seconds' observation."""
+    key = ("JaxTrainer",)
+    sum_before = {n: v for n, k, v
+                  in mdefs.TRAIN_RECOVERY_SECONDS.samples()
+                  if dict(k).get("trainer") == "JaxTrainer"}
+    chaos.configure("kill_worker:rank=1,step=3,resize=1", seed=7)
+    trainer, result = _fit(_loop(8, step_sleep=0.03), tmp_path, "traced")
+    assert result.error is None
+    assert [r["cause"] for r in trainer.recovery_log][:1] == \
+        ["worker_lost"]
+    recovery_s = trainer.recovery_log[0]["recovery_s"]
+    assert recovery_s > 0
+
+    spans = [s for s in span_capture.records
+             if s["name"].startswith("train.")]
+    assert spans
+    # ONE connected trace: every span shares the run's trace id and
+    # carries the run name for `ray-tpu trace train traced`.
+    assert {s["trace_id"] for s in spans} == {trainer._trace_id}
+    assert all(s["run"] == "traced" for s in spans)
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    (run_span,) = by_name["train.run"]
+    attempts = by_name["train.attempt"]
+    assert len(attempts) == 2
+    assert {a["outcome"] for a in attempts} == {"worker_lost",
+                                                "finished"}
+    assert all(a["parent_span_id"] == run_span["span_id"]
+               for a in attempts)
+    # Step windows parent to their attempt.
+    windows = by_name["train.step_window"]
+    assert windows
+    attempt_ids = {a["span_id"] for a in attempts}
+    assert all(w["parent_span_id"] in attempt_ids for w in windows)
+    # The recovery tree: parent under the run, children tile it.
+    (rec,) = by_name["train.recovery"]
+    assert rec["parent_span_id"] == run_span["span_id"]
+    assert rec["cause"] == "worker_lost"
+    assert rec["dur"] == recovery_s  # the SAME value, not approximately
+    kids = [s for s in spans
+            if s["parent_span_id"] == rec["span_id"]]
+    names = [k["name"] for k in kids]
+    assert names == ["train.recovery.teardown",
+                     "train.recovery.backoff",
+                     "train.recovery.reacquire",
+                     "train.recovery.restore_first_step"]
+    assert sum(k["dur"] for k in kids) == pytest.approx(rec["dur"],
+                                                        abs=1e-6)
+    # Children are contiguous: each starts where the previous ended.
+    for prev, nxt in zip(kids, kids[1:]):
+        assert nxt["ts"] == pytest.approx(prev["ts"] + prev["dur"],
+                                          abs=1e-6)
+    # And the metric histogram saw exactly this duration.
+    sum_after = {n: v for n, k, v
+                 in mdefs.TRAIN_RECOVERY_SECONDS.samples()
+                 if dict(k).get("trainer") == "JaxTrainer"}
+    delta = (sum_after.get("ray_tpu_train_recovery_seconds_sum", 0.0)
+             - sum_before.get("ray_tpu_train_recovery_seconds_sum", 0.0))
+    assert delta == pytest.approx(recovery_s, abs=1e-6)
+    assert key is not None
+
+
+# --------------------------------------------------------------- CLI
+def _cli_args(tmp_path, **kw):
+    ns = argparse.Namespace(kind="train", id="run-x", address=None,
+                            output=str(tmp_path / "trace.json"))
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_trace_train_cli_roundtrip(tmp_path, monkeypatch, capsys,
+                                   span_capture, goodput_ray):
+    """`ray-tpu trace train <run>` reconstructs the run's trace:
+    offset-ordered summary + chrome-trace JSON; an unknown run gets the
+    same helpful error text as `trace request`."""
+    chaos.configure("kill_worker:rank=1,step=2,resize=1", seed=7)
+    trainer, result = _fit(_loop(6, step_sleep=0.02), tmp_path, "run-x")
+    assert result.error is None
+
+    from ray_tpu.scripts import cli
+    from ray_tpu.util import state
+
+    spans = [dict(r) for r in span_capture.records
+             if r.get("state") == "SPAN"]
+    monkeypatch.setattr(cli, "_connect", lambda args: ray_tpu)
+    monkeypatch.setattr(
+        state, "list_tasks",
+        lambda limit=1000, filters=None, include_spans=False: spans)
+
+    cli.cmd_trace(_cli_args(tmp_path))
+    out = capsys.readouterr().out
+    assert "train.run" in out and "train.recovery" in out
+    assert "cause=worker_lost" in out
+    events = json.load(open(tmp_path / "trace.json"))
+    assert any(e.get("args", {}).get("span_id") for e in events
+               if e.get("ph") == "X")
+    # Flow arrows link the recovery children to their parent.
+    assert any(e.get("cat") == "flow" for e in events)
+
+    # Helpful empty-result error, same voice as `trace request`.
+    with pytest.raises(SystemExit, match="RAY_TPU_TRACING"):
+        cli.cmd_trace(_cli_args(tmp_path, id="no-such-run"))
+
+    # A trace id is accepted too.
+    cli.cmd_trace(_cli_args(tmp_path, id=trainer._trace_id))
+    assert "train.attempt" in capsys.readouterr().out
