@@ -66,6 +66,20 @@ Actions:
 ``resize``          publishes a ``world_target=N`` resize hint on the
                     preemption pubsub channel at a step boundary (no
                     death) — drives controller-side mesh re-formation.
+``kill_replica``    uncooperative SERVE replica death (same mechanics as
+                    ``kill_worker``) at the replica lifecycle site:
+                    ``phase=prefill`` fires before the engine admits the
+                    request (queued-or-prefilling), ``phase=decode`` with
+                    ``token=N`` fires while streaming the Nth generated
+                    token (mid-decode), ``phase=drain`` fires while the
+                    replica is draining — the three recovery paths of the
+                    serve failure plane.
+``drop_pressure``   the router's shared-pressure fetch skips its refresh
+                    and keeps serving the stale cached snapshot — drives
+                    the admission gate's stale-pressure behavior.
+``delay_tick``      sleeps ``secs`` in the serve engine's tick loop — a
+                    stuttering decode under which drains/streams must
+                    still complete.
 =================  =========================================================
 
 Matching keys (all optional): ``rank``, ``step``, ``proc``, ``node``,
@@ -119,9 +133,13 @@ _ACTION_SITES = {
     "drop_agent_vitals": "agent_vitals",
     "fail_shard_write": "ckpt_shard_write",
     "corrupt_shard": "ckpt_shard_file",
+    # Serve-plane sites (ray_tpu/serve): replica lifecycle faults.
+    "kill_replica": "serve_replica",
+    "drop_pressure": "serve_pressure",
+    "delay_tick": "serve_tick",
 }
-_MATCH_KEYS = ("rank", "step", "proc", "node", "run")
-_INT_PARAMS = ("rank", "step", "proc", "times", "resize", "world")
+_MATCH_KEYS = ("rank", "step", "proc", "node", "run", "phase", "token")
+_INT_PARAMS = ("rank", "step", "proc", "times", "resize", "world", "token")
 _FLOAT_PARAMS = ("secs", "p", "jitter")
 
 
@@ -311,7 +329,7 @@ def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
            coords: Dict[str, Any], directives: Dict[str, Any]) -> None:
     action = rule.action
     logger.warning("chaos: injecting %s at %s %s", action, site, coords)
-    if action == "kill_worker":
+    if action in ("kill_worker", "kill_replica"):
         resize = rule.params.get("resize")
         if resize:
             _publish_resize(int(resize), reason="chaos-node-lost")
@@ -319,7 +337,7 @@ def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
             os._exit(17)  # real worker process: die like a killed host
         _tls.dying = True
         raise SimulatedProcessDeath(
-            f"chaos kill_worker at {site} {coords}")
+            f"chaos {action} at {site} {coords}")
     if action == "slow_step":
         delay = float(rule.params.get("secs", 1.0))
         jitter = rule.params.get("jitter")
@@ -343,10 +361,18 @@ def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
         if path:
             _corrupt_file(str(path))
     elif action in ("drop_heartbeat", "drop_node_hb",
-                    "drop_agent_vitals"):
+                    "drop_agent_vitals", "drop_pressure"):
         directives["drop"] = True
     elif action == "delay_heartbeat":
         directives["delay_s"] = float(rule.params.get("secs", 1.0))
+    elif action == "delay_tick":
+        # Delayed engine tick: the serve decode loop stutters (a slow
+        # device, a co-tenant hog) without any request dying — drives
+        # drain-under-load and streaming-timeout paths with requests
+        # genuinely still in flight.
+        delay = float(rule.params.get("secs", 0.05))
+        time.sleep(delay)
+        directives["slept_s"] = delay
 
 
 def _publish_resize(world_target: int, reason: str) -> None:

@@ -139,6 +139,40 @@ SERVE_ROUTER_AFFINITY = Counter(
     "to the second rendezvous choice)",
     ("deployment", "decision"))
 
+# ----------------------------------------------- serve replica lifecycle (L6)
+# The serve failure plane: controller-initiated drains, observed replica
+# deaths, and in-flight request resumes — the serve twin of the elastic
+# trainer's restart/recovery series.
+SERVE_REPLICA_DRAINS = Counter(
+    "ray_tpu_serve_replica_drains_total",
+    "Controller-initiated replica drains by cause (scale_down/preemption/"
+    "delete) — a draining replica stops admitting, leaves the routing "
+    "ring, finishes in-flight requests up to RAY_TPU_SERVE_DRAIN_S, then "
+    "tears down",
+    ("deployment", "cause"))
+SERVE_REPLICA_DEATHS = Counter(
+    "ray_tpu_serve_replica_deaths_total",
+    "Replica deaths observed by the controller/router by cause "
+    "(died: health probe found it dead; drain: it died while draining)",
+    ("deployment", "cause"))
+SERVE_REPLICA_RESUMES = Counter(
+    "ray_tpu_serve_replica_resumes_total",
+    "In-flight requests recovered after replica death, by cause: "
+    "resubmit (queued/prefilling — no tokens lost), resume (mid-decode — "
+    "prompt + emitted tokens replayed as a new prefill; exactly-once "
+    "under greedy decoding), drain_reject (clean re-route off a draining "
+    "replica, no budget consumed)",
+    ("deployment", "cause"))
+SERVE_DRAIN_SECONDS = Histogram(
+    "ray_tpu_serve_drain_seconds",
+    "Drain initiation to teardown per drained replica, by outcome "
+    "(drained: in-flight work finished; deadline: RAY_TPU_SERVE_DRAIN_S "
+    "expired with requests still running; died: replica died while "
+    "draining)",
+    boundaries=(0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                300.0),
+    tag_keys=("deployment", "outcome"))
+
 # ------------------------------------------ serve request path (L6 + engine)
 # Per-request latency attribution emitted by the continuous-batching
 # engine at request lifecycle boundaries: TTFT decomposes into

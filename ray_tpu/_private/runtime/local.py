@@ -285,11 +285,31 @@ class _LocalActor:
         except BaseException as e:  # noqa: BLE001
             if self._maybe_simulated_death(e, return_ids):
                 return
+            if self._maybe_died_in_flight(return_ids):
+                return
             err = exceptions.RayTaskError.from_exception(
                 e, f"{self.cls.__name__}.{method_name}", task_id)
             self.runtime._store_error(err, return_ids)
         finally:
             _context.reset(token)
+
+    def _maybe_died_in_flight(self, return_ids) -> bool:
+        """The actor died OUT FROM UNDER this in-flight call (a
+        concurrent task hit a simulated process death and the dying
+        event loop cancelled this one): a real process death fails every
+        in-flight call with actor death, so the caller must see
+        ActorDiedError — not a RayTaskError(CancelledError) that reads
+        as a bug in the user method."""
+        with self._lock:
+            if not self.dead:
+                return False
+            cause = self.death_cause
+        self.runtime._store_error(
+            exceptions.ActorDiedError(
+                self.actor_id,
+                f"Actor {self.actor_id.hex()} died: {cause}"),
+            return_ids)
+        return True
 
     def _maybe_simulated_death(self, e: BaseException, return_ids) -> bool:
         """Chaos-injected process kill: the in-process runtime cannot lose
@@ -345,6 +365,8 @@ class _LocalActor:
             self.terminate()
         except BaseException as e:  # noqa: BLE001
             if self._maybe_simulated_death(e, return_ids):
+                return
+            if self._maybe_died_in_flight(return_ids):
                 return
             err = exceptions.RayTaskError.from_exception(
                 e, f"{self.cls.__name__}.{method_name}", task_id)

@@ -92,7 +92,10 @@ def publish_preempt(reason: str = "preempted", node: str = "*",
     groups at N workers (``ray_tpu.train.elastic.request_resize``), and
     ``kind="capacity"`` is the GCS health loop's cluster-grew hint —
     both are latched by :class:`ray_tpu.train.elastic.ResizeGuard`
-    rather than the JIT-save guards."""
+    rather than the JIT-save guards. The SERVE controller subscribes
+    too: a plain preemption notice drains the named node's replicas
+    (graceful drain + respawn, ``serve/api.py``) instead of letting the
+    host kill guillotine their in-flight requests."""
     notice = {"reason": reason, "node": node or "*", "ts": time.time(),
               "source": "publish"}
     if deadline_s is not None:

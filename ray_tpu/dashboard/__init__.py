@@ -92,6 +92,8 @@ _INDEX_HTML = """<!doctype html>
 <h2>Serve / request latency breakdown (TTFT = queue + arena-wait +
 prefill; TPOT)</h2><div id="reqlat"></div>
 <h2>Serve / replica pressure</h2><table id="pressure"></table>
+<h2>Serve / replica lifecycle (drains, deaths, resumes)</h2>
+<div id="lifecycle"></div>
 <h2>Train / input pipeline (stall, prefetch occupancy, bytes/s)</h2>
 <div id="ingest"></div>
 <h2>Train / goodput &amp; stragglers (wall-clock attribution, per-rank
@@ -282,6 +284,21 @@ async function elasticPanel(){
   document.getElementById("elastic").innerHTML=
     sparkRows(restarts.concat(world,rec),40)||"(no elastic trainers)";
 }
+async function lifecyclePanel(){
+  // Serve failure plane: drains_total{cause} stepping up says WHY
+  // replicas leave rotation (scale_down vs preemption), deaths_total
+  // splits probe-found deaths from died-while-draining, resumes_total
+  // {cause} is the in-flight recovery rate (resubmit = nothing lost,
+  // resume = mid-decode replay, drain_reject = free re-route), and the
+  // drain histogram (_sum/_count) is time-to-quiesce by outcome.
+  const reps=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_serve_replica_*&since=300&agg=last&step=3&limit=30");
+  const drain=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_serve_drain_seconds*&since=300&agg=avg&step=3"+
+    "&limit=10");
+  document.getElementById("lifecycle").innerHTML=
+    sparkRows(reps.concat(drain),40)||"(no replica lifecycle events)";
+}
 async function xlaPanel(){
   // Compile/retrace table per (node, program) from the xla series the
   // push plane lands in the TSDB, plus the registered profiler captures.
@@ -338,6 +355,7 @@ async function refresh(){
     await servePanel();
     await prefixPanel();
     await requestLatencyPanel();
+    await lifecyclePanel();
     await ingestPanel();
     await goodputPanel();
     await elasticPanel();

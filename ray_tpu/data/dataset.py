@@ -19,6 +19,7 @@ import builtins
 import glob as glob_mod
 import itertools
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
@@ -35,6 +36,19 @@ _STATS_ACTOR = "_rtpu_data_stats"
 
 _stats_handle = None
 _stats_handle_core = None
+_stats_lock = threading.Lock()
+
+
+def reset_stats_cache() -> None:
+    """Drop the cached stats-actor handle. Process-global state: tests
+    that run many init/shutdown cycles in ONE process (the tier-1 suite
+    is single-process) call this between sessions so a handle minted
+    against a previous runtime can never eat the first records of the
+    next one (the in-suite-only stats flake)."""
+    global _stats_handle, _stats_handle_core
+    with _stats_lock:
+        _stats_handle = None
+        _stats_handle_core = None
 
 
 def _record_stats(stats_key, op: str, rows_in: int, rows_out: int,
@@ -43,7 +57,11 @@ def _record_stats(stats_key, op: str, rows_in: int, rows_out: int,
     (reference: ``_StatsActor``, ``data/_internal/stats.py``). The handle
     is cached per runtime — a per-block name lookup would add a GCS
     round-trip to the very latency being measured, and a handle cached
-    across init/shutdown cycles would silently drop records."""
+    across init/shutdown cycles would silently drop records. The cache
+    is lock-guarded: concurrent block tasks in the in-process runtime
+    share these module globals, and an unguarded miss/reset race could
+    publish a handle paired with the WRONG core (records then land in a
+    dead session's actor until the next exception resets it)."""
     global _stats_handle, _stats_handle_core
     if not stats_key:
         return
@@ -51,13 +69,14 @@ def _record_stats(stats_key, op: str, rows_in: int, rows_out: int,
         from ray_tpu._private import worker as _worker_mod
 
         core = _worker_mod.global_worker().core
-        if _stats_handle is None or _stats_handle_core is not core:
-            _stats_handle = ray_tpu.get_actor(_STATS_ACTOR)
-            _stats_handle_core = core
-        _stats_handle.record.remote(stats_key, op, rows_in, rows_out,
-                                    seconds)
+        with _stats_lock:
+            if _stats_handle is None or _stats_handle_core is not core:
+                _stats_handle = ray_tpu.get_actor(_STATS_ACTOR)
+                _stats_handle_core = core
+            handle = _stats_handle
+        handle.record.remote(stats_key, op, rows_in, rows_out, seconds)
     except Exception:  # noqa: BLE001 — stats are best-effort
-        _stats_handle = None
+        reset_stats_cache()
 
 
 class _StatsActor:

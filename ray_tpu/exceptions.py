@@ -212,6 +212,31 @@ class CheckpointCorruptError(RayTpuError):
     committed manifest instead of surfacing this."""
 
 
+class ReplicaDrainingError(RayTpuError):
+    """The serve replica is draining (controller-initiated: scale-down,
+    preemption, rolling update) and no longer admits new requests. A
+    clean reject — the replica did no work — so routers retry on another
+    replica without consuming the request's resume budget."""
+
+    def __init__(self, reason: str = "replica is draining"):
+        self.reason = reason
+        super().__init__(reason)
+
+
+class ResumeExhaustedError(RayTpuError):
+    """A serve request's per-request resume budget
+    (``RAY_TPU_SERVE_MAX_RESUMES``) ran out: the request was resubmitted/
+    resumed after replica death the maximum number of times and the last
+    attempt also failed. Terminal — the caller sees this instead of the
+    raw ``ActorDiedError`` so it can distinguish "the fabric tried and
+    gave up" from "a replica died"."""
+
+    def __init__(self, reason: str = "resume budget exhausted",
+                 resumes: int = 0):
+        self.resumes = resumes
+        super().__init__(f"{reason} (after {resumes} resume(s))")
+
+
 class RaySystemError(RayTpuError):
     """Internal framework failure (deserialization, protocol, ...)."""
 

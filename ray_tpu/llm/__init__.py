@@ -192,11 +192,45 @@ class ContinuousLlamaDeployment:
         with self._lock:
             return self.batcher.pressure_snapshot()
 
-    def generate(self, prompt_token_ids: List[int],
+    def generate(self, prompt_token_ids,
                  max_tokens: int = 16):
-        """Streaming generator of token ids (serve stream=True surface)."""
+        """Streaming generator of token ids (serve stream=True surface).
+        Accepts either the token-id list directly or the ingress payload
+        dict (``{"prompt_token_ids": [...], "max_tokens": N}``) — the
+        HTTP/gRPC streaming routes (``POST /<name>/stream/generate``)
+        hand the whole JSON payload through as one argument, and the
+        recovery journal resubmits exactly that payload shape.
+
+        Chaos sites (``_private/chaos.py`` ``kill_replica``): before the
+        engine submit (``phase=prefill`` — the request is queued-or-
+        prefilling, nothing streamed) and before yielding the Nth token
+        (``phase=decode,token=N`` — mid-decode, N tokens already
+        streamed). The raised ``SimulatedProcessDeath`` unwinds through
+        the replica actor's task machinery into genuine actor death —
+        exactly what the ingress journal recovers from."""
+        from ray_tpu._private import chaos
+
+        resumed_tokens = 0
+        if isinstance(prompt_token_ids, dict):
+            payload = prompt_token_ids
+            prompt_token_ids = payload["prompt_token_ids"]
+            max_tokens = payload.get("max_tokens", max_tokens)
+            resumed_tokens = int(payload.get("resumed_tokens", 0) or 0)
+        if resumed_tokens and self.batcher.eos_token is not None \
+                and prompt_token_ids \
+                and prompt_token_ids[-1] == self.batcher.eos_token:
+            # Mid-decode RESUME whose last already-delivered token was
+            # EOS: the original generation had finished — only the
+            # end-of-stream sentinel died with the replica. Decoding
+            # the leftover budget would append post-EOS garbage the
+            # un-killed run never produced. (Only resumes check this:
+            # an ORIGINAL prompt may legitimately end with EOS.)
+            return
         q = self._queue_mod.Queue()
         trace = self._request_trace()
+        if chaos.enabled():
+            chaos.inject("serve_replica", phase="prefill",
+                         tokens=len(prompt_token_ids))
         with self._lock:
             rid = self.batcher.submit(list(prompt_token_ids),
                                       max_new_tokens=int(max_tokens),
@@ -204,6 +238,7 @@ class ContinuousLlamaDeployment:
             self._queues[rid] = q
         self._work.set()
         done = False
+        emitted = 0
         try:
             while True:
                 token = q.get(timeout=300)
@@ -213,12 +248,19 @@ class ContinuousLlamaDeployment:
                 if isinstance(token, Exception):
                     done = True
                     raise token
+                if chaos.enabled():
+                    # Fires BEFORE the yield: a rule with token=N dies
+                    # with exactly N tokens delivered downstream.
+                    chaos.inject("serve_replica", phase="decode",
+                                 token=emitted)
+                emitted += 1
                 yield token
         finally:
             self._queues.pop(rid, None)
             if not done:
-                # Abandoned stream (client disconnect): free the slot so
-                # the ghost request stops burning decode ticks.
+                # Abandoned stream (client disconnect or simulated
+                # process death): free the slot so the ghost request
+                # stops burning decode ticks.
                 with self._lock:
                     self.batcher.cancel(rid)
 

@@ -1404,8 +1404,15 @@ class ContinuousBatcher:
         """Admit waiting requests, run one decode tick over all active
         slots, and return the requests that finished (with
         ``sync_every > 1``, finish detection lags up to 2K ticks)."""
+        from ray_tpu._private import chaos
         from ray_tpu._private import metrics_defs as mdefs
 
+        if chaos.enabled():
+            # Delayed-engine-tick chaos site (``delay_tick``): decode
+            # stutters — a slow device, a co-tenant hog — with every
+            # request still alive. Drains under load and streaming
+            # timeouts must ride it out.
+            chaos.inject("serve_tick", engine=self._mtags["engine"])
         self._emit_gauges()
         if self.sync_every == 1:
             self._admit()

@@ -7,6 +7,7 @@ from ray_tpu.serve.api import (
     DeploymentResponse,
     delete,
     deployment,
+    drain,
     get_deployment_handle,
     run,
     shutdown,
@@ -25,9 +26,9 @@ __all__ = [
     "Application", "Deployment", "DeploymentHandle", "DeploymentResponse",
     "DeploymentResponseGenerator", "batch", "build_config", "delete",
     "deploy_config_data", "deploy_config_dict", "deploy_config_file",
-    "deployment", "get_deployment_handle", "get_multiplexed_model_id",
-    "multiplexed", "run", "shutdown", "start_grpc", "start_http",
-    "stop_grpc", "stop_http",
+    "deployment", "drain", "get_deployment_handle",
+    "get_multiplexed_model_id", "multiplexed", "run", "shutdown",
+    "start_grpc", "start_http", "stop_grpc", "stop_http",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

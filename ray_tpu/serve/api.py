@@ -129,10 +129,23 @@ class Replica:
             self.instance = cls_or_fn(*init_args, **(init_kwargs or {}))
         self._ongoing = 0
         self._total = 0
+        self._draining = False
         self._m_lock = threading.Lock()
         self._inspect = inspect
         self._sync_pool = _TPE(max_workers=max(1, int(sync_workers)),
                                thread_name_prefix="replica-sync")
+
+    def _admit(self) -> None:
+        """Count one request in — or reject it if this replica is
+        draining. The reject is a CLEAN typed error (the replica did no
+        work): routers re-route it to a live replica without consuming
+        the request's resume budget."""
+        with self._m_lock:
+            if self._draining:
+                raise ray_tpu.exceptions.ReplicaDrainingError(
+                    "replica is draining and no longer admits requests")
+            self._ongoing += 1
+            self._total += 1
 
     def _target(self, method: str):
         if self.is_function:
@@ -148,9 +161,7 @@ class Replica:
         from ray_tpu.serve import context as serve_context
         from ray_tpu.serve import multiplex
 
-        with self._m_lock:
-            self._ongoing += 1
-            self._total += 1
+        self._admit()
         token = multiplex._set_model_id(multiplexed_model_id)
         # The request context (request id + trace linkage) must be set
         # BEFORE copy_context() below so sync user code sees it in the
@@ -184,9 +195,7 @@ class Replica:
         from ray_tpu.serve import context as serve_context
         from ray_tpu.serve import multiplex
 
-        with self._m_lock:
-            self._ongoing += 1
-            self._total += 1
+        self._admit()
         token = multiplex._set_model_id(multiplexed_model_id)
         rtoken = (serve_context._set_request_context(request_ctx)
                   if request_ctx is not None else None)
@@ -256,6 +265,47 @@ class Replica:
     def health(self):
         return True
 
+    def node_id(self):
+        """The node hosting this replica — the controller's key for
+        preemption-notice targeting (a notice naming a node drains that
+        node's replicas instead of letting them be guillotined)."""
+        try:
+            return ray_tpu.get_runtime_context().get_node_id()
+        except Exception:  # noqa: BLE001 — no runtime context: untargetable
+            return ""
+
+    async def drain(self, deadline_s: Optional[float] = None):
+        """Controller-initiated graceful drain: stop admitting (new
+        requests get a clean :class:`ReplicaDrainingError` reject and
+        re-route), finish in-flight requests up to ``deadline_s``
+        (default ``RAY_TPU_SERVE_DRAIN_S``), then report back so the
+        controller tears this replica down. Async — in-flight requests
+        keep executing on this actor's loop while the drain waits."""
+        import asyncio
+
+        from ray_tpu._private import chaos
+
+        if deadline_s is None:
+            deadline_s = float(os.environ.get("RAY_TPU_SERVE_DRAIN_S",
+                                              "30"))
+        with self._m_lock:
+            self._draining = True
+            remaining = self._ongoing
+        t0 = time.monotonic()
+        deadline = t0 + max(float(deadline_s), 0.0)
+        while remaining > 0 and time.monotonic() < deadline:
+            if chaos.enabled():
+                # Death-while-draining chaos site: the host dies before
+                # the drain completes — in-flight streams fall back to
+                # the journal's resume path.
+                chaos.inject("serve_replica", phase="drain")
+            await asyncio.sleep(0.02)
+            with self._m_lock:
+                remaining = self._ongoing
+        return {"drained": remaining <= 0,
+                "waited_s": time.monotonic() - t0,
+                "remaining": remaining}
+
 
 class ServeController:
     """Reconciles deployment specs → replica actors and autoscales them."""
@@ -272,8 +322,36 @@ class ServeController:
         self._scale_intent: Dict[str, Any] = {}
         self._pg_cleanups: Dict[str, list] = {}
         self._replica_birth: Dict[int, float] = {}
+        # Draining replicas: name -> [{replica, ref, t0, deadline,
+        # cause}]. Out of the routing table (get_routes/pressure only
+        # see self.replicas) but not yet torn down: each entry's ``ref``
+        # is the in-flight Replica.drain() call, and _advance_drains
+        # kills the replica when it resolves (drained / died) or the
+        # deadline lapses.
+        self._draining: Dict[str, List[Dict[str, Any]]] = {}
         self._reconcile_lock = threading.Lock()
         self._stop = False
+        # Preemption notices drain a node's replicas instead of letting
+        # the kill guillotine their in-flight requests (the serve twin
+        # of the train plane's JIT-save guards; same pubsub channel).
+        from ray_tpu.checkpoint import preempt as _preempt
+
+        def _on_preempt(notice: Dict[str, Any]) -> None:
+            # Elastic control signals (capacity hints, world-target
+            # asks) ride this channel but are the trainers' to latch.
+            if notice.get("kind") == "capacity" or \
+                    notice.get("world_target") is not None:
+                return
+            try:
+                self._drain_for_preemption(notice)
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                logger.exception("preemption drain failed")
+
+        self._preempt_cb = _preempt.register_preempt_callback(_on_preempt)
+        try:
+            _preempt.ensure_listener()
+        except Exception:  # noqa: BLE001
+            pass
         threading.Thread(target=self._reconcile_loop, daemon=True).start()
 
     def deploy(self, name: str, cls_or_fn, init_args, init_kwargs,
@@ -371,12 +449,191 @@ class ServeController:
         self._scale_intent.pop(name, None)
         self._reconcile_once(name)
 
+    def _routes_changed(self, name: str) -> None:
+        """Publish a new routing table version AND drop the controller's
+        own loads/pressure caches for the deployment: they are arrays
+        aligned per-index with the OLD table, and routers refetching
+        after the event would otherwise be served the stale,
+        index-misaligned snapshots for up to a TTL (mis-costing
+        survivors / shedding on a removed replica's entry)."""
+        self._loads_cache.pop(name, None)
+        self._pressure_cache.pop(name, None)
+        self._route_version[name] = self._route_version.get(name, 0) + 1
+        _publish_route_event(name)
+
+    DRAIN_GRACE_S = 2.0  # RPC slack past the replica's own deadline
+
+    def _begin_drain(self, name: str, replica, cause: str) -> None:
+        """Start one replica's graceful drain. The caller (under the
+        reconcile lock) has already removed it from the routing table;
+        this fires ``Replica.drain`` and parks the entry for
+        :meth:`_advance_drains` to finish. A replica that cannot even be
+        asked to drain is killed on the spot."""
+        from ray_tpu._private import metrics_defs as mdefs
+
+        deadline_s = float(os.environ.get("RAY_TPU_SERVE_DRAIN_S", "30"))
+        entry = {"replica": replica, "t0": time.monotonic(),
+                 "deadline": time.monotonic() + deadline_s,
+                 "cause": cause, "ref": None}
+        try:
+            entry["ref"] = replica.drain.remote(deadline_s)
+        except Exception:  # noqa: BLE001 — undrainable: tear down now
+            try:
+                ray_tpu.kill(replica)
+            except Exception:  # noqa: BLE001
+                pass
+            return
+        self._draining.setdefault(name, []).append(entry)
+        mdefs.SERVE_REPLICA_DRAINS.inc(tags={"deployment": name,
+                                             "cause": cause})
+
+    def _advance_drains(self, name: str) -> None:
+        """Finish drains whose Replica.drain resolved (drained, hit its
+        deadline, or died mid-drain) — tear the replica down and record
+        the drain duration by outcome. Requests still running when the
+        deadline lapses are killed with the replica; their callers'
+        journals resume them on a live replica (death-while-draining
+        falls back to the resume path by design)."""
+        # Claim the entries under the lock (a preempt callback or drain
+        # RPC may append concurrently; an unlocked read-modify-write
+        # here could drop their entry and leak the replica), process
+        # outside it (the get below can block up to 1s), merge back.
+        with self._reconcile_lock:
+            entries = self._draining.pop(name, [])
+        if not entries:
+            return
+        from ray_tpu._private import metrics_defs as mdefs
+
+        now = time.monotonic()
+        keep = []
+        for e in entries:
+            outcome = None
+            try:
+                ready, _ = ray_tpu.wait([e["ref"]], num_returns=1,
+                                        timeout=0)
+            except Exception:  # noqa: BLE001
+                ready = []
+            if ready:
+                try:
+                    res = ray_tpu.get(e["ref"], timeout=1)
+                    outcome = ("drained" if res and res.get("drained")
+                               else "deadline")
+                except ray_tpu.exceptions.ActorDiedError:
+                    outcome = "died"
+                except Exception:  # noqa: BLE001
+                    outcome = "deadline"
+            elif now > e["deadline"] + self.DRAIN_GRACE_S:
+                outcome = "deadline"
+            if outcome is None:
+                keep.append(e)
+                continue
+            mdefs.SERVE_DRAIN_SECONDS.observe(
+                now - e["t0"], tags={"deployment": name,
+                                     "outcome": outcome})
+            if outcome == "died":
+                mdefs.SERVE_REPLICA_DEATHS.inc(
+                    tags={"deployment": name, "cause": "drain"})
+            try:
+                ray_tpu.kill(e["replica"])
+            except Exception:  # noqa: BLE001
+                pass
+        if keep:
+            with self._reconcile_lock:
+                # EXTEND, never assign: entries appended while we were
+                # processing must survive the merge.
+                self._draining.setdefault(name, []).extend(keep)
+
+    def _drain_for_preemption(self, notice: Dict[str, Any]) -> None:
+        """A preemption notice for a node: drain that node's replicas
+        (all replicas for an unscoped notice) instead of waiting for the
+        host to kill them. The routing table drops them immediately;
+        reconcile respawns replacements (checkpoint cold-start when the
+        deployment was built with ``checkpoint_path``)."""
+        target = str(notice.get("node", "*") or "*")
+        drain_all = target in ("", "*", "all")
+        # Phase 1, OUTSIDE the lock: probe replica node ids (up to ~2s
+        # of remote waits — holding the reconcile lock through them
+        # would freeze deploys and the very respawn work the preemption
+        # deadline depends on). One shared fan-out across ALL
+        # deployments (the get_replica_loads pattern).
+        with self._reconcile_lock:
+            snapshot = {name: list(reps)
+                        for name, reps in self.replicas.items() if reps}
+        hits_by_name: Dict[str, list] = {}
+        if drain_all:
+            hits_by_name = {n: list(reps) for n, reps in snapshot.items()}
+        else:
+            flat = [(name, r) for name, reps in snapshot.items()
+                    for r in reps]
+            refs = [r.node_id.remote() for _, r in flat]
+            try:
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs),
+                                        timeout=2.0)
+                ready_ids = {r.id().binary() for r in ready}
+                for (name, r), ref in zip(flat, refs):
+                    if ref.id().binary() not in ready_ids:
+                        continue
+                    try:
+                        nid = str(ray_tpu.get(ref, timeout=0.1) or "")
+                    except Exception:  # noqa: BLE001
+                        continue
+                    if nid and (nid == target or nid.startswith(target)):
+                        hits_by_name.setdefault(name, []).append(r)
+            except Exception:  # noqa: BLE001
+                hits_by_name = {}
+        # Phase 2, under the lock: mutate the tables — re-checking
+        # membership, since reconcile may have replaced a probed
+        # replica while we waited.
+        with self._reconcile_lock:
+            for name, hits in hits_by_name.items():
+                current = list(self.replicas.get(name, []))
+                hits = [r for r in hits if r in current]
+                if not hits:
+                    continue
+                stay = [r for r in current if r not in hits]
+                for r in hits:
+                    self._replica_birth.pop(id(r), None)
+                    self._begin_drain(name, r, cause="preemption")
+                self.replicas[name] = stay
+                self._routes_changed(name)
+
+    def drain_replicas(self, name: str, count: int = 1,
+                       cause: str = "operator") -> int:
+        """Operator/test surface: drain ``count`` replicas of ``name``
+        out of rotation WITHOUT shrinking the spec — reconcile respawns
+        replacements (a rolling replace). Returns how many drains
+        started."""
+        started = 0
+        with self._reconcile_lock:
+            current = list(self.replicas.get(name, []))
+            while current and started < count:
+                victim = current.pop()
+                self._replica_birth.pop(id(victim), None)
+                self._begin_drain(name, victim, cause=cause)
+                started += 1
+            if started:
+                self.replicas[name] = current
+                self._routes_changed(name)
+        return started
+
+    def draining_count(self, name: str) -> int:
+        return len(self._draining.get(name, []))
+
     def delete(self, name: str) -> bool:
         spec = self.deployments.pop(name, None)
         for r in self.replicas.pop(name, []):
             self._replica_birth.pop(id(r), None)
             try:
                 ray_tpu.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
+        with self._reconcile_lock:
+            doomed = self._draining.pop(name, [])
+        for e in doomed:
+            # Deleting a deployment is an explicit teardown: draining
+            # replicas go down with it.
+            try:
+                ray_tpu.kill(e["replica"])
             except Exception:  # noqa: BLE001
                 pass
         for cleanup in self._pg_cleanups.pop(name, []):
@@ -594,6 +851,10 @@ class ServeController:
             except ray_tpu.exceptions.ActorDiedError:
                 # Confirmed dead: replace immediately (no grace).
                 self._replica_birth.pop(id(r), None)
+                from ray_tpu._private import metrics_defs as mdefs
+
+                mdefs.SERVE_REPLICA_DEATHS.inc(
+                    tags={"deployment": name, "cause": "died"})
             except Exception:  # noqa: BLE001 — timeout: starting OR dead
                 birth = self._replica_birth.get(id(r))
                 if birth is not None and \
@@ -614,9 +875,7 @@ class ServeController:
                     [id(r) for r in self.replicas.get(name, [])]
                 self.replicas[name] = current
                 if changed:
-                    self._route_version[name] = \
-                        self._route_version.get(name, 0) + 1
-                    _publish_route_event(name)
+                    self._routes_changed(name)
                 return
             opts["scheduling_strategy"] = strategy
             if regrown:
@@ -643,20 +902,20 @@ class ServeController:
             self._replica_birth[id(replica)] = time.monotonic()
             current.append(replica)
         while len(current) > spec["num_replicas"]:
+            # Scale-down DRAINS the victim instead of killing it: it
+            # leaves the routing table now (the publish below), stops
+            # admitting, finishes its in-flight requests up to
+            # RAY_TPU_SERVE_DRAIN_S, and _advance_drains tears it down.
             victim = current.pop()
             self._replica_birth.pop(id(victim), None)
-            try:
-                ray_tpu.kill(victim)
-            except Exception:  # noqa: BLE001
-                pass
+            self._begin_drain(name, victim, cause="scale_down")
         changed = [id(r) for r in current] != \
             [id(r) for r in self.replicas.get(name, [])]
         self.replicas[name] = current
         if changed:
             # Push the new routing table to every handle (reference:
             # LongPollHost notify, long_poll.py:204).
-            self._route_version[name] = self._route_version.get(name, 0) + 1
-            _publish_route_event(name)
+            self._routes_changed(name)
 
     REPLICA_STARTUP_GRACE_S = 60.0
 
@@ -699,6 +958,14 @@ class ServeController:
                     self._reconcile_once(name)
                 except Exception:  # noqa: BLE001
                     pass
+            # Advance drains for every deployment with one in flight —
+            # including names no longer in the spec map (a redeploy
+            # mid-drain must not leak the old replica).
+            for name in list(self._draining):
+                try:
+                    self._advance_drains(name)
+                except Exception:  # noqa: BLE001
+                    pass
             try:
                 self._publish_pressure()
             except Exception:  # noqa: BLE001
@@ -706,8 +973,23 @@ class ServeController:
 
     def shutdown(self):
         self._stop = True
+        try:
+            from ray_tpu.checkpoint import preempt as _preempt
+
+            _preempt.unregister_preempt_callback(self._preempt_cb)
+        except Exception:  # noqa: BLE001
+            pass
         for name in list(self.deployments):
             self.delete(name)
+        with self._reconcile_lock:
+            leftovers = [e for entries in self._draining.values()
+                         for e in entries]
+            self._draining.clear()
+        for e in leftovers:
+            try:
+                ray_tpu.kill(e["replica"])
+            except Exception:  # noqa: BLE001
+                pass
 
 
 class DeploymentResponse:
@@ -721,20 +1003,53 @@ class DeploymentResponse:
         self._replica = replica
 
     def result(self, timeout_s: Optional[float] = 60.0):
+        from ray_tpu.serve import recovery
+
         ref, replica = self._ref, self._replica
-        attempts = 0
+        resumes = 0
+        drain_rejects = 0
         while True:
             try:
-                return ray_tpu.get(ref, timeout=timeout_s)
-            except ray_tpu.exceptions.ActorDiedError:
-                # The chosen replica died mid-flight: evict it from the
-                # handle's table (the controller may not have pruned it
-                # yet) and retry on a live replica (reference: router
-                # retries on ActorDiedError with an updated replica set).
+                out = ray_tpu.get(ref, timeout=timeout_s)
+                if resumes and self._handle is not None:
+                    # The call completed only thanks to >=1 death
+                    # retry: tagged so the outcome counter separates
+                    # clean finishes from recovered ones.
+                    recovery.note_unary_resumed(self._handle._name,
+                                                self._handle._model_id)
+                return out
+            except ray_tpu.exceptions.ReplicaDrainingError:
+                # Clean reject — the draining replica did no work, so
+                # the re-route is free (no resume budget). Bounded by
+                # the shared cap via the eviction below.
                 if self._handle is None or self._call is None or \
-                        attempts >= 5:
+                        drain_rejects >= recovery.DRAIN_REJECT_CAP:
                     raise
-                attempts += 1
+                drain_rejects += 1
+                recovery.note_unary_retry(self._handle._name,
+                                          "drain_reject")
+                self._handle._evict(replica)
+                args, kwargs = self._call
+                retry = self._handle.remote(*args, **kwargs)
+                ref, replica = retry._ref, retry._replica
+            except ray_tpu.exceptions.ActorDiedError as e:
+                # The chosen replica died mid-flight. A unary call's
+                # journal is its immutable (args, kwargs) submission
+                # plus the fact that ZERO response bytes were delivered
+                # — resubmission cannot double-deliver, so the retry is
+                # safe; it is still budgeted (RAY_TPU_SERVE_MAX_RESUMES,
+                # not a blind fixed cap) and tagged, and exhaustion is a
+                # typed terminal error (reference: router retries on
+                # ActorDiedError with an updated replica set).
+                if self._handle is None or self._call is None:
+                    raise
+                if resumes >= recovery.max_resumes():
+                    recovery.note_unary_exhausted(self._handle._name,
+                                                  self._handle._model_id)
+                    raise recovery.exhausted_error(
+                        self._handle._name, resumes) from e
+                resumes += 1
+                recovery.note_unary_retry(self._handle._name, "resubmit")
                 self._handle._evict(replica)
                 args, kwargs = self._call
                 retry = self._handle.remote(*args, **kwargs)
@@ -750,11 +1065,16 @@ class DeploymentResponseGenerator:
     yields them (reference: ``DeploymentResponseGenerator`` — handle
     ``stream=True``). Wraps the core ObjectRefGenerator.
     ``per_item_timeout_s`` bounds each item (None = wait indefinitely;
-    task failure still surfaces through the stream's stored error)."""
+    task failure still surfaces through the stream's stored error).
+    Carries the serving ``_replica`` so the recovery plane
+    (serve/recovery.py) can evict it from the routing table when the
+    stream dies mid-flight."""
 
-    def __init__(self, obj_ref_gen, per_item_timeout_s=None):
+    def __init__(self, obj_ref_gen, per_item_timeout_s=None,
+                 replica: Any = None):
         self._gen = obj_ref_gen
         self._timeout = per_item_timeout_s
+        self._replica = replica
 
     def __iter__(self):
         return self
@@ -950,6 +1270,15 @@ class DeploymentHandle:
             if name == self._name:
                 with st.lock:
                     st.dirty = True
+                    # The replica set changed (death, drain, scale):
+                    # per-index load/pressure snapshots are aligned with
+                    # the OLD table — invalidate them so the next read
+                    # refetches instead of mis-costing shifted indices
+                    # (or shedding on a drained replica's stale entry).
+                    st.loads_ts = 0.0
+                    st.pressure_ts = 0.0
+                    st.shared_loads = []
+                    st.shared_pressure = []
 
         try:
             _subscribe_route_events(on_event)
@@ -965,18 +1294,34 @@ class DeploymentHandle:
         _, replicas = ray_tpu.get(
             controller.get_routes.remote(self._name), timeout=30)
         with st.lock:
+            changed = [id(r) for r in replicas] != \
+                [id(r) for r in st.replicas]
             st.replicas = replicas
             st.dirty = False
             st.inflight = {}
+            if changed:
+                # New table: index-aligned caches are stale (see the
+                # route-event callback above).
+                st.loads_ts = 0.0
+                st.pressure_ts = 0.0
+                st.shared_loads = []
+                st.shared_pressure = []
 
     def _evict(self, replica) -> None:
-        """Drop a replica observed dead; refreshed tables re-add the live
-        set (reference: router removes failed replicas eagerly)."""
+        """Drop a replica observed dead or draining; refreshed tables
+        re-add the live set (reference: router removes failed replicas
+        eagerly)."""
         st = self._router
         with st.lock:
             st.replicas = [r for r in st.replicas if r is not replica]
             st.inflight = {}
             st.dirty = not st.replicas
+            # Its load/pressure entries must not cost the survivors
+            # (indices shifted) or feed the admission gate.
+            st.loads_ts = 0.0
+            st.pressure_ts = 0.0
+            st.shared_loads = []
+            st.shared_pressure = []
 
     def _choose(self, model_id: str = "", prefix_key: str = ""):
         """Power-of-two-choices over in-flight counts; multiplexed calls
@@ -1065,11 +1410,24 @@ class DeploymentHandle:
         the freshness path: routing and ingress admission read the
         CACHED copy; only one call per TTL pays the controller round
         trip (which itself serves from its own 0.5s probe cache), so
-        per-request cost is a clock read and a dict lookup."""
+        per-request cost is a clock read and a dict lookup. Subscribes
+        to route events so a replica removal (death/drain) invalidates
+        the cache even on gate-only paths that never route."""
+        from ray_tpu._private import chaos
+
+        self._ensure_subscribed()
         st = self._router
         now = time.monotonic()
         if now - st.pressure_ts < self.PRESSURE_TTL_S:
             return st.shared_pressure
+        if chaos.enabled():
+            # Dropped/stale pressure fetch: keep serving whatever the
+            # cache holds (possibly nothing) without refreshing — the
+            # admission gate and affinity policy must stay safe on
+            # stale data.
+            d = chaos.inject("serve_pressure", deployment=self._name)
+            if d and d.get("drop"):
+                return st.shared_pressure
         st.pressure_ts = now  # claim first: no thundering herd
         try:
             controller = ray_tpu.get_actor(CONTROLLER_NAME)
@@ -1141,7 +1499,7 @@ class DeploymentHandle:
                 gen.completed().future().add_done_callback(_sdone)
             except Exception:  # noqa: BLE001
                 _sdone(None)
-            return DeploymentResponseGenerator(gen)
+            return DeploymentResponseGenerator(gen, replica=replica)
         ref = replica.handle_request.remote(self._method, args, kwargs,
                                             self._model_id, request_ctx)
 
@@ -1350,6 +1708,18 @@ def run(app: Application, *, name: str = "default",
 
 def get_deployment_handle(name: str, app_name: str = "default") -> DeploymentHandle:
     return DeploymentHandle(name)
+
+
+def drain(name: str, count: int = 1) -> int:
+    """Gracefully drain ``count`` replicas of deployment ``name`` out of
+    rotation (operator surface — a rolling replace): each drained
+    replica stops admitting, leaves the routing ring, finishes its
+    in-flight requests up to ``RAY_TPU_SERVE_DRAIN_S``, and is replaced
+    by a fresh replica. Returns how many drains started."""
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(
+        controller.drain_replicas.remote(name, count, "operator"),
+        timeout=30)
 
 
 def delete(name: str):

@@ -28,8 +28,19 @@ def get_request_context() -> Optional[Dict[str, Any]]:
     """The in-flight serve request's context, or None outside a serve
     call. Keys: ``request_id``, ``trace_id``, ``parent_span_id``,
     ``deployment``, ``tenant`` (the multiplexed model id, '' for
-    single-tenant deployments)."""
+    single-tenant deployments), and — on a request the recovery journal
+    re-dispatched after replica death or a drain reject — ``attempt``
+    (1-based redispatch count; absent on the first attempt). The ids
+    stay IDENTICAL across attempts: a resumed request is one trace whose
+    engine spans land on two replicas."""
     return _request_ctx.get()
+
+
+def get_request_attempt() -> int:
+    """Redispatch count of the in-flight request (0 = first attempt —
+    also outside any serve call)."""
+    ctx = _request_ctx.get()
+    return int(ctx.get("attempt", 0)) if ctx else 0
 
 
 def _set_request_context(ctx: Optional[Dict[str, Any]]):
@@ -40,4 +51,4 @@ def _reset_request_context(token) -> None:
     _request_ctx.reset(token)
 
 
-__all__ = ["get_request_context"]
+__all__ = ["get_request_attempt", "get_request_context"]

@@ -190,14 +190,21 @@ class _Router:
     def stream(self, name: str, method: Optional[str], payload,
                model_id: str = "",
                request_ctx: Optional[Dict[str, Any]] = None):
+        """Streaming dispatch through the RECOVERY JOURNAL: the returned
+        iterator survives replica death (queued/prefilling requests
+        resubmit; mid-decode LLM requests resume as prompt + emitted
+        tokens, exactly-once under greedy decoding) and drain rejects
+        re-route for free. The iterator's ``.journal`` tells the ingress
+        whether to surface the ``x-ray-tpu-resumed`` marker."""
+        from ray_tpu.serve.recovery import (RecoverableStream,
+                                            RequestJournal)
+
         self._check_public(method)
-        h = self.handle(name).options(
-            method, stream=True, multiplexed_model_id=model_id,
-            request_context=request_ctx,
-            prefix_key=prefix_fingerprint(payload))
-        gen = h.remote(payload)
-        gen._timeout = 60.0  # per-item bound, like result()
-        return iter(gen)
+        journal = RequestJournal(name, method, payload,
+                                 model_id=model_id,
+                                 request_ctx=request_ctx)
+        return RecoverableStream(self.handle(name), journal,
+                                 per_item_timeout_s=60.0)
 
 
 def ingress_request_context(deployment: str, tenant: str = "",
@@ -483,10 +490,22 @@ class AsyncHttpProxy:
         except Exception:
             _close_ingress_span(rctx, ing_t0, "error", path)
             raise
+        journal = getattr(items, "journal", None)
         conn = "keep-alive" if keep_alive else "close"
+        marker_sent = False
+        extra = ""
+        if journal is not None and journal.needs_marker:
+            # A SAMPLED request was already resumed during the first
+            # pull: the continuation is a re-seeded draw, and the
+            # header says so before any token reaches the client.
+            from ray_tpu.serve.recovery import RESUMED_MARKER
+
+            extra = f"{RESUMED_MARKER}: {journal.resumes}\r\n"
+            marker_sent = True
         writer.write((f"HTTP/1.1 200 OK\r\n"
                       f"Content-Type: application/x-ndjson\r\n"
                       f"Transfer-Encoding: chunked\r\n"
+                      f"{extra}"
                       f"Connection: {conn}\r\n\r\n").encode())
         item = first
         try:
@@ -496,6 +515,17 @@ class AsyncHttpProxy:
                              + b"\r\n")
                 await writer.drain()  # backpressure: slow client, slow pull
                 item = await loop.run_in_executor(self._pool, pull)
+            if journal is not None and journal.needs_marker \
+                    and not marker_sent:
+                # The sampled resume happened MID-stream (headers long
+                # gone): a trailing NDJSON control object carries the
+                # marker instead.
+                from ray_tpu.serve.recovery import RESUMED_MARKER
+
+                chunk = json.dumps(
+                    {RESUMED_MARKER: journal.resumes}).encode() + b"\n"
+                writer.write(f"{len(chunk):x}\r\n".encode() + chunk
+                             + b"\r\n")
             writer.write(b"0\r\n\r\n")
             await writer.drain()
             _close_ingress_span(rctx, ing_t0, 200, path)
@@ -599,6 +629,15 @@ class GrpcProxy:
             for item in items:
                 yield pb.ServeReply(ok=True,
                                     payload=json.dumps(item).encode())
+            journal = getattr(items, "journal", None)
+            if journal is not None and journal.needs_marker:
+                # Sampled request resumed mid-decode: a trailing control
+                # reply surfaces the re-seed (the gRPC analog of the
+                # x-ray-tpu-resumed header/NDJSON marker).
+                from ray_tpu.serve.recovery import RESUMED_MARKER
+
+                yield pb.ServeReply(ok=True, payload=json.dumps(
+                    {RESUMED_MARKER: journal.resumes}).encode())
             status = "ok"
         except Exception as e:  # noqa: BLE001
             # Terminate with an RPC error, NOT a trailing ok=False item:

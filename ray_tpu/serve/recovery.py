@@ -1,0 +1,372 @@
+"""Serve in-flight request recovery: the journal + resume plane.
+
+The serve twin of ``train/elastic.py``'s restart machinery: a replica
+death must not cost the caller their request. Every streaming request
+dispatched through the ingress router is journaled — its *immutable
+submission* (the payload: prompt token ids, sampling knobs, max_tokens),
+the tenant, the request's trace context, and the items already streamed
+to the caller. When the serving replica dies mid-flight
+(``ActorDiedError`` surfacing out of the response stream), the journal
+decides the recovery:
+
+* **queued or prefilling** (zero items streamed): the submission is
+  simply resubmitted to a live replica — nothing was delivered, so the
+  retry is invisible (``cause="resubmit"``).
+* **mid-decode** (tokens already streamed): the journal rebuilds the
+  request as ``prompt + already-emitted tokens`` with the remaining
+  token budget and replays it as a fresh prefill on a live replica
+  (``cause="resume"``). Under greedy decoding this is **exactly-once by
+  construction**: the next token is a pure function of the context, so
+  the resumed stream continues bit-identically (verified by the chaos
+  e2e tests). A *sampled* request re-seeds at the resume point — its
+  continuation is a fresh draw, surfaced to the client via the
+  ``x-ray-tpu-resumed`` marker so exactly-once consumers can tell.
+* **draining replica** (clean reject at dispatch,
+  ``ReplicaDrainingError``): re-routed to another replica without
+  consuming the resume budget — the replica did no work.
+
+Budget: ``RAY_TPU_SERVE_MAX_RESUMES`` (default 2) death recoveries per
+request; exhaustion raises the typed
+:class:`~ray_tpu.exceptions.ResumeExhaustedError` and tags the request
+``resume_exhausted`` in ``ray_tpu_serve_request_outcomes_total``. A
+stream that completes after >=1 recovery is tagged ``resumed``.
+
+Every router dispatch path (unary retry in
+``serve/api.py::DeploymentResponse.result`` and the streaming path here)
+handles ``ActorDiedError`` through this module — a tier-1 source lint
+(tests/test_metrics_lint.py) enforces that no bare retry creeps back in.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu import exceptions
+
+logger = logging.getLogger(__name__)
+
+#: Stream/header marker a client sees when a SAMPLED request was resumed
+#: mid-decode (its continuation re-seeded — not the draw the dead
+#: replica would have produced). Greedy resumes are exactly-once and
+#: carry no marker.
+RESUMED_MARKER = "x-ray-tpu-resumed"
+
+#: Sentinel from :meth:`RequestJournal.resume_payload`: every requested
+#: token was already delivered before the death — the stream is complete,
+#: nothing to resume.
+COMPLETE = object()
+
+
+def max_resumes() -> int:
+    """Per-request death-recovery budget (``RAY_TPU_SERVE_MAX_RESUMES``,
+    read per decision so tests/operators retune live)."""
+    return int(os.environ.get("RAY_TPU_SERVE_MAX_RESUMES", "2"))
+
+
+#: Drain rejects a single request tolerates before giving up — rejects
+#: are free (the replica did no work) but must be bounded so a
+#: deployment whose every replica is draining cannot spin a dispatch
+#: loop forever. Shared by the streaming journal and the unary path in
+#: ``serve/api.py`` (ONE policy, no drift).
+DRAIN_REJECT_CAP = 16
+
+
+def exhausted_error(deployment: str,
+                    resumes: int) -> "exceptions.ResumeExhaustedError":
+    """The one typed terminal error both dispatch paths raise when the
+    resume budget runs out."""
+    return exceptions.ResumeExhaustedError(
+        f"replica serving {deployment!r} died and the resume budget "
+        f"(RAY_TPU_SERVE_MAX_RESUMES={max_resumes()}) is spent",
+        resumes=resumes)
+
+
+def is_llm_payload(payload: Any) -> bool:
+    """True for the LLM completion payload shape (``prompt_token_ids``)
+    whose streams are token-id items — the only shape resumable
+    *mid-stream* (the emitted tokens extend the prompt)."""
+    return (isinstance(payload, dict)
+            and isinstance(payload.get("prompt_token_ids"), (list, tuple)))
+
+
+def is_sampled(payload: Any) -> bool:
+    """True when the request explicitly asks for sampled decoding —
+    the case whose mid-decode resume re-seeds (and gets the
+    ``x-ray-tpu-resumed`` marker). Engine-default decoding is greedy
+    argmax, so an unannotated payload counts as greedy."""
+    if not isinstance(payload, dict):
+        return False
+    try:
+        if float(payload.get("temperature") or 0.0) > 0.0:
+            return True
+    except (TypeError, ValueError):
+        return True  # unparseable knob: assume sampled (be honest)
+    s = payload.get("sampling")
+    if isinstance(s, dict):
+        try:
+            return float(s.get("temperature") or 0.0) > 0.0
+        except (TypeError, ValueError):
+            return True
+    return False
+
+
+class RequestJournal:
+    """The immutable submission + delivery ledger of ONE streaming
+    request. The payload is never mutated; resume payloads are derived
+    copies. ``emitted`` holds exactly the items the consumer has been
+    handed (recorded *after* a successful pull, so an item lost in
+    flight is replayed, never skipped)."""
+
+    def __init__(self, deployment: str, method: Optional[str],
+                 payload: Any, model_id: str = "",
+                 request_ctx: Optional[Dict[str, Any]] = None):
+        self.deployment = deployment
+        self.method = method
+        self.payload = payload
+        self.model_id = model_id
+        # The SAME request context rides every attempt, so a resumed
+        # request's spans across two replicas land in ONE trace
+        # (`ray-tpu trace request` shows both replicas' engine spans).
+        self.request_ctx = request_ctx
+        self.emitted: List[Any] = []
+        self.resumes = 0          # death recoveries (budgeted)
+        self.drain_rejects = 0    # clean re-routes (not budgeted)
+        self.resumed_midstream = False
+
+    # ------------------------------------------------------------ queries
+    @property
+    def llm(self) -> bool:
+        return is_llm_payload(self.payload)
+
+    @property
+    def sampled(self) -> bool:
+        return is_sampled(self.payload)
+
+    @property
+    def needs_marker(self) -> bool:
+        """The client must be told: a sampled request was resumed
+        mid-decode, so its continuation is a re-seeded draw."""
+        return self.resumed_midstream and self.sampled
+
+    def record(self, item: Any) -> None:
+        self.emitted.append(item)
+
+    def tags(self, engine: str = "router") -> Dict[str, str]:
+        return {"deployment": self.deployment, "tenant": self.model_id,
+                "engine": engine}
+
+    # ------------------------------------------------------------- resume
+    def resume_payload(self) -> Any:
+        """The next attempt's submission, derived from the journal:
+
+        * nothing emitted -> the original payload (plain resubmission);
+        * mid-stream LLM request -> prompt extended by the emitted
+          tokens, ``max_tokens`` reduced by them (:data:`COMPLETE` when
+          zero remain);
+        * mid-stream non-LLM request -> ``None`` (items already reached
+          the caller and the stream has no replay semantics — not
+          resumable)."""
+        if not self.emitted:
+            return self.payload
+        if not self.llm:
+            return None
+        toks: List[int] = []
+        for it in self.emitted:
+            if isinstance(it, bool) or not isinstance(it, int):
+                return None  # non-token items: no replay semantics
+            toks.append(int(it))
+        try:
+            budget = int(self.payload.get("max_tokens", 16))
+        except (TypeError, ValueError):
+            return None
+        remaining = budget - len(toks)
+        if remaining <= 0:
+            return COMPLETE
+        ids = list(self.payload["prompt_token_ids"]) + toks
+        # resumed_tokens marks this as a mid-decode REPLAY: the serving
+        # deployment uses it to honor an EOS that was already streamed
+        # (the generation had finished; only the end-of-stream sentinel
+        # was lost with the replica) instead of decoding past it with
+        # the leftover budget.
+        return {**self.payload, "prompt_token_ids": ids,
+                "max_tokens": remaining, "resumed_tokens": len(toks)}
+
+
+class RecoverableStream:
+    """Iterator over a streaming deployment call that survives replica
+    death and drain. Wraps the handle dispatch: every pull that raises
+    ``ActorDiedError`` goes through the journal (resubmit / resume /
+    typed exhaustion), and a ``ReplicaDrainingError`` reject re-routes
+    to a live replica for free. This is the ONLY place the streaming
+    router path handles ``ActorDiedError`` (source-linted)."""
+
+    def __init__(self, handle, journal: RequestJournal,
+                 per_item_timeout_s: Optional[float] = 60.0):
+        self._handle = handle
+        self.journal = journal
+        self._timeout = per_item_timeout_s
+        self._inner = None
+        self._replica = None
+        self._completion_reported = False
+
+    def __iter__(self) -> "RecoverableStream":
+        return self
+
+    # ---------------------------------------------------------- dispatch
+    def _dispatch(self, payload: Any) -> None:
+        from ray_tpu.serve.proxy import prefix_fingerprint
+
+        j = self.journal
+        # The SAME trace context rides every attempt (one trace across
+        # both replicas); the attempt counter is stamped in so the two
+        # replicas' engine spans are tell-apart-able in the transcript.
+        rctx = j.request_ctx
+        if rctx is not None and (j.resumes or j.drain_rejects):
+            rctx = {**rctx, "attempt": j.resumes + j.drain_rejects}
+        # The prefix key is recomputed from the attempt's payload: after
+        # an eviction the rendezvous ring has one fewer replica, so the
+        # key re-homes onto the dead replica's second choice.
+        h = self._handle.options(
+            j.method, stream=True, multiplexed_model_id=j.model_id,
+            request_context=rctx,
+            prefix_key=prefix_fingerprint(payload))
+        gen = h.remote(payload)
+        gen._timeout = self._timeout
+        self._replica = getattr(gen, "_replica", None)
+        self._inner = iter(gen)
+
+    def _evict(self) -> None:
+        if self._replica is not None:
+            try:
+                self._handle._evict(self._replica)
+            except Exception:  # noqa: BLE001 — eviction is best-effort
+                pass
+            self._replica = None
+
+    # ------------------------------------------------------------ recover
+    def _reroute_drained(self) -> None:
+        """The chosen replica is draining (clean reject — it did no
+        work): evict it locally and redispatch the same submission.
+        Free — no resume budget consumed — but bounded by the replica
+        count so a fully-draining deployment cannot spin forever."""
+        from ray_tpu._private import metrics_defs as mdefs
+
+        j = self.journal
+        j.drain_rejects += 1
+        if j.drain_rejects > DRAIN_REJECT_CAP:
+            raise exceptions.ReplicaDrainingError(
+                f"every replica of {j.deployment!r} rejected the request "
+                f"as draining ({j.drain_rejects} rejects)")
+        self._evict()
+        mdefs.SERVE_REPLICA_RESUMES.inc(tags={
+            "deployment": j.deployment, "cause": "drain_reject"})
+        # A drain reject happens at dispatch, before anything streamed,
+        # so the original submission redispatches verbatim.
+        self._dispatch(j.resume_payload() if j.emitted else j.payload)
+
+    def _resume_after_death(self, err: BaseException) -> None:
+        from ray_tpu._private import metrics_defs as mdefs
+        from ray_tpu.util import tracing
+
+        j = self.journal
+        self._evict()
+        payload = j.resume_payload()
+        if payload is None:
+            # Items already reached the caller and the stream has no
+            # replay semantics: recovery would duplicate or reorder
+            # delivered items, so surface the death honestly.
+            raise err
+        if payload is COMPLETE:
+            # Every requested token was delivered before the death: the
+            # stream is COMPLETE, not failed (only the end-of-stream
+            # notification was lost) — no budget consumed, so this
+            # check precedes the exhaustion gate.
+            self._inner = iter(())
+            return
+        if j.resumes >= max_resumes():
+            mdefs.SERVE_REQ_OUTCOMES.inc(tags={
+                **j.tags(), "outcome": "resume_exhausted"})
+            raise exhausted_error(j.deployment, j.resumes) from err
+        cause = "resume" if j.emitted else "resubmit"
+        j.resumes += 1
+        if j.emitted:
+            j.resumed_midstream = True
+        mdefs.SERVE_REPLICA_RESUMES.inc(tags={
+            "deployment": j.deployment, "cause": cause})
+        rctx = j.request_ctx or {}
+        if rctx and tracing.enabled():
+            # A zero-duration marker span in the request's trace: the
+            # recovery point between the two replicas' engine spans.
+            tracing.emit_span(
+                "serve.resume", trace_id=rctx.get("trace_id", ""),
+                parent_span_id=rctx.get("parent_span_id", ""),
+                ts=time.time(), dur=0.0, kind="route",
+                request_id=rctx.get("request_id", ""),
+                deployment=j.deployment, cause=cause,
+                emitted=len(j.emitted), attempt=j.resumes)
+        logger.warning(
+            "serve: %s request to %r after replica death "
+            "(%d item(s) already streamed, attempt %d/%d)",
+            cause, j.deployment, len(j.emitted), j.resumes,
+            max_resumes())
+        self._dispatch(payload)
+
+    # ------------------------------------------------------------ iterate
+    def __next__(self) -> Any:
+        from ray_tpu._private import metrics_defs as mdefs
+
+        j = self.journal
+        if self._inner is None:
+            self._dispatch(j.payload)
+        while True:
+            try:
+                item = next(self._inner)
+            except StopIteration:
+                if j.resumes and not self._completion_reported:
+                    self._completion_reported = True
+                    mdefs.SERVE_REQ_OUTCOMES.inc(tags={
+                        **j.tags(), "outcome": "resumed"})
+                raise
+            except exceptions.ReplicaDrainingError:
+                self._reroute_drained()
+                continue
+            except exceptions.ActorDiedError as e:
+                self._resume_after_death(e)
+                continue
+            j.record(item)
+            return item
+
+
+def note_unary_resumed(deployment: str, tenant: str) -> None:
+    """Metrics for a unary call that completed after >=1 death retry
+    (the ``serve/api.py`` unary journal path)."""
+    from ray_tpu._private import metrics_defs as mdefs
+
+    mdefs.SERVE_REQ_OUTCOMES.inc(tags={
+        "deployment": deployment, "tenant": tenant, "engine": "router",
+        "outcome": "resumed"})
+
+
+def note_unary_exhausted(deployment: str, tenant: str) -> None:
+    from ray_tpu._private import metrics_defs as mdefs
+
+    mdefs.SERVE_REQ_OUTCOMES.inc(tags={
+        "deployment": deployment, "tenant": tenant, "engine": "router",
+        "outcome": "resume_exhausted"})
+
+
+def note_unary_retry(deployment: str, cause: str) -> None:
+    from ray_tpu._private import metrics_defs as mdefs
+
+    mdefs.SERVE_REPLICA_RESUMES.inc(tags={
+        "deployment": deployment, "cause": cause})
+
+
+__all__ = ["COMPLETE", "DRAIN_REJECT_CAP", "RESUMED_MARKER",
+           "RecoverableStream", "RequestJournal", "exhausted_error",
+           "is_llm_payload", "is_sampled", "max_resumes",
+           "note_unary_exhausted", "note_unary_resumed",
+           "note_unary_retry"]

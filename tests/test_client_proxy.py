@@ -19,12 +19,11 @@ from ray_tpu.cluster_utils import Cluster
 def proxy_cluster():
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
-    # Widened node-liveness TTL: client subprocesses spawning under
-    # co-tenant CPU load can starve the 0.5s heartbeats past the default
-    # 3s threshold and get the (healthy) node reaped mid-test (flaky
-    # since PR 1). Driver subprocesses inherit the env.
-    old_ttl = os.environ.get("RAY_TPU_HEARTBEAT_TTL_S")
-    os.environ["RAY_TPU_HEARTBEAT_TTL_S"] = "15"
+    # No widened heartbeat TTL anymore (the PR 1-era flake guard):
+    # client subprocesses spawning under co-tenant CPU load can still
+    # starve the 0.5s heartbeats past the 3s threshold, but the GCS
+    # health check is probe-before-reap now — the lapsed (healthy) node
+    # answers the direct liveness probe and keeps its registration.
     c = Cluster(head_node_args={"num_cpus": 4})
     c.wait_for_nodes()
     ray_tpu.init(address=c.address)  # the proxy shares this runtime
@@ -35,10 +34,6 @@ def proxy_cluster():
     proxy._server.close()
     ray_tpu.shutdown()
     c.shutdown()
-    if old_ttl is None:
-        os.environ.pop("RAY_TPU_HEARTBEAT_TTL_S", None)
-    else:
-        os.environ["RAY_TPU_HEARTBEAT_TTL_S"] = old_ttl
 
 
 CLIENT_SCRIPT = textwrap.dedent("""

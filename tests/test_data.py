@@ -12,9 +12,26 @@ from ray_tpu import data as rdata
 def ray8():
     if ray_tpu.is_initialized():
         ray_tpu.shutdown()
+    # The tier-1 suite runs every module in ONE process: a stats-actor
+    # handle cached by a PREVIOUS suite's session would silently eat
+    # this module's first stats records (the in-suite-only ordering
+    # flake) — start from clean process-global state.
+    from ray_tpu.data import dataset as dataset_mod
+
+    dataset_mod.reset_stats_cache()
     ray_tpu.init(num_cpus=8)
     yield
     ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stats_cache():
+    # Tests inside this module also cycle sessions (ray_start_regular,
+    # the distributed-shuffle cluster): reset between tests too.
+    from ray_tpu.data import dataset as dataset_mod
+
+    dataset_mod.reset_stats_cache()
+    yield
 
 
 def test_range_count_take():

@@ -1,0 +1,634 @@
+"""Serve fault tolerance: graceful drain, in-flight recovery, chaos
+replica lifecycle.
+
+The serve twin of tests/test_train_elastic.py — every recovery path is
+driven by a REAL injected fault (``_private/chaos.py`` serve sites:
+``kill_replica`` mid-prefill / mid-decode / while-draining,
+``delay_tick``, ``drop_pressure``), seed-deterministic like the train
+suite. Acceptance (ISSUE 13): ``kill_replica`` mid-decode under greedy
+sampling yields the bit-identical completion the un-killed run
+produces, and a drain under load finishes with zero dropped in-flight
+requests.
+"""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu._private import chaos
+from ray_tpu._private import metrics_defs as mdefs
+from ray_tpu.exceptions import ReplicaDrainingError, ResumeExhaustedError
+from ray_tpu.serve.recovery import (COMPLETE, RequestJournal, is_sampled,
+                                    max_resumes)
+
+pytestmark = pytest.mark.chaos
+
+
+# ----------------------------------------------------------- unit: journal
+
+def test_journal_resume_payload_shapes():
+    payload = {"prompt_token_ids": [1, 2, 3, 4], "max_tokens": 6}
+    j = RequestJournal("llm", "generate", payload)
+    # Nothing emitted: plain resubmission of the immutable submission.
+    assert j.resume_payload() is payload
+    # Mid-decode: prompt extends by the emitted tokens, budget shrinks,
+    # and the replay is marked (the deployment's EOS guard reads it).
+    j.record(10)
+    j.record(11)
+    resumed = j.resume_payload()
+    assert resumed == {"prompt_token_ids": [1, 2, 3, 4, 10, 11],
+                       "max_tokens": 4, "resumed_tokens": 2}
+    assert payload["prompt_token_ids"] == [1, 2, 3, 4]  # untouched
+    # Every token delivered: the stream is COMPLETE, not failed.
+    for t in (12, 13, 14, 15):
+        j.record(t)
+    assert j.resume_payload() is COMPLETE
+    # Non-token items have no replay semantics.
+    j2 = RequestJournal("llm", "generate", payload)
+    j2.record({"not": "a token"})
+    assert j2.resume_payload() is None
+    # bool is an int subclass — still not a token.
+    j3 = RequestJournal("llm", "generate", payload)
+    j3.record(True)
+    assert j3.resume_payload() is None
+    # Non-LLM payloads resubmit only from zero.
+    j4 = RequestJournal("echo", None, {"n": 3})
+    assert j4.resume_payload() == {"n": 3}
+    j4.record(1)
+    assert j4.resume_payload() is None
+
+
+def test_sampled_detection_and_marker_gate():
+    assert not is_sampled({"prompt_token_ids": [1], "max_tokens": 2})
+    assert not is_sampled({"temperature": 0})
+    assert is_sampled({"temperature": 0.7})
+    assert is_sampled({"sampling": {"temperature": 0.9}})
+    assert is_sampled({"temperature": "oops"})  # unparseable: honest
+    j = RequestJournal("llm", "generate",
+                       {"prompt_token_ids": [1], "max_tokens": 4,
+                        "temperature": 0.7})
+    assert not j.needs_marker          # nothing resumed yet
+    j.resumed_midstream = True
+    assert j.needs_marker              # sampled + resumed mid-decode
+    jg = RequestJournal("llm", "generate",
+                        {"prompt_token_ids": [1], "max_tokens": 4})
+    jg.resumed_midstream = True
+    assert not jg.needs_marker         # greedy resume is exactly-once
+
+
+def test_chaos_serve_rules_parse_and_act():
+    # kill_replica parses onto the serve_replica site with phase/token
+    # coordinates; drop_pressure and delay_tick return directives.
+    plan = chaos.configure(
+        "kill_replica:phase=decode,token=3;drop_pressure;"
+        "delay_tick:secs=0.001,times=2", seed=11)
+    try:
+        assert [r.site for r in plan.rules] == [
+            "serve_replica", "serve_pressure", "serve_tick"]
+        # Wrong phase / wrong token: nothing fires.
+        assert chaos.inject("serve_replica", phase="prefill",
+                            tokens=4) is None
+        assert chaos.inject("serve_replica", phase="decode",
+                            token=1) is None
+        assert chaos.inject("serve_pressure",
+                            deployment="d") == {"drop": True}
+        assert chaos.inject("serve_pressure", deployment="d") is None
+        d = chaos.inject("serve_tick", engine="e")
+        assert d and d["slept_s"] == pytest.approx(0.001)
+        # The matching kill raises simulated process death.
+        with pytest.raises(chaos.SimulatedProcessDeath):
+            chaos.inject("serve_replica", phase="decode", token=3)
+        log = [e["action"] for e in chaos.injection_log()]
+        assert log.count("kill_replica") == 1
+    finally:
+        chaos.configure(None)
+
+
+# ------------------------------------------------------ unit: replica drain
+
+def test_replica_drain_stops_admitting_and_reports():
+    from ray_tpu.serve.api import Replica
+
+    class Slow:
+        def __call__(self, payload):
+            time.sleep(0.15)
+            return payload
+
+    r = Replica(Slow, (), {}, is_function=False, sync_workers=2)
+
+    async def drive():
+        inflight = asyncio.ensure_future(
+            r.handle_request(None, ({"x": 1},), {}))
+        await asyncio.sleep(0.02)          # let it admit
+        drain = asyncio.ensure_future(r.drain(5.0))
+        await asyncio.sleep(0.02)          # drain flag latched
+        with pytest.raises(ReplicaDrainingError):
+            await r.handle_request(None, ({"x": 2},), {})
+        res = await drain                   # waits for the in-flight one
+        assert (await inflight) == {"x": 1}
+        return res
+
+    res = asyncio.new_event_loop().run_until_complete(drive())
+    assert res["drained"] and res["remaining"] == 0
+    # Deadline path: a wedged request times the drain out.
+    r2 = Replica(Slow, (), {}, is_function=False, sync_workers=2)
+
+    async def drive_deadline():
+        inflight = asyncio.ensure_future(
+            r2.handle_request(None, ({"x": 3},), {}))
+        await asyncio.sleep(0.02)
+        res = await r2.drain(0.05)          # far shorter than the call
+        await inflight
+        return res
+
+    res2 = asyncio.new_event_loop().run_until_complete(drive_deadline())
+    assert not res2["drained"] and res2["remaining"] == 1
+
+
+def test_resume_after_streamed_eos_stops_instead_of_decoding_past_it():
+    """A mid-decode resume whose last DELIVERED token was EOS means the
+    original generation had finished — only the end-of-stream sentinel
+    died with the replica. The resumed attempt must yield nothing, not
+    decode the leftover budget past EOS. (An ORIGINAL prompt ending in
+    EOS still generates: only marked replays check.)"""
+    from ray_tpu.llm import ContinuousLlamaDeployment
+    from ray_tpu.models import llama
+
+    # The raw replica class behind the @serve.deployment wrapper.
+    dep = ContinuousLlamaDeployment._cls_or_fn(
+        config=llama.LlamaConfig.tiny(), num_slots=2, max_len=64,
+        eos_token=99)
+    resumed = {"prompt_token_ids": [1, 2, 3, 99], "max_tokens": 5,
+               "resumed_tokens": 2}
+    assert list(dep.generate(resumed)) == []
+    fresh = {"prompt_token_ids": [1, 2, 3, 99], "max_tokens": 3}
+    out = list(dep.generate(fresh))
+    assert len(out) >= 1   # EOS may legitimately end it early, not 0
+
+
+# --------------------------------------------------------------- fixtures
+
+def _counter_value(metric, **want):
+    total = 0.0
+    for _, tags, v in metric.samples():
+        td = dict(tags)
+        if all(td.get(k) == v2 for k, v2 in want.items()):
+            total += v
+    return total
+
+
+@pytest.fixture(scope="module", autouse=True)
+def ray_session():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    chaos.configure(None)
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    yield
+    chaos.configure(None)
+
+
+LLM = "ContinuousLlamaDeployment"
+
+
+@pytest.fixture(scope="module")
+def llm_app(ray_session):
+    from ray_tpu.llm import build_continuous_llama_app
+    from ray_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny()
+    # Default seed -> every replica (and every respawn) initializes
+    # IDENTICAL params: a resumed request continues on a replica whose
+    # logits match the dead one's bit-for-bit.
+    app = build_continuous_llama_app(config=cfg, num_replicas=2,
+                                     num_slots=4, max_len=64)
+    serve.run(app, name="llm")
+    yield
+    serve.delete(LLM)
+
+
+def _controller():
+    return ray_tpu.get_actor("__serve_controller__")
+
+
+def _wait_replicas(name, n, timeout_s=90, drained=True):
+    """Wait until the controller routes n HEALTHY replicas (and, when
+    ``drained``, no drain is still in flight) — the clean-start point
+    after a test that killed or drained replicas. Health-probed, not
+    just counted: mid-reconcile the table can hold a dead replica the
+    controller hasn't probed yet, and a test starting then would see an
+    extra (legitimate, but count-perturbing) resume."""
+    controller = _controller()
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        reps = ray_tpu.get(controller.get_replicas.remote(name),
+                           timeout=10)
+        left = ray_tpu.get(controller.draining_count.remote(name),
+                           timeout=10) if drained else 0
+        if len(reps) == n and left == 0:
+            try:
+                for r in reps:
+                    ray_tpu.get(r.health.remote(), timeout=10)
+                return reps
+            except Exception:  # noqa: BLE001 — dead/starting: keep waiting
+                pass
+        time.sleep(0.2)
+    raise AssertionError(f"never reached {n} routed replicas of {name}")
+
+
+def _stream(payload, timeout_s=120.0):
+    from ray_tpu.serve.proxy import _Router
+
+    s = _Router().stream(LLM, "generate", payload)
+    s._timeout = timeout_s
+    return s
+
+
+PAYLOAD = {"prompt_token_ids": list(range(1, 9)), "max_tokens": 10}
+
+
+# ----------------------------------------------- acceptance: kill + resume
+
+def test_kill_mid_decode_greedy_resume_bit_identical(llm_app):
+    """ISSUE-13 acceptance: a replica killed mid-decode (REAL injected
+    actor death, 3 tokens already streamed) yields the bit-identical
+    completion the un-killed run produces, transparently."""
+    _wait_replicas(LLM, 2)
+    baseline = list(_stream(PAYLOAD))
+    assert len(baseline) == PAYLOAD["max_tokens"]
+
+    before = _counter_value(mdefs.SERVE_REPLICA_RESUMES,
+                            deployment=LLM, cause="resume")
+    chaos.configure("kill_replica:phase=decode,token=3", seed=7)
+    s = _stream(PAYLOAD)
+    out = list(s)
+    assert out == baseline, "resumed completion diverged from baseline"
+    assert s.journal.resumes == 1
+    assert s.journal.resumed_midstream
+    assert not s.journal.needs_marker       # greedy: exactly-once
+    kills = [e for e in chaos.injection_log()
+             if e["action"] == "kill_replica"]
+    assert kills and kills[0]["coords"]["token"] == 3
+    assert _counter_value(mdefs.SERVE_REPLICA_RESUMES,
+                          deployment=LLM, cause="resume") == before + 1
+    assert _counter_value(mdefs.SERVE_REQ_OUTCOMES, deployment=LLM,
+                          outcome="resumed") >= 1
+    chaos.configure(None)
+    _wait_replicas(LLM, 2)  # the replacement respawned
+
+
+def test_kill_mid_prefill_transparent_resubmit(llm_app):
+    """Queued-or-prefilling (zero tokens streamed): the journal
+    resubmits the identical submission — nothing lost, same output."""
+    _wait_replicas(LLM, 2)
+    baseline = list(_stream(PAYLOAD))
+    before = _counter_value(mdefs.SERVE_REPLICA_RESUMES,
+                            deployment=LLM, cause="resubmit")
+    chaos.configure("kill_replica:phase=prefill", seed=7)
+    s = _stream(PAYLOAD)
+    assert list(s) == baseline
+    assert s.journal.resumes == 1 and not s.journal.resumed_midstream
+    assert _counter_value(mdefs.SERVE_REPLICA_RESUMES,
+                          deployment=LLM, cause="resubmit") == before + 1
+    chaos.configure(None)
+    _wait_replicas(LLM, 2)
+
+
+def test_resume_budget_exhaustion_is_typed(llm_app, monkeypatch):
+    """Every attempt dies; the budget runs out -> the caller sees the
+    typed ResumeExhaustedError (not a raw ActorDiedError) and the
+    outcome counter tags resume_exhausted."""
+    _wait_replicas(LLM, 2)
+    monkeypatch.setenv("RAY_TPU_SERVE_MAX_RESUMES", "1")
+    assert max_resumes() == 1
+    before = _counter_value(mdefs.SERVE_REQ_OUTCOMES, deployment=LLM,
+                            outcome="resume_exhausted")
+    # times=2: the first kill consumes the budget's one resume; the
+    # resumed attempt is killed again (its own token counter restarts,
+    # so the same coordinates match) -> exhausted.
+    chaos.configure("kill_replica:phase=decode,token=2,times=2", seed=7)
+    with pytest.raises(ResumeExhaustedError):
+        list(_stream(PAYLOAD))
+    assert _counter_value(mdefs.SERVE_REQ_OUTCOMES, deployment=LLM,
+                          outcome="resume_exhausted") == before + 1
+    chaos.configure(None)
+    _wait_replicas(LLM, 2)
+
+
+def test_sampled_resume_surfaces_marker_over_http(llm_app):
+    """A SAMPLED request resumed mid-decode re-seeds; the client is told
+    via the x-ray-tpu-resumed marker (trailing NDJSON object when the
+    resume happens after headers went out)."""
+    import http.client
+    import json
+
+    _wait_replicas(LLM, 2)
+    port = serve.start_http(port=0)
+    try:
+        chaos.configure("kill_replica:phase=decode,token=2", seed=7)
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=180)
+        body = json.dumps({**PAYLOAD, "temperature": 0.7})
+        conn.request("POST", f"/{LLM}/stream/generate", body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        items = [json.loads(line) for line in resp.read().splitlines()
+                 if line]
+        conn.close()
+        tokens = [i for i in items if isinstance(i, int)]
+        markers = [i for i in items if isinstance(i, dict)]
+        assert len(tokens) == PAYLOAD["max_tokens"]
+        assert markers == [{"x-ray-tpu-resumed": 1}]
+    finally:
+        serve.stop_http()
+        chaos.configure(None)
+    _wait_replicas(LLM, 2)
+
+
+# -------------------------------------------------- acceptance: drain paths
+
+def test_drain_under_load_zero_dropped(llm_app):
+    """ISSUE-13 acceptance: a scale-down drain under live streaming load
+    finishes WITHOUT dropping a single in-flight request — the draining
+    replica leaves the routing ring, keeps decoding its streams to
+    completion, then tears down (drain metrics by cause/outcome)."""
+    _wait_replicas(LLM, 2)
+    # Stuttering decode (real injected delay) keeps requests in flight
+    # across the drain window.
+    chaos.configure("delay_tick:secs=0.05,times=-1", seed=3)
+    results = {}
+
+    def run_one(i):
+        p = {"prompt_token_ids": list(range(1 + i, 9 + i)),
+             "max_tokens": 16}
+        results[i] = list(_stream(p))
+
+    drains_before = _counter_value(mdefs.SERVE_REPLICA_DRAINS,
+                                   deployment=LLM, cause="scale_down")
+    threads = [threading.Thread(target=run_one, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.6)                      # streams mid-decode
+    controller = _controller()
+    assert ray_tpu.get(controller.drain_replicas.remote(
+        LLM, 1, "scale_down"), timeout=10) == 1
+    for t in threads:
+        t.join(timeout=180)
+    assert all(not t.is_alive() for t in threads)
+    assert all(len(v) == 16 for v in results.values()), \
+        f"dropped tokens: { {k: len(v) for k, v in results.items()} }"
+    chaos.configure(None)
+    _wait_replicas(LLM, 2)               # drain finished + respawn
+    assert _counter_value(mdefs.SERVE_REPLICA_DRAINS, deployment=LLM,
+                          cause="scale_down") == drains_before + 1
+    drained = [v for _, tags, v in mdefs.SERVE_DRAIN_SECONDS.samples()
+               if dict(tags).get("deployment") == LLM
+               and dict(tags).get("outcome") == "drained"]
+    assert drained, "no drain-duration sample with outcome=drained"
+
+
+def test_death_while_draining_falls_back_to_resume(llm_app):
+    """The draining replica dies before its streams finish (REAL
+    injected death at the drain chaos site): in-flight requests fall
+    back to the journal resume path and still complete bit-identically;
+    the controller records the death with cause=drain."""
+    _wait_replicas(LLM, 2)
+    long_payload = {"prompt_token_ids": list(range(1, 9)),
+                    "max_tokens": 24}
+    baseline = list(_stream(long_payload))
+    deaths_before = _counter_value(mdefs.SERVE_REPLICA_DEATHS,
+                                   deployment=LLM, cause="drain")
+    # Both replicas drain (rolling replace of the whole set) so the one
+    # serving our stream is certainly draining; the kill fires in the
+    # drain loop of a replica with work still in flight. Slow ticks keep
+    # the stream alive well into the drain.
+    chaos.configure(
+        "delay_tick:secs=0.08,times=-1;kill_replica:phase=drain,times=1",
+        seed=5)
+    out_box = {}
+
+    def run_one():
+        out_box["out"] = list(_stream(long_payload))
+
+    t = threading.Thread(target=run_one)
+    t.start()
+    time.sleep(0.3)
+    controller = _controller()
+    ray_tpu.get(controller.drain_replicas.remote(LLM, 2, "scale_down"),
+                timeout=10)
+    t.join(timeout=180)
+    assert not t.is_alive()
+    assert out_box["out"] == baseline
+    kills = [e for e in chaos.injection_log()
+             if e["action"] == "kill_replica"]
+    assert kills and kills[0]["coords"]["phase"] == "drain"
+    chaos.configure(None)
+    _wait_replicas(LLM, 2)
+    assert _counter_value(mdefs.SERVE_REPLICA_DEATHS, deployment=LLM,
+                          cause="drain") == deaths_before + 1
+
+
+def test_preemption_notice_drains_instead_of_killing(llm_app):
+    """A preemption notice on the PREEMPT channel drains replicas (the
+    node is going away — stop admitting, finish in-flight) instead of
+    letting the kill guillotine them; reconcile respawns replacements."""
+    from ray_tpu.checkpoint.preempt import publish_preempt
+
+    _wait_replicas(LLM, 2)
+    before = _counter_value(mdefs.SERVE_REPLICA_DRAINS,
+                            deployment=LLM, cause="preemption")
+    publish_preempt(reason="spot-preemption", node="*")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if _counter_value(mdefs.SERVE_REPLICA_DRAINS, deployment=LLM,
+                          cause="preemption") >= before + 2:
+            break
+        time.sleep(0.2)
+    assert _counter_value(mdefs.SERVE_REPLICA_DRAINS, deployment=LLM,
+                          cause="preemption") >= before + 2
+    _wait_replicas(LLM, 2)  # replacements respawned + drains finished
+
+
+# --------------------------------------------- router behavior under churn
+
+def test_affinity_rehomes_prefix_key_after_death(ray_session):
+    """Prefix-affinity routing under churn: a key sticks to its
+    rendezvous home; when the home replica dies, the key re-homes onto
+    its surviving rendezvous choice — consistently, not scattered."""
+    import uuid
+
+    from ray_tpu.serve.api import _affinity_candidates
+
+    @serve.deployment(name="WhoAmIChurn", num_replicas=2)
+    class WhoAmI:
+        def __init__(self):
+            self.tag = uuid.uuid4().hex
+
+        def __call__(self, payload):
+            return self.tag
+
+    h = serve.run(WhoAmI.bind(), name="whoami_churn")
+    try:
+        key = "prompt-fp-A"
+        tags = {h.options(prefix_key=key).remote({}).result(timeout_s=60)
+                for _ in range(6)}
+        assert len(tags) == 1, f"key did not stick: {tags}"
+        home_idx = _affinity_candidates(key, 2)[0]
+        victim = h._replicas[home_idx]
+        ray_tpu.kill(victim)
+        # The first call racing the death retries via the journal-gated
+        # unary path; afterwards the key must stick to ONE live replica.
+        retagged = {h.options(prefix_key=key).remote({}).result(
+            timeout_s=60) for _ in range(6)}
+        assert len(retagged) == 1, f"key scattered after death: {retagged}"
+        assert retagged != tags or len(h._replicas) >= 1
+    finally:
+        serve.delete("WhoAmIChurn")
+
+
+def test_pressure_cache_invalidated_when_replica_removed(ray_session):
+    """A route change (death/drain/scale) must invalidate the router's
+    TTL-cached per-index pressure/load snapshots: indices shift and a
+    drained replica's entry must not feed routing or the gate."""
+    from ray_tpu.serve import api as api_mod
+
+    h = serve.get_deployment_handle("anything")
+    st = h._router
+    st.shared_pressure = [{"queue_depth": 99}]
+    st.pressure_ts = time.monotonic()
+    st.shared_loads = [7]
+    st.loads_ts = time.monotonic()
+    st.subscribed = True  # install our own event below
+
+    # Simulate the controller's route push for this deployment.
+    h._ensure_subscribed()
+    # _ensure_subscribed was a no-op (subscribed=True): drive the bus
+    # callback path for real via a fresh handle on the local bus.
+    h2 = serve.get_deployment_handle("bus-deployment")
+    h2._ensure_subscribed()
+    st2 = h2._router
+    st2.shared_pressure = [{"queue_depth": 99}]
+    st2.pressure_ts = time.monotonic()
+    api_mod._publish_route_event("bus-deployment")
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and st2.shared_pressure:
+        time.sleep(0.05)
+    assert st2.shared_pressure == [] and st2.pressure_ts == 0.0
+
+    # And eviction invalidates synchronously.
+    st.replicas = ["r0", "r1"]
+    h._evict("r0")
+    assert st.shared_pressure == [] and st.pressure_ts == 0.0
+    assert st.shared_loads == [] and st.loads_ts == 0.0
+
+
+def test_gate_never_sheds_on_stale_pressure_from_drained_replica(
+        ray_session, monkeypatch):
+    """Admission gate + drain: a replica that reported saturating
+    pressure and then drained must not keep shedding traffic. The
+    route-change invalidation clears its entry, and even with chaos
+    DROPPING every subsequent pressure fetch (stale cache forever), the
+    gate fails open instead of shedding on the ghost entry."""
+    monkeypatch.setenv("RAY_TPU_SHED_QUEUE_DEPTH", "5")
+
+    @serve.deployment(name="PressyDrain", num_replicas=1)
+    class Pressy:
+        def __init__(self):
+            self._p = {"queue_depth": 50}
+
+        def set_pressure(self, p):
+            self._p = dict(p)
+            return self._p
+
+        def pressure(self):
+            return self._p
+
+        def __call__(self, payload):
+            return {"ok": True}
+
+    serve.run(Pressy.bind(), name="pressy_drain")
+    try:
+        from ray_tpu.serve.proxy import _Router
+
+        router = _Router()
+        gate = router.gate
+        # Saturated replica: the gate sheds (poll through the TTLs).
+        deadline = time.monotonic() + 20
+        shed = None
+        while time.monotonic() < deadline:
+            shed = gate.check("PressyDrain")
+            if shed is not None:
+                break
+            time.sleep(0.2)
+        assert shed is not None and shed[1] == "pressure"
+
+        # Drain the saturated replica out of rotation; every later
+        # pressure fetch is chaos-DROPPED, so only the invalidation
+        # can save the gate from the stale snapshot.
+        chaos.configure("drop_pressure:times=-1", seed=2)
+        assert serve.drain("PressyDrain", 1) == 1  # public operator API
+        deadline = time.monotonic() + 20
+        admitted = False
+        while time.monotonic() < deadline:
+            if gate.check("PressyDrain") is None:
+                admitted = True
+                break
+            time.sleep(0.2)
+        assert admitted, \
+            "gate kept shedding on a drained replica's stale pressure"
+    finally:
+        chaos.configure(None)
+        serve.delete("PressyDrain")
+
+
+# ----------------------------------------------------- unary journal path
+
+def test_unary_death_retry_is_budgeted_and_tagged(ray_session):
+    """The unary handle path recovers replica death through the journal
+    plane: retries are budgeted + tagged (no blind fixed-count retry),
+    and completion-after-retry lands in the outcomes counter."""
+
+    @serve.deployment(name="EchoU", num_replicas=2)
+    class EchoU:
+        def __call__(self, x):
+            return x * 2
+
+    h = serve.run(EchoU.bind(), name="echo_u")
+    try:
+        assert h.remote(3).result(timeout_s=60) == 6
+        before = _counter_value(mdefs.SERVE_REPLICA_RESUMES,
+                                deployment="EchoU", cause="resubmit")
+        ray_tpu.kill(h._replicas[0])
+        for i in range(8):
+            assert h.remote(i).result(timeout_s=60) == i * 2
+        assert _counter_value(mdefs.SERVE_REPLICA_RESUMES,
+                              deployment="EchoU",
+                              cause="resubmit") >= before + 1
+        assert _counter_value(mdefs.SERVE_REQ_OUTCOMES,
+                              deployment="EchoU", outcome="resumed") >= 1
+    finally:
+        serve.delete("EchoU")
+
+
+def test_unary_budget_exhaustion_typed(llm_app, monkeypatch):
+    """Budget 0: the first death surfaces the typed terminal error."""
+    _wait_replicas(LLM, 2)
+    monkeypatch.setenv("RAY_TPU_SERVE_MAX_RESUMES", "0")
+    chaos.configure("kill_replica:phase=prefill,times=1", seed=9)
+    h = serve.get_deployment_handle(LLM)
+    with pytest.raises(ResumeExhaustedError):
+        h.remote(PAYLOAD).result(timeout_s=60)
+    chaos.configure(None)
+    monkeypatch.delenv("RAY_TPU_SERVE_MAX_RESUMES")
+    _wait_replicas(LLM, 2)
