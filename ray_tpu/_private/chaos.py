@@ -80,6 +80,27 @@ Actions:
 ``delay_tick``      sleeps ``secs`` in the serve engine's tick loop — a
                     stuttering decode under which drains/streams must
                     still complete.
+``preempt_node``    fires at the chip-pool arbiter's handoff site
+                    (``pool_handoff``, matchable on ``stage=FREEING`` etc.):
+                    publishes a real preemption notice for ``target=<node>``
+                    (default ``*``) on the PREEMPT channel — a node dies
+                    MID-HANDOFF, so the serve controller drains its
+                    replicas and running trainers JIT-save, while the
+                    handoff must still converge.
+``fail_create_node``  the InstanceManager's ``provider.create_node`` call
+                    raises (``times=N``) — a cloud allocation failure
+                    (quota/stockout) that lands the instance in
+                    ALLOCATION_FAILED and drives the autoscaler's
+                    allocation backoff.
+``delay_drain``     sleeps ``secs`` inside a serve replica's drain wait
+                    loop — a drain that takes real time, under which the
+                    arbiter's FREEING stage (and its deadline handling)
+                    must hold.
+``kill_arbiter``    uncooperative chip-pool-arbiter death at its tick
+                    boundary (``pool_tick``, matchable on ``tick=N``) —
+                    raises :class:`SimulatedProcessDeath`; the restarted
+                    arbiter must resume (or roll back) every lease
+                    mid-flight from the journal.
 =================  =========================================================
 
 Matching keys (all optional): ``rank``, ``step``, ``proc``, ``node``,
@@ -137,9 +158,17 @@ _ACTION_SITES = {
     "kill_replica": "serve_replica",
     "drop_pressure": "serve_pressure",
     "delay_tick": "serve_tick",
+    "delay_drain": "serve_drain",
+    # Chip-pool / autoscaler sites (ray_tpu/autoscaler): handoff and
+    # provider faults.
+    "preempt_node": "pool_handoff",
+    "kill_arbiter": "pool_tick",
+    "fail_create_node": "provider_create",
 }
-_MATCH_KEYS = ("rank", "step", "proc", "node", "run", "phase", "token")
-_INT_PARAMS = ("rank", "step", "proc", "times", "resize", "world", "token")
+_MATCH_KEYS = ("rank", "step", "proc", "node", "run", "phase", "token",
+               "stage", "tick")
+_INT_PARAMS = ("rank", "step", "proc", "times", "resize", "world", "token",
+               "tick")
 _FLOAT_PARAMS = ("secs", "p", "jitter")
 
 
@@ -329,7 +358,7 @@ def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
            coords: Dict[str, Any], directives: Dict[str, Any]) -> None:
     action = rule.action
     logger.warning("chaos: injecting %s at %s %s", action, site, coords)
-    if action in ("kill_worker", "kill_replica"):
+    if action in ("kill_worker", "kill_replica", "kill_arbiter"):
         resize = rule.params.get("resize")
         if resize:
             _publish_resize(int(resize), reason="chaos-node-lost")
@@ -365,14 +394,29 @@ def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
         directives["drop"] = True
     elif action == "delay_heartbeat":
         directives["delay_s"] = float(rule.params.get("secs", 1.0))
-    elif action == "delay_tick":
-        # Delayed engine tick: the serve decode loop stutters (a slow
-        # device, a co-tenant hog) without any request dying — drives
-        # drain-under-load and streaming-timeout paths with requests
-        # genuinely still in flight.
+    elif action in ("delay_tick", "delay_drain"):
+        # Delayed engine tick / drain wait: the serve decode loop (or a
+        # replica's drain) stutters without any request dying — drives
+        # drain-under-load, streaming-timeout and slow-FREEING paths
+        # with requests genuinely still in flight.
         delay = float(rule.params.get("secs", 0.05))
         time.sleep(delay)
         directives["slept_s"] = delay
+    elif action == "preempt_node":
+        # A node dies mid-handoff: publish the REAL preemption notice
+        # (``target=`` names the node; default every subscriber) — the
+        # serve controller drains that node's replicas, trainers
+        # JIT-save; the directive lets the arbiter log what hit it.
+        target = str(rule.params.get("target", "*"))
+        try:
+            from ray_tpu.checkpoint.preempt import publish_preempt
+
+            publish_preempt(reason="chaos-preempt-node", node=target)
+        except Exception:  # noqa: BLE001 — chaos must not mask the fault
+            logger.exception("chaos: preempt_node publish failed")
+        directives["preempted_node"] = target
+    elif action == "fail_create_node":
+        raise RuntimeError(f"chaos fail_create_node at {coords}")
 
 
 def _publish_resize(world_target: int, reason: str) -> None:

@@ -173,6 +173,16 @@ SERVE_DRAIN_SECONDS = Histogram(
                 300.0),
     tag_keys=("deployment", "outcome"))
 
+# ----------------------------------------- serve pressure autoscaling (L6)
+SERVE_AUTOSCALE_DECISIONS = Counter(
+    "ray_tpu_serve_autoscale_decisions_total",
+    "Serve autoscaler scale intents applied, by direction (up/down) and "
+    "the dominant signal that drove them (ongoing: router in-flight vs "
+    "target_ongoing_requests; queue: engine queue depth vs "
+    "target_queue_depth; kv: paged-KV arena starvation; shed: ingress "
+    "shed rate observed since the last decision)",
+    ("deployment", "direction", "signal"))
+
 # ------------------------------------------ serve request path (L6 + engine)
 # Per-request latency attribution emitted by the continuous-batching
 # engine at request lifecycle boundaries: TTFT decomposes into
@@ -468,6 +478,76 @@ CKPT_PREEMPT_NOTICES = Counter(
     "Preemption notices delivered to this process, by source "
     "(local/publish/pubsub)",
     ("source",))
+
+# --------------------------------------- autoscaler reconciler (L7)
+AUTOSCALER_ALLOC_FAILURES = Counter(
+    "ray_tpu_autoscaler_allocation_failures_total",
+    "Provider create_node failures observed by the reconciler "
+    "(quota/stockout); a streak opens the exponential launch backoff",
+    ("provider",))
+AUTOSCALER_TICK_FAILURES = Gauge(
+    "ray_tpu_autoscaler_consecutive_tick_failures",
+    "Consecutive reconcile ticks that raised (0 = healthy); a streak "
+    "backs off the tick interval and the last error is surfaced in "
+    "Autoscaler.summary() and the dashboard",
+    ("provider",))
+
+# --------------------------------------- chip pool arbiter (L7, arbiter.py)
+# The serve<->train chip-handoff plane: every chip sits in exactly one
+# ledger state (serve / train / in_flight), and every lease transition is
+# journaled into the __pool__ KV so an arbiter restart resumes (or rolls
+# back) handoffs mid-flight.
+POOL_CHIPS = Gauge(
+    "ray_tpu_pool_chips",
+    "Chips per ledger owner (serve / train / in_flight) — the three "
+    "always sum to the pool total (the conservation invariant)",
+    ("owner",))
+POOL_LEASES = Gauge(
+    "ray_tpu_pool_leases",
+    "Live (non-terminal) chip leases by state-machine stage",
+    ("stage",))
+POOL_HANDOFFS = Counter(
+    "ray_tpu_pool_handoffs_total",
+    "Chip handoffs reaching a terminal disposition, by direction "
+    "(serve_to_train/train_to_serve) and outcome (committed: recipient "
+    "confirmed and the lease went live; returned: lease deadline lapsed "
+    "or an SLO reversal gave the chips back; aborted: rolled back before "
+    "commit)",
+    ("direction", "outcome"))
+POOL_HANDOFF_SECONDS = Histogram(
+    "ray_tpu_pool_handoff_seconds",
+    "Wall time from lease creation to COMMITTED (donor drain/shrink + "
+    "recipient absorb + confirmation), by direction",
+    boundaries=(0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 300.0,
+                1800.0),
+    tag_keys=("direction",))
+POOL_SLO_REVERSALS = Counter(
+    "ray_tpu_pool_slo_reversals_total",
+    "SLO-guard interventions: a planned take of serve chips refused "
+    "(refused) or a committed serve->train lease reversed (reversed), "
+    "by the breaching signal (shed_rate/ttft_p95/latency_p95)",
+    ("action", "signal"))
+POOL_INVARIANT_VIOLATIONS = Counter(
+    "ray_tpu_pool_invariant_violations_total",
+    "Chip-conservation invariant violations detected by the ledger "
+    "verifier (a chip in two ledger states, or orphaned) — any nonzero "
+    "value is a bug",
+    ("kind",))
+
+# ---------------------------------------------------- shared readbacks
+def serve_shed_total(deployment: str) -> float:
+    """Cumulative ingress sheds for one deployment (every
+    ``shed_*``-tagged outcome) — the single definition the serve
+    autoscaler's shed signal and the chip-pool SLO guard both read, so
+    a new shed outcome tag cannot silently diverge the two."""
+    total = 0.0
+    for _name, key, value in SERVE_REQ_OUTCOMES.samples():
+        tags = dict(key)
+        if tags.get("deployment") == deployment and \
+                str(tags.get("outcome", "")).startswith("shed"):
+            total += value
+    return total
+
 
 # --------------------------------------------- on-demand profiler capture
 PROFILE_CAPTURES = Counter(

@@ -195,7 +195,19 @@ class NodeManager:
         for k, v in (labels or {}).items():
             info.labels[k] = v
         self.labels = dict(labels or {})
-        self.gcs.RegisterNode(pb.RegisterNodeRequest(info=info))
+        # The very first RPC to a GCS that may have started milliseconds
+        # ago: retry briefly on connection refusal (its gRPC listener can
+        # lag the constructor's return under load) instead of failing a
+        # node bootstrap on a startup race.
+        deadline = time.monotonic() + 5.0
+        while True:
+            try:
+                self.gcs.RegisterNode(pb.RegisterNodeRequest(info=info))
+                break
+            except Exception:  # noqa: BLE001 — UNAVAILABLE during startup
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
         from ray_tpu._private import metrics_pusher, xla_monitor
 
         metrics_pusher.ensure_pusher(gcs_address,

@@ -153,6 +153,21 @@ def serve(service_name: str, handler_obj: Any, port: int = 0,
     )
     server.add_generic_rpc_handlers((generic,))
     bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0 and port != 0:
+        # A fixed-port bind can transiently fail right after the previous
+        # server on that port stopped (grpc tears its listener down
+        # asynchronously) — a GCS restarting in place hits exactly this
+        # window. Retry briefly instead of silently serving nothing.
+        deadline = time.monotonic() + 5.0
+        while bound == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+            bound = server.add_insecure_port(f"{host}:{port}")
+    if bound == 0:
+        # A server bound to nothing strands every client on
+        # connection-refused until their deadlines; fail loudly instead.
+        server.stop(None)
+        raise RuntimeError(
+            f"{service_name}: could not bind {host}:{port}")
     server.start()
     probe_stop = _start_lag_probe(service_name, executor)
     if probe_stop is not None:

@@ -170,8 +170,27 @@ class Autoscaler:
         self._idle_since: Dict[str, float] = {}
         self._unregistered_since: Dict[str, float] = {}
         self._warned_infeasible: set = set()
+        # Allocation-failure backoff: a failed provider create opens an
+        # exponential launch-suppression window (retrying a quota-
+        # exhausted provider at full tick rate hammers its API and fills
+        # the instance table with ALLOCATION_FAILED records).
+        self._alloc_fail_streak = 0
+        self._alloc_backoff_until = 0.0
+        self._alloc_backoff_base_s = float(os.environ.get(
+            "RAY_TPU_AUTOSCALER_ALLOC_BACKOFF_S", "2.0"))
+        self._alloc_backoff_max_s = float(os.environ.get(
+            "RAY_TPU_AUTOSCALER_ALLOC_BACKOFF_MAX_S", "60.0"))
+        # Tick-loop failure accounting: consecutive raised ticks back the
+        # interval off and the last error is surfaced in summary() / the
+        # dashboard instead of only the head-node log.
+        self._tick_fail_streak = 0
+        self._last_tick_error: Optional[str] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def _provider_tag(self) -> str:
+        return type(self.provider).__name__
 
     # ----------------------------------------------------------------- logic
     def _demand_bundles(self) -> List[Dict[str, float]]:
@@ -275,11 +294,31 @@ class Autoscaler:
                    len(managed) + needed_for_demand + pressure)
         want = min(want, self.max_workers)
 
+        from ray_tpu._private import metrics_defs as mdefs
+
         while len(self.provider.non_terminated_nodes()) < want:
+            if time.monotonic() < self._alloc_backoff_until:
+                break  # allocation-failure backoff window still open
             if self.im.launch_instances(1, self.node_config):
                 launched += 1
+                self._alloc_fail_streak = 0
             else:
-                break  # allocation failed: don't tight-loop the provider
+                # Allocation failed: count it, open/extend the
+                # exponential backoff window, and stop launching this
+                # tick (retrying at full rate next tick is exactly the
+                # provider-hammering this backoff exists to prevent).
+                self._alloc_fail_streak += 1
+                mdefs.AUTOSCALER_ALLOC_FAILURES.inc(
+                    tags={"provider": self._provider_tag})
+                backoff = min(
+                    self._alloc_backoff_base_s *
+                    2 ** (self._alloc_fail_streak - 1),
+                    self._alloc_backoff_max_s)
+                self._alloc_backoff_until = time.monotonic() + backoff
+                logger.warning(
+                    "allocation failed (streak %d); backing launches "
+                    "off %.1fs", self._alloc_fail_streak, backoff)
+                break
 
         now = time.monotonic()
         # Retry instances stuck TERMINATING (an earlier provider
@@ -328,8 +367,39 @@ class Autoscaler:
                         over -= 1
                 else:
                     self._idle_since.pop(pid, None)
+        self._publish_status()
         return {"launched": launched, "terminated": terminated,
                 "instances": self.im.summary()}
+
+    def summary(self) -> Dict[str, Any]:
+        """Operator/dashboard view of reconciler health: the instance
+        table plus the failure accounting (_loop streaks, allocation
+        backoff, last tick error) that would otherwise live only in the
+        head-node log."""
+        now = time.monotonic()
+        return {
+            "instances": self.im.summary(),
+            "provider": self._provider_tag,
+            "consecutive_tick_failures": self._tick_fail_streak,
+            "last_tick_error": self._last_tick_error,
+            "allocation_failure_streak": self._alloc_fail_streak,
+            "allocation_backoff_remaining_s": round(
+                max(self._alloc_backoff_until - now, 0.0), 3),
+            "tick_interval_s": self._effective_interval(),
+        }
+
+    def _publish_status(self) -> None:
+        """Mirror summary() into the GCS KV so the dashboard — which
+        talks to the GCS, not to this process — can render autoscaler
+        health without a runtime. Best-effort."""
+        try:
+            self.gcs.KvPut(pb.KvRequest(
+                ns=KV_NS, key="status",
+                value=json.dumps({"ts": time.time(),
+                                  **self.summary()}).encode(),
+                overwrite=True))
+        except Exception:  # noqa: BLE001 — monitoring mirror only
+            pass
 
     def _terminate_pid(self, provider_id: str, detail: str) -> bool:
         """Terminate through the instance table when this reconciler
@@ -353,12 +423,35 @@ class Autoscaler:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
+    TICK_BACKOFF_MAX_FACTOR = 8
+
+    def _effective_interval(self) -> float:
+        """Tick interval with failure backoff: a streak of raised ticks
+        (GCS unreachable, provider API down) doubles the interval up to
+        a cap instead of spinning the failing call at full rate."""
+        return self.tick_interval_s * min(
+            2 ** self._tick_fail_streak, self.TICK_BACKOFF_MAX_FACTOR)
+
     def _loop(self):
-        while not self._stop.wait(self.tick_interval_s):
+        from ray_tpu._private import metrics_defs as mdefs
+
+        while not self._stop.wait(self._effective_interval()):
             try:
                 self.reconcile_once()
-            except Exception:  # noqa: BLE001
-                logger.exception("autoscaler tick failed")
+                self._tick_fail_streak = 0
+                self._last_tick_error = None
+            except Exception as e:  # noqa: BLE001
+                # Swallowing alone loses the failure: count the streak
+                # into the gauge, keep the last error for summary()/the
+                # dashboard, and let _effective_interval back off.
+                self._tick_fail_streak += 1
+                self._last_tick_error = f"{type(e).__name__}: {e}"
+                logger.exception("autoscaler tick failed (streak %d)",
+                                 self._tick_fail_streak)
+                self._publish_status()
+            mdefs.AUTOSCALER_TICK_FAILURES.set(
+                float(self._tick_fail_streak),
+                tags={"provider": self._provider_tag})
 
     def stop(self):
         self._stop.set()

@@ -127,6 +127,15 @@ class InstanceManager:
                 self._instances[inst.instance_id] = inst
             self._set_status(inst, REQUESTED)
             try:
+                from ray_tpu._private import chaos
+
+                if chaos.enabled():
+                    # fail_create_node: a cloud allocation failure
+                    # (quota/stockout) raised exactly where the provider
+                    # would — the instance lands in ALLOCATION_FAILED and
+                    # the reconciler's launch backoff takes over.
+                    chaos.inject("provider_create",
+                                 provider=type(self.provider).__name__)
                 inst.provider_id = self.provider.create_node(node_config)
                 self._set_status(inst, ALLOCATED, inst.provider_id)
             except Exception as e:  # noqa: BLE001

@@ -100,6 +100,8 @@ prefill; TPOT)</h2><div id="reqlat"></div>
 step skew)</h2><div id="goodput"></div>
 <h2>Train / elasticity (restarts by cause, world size, recovery time)</h2>
 <div id="elastic"></div>
+<h2>Pool / chip leases &amp; handoffs (serve&harr;train arbitration)</h2>
+<div id="pool"></div><table id="poolleases"></table>
 <h2>Metrics (last 5 min)</h2><div id="metrics"></div>
 <h2>XLA programs (compiles / retraces / achieved)</h2>
 <table id="xla"></table>
@@ -284,6 +286,43 @@ async function elasticPanel(){
   document.getElementById("elastic").innerHTML=
     sparkRows(restarts.concat(world,rec),40)||"(no elastic trainers)";
 }
+async function poolPanel(){
+  // Chip-pool arbitration: chips per ledger owner (serve/train/
+  // in_flight always sum to the pool total — watch conservation at a
+  // glance), handoff counters, SLO reversals, plus the live lease table
+  // with state-machine stage and deadline. Autoscaler health (tick
+  // failures, allocation backoff) rides along: both planes share L7.
+  const series=await j("/api/v1/metrics/query?series=ray_tpu_pool_*"+
+                       "&since=300&agg=last&step=3&limit=30");
+  const aseries=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_autoscaler_*&since=300&agg=last&step=3&limit=10");
+  const p=await j("/api/v1/pool");
+  let head="";
+  if(p.allocation){
+    const a=p.allocation;
+    head=`<div style="font-size:.8rem;margin:.15rem 0">serve=${a.serve} `+
+      `train=${a.train} in_flight=${a.in_flight} / total=${a.total}`+
+      (p.last_reversal?` &nbsp; last SLO ${esc(p.last_reversal.action)}: `+
+        `${esc(p.last_reversal.signal)} on ${esc(p.last_reversal.lease_id)}`
+        :"")+
+      (p.autoscaler&&p.autoscaler.last_tick_error?
+        ` &nbsp; <span class="bad">autoscaler: `+
+        `${esc(p.autoscaler.last_tick_error)}</span>`:"")+`</div>`;
+  }
+  document.getElementById("pool").innerHTML=
+    head+(sparkRows(series.concat(aseries),40)||
+          (head?"":"(no chip-pool arbiter)"));
+  table(document.getElementById("poolleases"),
+    (p.leases||[]).slice(0,20).map(l=>({
+      lease:l.lease_id,direction:l.donor+"→"+l.recipient,
+      chips:l.chips,stage:l.stage,
+      deadline:l.deadline_ts?
+        new Date(l.deadline_ts*1000).toLocaleTimeString():"",
+      since:l.history&&l.history.length?
+        new Date(l.history[l.history.length-1][1]*1000)
+          .toLocaleTimeString():""})),
+    ["lease","direction","chips","stage","deadline","since"]);
+}
 async function lifecyclePanel(){
   // Serve failure plane: drains_total{cause} stepping up says WHY
   // replicas leave rotation (scale_down vs preemption), deaths_total
@@ -359,6 +398,7 @@ async function refresh(){
     await ingestPanel();
     await goodputPanel();
     await elasticPanel();
+    await poolPanel();
     await xlaPanel();
     document.getElementById("status").textContent=
       "updated "+new Date().toLocaleTimeString();
@@ -543,6 +583,20 @@ class Dashboard:
 
             return list_manifests_kv(gcs)
 
+        def pool_state():
+            """Chip-pool ledger + autoscaler health straight from the
+            GCS KV (the arbiter journals every lease transition into
+            ``__pool__``; the reconciler mirrors its summary into
+            ``autoscaler/status``) — renderable with no runtime."""
+            from ray_tpu.autoscaler.arbiter import read_pool_state
+
+            out = read_pool_state(gcs_address)
+            reply = gcs.KvGet(pb.KvRequest(ns="autoscaler",
+                                           key="status"))
+            out["autoscaler"] = (json.loads(reply.value)
+                                 if reply.found else None)
+            return out
+
         def serve_pressure():
             """Per-replica serve pressure (queue depth, KV blocks free,
             in-flight prefill tokens) mirrored into the GCS KV by the
@@ -628,6 +682,9 @@ class Dashboard:
                         ctype = "application/json"
                     elif path == "/api/v1/serve/pressure":
                         body = json.dumps(serve_pressure()).encode()
+                        ctype = "application/json"
+                    elif path == "/api/v1/pool":
+                        body = json.dumps(pool_state()).encode()
                         ctype = "application/json"
                     else:
                         route = {

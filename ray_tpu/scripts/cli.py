@@ -586,6 +586,47 @@ def cmd_ckpt(args):
               f"{m.get('dir', '')}")
 
 
+def cmd_pool(args):
+    """Chip-pool CLI: per-workload chip counts, live leases with
+    deadlines, handoffs in flight with their state-machine stage, and
+    the last SLO-guard reversal — straight from the ``__pool__`` KV
+    journal (the ``ray-tpu ckpt list`` offline-friendly style)."""
+    from ray_tpu.autoscaler.arbiter import TERMINAL, read_pool_state
+
+    state = read_pool_state(args.address or _auto_address())
+    if args.format == "json":
+        print(json.dumps(state, indent=2))
+        return
+    alloc = state.get("allocation")
+    if alloc is None:
+        print("no chip pool (no arbiter has journaled a config)")
+        return
+    print(f"chips: serve={alloc['serve']} train={alloc['train']} "
+          f"in_flight={alloc['in_flight']} / total={alloc['total']}")
+    rev = state.get("last_reversal")
+    if rev:
+        stamp = time.strftime("%H:%M:%S", time.localtime(rev.get("ts", 0)))
+        print(f"last SLO-guard {rev.get('action')}: {rev.get('signal')} "
+              f"on {rev.get('lease_id')} ({rev.get('direction')}, "
+              f"{rev.get('chips')} chips) at {stamp} "
+              f"{rev.get('detail', '')}")
+    leases = state.get("leases") or []
+    if not leases:
+        print("no leases")
+        return
+    for lease in leases:
+        flight = "" if lease["stage"] in TERMINAL else "  [in flight]"
+        deadline = ""
+        if lease.get("deadline_ts"):
+            deadline = "  deadline=" + time.strftime(
+                "%H:%M:%S", time.localtime(lease["deadline_ts"]))
+        since = time.strftime(
+            "%H:%M:%S", time.localtime(lease["history"][-1][1]))
+        print(f"{lease['lease_id']}  {lease['donor']}->"
+              f"{lease['recipient']}  chips={lease['chips']:<3} "
+              f"{lease['stage']:<15} since {since}{deadline}{flight}")
+
+
 def cmd_logs(args):
     """Tail cluster logs (reference: ``ray logs`` + the dashboard log
     viewer over the LOG pubsub channel)."""
@@ -932,6 +973,14 @@ def main(argv=None):
                         "of the cluster KV")
     p.add_argument("--format", choices=["table", "json"], default="table")
     p.set_defaults(fn=cmd_ckpt)
+
+    p = sub.add_parser("pool",
+                       help="chip pool: per-workload chips, live leases, "
+                            "handoffs in flight, last SLO reversal")
+    p.add_argument("action", choices=["status"])
+    p.add_argument("--address")
+    p.add_argument("--format", choices=["table", "json"], default="table")
+    p.set_defaults(fn=cmd_pool)
 
     p = sub.add_parser("logs", help="tail worker logs (or one job's logs)")
     p.add_argument("--address")

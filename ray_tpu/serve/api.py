@@ -102,6 +102,15 @@ DEFAULT_AUTOSCALING = {
     "target_ongoing_requests": 2.0,
     "upscale_delay_s": 0.3,
     "downscale_delay_s": 2.0,
+    # Engine-pressure policy (fed by the per-replica pressure fan-out):
+    # desired replicas also scale on admission-queue depth per replica,
+    # on paged-KV arena starvation (every engine replica with zero
+    # free+reclaimable blocks), and on ingress sheds observed since the
+    # last decision — replicas scale on ENGINE pressure, not just the
+    # router's ongoing-count. 0 disables a signal.
+    "target_queue_depth": 4.0,
+    "kv_starvation_upscale": True,
+    "shed_upscale": True,
 }
 
 
@@ -297,8 +306,11 @@ class Replica:
             if chaos.enabled():
                 # Death-while-draining chaos site: the host dies before
                 # the drain completes — in-flight streams fall back to
-                # the journal's resume path.
+                # the journal's resume path. delay_drain (serve_drain
+                # site) instead stretches the wait: a slow quiesce under
+                # which the pool arbiter's FREEING stage must hold.
                 chaos.inject("serve_replica", phase="drain")
+                chaos.inject("serve_drain")
             await asyncio.sleep(0.02)
             with self._m_lock:
                 remaining = self._ongoing
@@ -320,6 +332,9 @@ class ServeController:
         self._pressure_cache: Dict[str, Any] = {}
         # autoscaler intent: name -> (desired, first_seen_monotonic)
         self._scale_intent: Dict[str, Any] = {}
+        # Cumulative ingress-shed count seen at the last autoscale
+        # decision per deployment (the policy scales on the DELTA).
+        self._shed_seen: Dict[str, float] = {}
         self._pg_cleanups: Dict[str, list] = {}
         self._replica_birth: Dict[int, float] = {}
         # Draining replicas: name -> [{replica, ref, t0, deadline,
@@ -407,29 +422,81 @@ class ServeController:
         self._reconcile_once(name)
         return True
 
+    @staticmethod
+    def _shed_total(name: str) -> float:
+        """Cumulative ingress sheds for a deployment (pressure + tenant
+        buckets), via the shared readback in metrics_defs. In-process
+        registry: the local runtime hosts ingress and controller in one
+        process; cluster deployments scale primarily on the queue/KV
+        pressure signals."""
+        from ray_tpu._private import metrics_defs as mdefs
+
+        return mdefs.serve_shed_total(name)
+
+    def _pressure_desired(self, name: str, cfg: Dict[str, Any],
+                          current: int) -> tuple:
+        """(desired, signal) under the pressure policy: the max over the
+        ongoing-count target (reference: autoscaling_policy.py), the
+        engine admission-queue target, arena starvation, and the
+        ingress-shed delta — each signal reads the same per-replica
+        pressure fan-out the router and dashboard already consume."""
+        snaps = [s for s in self.get_replica_pressure(name)
+                 if s and not s.get("unreachable")]
+        ongoing = sum(float(s.get("ongoing") or 0) for s in snaps)
+        desired = math.ceil(ongoing / max(cfg["target_ongoing_requests"],
+                                          1e-9))
+        signal = "ongoing"
+        tq = float(cfg.get("target_queue_depth") or 0)
+        if tq > 0:
+            queue = sum(float(s.get("queue_depth") or 0) for s in snaps)
+            d_q = math.ceil(queue / tq)
+            if d_q > desired:
+                desired, signal = d_q, "queue"
+        if cfg.get("kv_starvation_upscale"):
+            engines = [s for s in snaps
+                       if float(s.get("kv_blocks_total") or 0) > 0]
+            starved = [s for s in engines
+                       if (float(s.get("kv_blocks_free") or 0)
+                           + float(s.get("kv_blocks_cached") or 0)) <= 0]
+            if engines and len(starved) == len(engines) and \
+                    current + 1 > desired:
+                # EVERY engine replica has nothing left to admit with:
+                # one more replica, even when queue counters look calm.
+                desired, signal = current + 1, "kv"
+        if cfg.get("shed_upscale"):
+            sheds = self._shed_total(name)
+            last = self._shed_seen.setdefault(name, sheds)
+            self._shed_seen[name] = sheds
+            if sheds > last and current + 1 > desired:
+                desired, signal = current + 1, "shed"
+        return desired, signal
+
     def _autoscale_once(self, name: str):
-        """Reference: autoscaling_policy.py — desired =
-        ceil(total_ongoing / target), clamped to [min, max], applied after
-        the respective upscale/downscale delay holds steadily."""
+        """Closed-loop replica scaling: desired comes from the pressure
+        policy (ongoing count, engine queue depth, KV-arena starvation,
+        shed rate), clamped to [min, max] and the pool arbiter's chip
+        cap, applied after the respective upscale/downscale delay holds
+        steadily. Scale-down always goes through the drain path
+        (reconcile drains victims instead of killing)."""
+        from ray_tpu._private import metrics_defs as mdefs
+
         spec = self.deployments.get(name)
         if spec is None or spec["autoscaling"] is None:
             return
         cfg = spec["autoscaling"]
-        replicas = self.replicas.get(name, [])
-        if not replicas:
+        if not self.replicas.get(name, []):
             return
-        ongoing = 0
-        for r in replicas:
-            try:
-                m = ray_tpu.get(r.metrics.remote(), timeout=2)
-                ongoing += m["ongoing"]
-            except Exception:  # noqa: BLE001
-                pass
-        desired = math.ceil(ongoing / max(cfg["target_ongoing_requests"],
-                                          1e-9))
-        desired = max(cfg["min_replicas"],
-                      min(cfg["max_replicas"], desired))
         current = spec["num_replicas"]
+        desired, signal = self._pressure_desired(name, cfg, current)
+        lo, hi = cfg["min_replicas"], cfg["max_replicas"]
+        cap = spec.get("pool_cap")
+        if cap is not None:
+            # Chips leased away by the pool arbiter are a hard ceiling —
+            # below min_replicas too: the arbiter's SLO guard is the
+            # path back, not a tug-of-war with the reconciler.
+            hi = min(hi, int(cap))
+            lo = min(lo, hi)
+        desired = max(lo, min(hi, desired))
         if desired == current:
             self._scale_intent.pop(name, None)
             return
@@ -447,6 +514,10 @@ class ServeController:
             if live is not None:
                 live["num_replicas"] = desired
         self._scale_intent.pop(name, None)
+        mdefs.SERVE_AUTOSCALE_DECISIONS.inc(tags={
+            "deployment": name,
+            "direction": "up" if desired > current else "down",
+            "signal": signal})
         self._reconcile_once(name)
 
     def _routes_changed(self, name: str) -> None:
@@ -618,6 +689,39 @@ class ServeController:
 
     def draining_count(self, name: str) -> int:
         return len(self._draining.get(name, []))
+
+    # ------------------------------------------------ chip-pool surface
+    def pool_set_replicas(self, name: str, target: int,
+                          cap: Optional[int] = None,
+                          cause: str = "pool") -> Dict[str, Any]:
+        """Pool-arbiter surface: set the deployment's replica target AND
+        its chip cap in one step. Shrinks go through the drain path (the
+        reconcile below drains victims); the cap clamps the pressure
+        autoscaler so it cannot re-grow into chips leased away
+        (``cap=None`` lifts the ceiling). Returns the previous state so
+        a crashed-and-restarted arbiter can re-issue this idempotently."""
+        with self._reconcile_lock:
+            spec = self.deployments.get(name)
+            if spec is None:
+                raise ValueError(f"unknown deployment {name!r}")
+            prev = {"target": spec["num_replicas"],
+                    "cap": spec.get("pool_cap")}
+            spec["num_replicas"] = max(int(target), 0)
+            spec["pool_cap"] = None if cap is None else max(int(cap), 0)
+        logger.info("pool: %s replicas -> %d (cap=%s, cause=%s)",
+                    name, target, cap, cause)
+        self._reconcile_once(name)
+        return prev
+
+    def pool_state(self, name: str) -> Dict[str, Any]:
+        """One-RPC snapshot the arbiter confirms handoff stages against:
+        routed (live, routable) replicas, the spec target, drains still
+        in flight, and the chip cap."""
+        spec = self.deployments.get(name) or {}
+        return {"routed": len(self.replicas.get(name, [])),
+                "target": spec.get("num_replicas", 0),
+                "draining": len(self._draining.get(name, [])),
+                "cap": spec.get("pool_cap")}
 
     def delete(self, name: str) -> bool:
         spec = self.deployments.pop(name, None)
@@ -950,8 +1054,18 @@ class ServeController:
             spec.pop("_migrate", False)
 
     def _reconcile_loop(self):
+        from ray_tpu._private import worker as worker_mod
+
         while not self._stop:
             time.sleep(0.5)
+            if worker_mod.global_worker_or_none() is None:
+                # The hosting runtime is gone (ray_tpu.shutdown() with
+                # this controller's stop RPC lost/raced): this thread is
+                # orphaned. Exit instead of letting the maintenance work
+                # below lazily AUTO-INITIALIZE a fresh runtime through
+                # global_worker() — a zombie controller quietly owning a
+                # new runtime is far worse than a missed tick.
+                return
             for name in list(self.deployments):
                 try:
                     self._autoscale_once(name)

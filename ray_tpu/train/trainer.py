@@ -288,6 +288,11 @@ class JaxTrainer:
                     guard.clear_target(resize_target
                                        if resize_target is not None
                                        else target)
+                    # The mesh is formed at this world size (executor
+                    # start = worker acquisition + backend on_start):
+                    # mirror it for the chip-pool arbiter's handoff
+                    # confirmation.
+                    self._publish_world(scaling.num_workers, attempt_idx)
                     worker_datasets = None
                     if self.datasets:
                         worker_datasets = [
@@ -419,7 +424,10 @@ class JaxTrainer:
                     self._pending_recovery = rec
         finally:
             guard.close()
-            # The run is over: the straggler GAUGE must not report an
+            # The run is over: the arbiter must not keep confirming
+            # against a dead run's world record.
+            self._publish_world(0, attempt_idx, ended=True)
+            # The straggler GAUGE must not report an
             # active straggler for a training run that no longer exists.
             # The KV record stays (ts-stamped, marked ended) as the
             # post-mortem surface, like `JaxTrainer.stragglers`.
@@ -501,6 +509,27 @@ class JaxTrainer:
             "fractions": ({c: v / wall for c, v in comps.items()}
                           if wall > 0 else {}),
         }
+
+    def _publish_world(self, world: int, attempt: int,
+                       ended: bool = False) -> None:
+        """Mirror the attempt's confirmed world size into the GCS
+        ``__train__`` KV (``world/<run>``) — the chip-pool arbiter reads
+        this to confirm a mesh re-formed at a leased world size before
+        committing the handoff. Best-effort like the straggler mirror."""
+        try:
+            import json
+
+            from ray_tpu.experimental import internal_kv as kv
+
+            rec = {"world": int(world), "attempt": int(attempt),
+                   "ts": time.time()}
+            if ended:
+                rec["run_ended"] = True
+            kv.internal_kv_put(f"world/{self._run_name}",
+                               json.dumps(rec).encode(),
+                               overwrite=True, namespace=TRAIN_KV_NS)
+        except Exception:  # noqa: BLE001 — KV mirror is best-effort
+            pass
 
     def _publish_straggler(self, rank: int,
                            info: Optional[Dict[str, Any]]) -> None:
@@ -806,4 +835,14 @@ class JaxTrainer:
                 # Closing ledger snapshots (wall frozen at session end)
                 # become the attempt's goodput_log entry.
                 self._account_goodput(final, mtags)
+                # A world-target ask that landed while the final steps
+                # were completing must NOT be silently dropped: re-form
+                # at the asked world (the restarted attempt restores
+                # past the last step and finishes immediately when no
+                # work remains, but the ask is honored and the world
+                # gauge/budget reflect it).
+                wt = guard.target
+                if wt is not None and wt != current_world:
+                    raise elastic.ResizeRequested(
+                        wt, reason="world-target hint")
                 return

@@ -159,6 +159,44 @@ class Histogram(Metric):
                 out.append((f"{self._name}_sum", key, self._sums[key]))
             return out
 
+    def bucket_snapshot(self, tags: Optional[Dict[str, str]] = None
+                        ) -> Tuple[Tuple[float, ...], List[int], int]:
+        """``(bounds, per-bucket counts, total)`` merged across every
+        label set matching ``tags`` (a subset filter; ``None`` = all).
+        In-process consumers (the chip-pool SLO guard) diff successive
+        snapshots to score a bounded window instead of the lifetime
+        distribution."""
+        want = tuple(sorted((tags or {}).items()))
+        merged = [0] * (len(self._bounds) + 1)
+        total = 0
+        with self._lock:
+            for key, counts in self._counts.items():
+                kd = dict(key)
+                if any(kd.get(k) != v for k, v in want):
+                    continue
+                for i, c in enumerate(counts):
+                    merged[i] += c
+                total += self._totals[key]
+        return self._bounds, merged, total
+
+    @staticmethod
+    def percentile_from(bounds: Sequence[float], counts: Sequence[int],
+                        q: float) -> Optional[float]:
+        """Upper-bound percentile estimate from bucket counts (the last
+        finite bound stands in for the +Inf bucket). ``None`` when the
+        window holds no observations."""
+        total = sum(counts)
+        if total <= 0:
+            return None
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank and c > 0:
+                return (bounds[i] if i < len(bounds)
+                        else bounds[-1] if bounds else float("inf"))
+        return bounds[-1] if bounds else float("inf")
+
 
 def prometheus_text() -> str:
     """Render every registered metric (the /metrics endpoint body)."""

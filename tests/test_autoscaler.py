@@ -431,3 +431,209 @@ def test_autoscaler_reports_instance_summary(cluster, mock_tpu_api):
     assert out["launched"] == 1
     assert out["instances"].get(im_mod.ALLOCATED) == 1
     request_resources(cluster.address, [])
+
+
+# ------------------------------------- allocation backoff + tick resilience
+
+def test_allocation_failure_backoff_and_metric(cluster):
+    """A failed provider create (real injected fault: fail_create_node)
+    opens an exponential launch backoff — the reconciler must NOT retry
+    at full rate next tick — and counts into
+    ray_tpu_autoscaler_allocation_failures_total."""
+    from ray_tpu._private import chaos
+    from ray_tpu._private import metrics_defs as mdefs
+
+    def alloc_failures():
+        return sum(v for _n, key, v
+                   in mdefs.AUTOSCALER_ALLOC_FAILURES.samples()
+                   if ("provider", "FakeNodeProvider") in key)
+
+    provider = FakeNodeProvider(cluster.address)
+    scaler = Autoscaler(cluster.address, provider, min_workers=1,
+                        max_workers=4)
+    scaler._alloc_backoff_base_s = 1.0  # ample vs slow-box reconciles
+
+    def wait_window_open():
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                scaler.summary()["allocation_backoff_remaining_s"] > 0:
+            time.sleep(0.05)
+        assert scaler.summary()["allocation_backoff_remaining_s"] == 0
+
+    before = alloc_failures()
+    chaos.configure("fail_create_node:times=2", seed=3)
+    try:
+        out = scaler.reconcile_once()
+        assert out["launched"] == 0
+        assert out["instances"].get("ALLOCATION_FAILED") == 1
+        assert alloc_failures() == before + 1
+        s = scaler.summary()
+        assert s["allocation_failure_streak"] == 1
+        # Inside the backoff window: NO new launch attempt, so the
+        # second chaos firing is NOT consumed and no new failure lands
+        # (only asserted while the window is verifiably still open).
+        if s["allocation_backoff_remaining_s"] > 0:
+            out = scaler.reconcile_once()
+            assert out["launched"] == 0
+            assert out["instances"].get("ALLOCATION_FAILED") == 1
+        # Window lapses -> retry (fails again, doubled backoff) ->
+        # lapses -> chaos exhausted -> launch succeeds, streak resets.
+        wait_window_open()
+        out = scaler.reconcile_once()
+        assert out["instances"].get("ALLOCATION_FAILED") == 2
+        assert scaler.summary()["allocation_failure_streak"] == 2
+        assert alloc_failures() == before + 2
+        wait_window_open()
+        out = scaler.reconcile_once()
+        assert out["launched"] == 1
+        assert scaler.summary()["allocation_failure_streak"] == 0
+        # The reconcile mirrored its summary into the KV for the
+        # dashboard.
+        from ray_tpu._private import rpc
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        gcs = rpc.get_stub("GcsService", cluster.address)
+        reply = gcs.KvGet(pb.KvRequest(ns="autoscaler", key="status"))
+        assert reply.found
+        import json as _json
+
+        status = _json.loads(reply.value)
+        assert status["provider"] == "FakeNodeProvider"
+        assert "consecutive_tick_failures" in status
+    finally:
+        chaos.configure(None)
+        for nid in provider.non_terminated_nodes():
+            provider.terminate_node(nid)
+
+
+def test_tick_loop_counts_failures_backs_off_and_recovers(cluster):
+    """_loop must not just swallow exceptions: consecutive failed ticks
+    count into the gauge, the interval backs off, and summary() carries
+    the last error; a healthy tick resets all three."""
+    from ray_tpu._private import metrics_defs as mdefs
+
+    provider = FakeNodeProvider(cluster.address)
+    scaler = Autoscaler(cluster.address, provider, min_workers=0,
+                        max_workers=2, tick_interval_s=0.02)
+    healthy = scaler.reconcile_once
+
+    def boom():
+        raise RuntimeError("tick boom")
+
+    scaler.reconcile_once = boom
+    scaler.start()
+    try:
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                scaler._tick_fail_streak < 3:
+            time.sleep(0.05)
+        s = scaler.summary()
+        assert s["consecutive_tick_failures"] >= 3
+        assert "tick boom" in s["last_tick_error"]
+        assert s["tick_interval_s"] > scaler.tick_interval_s
+        gauge = {dict(k).get("provider"): v for _n, k, v
+                 in mdefs.AUTOSCALER_TICK_FAILURES.samples()}
+        assert gauge.get("FakeNodeProvider", 0) >= 3
+        # Recovery: a clean tick resets the streak and the interval.
+        scaler.reconcile_once = healthy
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                scaler._tick_fail_streak != 0:
+            time.sleep(0.05)
+        s = scaler.summary()
+        assert s["consecutive_tick_failures"] == 0
+        assert s["last_tick_error"] is None
+        assert s["tick_interval_s"] == scaler.tick_interval_s
+    finally:
+        scaler.stop()
+        for nid in provider.non_terminated_nodes():
+            provider.terminate_node(nid)
+
+
+# ----------------------------- instance_manager failure-branch coverage
+
+class _FlakyTerminateProvider:
+    """create succeeds; the FIRST terminate call fails transiently."""
+
+    def __init__(self):
+        self.nodes = []
+        self.terminate_calls = 0
+
+    def create_node(self, cfg):
+        nid = f"flaky-{len(self.nodes)}"
+        self.nodes.append(nid)
+        return nid
+
+    def terminate_node(self, nid):
+        self.terminate_calls += 1
+        if self.terminate_calls == 1:
+            raise RuntimeError("API 503")
+        self.nodes.remove(nid)
+
+    def non_terminated_nodes(self):
+        return list(self.nodes)
+
+
+def test_instance_manager_terminating_retry_path():
+    """A failed provider terminate leaves the instance TERMINATING (NOT
+    TERMINATED — that would leak the cloud node) and a later retry
+    through the same manager completes it."""
+    from ray_tpu.autoscaler import instance_manager as im_mod
+
+    p = _FlakyTerminateProvider()
+    im = im_mod.InstanceManager(p)
+    (inst,) = im.launch_instances(1, {})
+    assert not im.terminate_instance(inst.instance_id, "first try")
+    assert inst.status == im_mod.TERMINATING
+    assert inst.provider_id in p.non_terminated_nodes()
+    # The retry transitions TERMINATING -> TERMINATED (no illegal
+    # TERMINATING -> TERMINATING re-entry).
+    assert im.terminate_instance(inst.instance_id, "retry")
+    assert inst.status == im_mod.TERMINATED
+    assert p.non_terminated_nodes() == []
+    assert [s for s, _, _ in inst.history] == [
+        im_mod.QUEUED, im_mod.REQUESTED, im_mod.ALLOCATED,
+        im_mod.TERMINATING, im_mod.TERMINATED]
+
+
+def test_instance_manager_allocation_failed_is_terminal():
+    """ALLOCATION_FAILED is terminal: it cannot transition anywhere,
+    terminate is a no-op, and it must not shadow its provider id."""
+    from ray_tpu.autoscaler import instance_manager as im_mod
+
+    class FailingProvider:
+        def create_node(self, cfg):
+            raise RuntimeError("stockout")
+
+        def terminate_node(self, nid):
+            raise AssertionError("must not be called")
+
+        def non_terminated_nodes(self):
+            return []
+
+    im = im_mod.InstanceManager(FailingProvider())
+    assert im.launch_instances(1, {}) == []
+    (failed,) = im.instances({im_mod.ALLOCATION_FAILED})
+    assert not im.terminate_instance(failed.instance_id)
+    with pytest.raises(im_mod.InvalidTransition):
+        im._set_status(failed, im_mod.REQUESTED)
+    assert im.get_by_provider_id(failed.provider_id or "") is None
+    # sync_from must skip it (no "vanished" transition off a terminal).
+    im.sync_from(set(), set())
+    assert failed.status == im_mod.ALLOCATION_FAILED
+
+
+def test_instance_manager_sync_terminates_vanished_terminating():
+    """An instance stuck TERMINATING whose node vanishes externally
+    (the cloud finally reaped it) folds to TERMINATED on sync."""
+    from ray_tpu.autoscaler import instance_manager as im_mod
+
+    p = _FlakyTerminateProvider()
+    im = im_mod.InstanceManager(p)
+    (inst,) = im.launch_instances(1, {})
+    assert not im.terminate_instance(inst.instance_id)  # 503: stuck
+    assert inst.status == im_mod.TERMINATING
+    p.nodes.clear()  # reaped out-of-band
+    im.sync_from(set(p.non_terminated_nodes()), set())
+    assert inst.status == im_mod.TERMINATED
+    assert inst.history[-1][2] == "vanished from provider"

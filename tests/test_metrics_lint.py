@@ -452,3 +452,83 @@ def test_engine_tick_and_prefill_entry_points_are_instrumented():
         eng = ContinuousBatcher(cfg, num_slots=2, max_len=64, paged=paged)
         assert isinstance(eng._tick, InstrumentedJit), paged
         assert isinstance(eng._prefill, InstrumentedJit), paged
+
+
+def test_pool_and_autoscaler_series_are_cataloged():
+    """The chip-pool arbiter + autoscaler-resilience series ship
+    described + tagged in the catalog — the dashboard 'Pool / chip
+    leases & handoffs' panel, `ray-tpu pool status`, and the ISSUE-15
+    acceptance criteria read them."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_pool_chips",
+        "ray_tpu_pool_leases",
+        "ray_tpu_pool_handoffs_total",
+        "ray_tpu_pool_handoff_seconds",
+        "ray_tpu_pool_slo_reversals_total",
+        "ray_tpu_pool_invariant_violations_total",
+        "ray_tpu_autoscaler_allocation_failures_total",
+        "ray_tpu_autoscaler_consecutive_tick_failures",
+        "ray_tpu_serve_autoscale_decisions_total",
+    }
+    missing = required - names
+    assert not missing, (
+        f"pool/autoscaler series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name in required:
+            assert m.description.strip() and m.tag_keys, m.name
+        if m.name == "ray_tpu_pool_chips":
+            assert "owner" in m.tag_keys
+        if m.name == "ray_tpu_pool_handoffs_total":
+            assert {"direction", "outcome"} <= set(m.tag_keys)
+        if m.name == "ray_tpu_pool_slo_reversals_total":
+            assert {"action", "signal"} <= set(m.tag_keys)
+        if m.name == "ray_tpu_serve_autoscale_decisions_total":
+            assert {"deployment", "direction", "signal"} <= set(m.tag_keys)
+        if m.name.startswith("ray_tpu_autoscaler_"):
+            assert "provider" in m.tag_keys, m.name
+    # The dashboard renders the plane beside Train / elasticity.
+    from ray_tpu import dashboard
+
+    assert 'id="pool"' in dashboard._INDEX_HTML
+
+
+def test_arbiter_ledger_transitions_are_journaled():
+    """Source lint: EVERY KV mutation in the arbiter goes through the
+    ledger's journaled helpers (_journal_put/_journal_del) or the KV
+    store adapters they call — never a bare internal_kv/KvPut write. A
+    bare write could move chips without a journal record, and the whole
+    crash-resume story (and the conservation invariant) hangs off the
+    journal being complete."""
+    import pathlib
+
+    import ray_tpu
+    from ray_tpu.autoscaler import arbiter
+
+    path = pathlib.Path(ray_tpu.__file__).parent / "autoscaler" / \
+        "arbiter.py"
+    allowed = {"_journal_put", "_journal_del",   # the ledger chokepoints
+               "put", "delete"}                  # the KV store adapters
+    current_def = "<module>"
+    for i, line in enumerate(path.read_text().splitlines()):
+        stripped = line.strip()
+        if stripped.startswith(("def ", "async def ")):
+            current_def = stripped.split("def ", 1)[1].split("(")[0]
+        code = stripped.split("#", 1)[0]
+        if "internal_kv_put(" in code or "internal_kv_del(" in code or \
+                ".kv.put(" in code or ".kv.delete(" in code or \
+                "KvPut(" in code:
+            assert current_def in allowed, (
+                f"arbiter.py:{i + 1} writes the KV in {current_def!r} "
+                f"outside the journaled helpers — route it through "
+                f"PoolLedger._journal_put/_journal_del")
+    # The chokepoints and the state machine actually exist.
+    assert callable(arbiter.PoolLedger._journal_put)
+    assert callable(arbiter.PoolLedger._journal_del)
+    src = path.read_text()
+    for marker in ("_LEASE_TRANSITIONS", "InvalidLeaseTransition",
+                   "def verify", "def advance"):
+        assert marker in src, marker
+    # Every advance() call journals through the validated helper (no
+    # parallel transition path).
+    assert "self._journal_put(f\"lease/" in src

@@ -135,7 +135,11 @@ def test_kill_worker_recovers_shrunk_then_grows_back(elastic_ray,
 
     chaos.configure("kill_worker:rank=1,step=3,resize=2", seed=7)
     restores = []
-    loop = _make_loop(total, restores=restores,
+    # step_sleep keeps steps slower than the controller's poll cadence,
+    # so the grow-back ask is seen while steps remain (the attempt
+    # re-forms mid-run and reports at the grown world) regardless of
+    # process warm-up.
+    loop = _make_loop(total, restores=restores, step_sleep=0.03,
                       resize_at={(2, 6): 4})  # grow back at world 2, step 6
     trainer, result = _fit(loop, tmp_path, "chaotic")
 
@@ -168,7 +172,8 @@ def test_resize_shrink_then_grow_bit_identical(elastic_ray, tmp_path):
     re-formations charge the resize budget (no backoff) and every restore
     is bit-identical across topologies."""
     restores = []
-    loop = _make_loop(10, restores=restores,
+    # Slow steps (see the kill test): both asks land mid-run.
+    loop = _make_loop(10, restores=restores, step_sleep=0.03,
                       resize_at={(4, 2): 2, (2, 6): 4})
     trainer, result = _fit(loop, tmp_path, "resize")
     assert result.error is None
