@@ -138,8 +138,13 @@ class SimulatedProcessDeath(BaseException):
     runtime (``_private/runtime/local.py``) converts it into genuine
     actor death instead of a task error."""
 
-    def __init__(self, reason: str = "chaos: worker killed"):
+    def __init__(self, reason: str = "chaos: worker killed",
+                 event_id: str = ""):
         self.reason = reason
+        # Flight-recorder id of the injection that killed this process:
+        # recovery code records it as the ``cause`` of its reaction so
+        # chaos e2es can assert the whole causal chain.
+        self.event_id = event_id
         super().__init__(reason)
 
 
@@ -344,18 +349,42 @@ def inject(site: str, **coords: Any) -> Optional[Dict[str, Any]]:
             if rule.p is not None and not _coin(plan, rule, site, coords):
                 continue
             _fired[rule.id] = _fired.get(rule.id, 0) + 1
+            entry = {
+                "seq": len(_log), "action": rule.action, "site": site,
+                "rule": rule.id, "ts": time.time(),
+                "coords": {k: v for k, v in coords.items()
+                           if isinstance(v, (int, float, str))}}
             if len(_log) < _MAX_LOG:
-                _log.append({
-                    "seq": len(_log), "action": rule.action, "site": site,
-                    "rule": rule.id, "ts": time.time(),
-                    "coords": {k: v for k, v in coords.items()
-                               if isinstance(v, (int, float, str))}})
-        _apply(plan, rule, site, coords, directives)
+                _log.append(entry)
+        # Every firing is a flight-recorder root event; its id rides the
+        # injection-log entry, the returned directives, and (for kills)
+        # the SimulatedProcessDeath, so reactions downstream can cite it
+        # as their cause.
+        event_id = _emit_injection(rule, site, coords)
+        entry["event_id"] = event_id
+        _apply(plan, rule, site, coords, directives, event_id)
+        directives["event_id"] = event_id
     return directives or None
 
 
+def _emit_injection(rule: ChaosRule, site: str,
+                    coords: Dict[str, Any]) -> str:
+    from ray_tpu._private import events as _events
+
+    subject: Dict[str, Any] = {}
+    for ck, sk in (("lease", "lease_id"), ("replica", "replica"),
+                   ("node", "node"), ("run", "run"),
+                   ("deployment", "deployment")):
+        v = coords.get(ck)
+        if isinstance(v, (int, str)):
+            subject[sk] = v
+    return _events.emit("chaos.inject", subject=subject,
+                        action=rule.action, site=site, rule=rule.id)
+
+
 def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
-           coords: Dict[str, Any], directives: Dict[str, Any]) -> None:
+           coords: Dict[str, Any], directives: Dict[str, Any],
+           event_id: str = "") -> None:
     action = rule.action
     logger.warning("chaos: injecting %s at %s %s", action, site, coords)
     if action in ("kill_worker", "kill_replica", "kill_arbiter"):
@@ -366,7 +395,7 @@ def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
             os._exit(17)  # real worker process: die like a killed host
         _tls.dying = True
         raise SimulatedProcessDeath(
-            f"chaos {action} at {site} {coords}")
+            f"chaos {action} at {site} {coords}", event_id=event_id)
     if action == "slow_step":
         delay = float(rule.params.get("secs", 1.0))
         jitter = rule.params.get("jitter")
@@ -411,7 +440,9 @@ def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
         try:
             from ray_tpu.checkpoint.preempt import publish_preempt
 
-            publish_preempt(reason="chaos-preempt-node", node=target)
+            notice = publish_preempt(reason="chaos-preempt-node",
+                                     node=target, cause=event_id)
+            directives["notice_id"] = notice.get("notice_id", "")
         except Exception:  # noqa: BLE001 — chaos must not mask the fault
             logger.exception("chaos: preempt_node publish failed")
         directives["preempted_node"] = target

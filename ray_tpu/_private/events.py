@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+import uuid
+from typing import Any, Callable, Dict, Iterable, List, Optional, Set
 
 logger = logging.getLogger(__name__)
 
@@ -167,3 +169,248 @@ def timeline(filename: Optional[str] = None) -> List[Dict[str, Any]]:
 def clear() -> None:
     with _lock:
         _events.clear()
+
+
+# ---------------------------------------------------------------------------
+# Cluster flight recorder: typed, causally-linked control-plane events.
+#
+# Every control-plane decision (lease transition, SLO reversal, drain,
+# preemption notice, elastic recovery, probe-before-reap verdict, chaos
+# injection) emits one record carrying an ``event_id``, a ``cause`` link
+# to the parent event id, and ``subject`` keys (lease_id, replica, run,
+# node, deployment, request_id...). Records land in a bounded per-process
+# ring (queryable in local mode) and ride the same BufferedPublisher
+# drop-accounting path as tracing spans to a bounded GCS store, so the
+# fleet-operator question "why did my chips move" resolves to one
+# connected chain instead of disconnected counters.
+# ---------------------------------------------------------------------------
+
+FLIGHT_CHANNEL = "FLIGHT_EVENT"
+FLIGHT_RING_MAX = int(os.environ.get("RAY_TPU_FLIGHT_RING_MAX", "20000"))
+
+_flight_lock = threading.Lock()
+_flight: List[Dict[str, Any]] = []
+_flight_publisher: Optional[BufferedPublisher] = None
+_flight_pub_lock = threading.Lock()
+# The GCS server process writes its own emissions straight into its
+# store (it IS the sink — publishing to itself would deadlock the
+# servicer thread on its own channel).
+_local_sink: Optional[Callable[[List[Dict[str, Any]]], None]] = None
+
+
+def set_local_sink(fn: Optional[Callable[[List[Dict[str, Any]]], None]]) -> None:
+    """Route this process's flight events directly to ``fn(batch)``
+    instead of the pubsub publisher (used by the GCS server process)."""
+    global _local_sink
+    _local_sink = fn
+
+
+def _get_flight_publisher() -> BufferedPublisher:
+    global _flight_publisher
+    with _flight_pub_lock:
+        if _flight_publisher is None:
+            def gcs_getter():
+                # Non-initializing: a flush thread must never resurrect
+                # a global worker after shutdown (tracing._live_core).
+                from ray_tpu._private import worker as worker_mod
+
+                w = getattr(worker_mod, "_global_worker", None)
+                core = None if w is None else w.core
+                return getattr(core, "gcs", None) if core else None
+
+            _flight_publisher = BufferedPublisher(FLIGHT_CHANNEL, gcs_getter)
+        return _flight_publisher
+
+
+def _flight_process_ids() -> Dict[str, str]:
+    try:
+        from ray_tpu.util.tracing import _process_ids
+
+        return _process_ids()
+    except Exception:  # noqa: BLE001
+        return {"worker_id": "driver", "node_id": ""}
+
+
+def emit(etype: str, cause: Optional[str] = None,
+         subject: Optional[Dict[str, Any]] = None, **attrs) -> str:
+    """Record one flight event; returns its event id.
+
+    ``cause`` is the parent event id ("" breaks the chain); ``subject``
+    keys identify what the event is about (lease_id, replica, run, node,
+    deployment, request_id, trace_id). Extra keyword attrs ride under
+    ``attrs``. Never raises: the recorder is best-effort by design."""
+    event_id = uuid.uuid4().hex[:16]
+    try:
+        rec: Dict[str, Any] = {
+            "event_id": event_id,
+            "type": str(etype),
+            "ts": time.time(),
+            "cause": str(cause or ""),
+            "subject": {str(k): str(v) for k, v in (subject or {}).items()
+                        if v not in (None, "")},
+            **_flight_process_ids(),
+        }
+        if attrs:
+            rec["attrs"] = {str(k): v for k, v in attrs.items()}
+        evicted = 0
+        with _flight_lock:
+            _flight.append(rec)
+            if len(_flight) > FLIGHT_RING_MAX:
+                evicted = len(_flight) - FLIGHT_RING_MAX
+                del _flight[:evicted]
+        if evicted:
+            _count_dropped("flight", evicted)
+        try:
+            from ray_tpu._private import metrics_defs as mdefs
+
+            mdefs.EVENTS_TOTAL.inc(tags={"type": str(etype)})
+        except Exception:  # noqa: BLE001
+            pass
+        sink = _local_sink
+        if sink is not None:
+            sink([rec])
+        else:
+            _get_flight_publisher().add(rec)
+    except Exception:  # noqa: BLE001 — recording must never break callers
+        logger.debug("flight emit failed", exc_info=True)
+    return event_id
+
+
+def _subject_matches(rec: Dict[str, Any], subject: Dict[str, Any]) -> bool:
+    sub = rec.get("subject", {})
+    return all(sub.get(str(k)) == str(v) for k, v in subject.items())
+
+
+def match_events(records: Iterable[Dict[str, Any]],
+                 types: Optional[Iterable[str]] = None,
+                 subject: Optional[Dict[str, Any]] = None,
+                 since: Optional[float] = None,
+                 until: Optional[float] = None,
+                 limit: int = 1000) -> List[Dict[str, Any]]:
+    """Filter flight records by type set / subject keys / time window.
+    Shared by the local ring, the GCS query path, and the CLI so every
+    surface answers filters identically."""
+    tset = {str(t) for t in types} if types else None
+    out = []
+    for r in records:
+        if tset is not None and r.get("type") not in tset:
+            continue
+        if subject and not _subject_matches(r, subject):
+            continue
+        ts = r.get("ts", 0.0)
+        if since is not None and ts < since:
+            continue
+        if until is not None and ts > until:
+            continue
+        out.append(r)
+    return out[-max(int(limit), 0):]
+
+
+def local_events(types: Optional[Iterable[str]] = None,
+                 subject: Optional[Dict[str, Any]] = None,
+                 since: Optional[float] = None,
+                 until: Optional[float] = None,
+                 limit: int = 1000) -> List[Dict[str, Any]]:
+    """Query this process's flight ring (the source of truth in local
+    mode, where every plane shares one process). ``since``/``until``
+    under 1e9 are relative seconds before now — the same convention the
+    GCS ``__events__`` query path answers, so callers can switch
+    transports without changing their window arguments."""
+    now = time.time()
+    if since is not None and float(since) < 1e9:
+        since = now - float(since)
+    if until is not None and float(until) < 1e9:
+        until = now - float(until)
+    with _flight_lock:
+        recs = list(_flight)
+    return match_events(recs, types=types, subject=subject,
+                        since=since, until=until, limit=limit)
+
+
+def latest_event_id(types: Iterable[str],
+                    subject: Optional[Dict[str, Any]] = None) -> str:
+    """Newest in-ring event id matching ``types`` (+ subject keys), or
+    "". Best-effort cause inference for sites that observe an effect
+    (a dead replica, a drain rejection) without the trigger's id in
+    hand — correct in-process, empty across process boundaries."""
+    tset = {str(t) for t in types}
+    with _flight_lock:
+        for rec in reversed(_flight):
+            if rec.get("type") in tset and (
+                    not subject or _subject_matches(rec, subject)):
+                return rec.get("event_id", "")
+    return ""
+
+
+def causal_chain(records: List[Dict[str, Any]],
+                 seed_ids: Iterable[str],
+                 subject_rounds: int = 1) -> List[Dict[str, Any]]:
+    """Causal closure of the seed events over ``records``: ancestors via
+    ``cause`` links, descendants via reverse links, plus
+    ``subject_rounds`` rounds of subject-join (events sharing any
+    subject key=value with the selected set, re-closed causally each
+    round — this is how a request's chain picks up the lease reversal
+    that shares only a lease_id with the drain's cause). Sorted by ts."""
+    by_id = {r["event_id"]: r for r in records if r.get("event_id")}
+    children: Dict[str, List[str]] = {}
+    for r in records:
+        c = r.get("cause", "")
+        if c:
+            children.setdefault(c, []).append(r.get("event_id", ""))
+
+    def close(selected: Set[str]) -> Set[str]:
+        frontier = list(selected)
+        while frontier:
+            eid = frontier.pop()
+            rec = by_id.get(eid)
+            if rec is None:
+                continue
+            cause = rec.get("cause", "")
+            if cause and cause in by_id and cause not in selected:
+                selected.add(cause)
+                frontier.append(cause)
+            for kid in children.get(eid, ()):
+                if kid and kid not in selected:
+                    selected.add(kid)
+                    frontier.append(kid)
+        return selected
+
+    selected = close({e for e in seed_ids if e in by_id})
+    for _ in range(max(subject_rounds, 0)):
+        pairs = set()
+        for eid in selected:
+            for k, v in by_id[eid].get("subject", {}).items():
+                pairs.add((k, v))
+        added = {r["event_id"] for r in records
+                 if r.get("event_id") and r["event_id"] not in selected
+                 and any((k, v) in pairs
+                         for k, v in r.get("subject", {}).items())}
+        if not added:
+            break
+        selected = close(selected | added)
+    return sorted((by_id[e] for e in selected), key=lambda r: r.get("ts", 0.0))
+
+
+def flight_span_records(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Map flight events onto the tracing span-record shape so
+    ``spans_to_chrome_events`` renders cause links as chrome flow
+    arrows alongside real spans in ``ray-tpu timeline``."""
+    out = []
+    for r in records:
+        sub = r.get("subject", {})
+        out.append({
+            "name": r.get("type", "event"), "kind": "flight",
+            "trace_id": sub.get("request_id") or sub.get("trace_id")
+            or sub.get("lease_id") or "flight",
+            "span_id": r.get("event_id", ""),
+            "parent_span_id": r.get("cause", ""),
+            "ts": r.get("ts", 0.0), "dur": 0.0,
+            "node_id": r.get("node_id", ""),
+            "worker_id": r.get("worker_id", "control"),
+        })
+    return out
+
+
+def clear_flight() -> None:
+    with _flight_lock:
+        _flight.clear()

@@ -88,6 +88,16 @@ class GcsServer:
         # RAY_TPU_EVENT_DIR for external consumers when set.
         self._export_events: "deque" = deque(
             maxlen=int(os.environ.get("RAY_TPU_EXPORT_EVENTS_MAX", 10000)))
+        # Flight recorder store (events.py emit()): bounded + time-retained
+        # like the TSDB, WAL-journaled so a head restart keeps recent
+        # control-plane history. Served through the __events__ namespace
+        # (JSON-dict keys are flight queries; key "" keeps the legacy
+        # export-event read path).
+        self._flight_events: List[Dict] = []
+        self._flight_max = int(os.environ.get(
+            "RAY_TPU_FLIGHT_EVENTS_MAX", 20000))
+        self._flight_retention_s = float(os.environ.get(
+            "RAY_TPU_FLIGHT_RETENTION_S", 1800.0))
         self._event_dir = os.environ.get("RAY_TPU_EVENT_DIR") or None
         self._event_file_lock = threading.Lock()
         self._event_file_bytes = 0
@@ -195,6 +205,12 @@ class GcsServer:
         metrics_pusher.note_inprocess_gcs(f"127.0.0.1:{self.port}")
         threading.Thread(target=self._metrics_sample_loop, daemon=True,
                          name="gcs-metrics-sampler").start()
+        # This process's own flight events (probe verdicts, node deaths)
+        # write straight into the store — publishing to ourselves would
+        # block a servicer thread on its own channel.
+        from ray_tpu._private import events as events_mod
+
+        events_mod.set_local_sink(self._ingest_flight)
 
     def _metrics_sample_loop(self):
         # Known limitation (matches Prometheus registry semantics): the
@@ -254,6 +270,7 @@ class GcsServer:
                 "holders": {h: (nid, is_drv) for h, (nid, is_drv, _)
                             in self._holder_meta.items()},
                 "freed": list(self._freed),
+                "flight": list(self._flight_events),
             }
         return pickle.dumps(state)
 
@@ -286,6 +303,7 @@ class GcsServer:
             self._holder_meta[h] = (nid, is_drv, now)
         for oid in state.get("freed", ()):
             self._freed[oid] = now
+        self._flight_events = list(state.get("flight", ()))
 
     def _claim_actor_name(self, info) -> None:
         """Maintain the name table for one actor update (caller holds the
@@ -369,6 +387,13 @@ class GcsServer:
                 self._freed[oid] = now
                 self._locations.pop(oid, None)
                 self._object_sizes.pop(oid, None)
+        elif kind == "flight":
+            # Replay without re-journaling (the record already lives in
+            # the log) and without drop accounting (replay is not loss).
+            self._flight_events.extend(rec[1])
+            over = len(self._flight_events) - self._flight_max
+            if over > 0:
+                del self._flight_events[:over]
         else:
             logger.warning("unknown WAL record kind %r", kind)
 
@@ -453,6 +478,38 @@ class GcsServer:
                 self._event_file_bytes += len(line)
         except Exception:  # noqa: BLE001 — export is best-effort
             pass
+
+    def _ingest_flight(self, batch, journal: bool = True) -> None:
+        """Ingest flight-recorder events (FLIGHT_EVENT pubsub batches and
+        this process's own emissions). Retention-expired records age out
+        silently; cap evictions are LOSS and counted in
+        ray_tpu_events_dropped_total{buffer="gcs_flight"}."""
+        if not batch:
+            return
+        now = time.time()
+        evicted = 0
+        with self._lock:
+            self._flight_events.extend(batch)
+            cutoff = now - self._flight_retention_s
+            aged = 0
+            for rec in self._flight_events:
+                if rec.get("ts", now) >= cutoff:
+                    break
+                aged += 1
+            if aged:
+                del self._flight_events[:aged]
+            over = len(self._flight_events) - self._flight_max
+            if over > 0:
+                del self._flight_events[:over]
+                evicted = over
+            if journal:
+                # Inside the lock like KV writes: replay order must
+                # match apply order.
+                self._wal_append(("flight", list(batch)))
+        if evicted:
+            from ray_tpu._private import events as events_mod
+
+            events_mod._count_dropped("gcs_flight", evicted)
 
     def RegisterNode(self, request, context):
         info = request.info
@@ -578,17 +635,24 @@ class GcsServer:
             alive = True
         except Exception:  # noqa: BLE001 — unreachable: confirmed dead
             pass
+        from ray_tpu._private import events as events_mod
+
         if alive:
             with self._lock:
                 info = self._nodes.get(node_id)
                 if info is not None and info.alive:
                     self._last_heartbeat[node_id] = time.monotonic()
+            events_mod.emit("gcs.probe", subject={"node": node_id},
+                            verdict="alive_kept")
             logger.warning(
                 "node %s heartbeats lapsed past the TTL but the node "
                 "manager answered a probe — keeping it (slow, not dead)",
                 node_id[:8])
         else:
-            self._mark_dead(node_id, "missed heartbeats; probe failed")
+            probe_ev = events_mod.emit(
+                "gcs.probe", subject={"node": node_id}, verdict="dead")
+            self._mark_dead(node_id, "missed heartbeats; probe failed",
+                            cause=probe_ev)
 
     def _reconcile_jobs(self):
         """Sweep jobs stuck PENDING/RUNNING after their submitting client
@@ -681,7 +745,7 @@ class GcsServer:
                         "record(s) for %s", len(entries), prefix)
         return deleted
 
-    def _mark_dead(self, node_id: str, reason: str):
+    def _mark_dead(self, node_id: str, reason: str, cause: str = ""):
         with self._lock:
             info = self._nodes.get(node_id)
             if info is None or not info.alive:
@@ -689,6 +753,10 @@ class GcsServer:
             info.alive = False
         logger.warning("node %s marked dead: %s", node_id[:8], reason)
         self._export_event("NODE_DEAD", node_id=node_id, reason=reason)
+        from ray_tpu._private import events as events_mod
+
+        events_mod.emit("gcs.node_dead", cause=cause,
+                        subject={"node": node_id}, reason=reason)
         self._publish("NODE", pickle.dumps(
             {"event": "dead", "node_id": node_id, "reason": reason}))
         self._on_node_dead(node_id)
@@ -716,6 +784,30 @@ class GcsServer:
                 events = list(self._task_events)
             return pb.KvReply(found=True, value=pickle.dumps(events))
         if request.ns == "__events__":
+            if request.key:
+                # Flight-recorder query: the key is a JSON dict
+                # (types/subject/since/until/limit; "since"/"until"
+                # under 10^9 are relative seconds before now, like the
+                # __metrics__ read path).
+                from ray_tpu._private import events as events_mod
+
+                try:
+                    q = json.loads(request.key)
+                    now = time.time()
+                    for bound in ("since", "until"):
+                        v = q.get(bound)
+                        if v is not None and float(v) < 1e9:
+                            q[bound] = now - float(v)
+                    with self._lock:
+                        recs = list(self._flight_events)
+                    hits = events_mod.match_events(
+                        recs, types=q.get("types") or None,
+                        subject=q.get("subject") or None,
+                        since=q.get("since"), until=q.get("until"),
+                        limit=int(q.get("limit") or 1000))
+                except Exception as e:  # noqa: BLE001 — malformed query
+                    return pb.KvReply(found=False, value=repr(e).encode())
+                return pb.KvReply(found=True, value=pickle.dumps(hits))
             with self._lock:
                 events = list(self._export_events)
             return pb.KvReply(found=True, value=pickle.dumps(events))
@@ -1065,6 +1157,14 @@ class GcsServer:
                 self._tsdb.ingest(batch.get("samples", ()),
                                   labels=batch.get("labels"),
                                   ts=batch.get("ts") or time.time())
+            except Exception:  # noqa: BLE001 — a bad batch must not 500
+                pass
+            return pb.Empty()
+        if request.channel == "FLIGHT_EVENT":
+            # Flight-recorder batches from per-process BufferedPublishers:
+            # store-only, like METRICS (no subscriber fan-out).
+            try:
+                self._ingest_flight(list(pickle.loads(request.data)))
             except Exception:  # noqa: BLE001 — a bad batch must not 500
                 pass
             return pb.Empty()
@@ -1505,8 +1605,11 @@ class GcsServer:
     # ------------------------------------------------------------- lifecycle
     def shutdown(self):
         self._stop.set()
+        from ray_tpu._private import events as events_mod
         from ray_tpu._private import metrics_pusher
 
+        if events_mod._local_sink == self._ingest_flight:
+            events_mod.set_local_sink(None)
         metrics_pusher.forget_inprocess_gcs(f"127.0.0.1:{self.port}")
         self._work_pool.shutdown(wait=False)
         if self._wal is not None:

@@ -234,9 +234,15 @@ SERVE_REQ_OUTCOMES = Counter(
 EVENTS_DROPPED = Counter(
     "ray_tpu_events_dropped_total",
     "Task-event/span records shed by a full buffer, by buffer "
-    "(timeline ring, per-channel BufferedPublisher) — a non-zero rate "
-    "means traces have holes",
+    "(timeline ring, per-channel BufferedPublisher, flight ring, GCS "
+    "flight store) — a non-zero rate means traces/chains have holes",
     ("buffer",))
+EVENTS_TOTAL = Counter(
+    "ray_tpu_events_total",
+    "Flight-recorder control-plane events emitted, by event type "
+    "(lease transitions, drains, preemption notices, recoveries, chaos "
+    "injections...); loss is counted in ray_tpu_events_dropped_total",
+    ("type",))
 
 # ---------------------------------------------------------------- train (L6)
 TRAIN_REPORTS = Counter(

@@ -76,6 +76,8 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import events as _events
+
 logger = logging.getLogger(__name__)
 
 POOL_KV_NS = "__pool__"
@@ -276,12 +278,19 @@ class PoolLedger:
             "history": [[PENDING, time.time(), "created"]],
         }
         self._journal_put(f"lease/{lease['lease_id']}", lease)
+        _events.emit("pool.lease", subject={"lease_id": lease["lease_id"]},
+                     stage=PENDING, detail="created", donor=donor,
+                     recipient=recipient, chips=int(chips))
         return lease
 
     def advance(self, lease: Dict[str, Any], stage: str,
-                detail: str = "", **fields: Any) -> Dict[str, Any]:
+                detail: str = "", cause_event: str = "",
+                **fields: Any) -> Dict[str, Any]:
         """Validated, journaled transition (+ optional recorded fields,
-        e.g. the absolute targets a restarted arbiter re-issues)."""
+        e.g. the absolute targets a restarted arbiter re-issues).
+        ``cause_event`` links the flight-recorder record for this
+        transition to the event that forced it (SLO breach, preemption
+        notice)."""
         if stage not in _LEASE_TRANSITIONS.get(lease["stage"], set()):
             raise InvalidLeaseTransition(
                 f"lease {lease['lease_id']}: {lease['stage']} -> {stage}")
@@ -290,17 +299,26 @@ class PoolLedger:
         hist.append([stage, time.time(), detail])
         lease["history"] = hist
         self._journal_put(f"lease/{lease['lease_id']}", lease)
+        _events.emit("pool.lease", cause=cause_event,
+                     subject={"lease_id": lease["lease_id"]},
+                     stage=stage, detail=detail, donor=lease["donor"],
+                     recipient=lease["recipient"], chips=lease["chips"])
         if stage in TERMINAL:
             self._prune()
         return lease
 
     def record_reversal(self, lease: Dict[str, Any], action: str,
-                        signal: str, detail: str = "") -> None:
+                        signal: str, detail: str = "",
+                        cause_event: str = "") -> str:
         self._journal_put("last_reversal", {
             "lease_id": lease["lease_id"], "action": action,
             "signal": signal, "detail": detail, "ts": time.time(),
             "chips": lease["chips"],
             "direction": f"{lease['donor']}_to_{lease['recipient']}"})
+        return _events.emit(
+            "pool.reversal", cause=cause_event,
+            subject={"lease_id": lease["lease_id"]},
+            action=action, signal=signal, detail=detail)
 
     def last_reversal(self) -> Optional[Dict[str, Any]]:
         return self._read("last_reversal")
@@ -701,6 +719,16 @@ class ChipPoolArbiter:
                              direction=f"{lease['donor']}_to_"
                                        f"{lease['recipient']}")
             if d and d.get("preempted_node"):
+                # Record the observation with the preemption NOTICE as
+                # cause: the same notice also drives the serve drain and
+                # the trainer's JIT save, so all three reactions tie back
+                # to one chain.
+                _events.emit(
+                    "pool.handoff_preempted",
+                    cause=d.get("notice_id") or d.get("event_id", ""),
+                    subject={"lease_id": lease["lease_id"],
+                             "node": d["preempted_node"]},
+                    stage=lease["stage"])
                 logger.warning("pool: node %s preempted mid-handoff "
                                "(lease %s, stage %s)",
                                d["preempted_node"], lease["lease_id"],
@@ -764,11 +792,12 @@ class ChipPoolArbiter:
                 # plane is already regressing.
                 mdefs.POOL_SLO_REVERSALS.inc(tags={
                     "action": "refused", "signal": breach["signal"]})
-                self.ledger.record_reversal(
+                rev_ev = self.ledger.record_reversal(
                     lease, "refused", breach["signal"],
                     detail=f"value={breach['value']}")
                 self.ledger.advance(lease, ABORTED,
-                                    f"slo {breach['signal']}")
+                                    f"slo {breach['signal']}",
+                                    cause_event=rev_ev)
                 mdefs.POOL_HANDOFFS.inc(tags={"direction": direction,
                                               "outcome": "aborted"})
                 return
@@ -837,10 +866,11 @@ class ChipPoolArbiter:
                 # serve plane gets its chips back.
                 mdefs.POOL_SLO_REVERSALS.inc(tags={
                     "action": "reversed", "signal": breach["signal"]})
-                self.ledger.record_reversal(
+                rev_ev = self.ledger.record_reversal(
                     lease, "reversed", breach["signal"],
                     detail=f"value={breach['value']}")
-                self._begin_return(lease, f"slo {breach['signal']}")
+                self._begin_return(lease, f"slo {breach['signal']}",
+                                   cause_event=rev_ev)
             elif lease["deadline_ts"] is not None and \
                     time.time() > lease["deadline_ts"]:
                 self._begin_return(lease, "lease deadline lapsed")
@@ -892,11 +922,12 @@ class ChipPoolArbiter:
                 self._maybe_uncap(lease)
             return
 
-    def _begin_return(self, lease: Dict[str, Any], detail: str) -> None:
+    def _begin_return(self, lease: Dict[str, Any], detail: str,
+                      cause_event: str = "") -> None:
         recipient = self.workloads[lease["recipient"]]
         give_back = recipient.target_chips() - lease["chips"]
         lease = self.ledger.advance(
-            lease, RETURN_FREEING, detail,
+            lease, RETURN_FREEING, detail, cause_event=cause_event,
             return_recipient_target=give_back)
         self._issue(lease, recipient, "return_recipient_target",
                     "pool-return-free")

@@ -82,7 +82,8 @@ def publish_preempt(reason: str = "preempted", node: str = "*",
                     gcs_address: Optional[str] = None,
                     deadline_s: Optional[float] = None,
                     world_target: Optional[int] = None,
-                    kind: Optional[str] = None) -> Dict[str, Any]:
+                    kind: Optional[str] = None,
+                    cause: str = "") -> Dict[str, Any]:
     """Publish a preemption notice cluster-wide (GCS PREEMPT channel);
     without a reachable GCS the notice fires locally instead. ``node``
     scopes delivery (``*`` = every subscriber).
@@ -104,6 +105,17 @@ def publish_preempt(reason: str = "preempted", node: str = "*",
         notice["world_target"] = int(world_target)
     if kind is not None:
         notice["kind"] = str(kind)
+    # The notice id IS its flight-recorder event id: every plane that
+    # reacts (serve drain, trainer JIT-save/recovery, arbiter mid-handoff
+    # abort) records it as their cause, tying the whole fan-out to one
+    # chain. ``cause`` links the notice itself to its trigger (e.g. a
+    # chaos injection).
+    from ray_tpu._private import events as _events
+
+    notice["notice_id"] = _events.emit(
+        "preempt.notice", cause=cause,
+        subject={"node": notice["node"]}, reason=reason,
+        world_target=world_target, kind=kind)
     gcs = _gcs_stub(gcs_address)
     if gcs is not None:
         import pickle

@@ -102,6 +102,8 @@ step skew)</h2><div id="goodput"></div>
 <div id="elastic"></div>
 <h2>Pool / chip leases &amp; handoffs (serve&harr;train arbitration)</h2>
 <div id="pool"></div><table id="poolleases"></table>
+<h2>Cluster / flight recorder (causal control-plane events —
+``ray-tpu why &lt;id&gt;`` walks a chain)</h2><table id="flight"></table>
 <h2>Metrics (last 5 min)</h2><div id="metrics"></div>
 <h2>XLA programs (compiles / retraces / achieved)</h2>
 <table id="xla"></table>
@@ -323,6 +325,23 @@ async function poolPanel(){
           .toLocaleTimeString():""})),
     ["lease","direction","chips","stage","deadline","since"]);
 }
+async function flightPanel(){
+  // Flight recorder: newest control-plane events (lease transitions,
+  // drains, preemption notices, recoveries, chaos injections). The
+  // cause column chains each event to the one that triggered it —
+  // `ray-tpu why request|run|lease|node <id>` walks the whole chain.
+  const evs=await j("/api/v1/events?since=600&limit=200");
+  table(document.getElementById("flight"),
+    evs.slice(-25).reverse().map(e=>({
+      at:new Date(e.ts*1000).toLocaleTimeString(),
+      event:e.event_id,type:e.type,
+      subject:Object.entries(e.subject||{})
+        .map(([k,v])=>`${k}=${v}`).join(","),
+      cause:e.cause||"",
+      detail:Object.entries(e.attrs||{}).slice(0,4)
+        .map(([k,v])=>`${k}=${v}`).join(",")})),
+    ["at","event","type","subject","cause","detail"]);
+}
 async function lifecyclePanel(){
   // Serve failure plane: drains_total{cause} stepping up says WHY
   // replicas leave rotation (scale_down vs preemption), deaths_total
@@ -399,6 +418,7 @@ async function refresh(){
     await goodputPanel();
     await elasticPanel();
     await poolPanel();
+    await flightPanel();
     await xlaPanel();
     document.getElementById("status").textContent=
       "updated "+new Date().toLocaleTimeString();
@@ -633,6 +653,32 @@ class Dashboard:
                     f"bad metrics query: {reply.value.decode()}")
             return pickle.loads(reply.value)
 
+        def flight_events(params):
+            """Flight-recorder query: ``type`` (comma-separated event
+            types), ``subject.<k>=<v>`` filters, ``since``/``until``
+            (seconds ago, or absolute unix ts), ``limit`` — answered
+            server-side by the GCS-journaled event store through the
+            reserved ``__events__`` KV namespace (same transport idiom
+            as the ``__metrics__`` TSDB queries)."""
+            types = [t for t in (params.get("type") or "").split(",")
+                     if t]
+            q = {
+                "types": types or None,
+                "subject": {k[len("subject."):]: v
+                            for k, v in params.items()
+                            if k.startswith("subject.")},
+                "since": float(params.get("since", 600.0)),
+                "until": (float(params["until"])
+                          if "until" in params else None),
+                "limit": int(params.get("limit", 1000)),
+            }
+            reply = gcs.KvGet(pb.KvRequest(ns="__events__",
+                                           key=json.dumps(q)))
+            if not reply.found:
+                raise ValueError(
+                    f"bad flight-event query: {reply.value.decode()}")
+            return pickle.loads(reply.value)
+
         def cluster_status():
             ns = nodes()
             total, avail = {}, {}
@@ -685,6 +731,10 @@ class Dashboard:
                         ctype = "application/json"
                     elif path == "/api/v1/pool":
                         body = json.dumps(pool_state()).encode()
+                        ctype = "application/json"
+                    elif path == "/api/v1/events":
+                        body = json.dumps(flight_events(params),
+                                          default=str).encode()
                         ctype = "application/json"
                     else:
                         route = {

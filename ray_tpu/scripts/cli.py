@@ -182,16 +182,38 @@ def _connect(args):
 
 def cmd_timeline(args):
     """Chrome-trace dump of cluster task events (reference: ``ray
-    timeline`` -> GlobalState.chrome_tracing_dump, _private/state.py:442).
-    Open the output in chrome://tracing or https://ui.perfetto.dev."""
+    timeline`` -> GlobalState.chrome_tracing_dump, _private/state.py:442),
+    merged with the flight recorder's control-plane events (rendered as
+    zero-duration slices whose cause links become flow arrows). Open the
+    output in chrome://tracing or https://ui.perfetto.dev."""
     _connect(args)
+    from ray_tpu._private import events as _events
     from ray_tpu.util import state
+    from ray_tpu.util.tracing import spans_to_chrome_events
 
     events = state.task_timeline()
+    flight = state.list_flight_events(limit=100000)
+    if flight:
+        events = events + spans_to_chrome_events(
+            _events.flight_span_records(flight))
     out = args.output or f"ray-tpu-timeline-{int(time.time())}.json"
     with open(out, "w") as f:
         json.dump(events, f)
-    print(f"wrote {len(events)} trace events to {out}")
+    print(f"wrote {len(events)} trace events to {out} "
+          f"({len(flight)} flight-recorder events)")
+
+
+def _no_records_exit(what: str, want, tracing_gated: bool = True):
+    """The one empty-result message for every record-lookup command
+    (``trace request``, ``trace train``, ``why``): same diagnosis —
+    flushing is periodic and drops are accounted — phrased once. The
+    flight recorder is always on, so ``why`` skips the tracing hint."""
+    gate = ("was the cluster started with RAY_TPU_TRACING=1, and has "
+            if tracing_gated else "has ")
+    raise SystemExit(
+        f"no {what} found for {want!r} — {gate}the buffer flushed "
+        f"(reporters flush every 0.2s)? Drops are counted in "
+        f"ray_tpu_events_dropped_total.")
 
 
 def cmd_trace(args):
@@ -227,11 +249,7 @@ def cmd_trace(args):
                    if e["name"].startswith("train.")
                    and want in (e.get("run"), e.get("trace_id"))]
         if not matched:
-            raise SystemExit(
-                f"no train spans found for run/trace id {want!r} — was "
-                f"the trainer started with RAY_TPU_TRACING=1, and has "
-                f"the span buffer flushed (reporters flush every 0.2s)? "
-                f"Drops are counted in ray_tpu_events_dropped_total.")
+            _no_records_exit("train spans", want)
         by_trace = {}
         for e in matched:
             by_trace.setdefault(e["trace_id"], []).append(e["ts"])
@@ -246,11 +264,7 @@ def cmd_trace(args):
         trace_ids = {e["trace_id"] for e in spans
                      if want in (e.get("request_id"), e.get("trace_id"))}
         if not trace_ids:
-            raise SystemExit(
-                f"no spans found for request/trace id {want!r} — was the "
-                f"cluster started with RAY_TPU_TRACING=1, and has the "
-                f"span buffer flushed (reporters flush every 0.2s)? "
-                f"Drops are counted in ray_tpu_events_dropped_total.")
+            _no_records_exit("spans", want)
         if len(trace_ids) > 1:
             raise SystemExit(
                 f"id {want!r} matches {len(trace_ids)} traces — pass the "
@@ -278,6 +292,85 @@ def cmd_trace(args):
               f"{extra}")
     print(f"wrote chrome trace to {out} "
           f"(open in chrome://tracing or https://ui.perfetto.dev)")
+
+
+# flight-recorder `why` kinds → the subject key each one pins.
+_WHY_SUBJECT_KEY = {"request": "request_id", "run": "run",
+                    "lease": "lease_id", "node": "node"}
+
+
+def cmd_why(args):
+    """Causal narrative for ONE subject from the cluster flight
+    recorder: ``ray-tpu why request|run|lease|node <id>``.
+
+    Finds every control-plane event whose subject carries the id, walks
+    cause links both ways (the events that triggered it and the events
+    it triggered) plus a subject-join round (events sharing a lease /
+    replica / node / run with the chain), then merges tracing spans
+    that belong to the same request or run into one time-ordered story
+    — e.g. chaos preempt injection → preemption notice → replica drain
+    → journaled resume → lease reversal, each line carrying its event
+    id and the id of its cause."""
+    _connect(args)
+    from ray_tpu._private import events as _events
+    from ray_tpu.util import state
+
+    key = _WHY_SUBJECT_KEY[args.kind]
+    want = str(args.id)
+    records = state.list_flight_events(limit=100000)
+    seeds = [r["event_id"] for r in records
+             if str((r.get("subject") or {}).get(key, "")) == want]
+    if not seeds:
+        _no_records_exit(f"flight events keyed {key}", want,
+                         tracing_gated=False)
+    chain = _events.causal_chain(records, seeds)
+    by_id = {r["event_id"]: r for r in chain}
+    # Tracing spans sharing an id with the chain tell the data-plane
+    # half of the story (what the request/run was doing when the
+    # control plane acted); spans are garnish — missing tracing or a
+    # failed span query must never sink the narrative.
+    spans = []
+    try:
+        subj_vals = {v for r in chain
+                     for v in (r.get("subject") or {}).values()}
+        spans = [e for e in state.list_tasks(limit=100000,
+                                             include_spans=True)
+                 if e.get("state") == "SPAN"
+                 and (e.get("request_id") in subj_vals
+                      or e.get("run") in subj_vals
+                      or e.get("trace_id") in subj_vals)]
+    except Exception:  # noqa: BLE001
+        spans = []
+    rows = ([("event", r["ts"], r) for r in chain]
+            + [("span", s["ts"], s) for s in spans])
+    rows.sort(key=lambda t: t[1])
+    t0 = rows[0][1]
+    print(f"why {args.kind} {want}: {len(chain)} events"
+          + (f", {len(spans)} spans" if spans else ""))
+    for what, ts, r in rows:
+        off_ms = (ts - t0) * 1e3
+        if what == "span":
+            print(f"  +{off_ms:9.2f}ms  {'(span)':16}  "
+                  f"{r['name']:22} dur={r.get('dur', 0.0) * 1e3:.2f}ms "
+                  f"worker={r.get('worker_id', '')}")
+            continue
+        subject = ",".join(f"{k}={v}" for k, v in
+                           sorted((r.get("subject") or {}).items()))
+        attrs = ",".join(
+            f"{k}={v}" for k, v in sorted((r.get("attrs") or {}).items())
+            if v not in (None, ""))
+        cause = r.get("cause") or ""
+        arrow = ""
+        if cause:
+            arrow = ("  <= " + cause
+                     + ("" if cause in by_id else " (outside chain)"))
+        print(f"  +{off_ms:9.2f}ms  {r['event_id']}  {r['type']:22} "
+              f"[{subject}]" + (f" {attrs}" if attrs else "") + arrow)
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump({"events": chain, "spans": spans}, f,
+                      indent=2, default=str)
+        print(f"wrote chain to {args.output}")
 
 
 def cmd_list(args):
@@ -912,6 +1005,22 @@ def main(argv=None):
                    help="chrome-trace JSON path (default: "
                         "ray-tpu-trace-<id>.json)")
     p.set_defaults(fn=cmd_trace)
+
+    p = sub.add_parser("why",
+                       help="causal narrative from the flight recorder: "
+                            "'why request|run|lease|node <id>' walks "
+                            "control-plane cause links across planes "
+                            "(chaos injection -> preemption notice -> "
+                            "drain -> resume -> lease reversal) and "
+                            "joins any tracing spans for the subject")
+    p.add_argument("kind", choices=["request", "run", "lease", "node"],
+                   help="subject kind the id names")
+    p.add_argument("id",
+                   help="request id / run name / lease id / node id")
+    p.add_argument("--address")
+    p.add_argument("--output", "-o",
+                   help="also write the chain (events + spans) as JSON")
+    p.set_defaults(fn=cmd_why)
 
     p = sub.add_parser("list", help="list cluster state")
     p.add_argument("kind", choices=["nodes", "actors", "tasks", "objects",

@@ -38,6 +38,7 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import events as _events
 
 logger = logging.getLogger(__name__)
 
@@ -518,7 +519,13 @@ class ServeController:
             "deployment": name,
             "direction": "up" if desired > current else "down",
             "signal": signal})
-        self._reconcile_once(name)
+        # Flight-recorder root for the scale-down drains the reconcile
+        # below starts (they cite this decision as their cause_event).
+        scale_ev = _events.emit(
+            "serve.autoscale", subject={"deployment": name},
+            direction="up" if desired > current else "down",
+            signal=signal, current=current, desired=desired)
+        self._reconcile_once(name, cause_event=scale_ev)
 
     def _routes_changed(self, name: str) -> None:
         """Publish a new routing table version AND drop the controller's
@@ -534,26 +541,38 @@ class ServeController:
 
     DRAIN_GRACE_S = 2.0  # RPC slack past the replica's own deadline
 
-    def _begin_drain(self, name: str, replica, cause: str) -> None:
+    def _begin_drain(self, name: str, replica, cause: str,
+                     cause_event: str = "") -> None:
         """Start one replica's graceful drain. The caller (under the
         reconcile lock) has already removed it from the routing table;
         this fires ``Replica.drain`` and parks the entry for
         :meth:`_advance_drains` to finish. A replica that cannot even be
-        asked to drain is killed on the spot."""
+        asked to drain is killed on the spot. ``cause_event`` links the
+        flight-recorder record to what forced the drain (a preemption
+        notice id, an autoscale decision)."""
         from ray_tpu._private import metrics_defs as mdefs
 
         deadline_s = float(os.environ.get("RAY_TPU_SERVE_DRAIN_S", "30"))
+        replica_tag = f"{id(replica):x}"
         entry = {"replica": replica, "t0": time.monotonic(),
                  "deadline": time.monotonic() + deadline_s,
                  "cause": cause, "ref": None}
         try:
             entry["ref"] = replica.drain.remote(deadline_s)
         except Exception:  # noqa: BLE001 — undrainable: tear down now
+            _events.emit("serve.drain_begin", cause=cause_event,
+                         subject={"deployment": name,
+                                  "replica": replica_tag},
+                         drain_cause=cause, outcome="undrainable")
             try:
                 ray_tpu.kill(replica)
             except Exception:  # noqa: BLE001
                 pass
             return
+        entry["event_id"] = _events.emit(
+            "serve.drain_begin", cause=cause_event,
+            subject={"deployment": name, "replica": replica_tag},
+            drain_cause=cause, deadline_s=deadline_s)
         self._draining.setdefault(name, []).append(entry)
         mdefs.SERVE_REPLICA_DRAINS.inc(tags={"deployment": name,
                                              "cause": cause})
@@ -601,6 +620,12 @@ class ServeController:
             mdefs.SERVE_DRAIN_SECONDS.observe(
                 now - e["t0"], tags={"deployment": name,
                                      "outcome": outcome})
+            _events.emit("serve.drain_end",
+                         cause=e.get("event_id", ""),
+                         subject={"deployment": name,
+                                  "replica": f"{id(e['replica']):x}"},
+                         outcome=outcome, drain_cause=e["cause"],
+                         waited_s=now - e["t0"])
             if outcome == "died":
                 mdefs.SERVE_REPLICA_DEATHS.inc(
                     tags={"deployment": name, "cause": "drain"})
@@ -664,7 +689,13 @@ class ServeController:
                 stay = [r for r in current if r not in hits]
                 for r in hits:
                     self._replica_birth.pop(id(r), None)
-                    self._begin_drain(name, r, cause="preemption")
+                    # The notice id is the drain's cause: the trainer's
+                    # JIT save and the arbiter's mid-handoff handling
+                    # record the same id, tying all three reactions to
+                    # one preemption chain.
+                    self._begin_drain(
+                        name, r, cause="preemption",
+                        cause_event=str(notice.get("notice_id", "")))
                 self.replicas[name] = stay
                 self._routes_changed(name)
 
@@ -864,7 +895,7 @@ class ServeController:
         return {name: {"num_replicas": spec["num_replicas"]}
                 for name, spec in self.deployments.items()}
 
-    def _reconcile_once(self, name: str):
+    def _reconcile_once(self, name: str, cause_event: str = ""):
         # Slow placement-group creation happens OUTSIDE the lock (a 30s
         # wait under it would freeze every deployment's maintenance);
         # the lock then only covers fast state transitions.
@@ -873,7 +904,7 @@ class ServeController:
         # thread would otherwise race group creation / replica lists
         # (last-write-wins leaks the loser's group and replicas).
         with self._reconcile_lock:
-            self._reconcile_locked(name)
+            self._reconcile_locked(name, cause_event=cause_event)
 
     def _compact_needs_grow(self, spec) -> bool:
         pg = spec.get("_pg")
@@ -933,7 +964,7 @@ class ServeController:
             spec["_pg"] = new_pg
             spec["_pg_bundle"] = per_replica
 
-    def _reconcile_locked(self, name: str):
+    def _reconcile_locked(self, name: str, cause_event: str = ""):
         spec = self.deployments.get(name)
         if spec is None:
             return
@@ -1012,7 +1043,8 @@ class ServeController:
             # RAY_TPU_SERVE_DRAIN_S, and _advance_drains tears it down.
             victim = current.pop()
             self._replica_birth.pop(id(victim), None)
-            self._begin_drain(name, victim, cause="scale_down")
+            self._begin_drain(name, victim, cause="scale_down",
+                              cause_event=cause_event)
         changed = [id(r) for r in current] != \
             [id(r) for r in self.replicas.get(name, [])]
         self.replicas[name] = current
@@ -1115,6 +1147,36 @@ class DeploymentResponse:
         self._handle = handle
         self._call = call
         self._replica = replica
+        # Minted lazily at the FIRST retry: the clean unary path does no
+        # per-request id work (with tracing off it must stay free), but a
+        # re-routed/resubmitted request needs a stable subject key so its
+        # flight-recorder resume events chain under one request id.
+        self._request_id = ""
+
+    def _note_flight_resume(self, mode: str, replica=None) -> None:
+        name = self._handle._name
+        if not self._request_id:
+            self._request_id = uuid.uuid4().hex[:16]
+        # Best-effort cause inference (in-process rings only). Prefer
+        # THE rejecting replica's own drain record: a sibling drain (a
+        # scale-down racing a preemption) can be newer but causally
+        # unrelated — deployment-newest would misattribute the resume.
+        # Fallbacks: the newest drain for the deployment, then the
+        # newest injection/drain anywhere (the trigger observed an
+        # effect — a reject, a dead replica — without its event id).
+        cause = ""
+        if replica is not None:
+            cause = _events.latest_event_id(
+                ["serve.drain_begin"],
+                subject={"deployment": name,
+                         "replica": f"{id(replica):x}"})
+        cause = cause or _events.latest_event_id(
+            ["serve.drain_begin"], subject={"deployment": name}) or \
+            _events.latest_event_id(["serve.drain_begin", "chaos.inject"])
+        _events.emit("serve.resume", cause=cause,
+                     subject={"deployment": name,
+                              "request_id": self._request_id},
+                     mode=mode)
 
     def result(self, timeout_s: Optional[float] = 60.0):
         from ray_tpu.serve import recovery
@@ -1142,6 +1204,7 @@ class DeploymentResponse:
                 drain_rejects += 1
                 recovery.note_unary_retry(self._handle._name,
                                           "drain_reject")
+                self._note_flight_resume("drain_reject", replica)
                 self._handle._evict(replica)
                 args, kwargs = self._call
                 retry = self._handle.remote(*args, **kwargs)
@@ -1164,6 +1227,7 @@ class DeploymentResponse:
                         self._handle._name, resumes) from e
                 resumes += 1
                 recovery.note_unary_retry(self._handle._name, "resubmit")
+                self._note_flight_resume("resubmit", replica)
                 self._handle._evict(replica)
                 args, kwargs = self._call
                 retry = self._handle.remote(*args, **kwargs)

@@ -42,11 +42,34 @@ from __future__ import annotations
 import logging
 import os
 import time
+import uuid
 from typing import Any, Dict, List, Optional
 
 from ray_tpu import exceptions
+from ray_tpu._private import events as _events
 
 logger = logging.getLogger(__name__)
+
+
+def _flight_resume(j: "RequestJournal", mode: str) -> str:
+    """Flight-recorder record of one stream re-route/resume. The
+    request id comes from the trace context when present; otherwise one
+    is minted at the first recovery and stuck to the journal, so every
+    recovery of the request chains under the same subject. The cause is
+    inferred best-effort from the in-process ring (newest drain-begin
+    for this deployment, else newest drain/injection anywhere)."""
+    rid = (j.request_ctx or {}).get("request_id", "")
+    if not rid:
+        rid = getattr(j, "flight_request_id", "")
+        if not rid:
+            rid = j.flight_request_id = uuid.uuid4().hex[:16]
+    cause = _events.latest_event_id(
+        ["serve.drain_begin"], subject={"deployment": j.deployment}) or \
+        _events.latest_event_id(["serve.drain_begin", "chaos.inject"])
+    return _events.emit(
+        "serve.resume", cause=cause,
+        subject={"deployment": j.deployment, "request_id": rid},
+        mode=mode, emitted=len(j.emitted), attempt=j.resumes)
 
 #: Stream/header marker a client sees when a SAMPLED request was resumed
 #: mid-decode (its continuation re-seeded — not the draw the dead
@@ -263,6 +286,7 @@ class RecoverableStream:
         self._evict()
         mdefs.SERVE_REPLICA_RESUMES.inc(tags={
             "deployment": j.deployment, "cause": "drain_reject"})
+        _flight_resume(j, "drain_reject")
         # A drain reject happens at dispatch, before anything streamed,
         # so the original submission redispatches verbatim.
         self._dispatch(j.resume_payload() if j.emitted else j.payload)
@@ -296,6 +320,7 @@ class RecoverableStream:
             j.resumed_midstream = True
         mdefs.SERVE_REPLICA_RESUMES.inc(tags={
             "deployment": j.deployment, "cause": cause})
+        _flight_resume(j, cause)
         rctx = j.request_ctx or {}
         if rctx and tracing.enabled():
             # A zero-duration marker span in the request's trace: the

@@ -101,10 +101,13 @@ def request_resize(num_workers: int, reason: str = "operator-resize",
     latch it through their :class:`ResizeGuard`, tear the group down at a
     step boundary, and restart from the newest committed manifest at the
     new world size."""
+    from ray_tpu._private import events as _events
     from ray_tpu.checkpoint.preempt import publish_preempt
 
+    resize_ev = _events.emit("train.resize",
+                             world_target=int(num_workers), reason=reason)
     return publish_preempt(reason=reason, gcs_address=gcs_address,
-                           world_target=int(num_workers))
+                           world_target=int(num_workers), cause=resize_ev)
 
 
 class RecoveryTrace:
@@ -123,12 +126,17 @@ class RecoveryTrace:
     the metric can never drift apart."""
 
     def __init__(self, trace_id: str, parent_span_id: str, run: str,
-                 cause: str, attempt: int):
+                 cause: str, attempt: int, cause_event: str = ""):
         self.trace_id = trace_id
         self.parent_span_id = parent_span_id
         self.run = run
         self.cause = cause
         self.attempt = attempt
+        # Flight-recorder id of the event that killed the attempt (a
+        # preemption notice id off PreemptedError.notice, or a chaos
+        # injection's SimulatedProcessDeath.event_id), linking this
+        # recovery into the cluster-wide causal chain.
+        self.cause_event = cause_event
         self.t0_wall = time.time()
         self.phases: List[Tuple[str, float]] = []  # ordered (name, dur)
 
@@ -149,8 +157,19 @@ class RecoveryTrace:
         ('' with tracing off). ``outcome="failed"`` marks a recovery
         whose restarted attempt died before its first report (the next
         recovery's trace then covers the follow-up)."""
+        from ray_tpu._private import events as _events
         from ray_tpu.util import tracing
 
+        # The flight event goes out unconditionally (BEFORE the tracing
+        # gate): recovery cause + outcome must reach the recorder even
+        # with span tracing off.
+        cause = self.cause_event
+        if not cause and self.cause == PREEMPTION:
+            cause = _events.latest_event_id(["preempt.notice"])
+        _events.emit("train.recovery", cause=cause,
+                     subject={"run": self.run},
+                     recovery_cause=self.cause, attempt=self.attempt,
+                     outcome=outcome, recovery_s=float(recovery_s))
         if not tracing.enabled():
             return ""
         rid = tracing.gen_id()

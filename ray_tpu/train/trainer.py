@@ -401,9 +401,19 @@ class JaxTrainer:
                             outcome="failed")
                         self._pending_recovery = None
                     self._failure_ts = t_detect
+                    # Tie the recovery to the flight event that killed
+                    # the attempt: a PreemptedError carries the notice
+                    # (whose notice_id IS its event id), a chaos kill
+                    # carries the injection's event id.
+                    cause_event = ""
+                    notice = getattr(e, "notice", None)
+                    if isinstance(notice, dict):
+                        cause_event = str(notice.get("notice_id", ""))
+                    if not cause_event:
+                        cause_event = str(getattr(e, "event_id", ""))
                     rec = elastic.RecoveryTrace(
                         self._trace_id, self._run_span, self._run_name,
-                        cause, attempt_idx + 1)
+                        cause, attempt_idx + 1, cause_event=cause_event)
                     rec.t0_wall = detect_wall
                     rec.phase("teardown", teardown_s)
                     self.recovery_log.append({

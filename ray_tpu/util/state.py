@@ -152,6 +152,40 @@ def list_cluster_events(limit: int = 1000) -> List[Dict[str, Any]]:
     return events[-limit:]
 
 
+def list_flight_events(types: Optional[List[str]] = None,
+                       subject: Optional[Dict[str, str]] = None,
+                       since: Optional[float] = None,
+                       until: Optional[float] = None,
+                       limit: int = 1000) -> List[Dict[str, Any]]:
+    """Causally-linked control-plane events from the cluster flight
+    recorder (``ray-tpu why`` / the dashboard timeline feed on it).
+
+    Cluster mode queries the GCS-journaled store through the reserved
+    ``__events__`` KV namespace (a JSON dict key filters server-side;
+    ``since``/``until`` under 1e9 are relative seconds before now);
+    local mode reads this process's ring — the same records, since
+    every plane of a local cluster emits into one process."""
+    core = _core()
+    gcs = getattr(core, "gcs", None)
+    if gcs is None:
+        from ray_tpu._private import events as _events
+
+        return _events.local_events(types=types, subject=subject,
+                                    since=since, until=until, limit=limit)
+    import json
+    import pickle
+
+    from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+    query = json.dumps({"types": types, "subject": subject,
+                        "since": since, "until": until, "limit": limit})
+    reply = gcs.KvGet(pb.KvRequest(ns="__events__", key=query))
+    if not reply.found:
+        raise RuntimeError(
+            f"flight-event query failed: {reply.value.decode()}")
+    return pickle.loads(reply.value)
+
+
 def memory_summary() -> Dict[str, Any]:
     """Cluster object-memory report (reference: ``ray memory`` — per-object
     size, store locations, and reference holders from the GCS tables)."""

@@ -1,0 +1,382 @@
+"""Cluster flight recorder: causally-linked control-plane events.
+
+Covers the event plane end to end at every altitude below the big
+chaos e2es (which assert full injection→notice→drain→resume→reversal
+chains in ``test_pool_arbiter.py`` / ``test_serve_drain.py``):
+
+- emit / ring query semantics (type, subject, relative time windows)
+- causal_chain closure: cause links both directions + subject joins
+- bounded-loss accounting (local ring overflow, GCS store cap) — aging
+  past retention is silent, eviction under the cap is counted LOSS
+- the GCS ``__events__`` store: pubsub ingest, server-side JSON-keyed
+  query, WAL journaling across a head restart
+- ``ray-tpu why request|lease`` narrative roundtrip and the shared
+  empty-result message
+- the dashboard ``/api/v1/events`` feed + flight panel wiring
+- chaos injections as chain roots (directive / SimulatedProcessDeath
+  event ids, preempt-notice cause links)
+"""
+
+import json
+import pickle
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import chaos
+from ray_tpu._private import events as flight
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+
+@pytest.fixture(autouse=True)
+def _clean_ring():
+    flight.clear_flight()
+    yield
+    flight.clear_flight()
+    flight.set_local_sink(None)
+
+
+@pytest.fixture
+def gcs_server():
+    from ray_tpu._private.gcs.server import GcsServer
+
+    server = GcsServer(port=0)
+    yield server
+    server.shutdown()
+
+
+def _query(server, **q):
+    reply = server.KvGet(
+        pb.KvRequest(ns="__events__", key=json.dumps(q)), None)
+    assert reply.found, reply.value
+    return pickle.loads(reply.value)
+
+
+def _seed_chain():
+    """One canonical preemption story: injection → notice → {mid-handoff
+    abort, drain} → resume, plus a reversal that shares only the lease
+    subject — and one unrelated event that must stay out of the chain."""
+    a = flight.emit("chaos.inject", subject={"node": "n1"},
+                    action="preempt_node")
+    b = flight.emit("preempt.notice", cause=a, subject={"node": "n1"})
+    m = flight.emit("pool.handoff_preempted", cause=b,
+                    subject={"lease_id": "L1", "node": "n1"})
+    d = flight.emit("serve.drain_begin", cause=b,
+                    subject={"deployment": "dep", "replica": "r0"})
+    r = flight.emit("serve.resume", cause=d,
+                    subject={"deployment": "dep", "request_id": "req-1"})
+    e = flight.emit("pool.reversal", subject={"lease_id": "L1"},
+                    winner="serve")
+    noise = flight.emit("serve.autoscale", subject={"deployment": "other"})
+    return a, b, m, d, r, e, noise
+
+
+# ------------------------------------------------------------- ring units
+
+
+def test_emit_shape_and_ring_filters():
+    a = flight.emit("chaos.inject", subject={"node": "n1", "blank": ""},
+                    action="kill_worker")
+    b = flight.emit("preempt.notice", cause=a, subject={"node": "n1"})
+    c = flight.emit("serve.drain_begin", cause=b,
+                    subject={"deployment": "d"})
+    recs = flight.local_events()
+    assert [r["event_id"] for r in recs] == [a, b, c]
+    assert all(len(r["event_id"]) == 16 for r in recs)
+    first = recs[0]
+    # Empty subject values are dropped; attrs ride separately; process
+    # identity is stamped on every record.
+    assert first["subject"] == {"node": "n1"}
+    assert first["attrs"] == {"action": "kill_worker"}
+    assert first["cause"] == "" and recs[1]["cause"] == a
+    assert "worker_id" in first and "node_id" in first
+
+    assert [r["event_id"] for r in
+            flight.local_events(types=["preempt.notice"])] == [b]
+    assert [r["event_id"] for r in
+            flight.local_events(subject={"node": "n1"})] == [a, b]
+    assert len(flight.local_events(limit=2)) == 2
+    # since/until under 1e9 are relative seconds before now — the GCS
+    # query convention, answered identically here.
+    assert len(flight.local_events(since=60)) == 3
+    assert flight.local_events(until=60) == []
+
+    assert flight.latest_event_id(["preempt.notice"]) == b
+    assert flight.latest_event_id(
+        ["serve.drain_begin"], subject={"deployment": "d"}) == c
+    assert flight.latest_event_id(["no.such.type"]) == ""
+
+
+def test_emit_never_raises_and_always_returns_an_id(monkeypatch):
+    # Sabotage the downstream transport: emit must stay silent and still
+    # hand back an id the caller can thread as a cause.
+    def boom(batch):
+        raise RuntimeError("sink down")
+
+    flight.set_local_sink(boom)
+    eid = flight.emit("pool.lease", subject={"lease_id": "L"})
+    assert len(eid) == 16
+    # The ring got the record even though the sink blew up after it.
+    assert flight.local_events(types=["pool.lease"])[0]["event_id"] == eid
+
+
+def test_causal_chain_closure_and_subject_join():
+    a, b, m, d, r, e, noise = _seed_chain()
+    recs = flight.local_events()
+
+    # Seeding from the leaf resume walks ancestors (d, b, a), then
+    # descendants of those (m), then the subject-join round picks up the
+    # reversal via the lease_id it shares with the mid-handoff abort.
+    chain = flight.causal_chain(recs, [r])
+    ids = [x["event_id"] for x in chain]
+    assert set(ids) == {a, b, m, d, r, e}
+    assert noise not in ids
+    assert ids == sorted(ids, key=lambda i: next(
+        x["ts"] for x in chain if x["event_id"] == i))
+
+    # Seeding from the root reaches the identical set: closure is
+    # direction-agnostic.
+    assert {x["event_id"] for x in flight.causal_chain(recs, [a])} \
+        == {a, b, m, d, r, e}
+
+    # Without the subject round the reversal (cause-linkless) is
+    # unreachable — the join is what stitches it in.
+    assert e not in {x["event_id"] for x in
+                     flight.causal_chain(recs, [r], subject_rounds=0)}
+
+    # Unknown seeds select nothing.
+    assert flight.causal_chain(recs, ["feedfacefeedface"]) == []
+
+
+def test_ring_overflow_is_counted_loss(monkeypatch):
+    monkeypatch.setattr(flight, "FLIGHT_RING_MAX", 10)
+    before = flight.dropped_counts().get("flight", 0.0)
+    ids = [flight.emit("t.tick", seq=i) for i in range(25)]
+    recs = flight.local_events(limit=100)
+    assert [r["event_id"] for r in recs] == ids[-10:]
+    assert flight.dropped_counts().get("flight", 0.0) - before == 15
+
+
+def test_flight_events_render_in_chrome_timeline():
+    from ray_tpu.util.tracing import spans_to_chrome_events
+
+    a = flight.emit("chaos.inject", subject={"node": "n1"})
+    flight.emit("preempt.notice", cause=a, subject={"node": "n1"})
+    evs = spans_to_chrome_events(
+        flight.flight_span_records(flight.local_events()))
+    names = {e["name"] for e in evs}
+    assert {"chaos.inject", "preempt.notice"} <= names
+    # The cause link renders as a chrome flow arrow (s/f pair).
+    assert {"s", "f"} <= {e["ph"] for e in evs}
+
+
+# --------------------------------------------------- GCS __events__ store
+
+
+def test_gcs_store_ingest_query_and_bounded_loss(gcs_server):
+    # The server process IS the sink: constructing it routes this
+    # process's emissions straight into the store.
+    a = flight.emit("pool.lease", subject={"lease_id": "L1"})
+    b = flight.emit("pool.reversal", subject={"lease_id": "L1"})
+    flight.emit("serve.autoscale", subject={"deployment": "d"})
+    # Remote processes reach the same store via FLIGHT_EVENT pubsub.
+    remote = {"event_id": "feedbeeffeedbeef", "type": "train.recovery",
+              "ts": time.time(), "cause": "", "subject": {"run": "r1"}}
+    gcs_server.Publish(pb.PublishRequest(
+        channel=flight.FLIGHT_CHANNEL, data=pickle.dumps([remote])), None)
+
+    assert {r["event_id"] for r in _query(gcs_server, limit=100)} \
+        >= {a, b, "feedbeeffeedbeef"}
+    assert [r["event_id"] for r in
+            _query(gcs_server, types=["pool.reversal"])] == [b]
+    assert [r["event_id"] for r in
+            _query(gcs_server, subject={"lease_id": "L1"})] == [a, b]
+    assert _query(gcs_server, subject={"lease_id": "zzz"}) == []
+    assert _query(gcs_server, since=600, limit=100)  # relative window
+
+    # Malformed query: found=False with the parse error, not a crash.
+    reply = gcs_server.KvGet(
+        pb.KvRequest(ns="__events__", key="not json"), None)
+    assert not reply.found
+    # Legacy export-event read (empty key) still answers.
+    legacy = gcs_server.KvGet(pb.KvRequest(ns="__events__", key=""), None)
+    assert legacy.found and isinstance(pickle.loads(legacy.value), list)
+
+    # Retention ages silently; cap evictions are LOSS and counted.
+    gcs_server._flight_max = 5
+    gcs_server._flight_retention_s = 10.0
+    now = time.time()
+    stale = [{"event_id": f"0ld{i:013d}", "type": "t.t", "ts": now - 100,
+              "cause": "", "subject": {}} for i in range(3)]
+    fresh = [{"event_id": f"fr3sh{i:011d}", "type": "t.t", "ts": now,
+              "cause": "", "subject": {}} for i in range(8)]
+    before = flight.dropped_counts().get("gcs_flight", 0.0)
+    with gcs_server._lock:
+        gcs_server._flight_events = []
+    gcs_server._ingest_flight(stale + fresh, journal=False)
+    kept = _query(gcs_server, limit=100)
+    assert [r["event_id"] for r in kept] \
+        == [f"fr3sh{i:011d}" for i in range(3, 8)]
+    # 3 stale aged out (no loss), 3 fresh evicted over the cap (loss).
+    assert flight.dropped_counts().get("gcs_flight", 0.0) - before == 3
+
+
+def test_flight_events_survive_head_restart(tmp_path):
+    from ray_tpu._private.gcs.server import GcsServer
+
+    path = str(tmp_path / "gcs_state.bin")
+    server = GcsServer(port=0, persist_path=path)
+    ids = [flight.emit("pool.lease", subject={"lease_id": "L"}, n=i)
+           for i in range(5)]
+    assert server.wal_sync()
+    server.shutdown()
+
+    # The ring dies with the process; the journaled store does not.
+    flight.clear_flight()
+    server2 = GcsServer(port=0, persist_path=path)
+    try:
+        restored = _query(server2, subject={"lease_id": "L"}, limit=100)
+        assert [r["event_id"] for r in restored] == ids
+        assert restored[0]["attrs"] == {"n": 0}
+    finally:
+        server2.shutdown()
+
+
+# ------------------------------------------------------------ ray-tpu why
+
+
+@pytest.fixture
+def local_ray():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_why_cli_request_and_lease_roundtrip(local_ray, capsys,
+                                             monkeypatch, tmp_path):
+    from ray_tpu.scripts import cli
+
+    a, b, m, d, r, e, noise = _seed_chain()
+    monkeypatch.setattr(cli, "_connect", lambda args: ray_tpu)
+
+    cli.main(["why", "request", "req-1"])
+    out = capsys.readouterr().out
+    assert "why request req-1: 6 events" in out
+    for eid in (a, b, m, d, r, e):
+        assert eid in out
+    assert noise not in out
+    # Each non-root line cites its cause id.
+    assert f"<= {b}" in out and f"<= {d}" in out
+
+    outfile = str(tmp_path / "chain.json")
+    cli.main(["why", "lease", "L1", "--output", outfile])
+    out = capsys.readouterr().out
+    assert "why lease L1" in out
+    for eid in (a, b, m, e):
+        assert eid in out
+    with open(outfile) as f:
+        dumped = json.load(f)
+    assert {x["event_id"] for x in dumped["events"]} \
+        == {a, b, m, d, r, e}
+
+    # The shared empty-result message: no tracing hint (the recorder is
+    # always on), drops pointer present.
+    with pytest.raises(SystemExit) as ei:
+        cli.main(["why", "request", "no-such-request"])
+    msg = str(ei.value)
+    assert "no flight events keyed request_id" in msg
+    assert "ray_tpu_events_dropped_total" in msg
+    assert "RAY_TPU_TRACING" not in msg
+
+
+# -------------------------------------------------------------- dashboard
+
+
+def test_dashboard_events_endpoint_and_panel(gcs_server):
+    from ray_tpu.dashboard import Dashboard
+
+    a = flight.emit("chaos.inject", subject={"node": "n1"})
+    b = flight.emit("preempt.notice", cause=a, subject={"node": "n1"})
+    flight.emit("serve.autoscale", subject={"deployment": "dep"})
+
+    dash = Dashboard(f"127.0.0.1:{gcs_server.port}", port=0)
+    try:
+        def get(q):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{dash.port}/api/v1/events?{q}",
+                    timeout=10) as resp:
+                return json.loads(resp.read())
+
+        assert {e["event_id"] for e in get("since=600&limit=100")} \
+            >= {a, b}
+        assert [e["event_id"]
+                for e in get("type=preempt.notice")] == [b]
+        assert [e["event_id"] for e in get("subject.node=n1")] == [a, b]
+        assert [e["event_id"]
+                for e in get("type=chaos.inject,preempt.notice"
+                             "&subject.node=n1&limit=1")] == [b]
+        assert get("subject.node=zzz") == []
+
+        with urllib.request.urlopen(f"http://127.0.0.1:{dash.port}/",
+                                    timeout=10) as resp:
+            page = resp.read().decode()
+        assert 'id="flight"' in page and "/api/v1/events" in page
+    finally:
+        dash.stop()
+
+
+# --------------------------------------------------- chaos as chain roots
+
+
+@pytest.mark.chaos
+def test_chaos_preempt_injection_roots_the_chain():
+    from ray_tpu.checkpoint import preempt
+
+    notices = []
+    cb = preempt.register_preempt_callback(notices.append)
+    chaos.configure("preempt_node:stage=FREEING,target=nodeX", seed=3)
+    try:
+        d = chaos.inject("pool_handoff", stage="FREEING", lease="L9")
+        assert d and d["preempted_node"] == "nodeX"
+        inject_id = d["event_id"]
+        notice_id = d["notice_id"]
+        assert inject_id and notice_id
+        assert chaos.injection_log()[0]["event_id"] == inject_id
+
+        # The injection is a root event carrying the lease subject...
+        inj = flight.local_events(types=["chaos.inject"])[-1]
+        assert inj["event_id"] == inject_id
+        assert inj["subject"]["lease_id"] == "L9"
+        assert inj["cause"] == ""
+        # ...the REAL preemption notice both reached the listener with
+        # its id and hit the recorder as the injection's child...
+        assert notices and notices[0]["notice_id"] == notice_id
+        nev = next(r for r in flight.local_events(types=["preempt.notice"])
+                   if r["event_id"] == notice_id)
+        assert nev["cause"] == inject_id
+        assert nev["subject"]["node"] == "nodeX"
+        # ...and causal_chain connects the two from the root.
+        chain_ids = {r["event_id"] for r in flight.causal_chain(
+            flight.local_events(limit=100000), [inject_id])}
+        assert {inject_id, notice_id} <= chain_ids
+    finally:
+        preempt.unregister_preempt_callback(cb)
+        chaos.reset()
+
+
+@pytest.mark.chaos
+def test_kill_injection_id_rides_the_death():
+    chaos.configure("kill_worker:rank=1,step=3", seed=7)
+    try:
+        assert chaos.inject("train_step", rank=1, step=2) is None
+        with pytest.raises(chaos.SimulatedProcessDeath) as ei:
+            chaos.inject("train_step", rank=1, step=3)
+        assert ei.value.event_id
+        assert chaos.injection_log()[0]["event_id"] == ei.value.event_id
+        inj = flight.local_events(types=["chaos.inject"])[-1]
+        assert inj["event_id"] == ei.value.event_id
+        assert inj["attrs"]["action"] == "kill_worker"
+    finally:
+        chaos.reset()

@@ -532,3 +532,80 @@ def test_arbiter_ledger_transitions_are_journaled():
     # Every advance() call journals through the validated helper (no
     # parallel transition path).
     assert "self._journal_put(f\"lease/" in src
+
+
+def _funcs_emit_flight(path, funcs, window: int = 60):
+    """Assert each named function body contains a flight-recorder
+    ``_events.emit(`` within ``window`` lines of its def — the causal
+    chain is only connected if these sites keep emitting."""
+    lines = path.read_text().splitlines()
+    for fn in funcs:
+        hits = [i for i, ln in enumerate(lines)
+                if ln.strip().startswith(("def ", "async def "))
+                and ln.strip().split("def ", 1)[1].startswith(fn + "(")]
+        assert hits, f"{path.name}: function {fn!r} vanished"
+        assert any("_events.emit(" in "\n".join(lines[i:i + window])
+                   for i in hits), (
+            f"{path.name}: {fn!r} no longer records a flight event — "
+            f"the `ray-tpu why` causal chain breaks without it")
+
+
+def test_flight_recorder_series_and_emit_sites_are_pinned():
+    """The flight recorder only answers ``ray-tpu why`` if every
+    control plane actually emits: the event counter/drop accounting
+    ship in the catalog, and source lints pin the arbiter's journaled
+    lease transitions, the serve controller's drain begin/advance, and
+    elastic recovery close to their ``_events.emit`` calls — a refactor
+    dropping one silently severs the causal chain."""
+    import pathlib
+
+    import ray_tpu
+
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_events_total",
+        "ray_tpu_events_dropped_total",
+    }
+    missing = required - names
+    assert not missing, (
+        f"flight-recorder series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name == "ray_tpu_events_total":
+            assert m.description.strip() and "type" in m.tag_keys
+        if m.name == "ray_tpu_events_dropped_total":
+            assert "buffer" in m.tag_keys
+
+    root = pathlib.Path(ray_tpu.__file__).parent
+    # Arbiter: every journaled lease transition (create/advance) and the
+    # SLO reversal record emit beside their _journal_put.
+    _funcs_emit_flight(root / "autoscaler" / "arbiter.py",
+                       ["create_lease", "advance", "record_reversal"])
+    # Serve controller: drains emit at begin AND at settle.
+    _funcs_emit_flight(root / "serve" / "api.py",
+                       ["_begin_drain", "_advance_drains"],
+                       window=80)
+    # Elastic recovery: RecoveryTrace.close records cause + outcome
+    # BEFORE the tracing gate (flight events flow with tracing off).
+    elastic_src = (root / "train" / "elastic.py").read_text()
+    close_body = elastic_src.split("def close(", 1)[1]
+    emit_at = close_body.index("_events.emit(")
+    gate_at = close_body.index("tracing.enabled()")
+    assert emit_at < gate_at, (
+        "train.recovery flight emit moved behind the tracing gate — "
+        "recoveries would vanish from the recorder with tracing off")
+    # Preemption notices carry their event id cluster-wide.
+    preempt_src = (root / "checkpoint" / "preempt.py").read_text()
+    assert 'notice["notice_id"]' in preempt_src
+    # The GCS probe-before-reap verdicts and chaos injections emit.
+    gcs_src = (root / "_private" / "gcs" / "server.py").read_text()
+    assert '"gcs.probe"' in gcs_src and '"gcs.node_dead"' in gcs_src
+    assert '"chaos.inject"' in (root / "_private" /
+                                "chaos.py").read_text()
+    # The dashboard renders the plane and the CLI walks it.
+    from ray_tpu import dashboard
+
+    assert 'id="flight"' in dashboard._INDEX_HTML
+    assert "/api/v1/events" in dashboard._INDEX_HTML
+    from ray_tpu.scripts import cli
+
+    assert callable(cli.cmd_why)

@@ -566,6 +566,12 @@ def test_diurnal_chip_handoff_e2e(tmp_path, monkeypatch):
 
         handle = serve.run(PoolEcho.bind())
         assert handle.remote(0).result(timeout_s=30) == 0
+        # The pre-drain replica table: when the preemption fires, the
+        # flight-recorder leg re-arms the handle with it so a dispatch
+        # lands on a draining replica deterministically (route events
+        # otherwise refresh the table before the next natural request).
+        pre_replicas = list(handle._replicas)
+        assert pre_replicas
 
         # Elastic trainer on its own thread: world 1, grows to 3 when
         # the night handoff lands its chips.
@@ -628,6 +634,7 @@ def test_diurnal_chip_handoff_e2e(tmp_path, monkeypatch):
         # NIGHT: drive ticks; the arbiter dies mid-lease at tick 5 and
         # a fresh instance must resume from the journal.
         killed = False
+        forced_request_id = None
         deadline = time.monotonic() + 120
         while time.monotonic() < deadline:
             try:
@@ -640,9 +647,36 @@ def test_diurnal_chip_handoff_e2e(tmp_path, monkeypatch):
                                               slo=guard)
                 continue
             assert st["violations"] == [], st
+            if forced_request_id is None and any(
+                    e["action"] == "preempt_node"
+                    for e in chaos.injection_log()):
+                # The preemption drains began during THIS tick (the
+                # notice fans out synchronously in-process). Re-arm the
+                # handle with the pre-drain table so a dispatch lands on
+                # a draining (or already torn-down) replica: its reject
+                # or death forces the journaled re-route whose
+                # flight-recorder resume the acceptance below walks
+                # back to the chaos injection.
+                forced_request_id = ""
+                for _ in range(20):
+                    with handle._lock:
+                        handle._router.replicas = list(pre_replicas)
+                        handle._router.dirty = False
+                        handle._router.inflight = {}
+                    resp = handle.remote(424242)
+                    assert resp.result(timeout_s=60) == 424242
+                    if resp._request_id:
+                        # Minted at the first retry: non-empty means
+                        # the request really was displaced and resumed.
+                        forced_request_id = resp._request_id
+                        break
+                assert forced_request_id, (
+                    "no dispatch against the pre-preemption fleet was "
+                    "rejected — the drain never displaced a request")
             if committed():
                 break
             time.sleep(0.25)
+        assert forced_request_id, "preempt_node never fired"
         leases = arbiter.ledger.leases()
         assert leases and leases[0]["stage"] == arb.COMMITTED, leases
         assert killed, "kill_arbiter never fired"
@@ -705,6 +739,68 @@ def test_diurnal_chip_handoff_e2e(tmp_path, monkeypatch):
         assert back_to_one()
         assert arbiter.ledger.allocation() == {
             "serve": 3, "train": 1, "in_flight": 0, "total": 4}
+
+        # ISSUE-16 acceptance: the flight recorder connects the whole
+        # night-to-morning story by event id — chaos preempt_node
+        # injection → preemption notice → replica drain → journaled
+        # request resume → lease reversal — and `ray-tpu why request
+        # <id>` prints the connected chain.
+        import contextlib
+        import io
+
+        from ray_tpu._private import events as flight
+        from ray_tpu.scripts import cli as cli_mod
+
+        inject_id = preempts[0]["event_id"]
+        assert inject_id, "chaos.inject stopped returning its event id"
+        recs = flight.local_events(limit=100000)
+        by_id = {r["event_id"]: r for r in recs}
+        notices = [r for r in recs if r["type"] == "preempt.notice"
+                   and r["cause"] == inject_id]
+        assert notices, "no preemption notice caused by the injection"
+        notice_id = notices[0]["event_id"]
+        drains = [r for r in recs if r["type"] == "serve.drain_begin"
+                  and r["cause"] == notice_id]
+        assert drains, "no replica drain links back to the notice"
+        mid = [r for r in recs if r["type"] == "pool.handoff_preempted"
+               and r["subject"].get("lease_id") == lease_id]
+        assert mid and mid[0]["cause"] in (notice_id, inject_id), mid
+        rev_evs = [r for r in recs if r["type"] == "pool.reversal"
+                   and r["subject"].get("lease_id") == lease_id]
+        assert rev_evs, "the SLO reversal never hit the recorder"
+
+        def ancestor_ids(eid):
+            seen = set()
+            while eid and eid in by_id and eid not in seen:
+                seen.add(eid)
+                eid = by_id[eid].get("cause", "")
+            return seen
+
+        resumed = next(
+            (r for r in recs if r["type"] == "serve.resume"
+             and r["subject"].get("request_id")
+             and inject_id in ancestor_ids(r["event_id"])), None)
+        assert resumed is not None, (
+            "no resumed request chains back to the chaos injection")
+        # One causal closure holds every link (the reversal joins
+        # through the lease_id it shares with the mid-handoff record).
+        chain_ids = {r["event_id"]
+                     for r in flight.causal_chain(recs, [inject_id])}
+        for eid in (notice_id, mid[0]["event_id"],
+                    rev_evs[0]["event_id"], resumed["event_id"],
+                    *(d["event_id"] for d in drains)):
+            assert eid in chain_ids, by_id.get(eid, eid)
+        # `ray-tpu why request <id>` renders the same chain, each link
+        # printed by event id.
+        monkeypatch.setattr(cli_mod, "_connect", lambda a: ray_tpu)
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            cli_mod.main(["why", "request",
+                          resumed["subject"]["request_id"]])
+        text = buf.getvalue()
+        for eid in (inject_id, notice_id, resumed["event_id"],
+                    rev_evs[0]["event_id"]):
+            assert eid in text, (eid, text)
 
         # Wind down: finish traffic, release the trainer's step sleeps,
         # and let the run complete.

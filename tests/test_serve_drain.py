@@ -94,8 +94,9 @@ def test_chaos_serve_rules_parse_and_act():
                             tokens=4) is None
         assert chaos.inject("serve_replica", phase="decode",
                             token=1) is None
-        assert chaos.inject("serve_pressure",
-                            deployment="d") == {"drop": True}
+        d = chaos.inject("serve_pressure", deployment="d")
+        assert d.pop("event_id")  # every firing carries its flight id
+        assert d == {"drop": True}
         assert chaos.inject("serve_pressure", deployment="d") is None
         d = chaos.inject("serve_tick", engine="e")
         assert d and d["slept_s"] == pytest.approx(0.001)
@@ -283,6 +284,22 @@ def test_kill_mid_decode_greedy_resume_bit_identical(llm_app):
                           deployment=LLM, cause="resume") == before + 1
     assert _counter_value(mdefs.SERVE_REQ_OUTCOMES, deployment=LLM,
                           outcome="resumed") >= 1
+    # Flight recorder: the injection's event id (returned by inject and
+    # carried on the log entry) is the CAUSE of the journaled resume —
+    # the kill and the recovery are one connected chain, not two
+    # disconnected counters.
+    from ray_tpu._private import events as flight
+
+    inject_id = kills[0]["event_id"]
+    assert inject_id, "chaos.inject stopped returning its event id"
+    resumed_evs = [r for r in flight.local_events(types=["serve.resume"])
+                   if r["cause"] == inject_id]
+    assert resumed_evs, "the mid-decode resume never chained to the kill"
+    assert resumed_evs[0]["subject"].get("deployment") == LLM
+    assert resumed_evs[0]["subject"].get("request_id")
+    chain_ids = {r["event_id"] for r in flight.causal_chain(
+        flight.local_events(limit=100000), [inject_id])}
+    assert {inject_id, resumed_evs[0]["event_id"]} <= chain_ids
     chaos.configure(None)
     _wait_replicas(LLM, 2)  # the replacement respawned
 

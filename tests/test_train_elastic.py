@@ -342,15 +342,26 @@ def test_chaos_exact_rule_fires_once_at_its_coordinates():
     assert log[0]["coords"] == {"rank": 1, "step": 3}
 
 
+def _fired(directives):
+    """Every firing carries its flight-recorder event id; strip it so
+    the cooperative-directive payload can be compared exactly."""
+    assert directives is not None and directives.pop("event_id")
+    return directives
+
+
 def test_chaos_cooperative_sites_return_directives():
     chaos.configure("drop_node_hb;drop_agent_vitals;"
                     "drop_heartbeat:rank=0;"
                     "delay_heartbeat:rank=1,secs=0.01")
-    assert chaos.inject("node_heartbeat", node="abc") == {"drop": True}
+    assert _fired(chaos.inject("node_heartbeat",
+                               node="abc")) == {"drop": True}
     assert chaos.inject("node_heartbeat", node="abc") is None  # times=1
-    assert chaos.inject("agent_vitals", node="abc") == {"drop": True}
-    assert chaos.inject("train_heartbeat", rank=0) == {"drop": True}
-    assert chaos.inject("train_heartbeat", rank=1) == {"delay_s": 0.01}
+    assert _fired(chaos.inject("agent_vitals",
+                               node="abc")) == {"drop": True}
+    assert _fired(chaos.inject("train_heartbeat",
+                               rank=0)) == {"drop": True}
+    assert _fired(chaos.inject("train_heartbeat",
+                               rank=1)) == {"delay_s": 0.01}
     assert chaos.inject("train_heartbeat", rank=2) is None
 
 
@@ -361,7 +372,8 @@ def test_chaos_env_activation(monkeypatch):
     plan = chaos.current_plan()
     assert plan is not None and plan.seed == 13
     # slow_step acts in place (sleeps) and reports the applied delay.
-    assert chaos.inject("train_step", rank=0, step=1) == {"slept_s": 0.0}
+    assert _fired(chaos.inject("train_step",
+                               rank=0, step=1)) == {"slept_s": 0.0}
     assert [e["action"] for e in chaos.injection_log()] == ["slow_step"]
 
 
