@@ -390,6 +390,29 @@ CB_KV_BLOCKS_SHARED = Gauge(
     "Indexed prefix blocks pinned (refcounted) by at least one live "
     "slot — never reclaimed while referenced",
     ("engine",))
+CB_SPEC_DRAFT_TOKENS = Counter(
+    "ray_tpu_cb_spec_draft_tokens_total",
+    "Tokens proposed by the speculative-decode drafter (k per slot per "
+    "spec tick); with accepted_tokens this prices how much verify "
+    "bandwidth the drafts are buying",
+    ("engine",))
+CB_SPEC_ACCEPTED_TOKENS = Counter(
+    "ray_tpu_cb_spec_accepted_tokens_total",
+    "Drafted tokens the batched verify pass accepted (committed beyond "
+    "the one token a plain tick would have produced)",
+    ("engine",))
+CB_SPEC_ACCEPT_RATE = Gauge(
+    "ray_tpu_cb_spec_accept_rate",
+    "Windowed speculative-decode accept rate (accepted/drafted over the "
+    "last RAY_TPU_SPEC_WINDOW spec ticks) — the controller input that "
+    "moves spec_k along its rung ladder",
+    ("engine",))
+CB_SPEC_K = Gauge(
+    "ray_tpu_cb_spec_k",
+    "Live speculative draft depth k the engine is dispatching (0 = the "
+    "controller parked on the plain tick; configured maximum is the "
+    "spec_k knob)",
+    ("engine",))
 
 # ------------------------------------------------- XLA plane (_private/
 # xla_monitor.py): compiles/retraces per instrumented program, compiler

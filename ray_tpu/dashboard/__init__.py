@@ -88,6 +88,7 @@ _INDEX_HTML = """<!doctype html>
 <h1>ray_tpu dashboard <span id="status"></span></h1>
 <h2>Cluster</h2><div id="cluster"></div>
 <h2>Serve / KV arena</h2><div id="serve"></div>
+<h2>Serve / speculative decode</h2><div id="spec"></div>
 <h2>Serve / prefix cache &amp; affinity routing</h2><div id="prefix"></div>
 <h2>Serve / request latency breakdown (TTFT = queue + arena-wait +
 prefill; TPOT)</h2><div id="reqlat"></div>
@@ -193,6 +194,18 @@ async function servePanel(){
                      "&since=300&agg=avg&step=3&limit=60");
   document.getElementById("serve").innerHTML=
     sparkRows(data,60)||"(no serve engines)";
+}
+async function specPanel(){
+  // Speculative decode vitals per engine: the live draft depth k (the
+  // controller ladders it from the windowed accept rate — k stepping to
+  // 0 means drafts stopped paying), the accept-rate gauge itself, and
+  // the drafted/accepted token counters whose slope ratio is the
+  // long-run acceptance. Accept rate sagging while k stays high means
+  // the workload outran the drafter.
+  const data=await j("/api/v1/metrics/query?series=ray_tpu_cb_spec_*"+
+                     "&since=300&agg=avg&step=3&limit=20");
+  document.getElementById("spec").innerHTML=
+    sparkRows(data,20)||"(no speculative decode)";
 }
 async function prefixPanel(){
   // Prefix-cache effectiveness + router affinity: hit vs miss prompt
@@ -411,6 +424,7 @@ async function refresh(){
       .map(l=>`[${l.worker} ${l.pid}] ${l.line}`).join("\\n");
     await metricsPanel();
     await servePanel();
+    await specPanel();
     await prefixPanel();
     await requestLatencyPanel();
     await lifecyclePanel();

@@ -95,6 +95,9 @@ class ContinuousLlamaDeployment:
                  num_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
                  sampling=None,
+                 spec_k: Optional[int] = None,
+                 spec_draft_layers: Optional[int] = None,
+                 spec_adaptive: Optional[bool] = None,
                  checkpoint_path: Optional[str] = None):
         """Engine knobs (``num_slots``, ``max_len``, ``sync_every``,
         ``use_decode_kernel``, and the paged-KV plane's ``paged`` /
@@ -107,7 +110,15 @@ class ContinuousLlamaDeployment:
         (``{"temperature": 0.7, "top_p": 0.9, "seed": 0}``), which is
         what YAML-sourced deploy configs produce. ``checkpoint_path``
         cold-starts params from a training run's newest committed
-        checkpoint (manifest plane)."""
+        checkpoint (manifest plane).
+
+        Speculative decoding rides the same path: ``spec_k`` (or
+        ``RAY_TPU_SPEC_K``) enables draft-and-verify decode at depth k,
+        ``spec_draft_layers`` sizes the truncated self-drafter, and
+        ``spec_adaptive`` lets the accept-rate controller ladder k (down
+        to 0 = the plain tick). All three are ordinary ``init_kwargs``
+        overrides, so a YAML deploy config can turn speculation on per
+        deployment."""
         import queue
         import threading
 
@@ -127,7 +138,9 @@ class ContinuousLlamaDeployment:
             use_decode_kernel=use_decode_kernel, paged=paged,
             block_size=block_size, kv_dtype=kv_dtype,
             num_blocks=num_blocks, prefix_cache=prefix_cache,
-            sampling=sampling)
+            sampling=sampling, spec_k=spec_k,
+            spec_draft_layers=spec_draft_layers,
+            spec_adaptive=spec_adaptive)
         threading.Thread(target=self._tick_loop, daemon=True,
                          name="llm-ticks").start()
 
@@ -281,6 +294,9 @@ def build_continuous_llama_app(config: Optional[llama.LlamaConfig] = None,
                                num_blocks: Optional[int] = None,
                                prefix_cache: Optional[bool] = None,
                                sampling=None,
+                               spec_k: Optional[int] = None,
+                               spec_draft_layers: Optional[int] = None,
+                               spec_adaptive: Optional[bool] = None,
                                checkpoint_path: Optional[str] = None):
     dep = ContinuousLlamaDeployment.options(num_replicas=num_replicas)
     # Keyword bind so per-deploy ``init_kwargs`` overrides (serve config
@@ -290,7 +306,9 @@ def build_continuous_llama_app(config: Optional[llama.LlamaConfig] = None,
                     use_decode_kernel=use_decode_kernel, paged=paged,
                     block_size=block_size, kv_dtype=kv_dtype,
                     num_blocks=num_blocks, prefix_cache=prefix_cache,
-                    sampling=sampling,
+                    sampling=sampling, spec_k=spec_k,
+                    spec_draft_layers=spec_draft_layers,
+                    spec_adaptive=spec_adaptive,
                     checkpoint_path=checkpoint_path)
 
 

@@ -44,6 +44,7 @@ arena back attends exactly what the original prefill attended.
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from collections import deque
 from typing import Any, Dict, List, Optional
@@ -54,14 +55,17 @@ import numpy as np
 
 from ray_tpu._private import xla_monitor
 from ray_tpu.models import llama
-from ray_tpu.models.inference import (KVCache, _attend_cached,
+from ray_tpu.models.inference import (ExternalLlamaDrafter, KVCache,
+                                      SelfDrafter, _attend_cached,
                                       _forward_cached, lm_head_logits)
 from ray_tpu.models.llama import rms_norm
 from ray_tpu.models.paged_kv import (GARBAGE_BLOCK, BlockAllocator,
                                      PagedKVCache, RadixBlockIndex,
                                      prompt_chunks, quantize_kv,
                                      resolve_kv_dtype)
-from ray_tpu.models.sampling import SamplingParams, sample_tokens, step_key
+from ray_tpu.models.sampling import (SPEC_DRAFT_SALT, SamplingParams,
+                                     filtered_probs, sample_tokens,
+                                     spec_commit, step_key)
 from ray_tpu.ops.decode_attention import (decode_applicable,
                                           decode_attention,
                                           decode_attention_reference,
@@ -150,6 +154,287 @@ def _layer_finish(x, o, layer, c):
     up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
     return x + jnp.einsum("bsm,me->bse", jax.nn.silu(gate) * up,
                           layer["w_down"].astype(c.dtype))
+
+
+def _apply_rope_window(x, cos, sin):
+    """RoPE with per-(slot, position) angles: x [B, S, H, D], cos/sin
+    [B, S, D//2] — the k+1-token verify-window analog of
+    :func:`_apply_rope_batched` (same elementwise math, broadcast over
+    heads instead of over a singleton window)."""
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    return jnp.concatenate(
+        [x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).astype(dtype)
+
+
+def _layer_qkv_window(x, layer, cos, sin, c):
+    """:func:`_layer_qkv` over a k+1-token verify window: x [B, S, E],
+    per-(slot, position) RoPE angles [B, S, D//2]. The projections are
+    the same contractions as the s=1 tick — the window rides the batch
+    dims, the E-axis accumulation is untouched — which the spec-on/off
+    bit-parity tests pin down."""
+    h = rms_norm(x, layer["attn_norm"], c.rms_eps)
+    q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(c.dtype))
+    k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(c.dtype))
+    v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(c.dtype))
+    return (_apply_rope_window(q, cos, sin),
+            _apply_rope_window(k, cos, sin), v)
+
+
+def _layer_finish_window(x, o, layer, c):
+    """:func:`_layer_finish` over a verify window: o [B, S, H, D]."""
+    x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(c.dtype))
+    h = rms_norm(x, layer["mlp_norm"], c.rms_eps)
+    gate = jnp.einsum("bse,em->bsm", h, layer["w_gate"].astype(c.dtype))
+    up = jnp.einsum("bse,em->bsm", h, layer["w_up"].astype(c.dtype))
+    return x + jnp.einsum("bsm,me->bse", jax.nn.silu(gate) * up,
+                          layer["w_down"].astype(c.dtype))
+
+
+def _draft_forward_paged(params, n_draft, tokens, positions, tables,
+                         limits, cache: PagedKVCache,
+                         config: llama.LlamaConfig, use_kernel: bool):
+    """One self-draft forward: tokens [B] at ``positions`` through the
+    FIRST ``n_draft`` target layers, reading and writing the target's
+    OWN paged arena (same tables/limits/garbage redirect as the tick).
+    The truncated stack computes bitwise the target's layer-[0:n) K/V,
+    so context is already resident and the draft's writes are the bytes
+    verify will rewrite identically. Returns (draft logits [B, V]
+    through the target's final norm + lm_head, updated cache)."""
+    c = config
+    quantized = cache.quantized
+    bs = cache.block_size
+    cos, sin = rope_frequencies(c.head_dim, 0, c.rope_theta,
+                                positions=positions)
+    x = params["embed"].astype(c.dtype)[tokens][:, None, :]
+    scale = c.head_dim ** -0.5
+    gathered = jnp.take_along_axis(
+        tables, (positions // bs)[:, None], axis=1)[:, 0]
+    block_idx = jnp.where(positions < limits, gathered, GARBAGE_BLOCK)
+    flat_pos = block_idx * bs + positions % bs
+
+    def layer_fn(carry, layer):
+        x, ck_all, cv_all, ks_all, vs_all, li = carry
+        ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
+        q, k, v = _layer_qkv(x, layer, cos, sin, c)
+        k_tok, v_tok = k[:, 0], v[:, 0]
+        ksl = vsl = None
+        if quantized:
+            kq, ksc = quantize_kv(k_tok)
+            vq, vsc = quantize_kv(v_tok)
+            ksl = jax.lax.dynamic_index_in_dim(ks_all, li, 0,
+                                               keepdims=False)
+            vsl = jax.lax.dynamic_index_in_dim(vs_all, li, 0,
+                                               keepdims=False)
+            ksl = _scatter_arena(ksl, ksc, flat_pos)
+            vsl = _scatter_arena(vsl, vsc, flat_pos)
+        else:
+            kq, vq = k_tok, v_tok
+        ck = _scatter_arena(ck, kq, flat_pos)
+        cv = _scatter_arena(cv, vq, flat_pos)
+        o = paged_decode_attention(q[:, 0], ck, cv, tables, positions,
+                                   scale, k_scale=ksl, v_scale=vsl,
+                                   use_kernel=use_kernel)
+        o = o.astype(x.dtype)
+        ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, li, 0)
+        cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, li, 0)
+        if quantized:
+            ks_all = jax.lax.dynamic_update_index_in_dim(ks_all, ksl,
+                                                         li, 0)
+            vs_all = jax.lax.dynamic_update_index_in_dim(vs_all, vsl,
+                                                         li, 0)
+        x = _layer_finish(x, o, layer, c)
+        return (x, ck_all, cv_all, ks_all, vs_all, li + 1), None
+
+    sliced = jax.tree.map(lambda a: a[:n_draft], params["layers"])
+    carry0 = (x, cache.k, cache.v, cache.k_scale, cache.v_scale,
+              jnp.int32(0))
+    (x, nk, nv, nks, nvs, _), _ = jax.lax.scan(layer_fn, carry0, sliced)
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = lm_head_logits(x, params, c)
+    return logits[:, 0], PagedKVCache(k=nk, v=nv, k_scale=nks,
+                                      v_scale=nvs)
+
+
+def _draft_forward_dense(dparams, tokens, positions, dcache: KVCache,
+                         dconfig: llama.LlamaConfig):
+    """External-drafter decode step over the drafter's own dense
+    per-slot cache (reference attention — the drafter is small by
+    construction, so the fused kernel buys nothing). Returns
+    (logits [B, V], updated cache)."""
+    c = dconfig
+    cos, sin = rope_frequencies(c.head_dim, 0, c.rope_theta,
+                                positions=positions)
+    x = dparams["embed"].astype(c.dtype)[tokens][:, None, :]
+    scale = c.head_dim ** -0.5
+
+    def layer_fn(carry, layer):
+        x, ck_all, cv_all, li = carry
+        ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
+        q, k, v = _layer_qkv(x, layer, cos, sin, c)
+        ck = _scatter_slot(ck, k[:, 0].astype(ck.dtype), positions)
+        cv = _scatter_slot(cv, v[:, 0].astype(cv.dtype), positions)
+        o = decode_attention(q[:, 0], ck, cv, positions, scale,
+                             use_kernel=False)
+        ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, li, 0)
+        cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, li, 0)
+        x = _layer_finish(x, o, layer, c)
+        return (x, ck_all, cv_all, li + 1), None
+
+    (x, nk, nv, _), _ = jax.lax.scan(
+        layer_fn, (x, dcache.k, dcache.v, jnp.int32(0)),
+        dparams["layers"])
+    x = rms_norm(x, dparams["final_norm"], c.rms_eps)
+    logits = lm_head_logits(x, dparams, c)
+    return logits[:, 0], KVCache(k=nk, v=nv)
+
+
+def _verify_forward_paged(params, tokens, positions, tables, limits,
+                          cache: PagedKVCache, config: llama.LlamaConfig,
+                          use_kernel: bool):
+    """ONE batched verify pass over each slot's k+1-token window: tokens
+    [B, S] at per-slot absolute ``positions`` [B, S] (= p .. p+k).
+
+    Projections and the MLP run batched over the window — verify streams
+    the parameters ONCE for all k+1 positions, which is the speculative
+    roofline lever — while attention runs per window position through the
+    EXISTING paged decode path. All k+1 positions' K/V scatter before any
+    query attends, which is safe because position masking hides in-window
+    successors (query j sees [0..p+j] only), and overrun/freed-slot
+    writes redirect to the garbage block exactly like the plain tick.
+    Returns (fp32 logits [B, S, V], updated cache)."""
+    c = config
+    quantized = cache.quantized
+    bs = cache.block_size
+    b, s = tokens.shape
+    cos, sin = rope_frequencies(c.head_dim, 0, c.rope_theta,
+                                positions=positions.reshape(-1))
+    cos = cos.reshape(b, s, -1)
+    sin = sin.reshape(b, s, -1)
+    x = params["embed"].astype(c.dtype)[tokens]               # [B, S, E]
+    scale = c.head_dim ** -0.5
+    gathered = jnp.take_along_axis(tables, positions // bs, axis=1)
+    block_idx = jnp.where(positions < limits[:, None], gathered,
+                          GARBAGE_BLOCK)
+    flat_pos = (block_idx * bs + positions % bs).reshape(-1)  # [B*S]
+
+    def layer_fn(carry, layer):
+        x, ck_all, cv_all, ks_all, vs_all, li = carry
+        ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
+        q, k, v = _layer_qkv_window(x, layer, cos, sin, c)
+        k_tok = k.reshape(b * s, *k.shape[2:])
+        v_tok = v.reshape(b * s, *v.shape[2:])
+        ksl = vsl = None
+        if quantized:
+            # Per-token/per-head scales reduce over D only, so the
+            # window-batched quantize is bitwise the tick's.
+            kq, ksc = quantize_kv(k_tok)
+            vq, vsc = quantize_kv(v_tok)
+            ksl = jax.lax.dynamic_index_in_dim(ks_all, li, 0,
+                                               keepdims=False)
+            vsl = jax.lax.dynamic_index_in_dim(vs_all, li, 0,
+                                               keepdims=False)
+            ksl = _scatter_arena(ksl, ksc, flat_pos)
+            vsl = _scatter_arena(vsl, vsc, flat_pos)
+        else:
+            kq, vq = k_tok, v_tok
+        ck = _scatter_arena(ck, kq, flat_pos)
+        cv = _scatter_arena(cv, vq, flat_pos)
+        outs = []
+        for j in range(s):  # unrolled: s = k+1, small and static
+            outs.append(paged_decode_attention(
+                q[:, j], ck, cv, tables, positions[:, j], scale,
+                k_scale=ksl, v_scale=vsl, use_kernel=use_kernel))
+        o = jnp.stack(outs, axis=1).astype(x.dtype)       # [B, S, H, D]
+        ck_all = jax.lax.dynamic_update_index_in_dim(ck_all, ck, li, 0)
+        cv_all = jax.lax.dynamic_update_index_in_dim(cv_all, cv, li, 0)
+        if quantized:
+            ks_all = jax.lax.dynamic_update_index_in_dim(ks_all, ksl,
+                                                         li, 0)
+            vs_all = jax.lax.dynamic_update_index_in_dim(vs_all, vsl,
+                                                         li, 0)
+        x = _layer_finish_window(x, o, layer, c)
+        return (x, ck_all, cv_all, ks_all, vs_all, li + 1), None
+
+    carry0 = (x, cache.k, cache.v, cache.k_scale, cache.v_scale,
+              jnp.int32(0))
+    (x, nk, nv, nks, nvs, _), _ = jax.lax.scan(layer_fn, carry0,
+                                               params["layers"])
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    logits = lm_head_logits(x, params, c)
+    return logits, PagedKVCache(k=nk, v=nv, k_scale=nks, v_scale=nvs)
+
+
+def _spec_tick_paged(params, tokens, positions, tables, limits,
+                     cache: PagedKVCache, step,
+                     config: llama.LlamaConfig, k: int, n_draft: int,
+                     use_kernel: bool, sampling: SamplingParams,
+                     draft_params=None, draft_cache=None,
+                     draft_config=None):
+    """Speculative decode tick: draft ``k`` tokens per slot, score all
+    k+1 window positions in ONE batched verify pass, accept per slot
+    in-device (:func:`~ray_tpu.models.sampling.spec_commit`).
+
+    Returns ``(committed [B, k+1], counts [B], next_tokens [B],
+    next_positions [B], cache, draft_cache, step + 1)`` — the device
+    threads its own next-token/next-position state exactly like the
+    plain tick, so buffered mode runs spec ticks back-to-back without a
+    host sync. Rejected draft writes land past each slot's committed
+    length inside its (k-lookahead-extended) reservation and are dead on
+    arrival: every future decode overwrites a position before attending
+    it, and a buffered rewind simply re-uploads host counts — the
+    garbage-block redirect + replay machinery, unchanged."""
+    external = draft_params is not None
+    d_tokens: List[Any] = []
+    d_probs: List[Any] = []
+    tok = tokens
+    pos = positions
+    dcache = draft_cache if external else cache
+    draft_key = None if sampling.greedy else step_key(
+        sampling.seed, step, salt=SPEC_DRAFT_SALT)
+    for i in range(k):
+        if external:
+            logits_d, dcache = _draft_forward_dense(
+                draft_params, tok, pos, dcache, draft_config)
+        else:
+            logits_d, dcache = _draft_forward_paged(
+                params, n_draft, tok, pos, tables, limits, dcache,
+                config, use_kernel)
+        if sampling.greedy:
+            nxt = jnp.argmax(logits_d, axis=-1).astype(jnp.int32)
+        else:
+            # The drafter proposes from its OWN filtered distribution;
+            # acceptance needs those q rows, and the proposal stream is
+            # salted apart from accept/fix/base-tick draws.
+            d_probs.append(filtered_probs(
+                logits_d, sampling.temperature, sampling.top_p))
+            nxt = sample_tokens(logits_d, jax.random.fold_in(draft_key, i),
+                                sampling.temperature, sampling.top_p)
+        d_tokens.append(nxt)
+        tok = nxt
+        pos = pos + 1
+    if not external:
+        cache = dcache  # self-draft wrote the shared arena layers [0:n)
+    window = jnp.stack([tokens] + d_tokens, axis=1)          # [B, k+1]
+    window_pos = positions[:, None] + jnp.arange(k + 1)[None, :]
+    logits, cache = _verify_forward_paged(params, window, window_pos,
+                                          tables, limits, cache, config,
+                                          use_kernel)
+    drafts = jnp.stack(d_tokens, axis=1)
+    probs = jnp.stack(d_probs, axis=1) if d_probs else None
+    committed, counts = spec_commit(drafts, probs, logits, step, sampling)
+    next_tokens = jnp.take_along_axis(
+        committed, (counts - 1)[:, None], axis=1)[:, 0]
+    next_positions = positions + counts
+    return (committed, counts, next_tokens, next_positions, cache,
+            dcache if external else None, step + 1)
 
 
 def _decode_tick(params, tokens, positions, cache: KVCache, step,
@@ -404,6 +689,49 @@ def _resolve_decode_kernel(config: llama.LlamaConfig, max_len: int,
     return bool(use_decode_kernel)
 
 
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "").strip()
+    return int(raw) if raw else default
+
+
+def _resolve_spec_k(spec_k: Optional[int]) -> int:
+    """Speculative depth: explicit arg > RAY_TPU_SPEC_K env > 0 (off)."""
+    if spec_k is None:
+        spec_k = _env_int("RAY_TPU_SPEC_K", 0)
+    spec_k = int(spec_k)
+    if spec_k < 0:
+        raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+    return spec_k
+
+
+def _resolve_spec_draft_layers(arg: Optional[int], num_layers: int) -> int:
+    """Self-draft depth: explicit arg > RAY_TPU_SPEC_DRAFT_LAYERS env >
+    num_layers // 4 (floor 1 — the EAGLE-style 'shallow slice of the
+    target' default)."""
+    if arg is None:
+        arg = _env_int("RAY_TPU_SPEC_DRAFT_LAYERS",
+                       max(1, num_layers // 4))
+    arg = int(arg)
+    if not 1 <= arg <= num_layers:
+        raise ValueError(
+            f"spec_draft_layers must be in [1, {num_layers}], got {arg}")
+    return arg
+
+
+def _spec_ladder(spec_k: int) -> List[int]:
+    """Adaptive-k steps: powers of two up to spec_k, plus spec_k itself —
+    log-bounded, so the compiled spec-tick program count is log-bounded
+    too (one program per ladder rung, window dims whitelisted
+    prefill_dims-style)."""
+    ks = set()
+    v = 1
+    while v < spec_k:
+        ks.add(v)
+        v *= 2
+    ks.add(spec_k)
+    return sorted(ks)
+
+
 class ContinuousBatcher:
     """Iteration-level scheduler over a fixed pool of KV-cache slots."""
 
@@ -419,7 +747,11 @@ class ContinuousBatcher:
                  kv_dtype: Optional[str] = None,
                  num_blocks: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
-                 sampling=None):
+                 sampling=None,
+                 spec_k: Optional[int] = None,
+                 spec_draft_layers: Optional[int] = None,
+                 spec_adaptive: Optional[bool] = None,
+                 drafter=None):
         """``token_callback(rid, token)`` fires for every generated token
         as it is produced (serving streams ride this).
 
@@ -468,7 +800,27 @@ class ContinuousBatcher:
         ``sampling`` (:class:`~ray_tpu.models.sampling.SamplingParams`
         or a dict) selects in-device token sampling; the default is
         greedy argmax. Sampled decode is deterministic under a fixed
-        ``sampling.seed``."""
+        ``sampling.seed``.
+
+        SPECULATIVE DECODING (``spec_k`` > 0, or ``RAY_TPU_SPEC_K``;
+        paged engines only — the rewind substrate): each tick a cheap
+        drafter proposes up to ``spec_k`` tokens per slot, one batched
+        verify pass scores all k+1 positions through the same paged
+        attention path, and per-slot acceptance commits a variable
+        number of tokens — decode tokens per param-stream instead of
+        one. ``drafter`` is a
+        :class:`~ray_tpu.models.inference.SelfDrafter` (default: the
+        target's first ``spec_draft_layers`` /
+        ``RAY_TPU_SPEC_DRAFT_LAYERS`` layers over the target's own
+        arena) or an
+        :class:`~ray_tpu.models.inference.ExternalLlamaDrafter` (a
+        separate small checkpoint with its own dense cache).
+        ``spec_adaptive`` (default on; ``RAY_TPU_SPEC_ADAPTIVE``)
+        ladders k from the windowed accept rate — down to 0, which
+        dispatches the EXACT pre-spec tick program. Greedy outputs are
+        bit-identical spec-on/off; sampled acceptance is rejection
+        sampling that preserves the target distribution and replays
+        deterministically across buffered rewinds."""
         self.config = config
         self.num_slots = num_slots
         self.max_len = max_len
@@ -491,6 +843,58 @@ class ContinuousBatcher:
         self.use_decode_kernel = _resolve_decode_kernel(
             config, max_len, use_decode_kernel, paged=self.paged,
             block_size=self.block_size)
+        # Speculative-decode knobs resolve BEFORE the arena is sized:
+        # reservations carry spec_k look-ahead tokens (rejected draft
+        # writes must land in already-reserved blocks), so max_blocks /
+        # the default arena grow accordingly.
+        self.spec_k = _resolve_spec_k(spec_k)
+        self.drafter = drafter
+        if self.spec_k:
+            if not self.paged:
+                raise ValueError(
+                    "speculative decoding needs the paged KV plane (the "
+                    "garbage-block rewind substrate); use paged=True or "
+                    "spec_k=0")
+            if self.drafter is None:
+                self.drafter = SelfDrafter(spec_draft_layers)
+            if self.drafter.external:
+                if self.drafter.config.vocab_size != config.vocab_size:
+                    raise ValueError(
+                        "external drafter must share the target's "
+                        "vocabulary")
+                self.spec_draft_layers = self.drafter.config.num_layers
+            else:
+                self.spec_draft_layers = _resolve_spec_draft_layers(
+                    spec_draft_layers
+                    if spec_draft_layers is not None
+                    else self.drafter.draft_layers, config.num_layers)
+            if spec_adaptive is None:
+                spec_adaptive = env_flag("RAY_TPU_SPEC_ADAPTIVE")
+            self.spec_adaptive = (True if spec_adaptive is None
+                                  else bool(spec_adaptive))
+            self._spec_ladder_ks = _spec_ladder(self.spec_k)
+        else:
+            self.drafter = None
+            self.spec_draft_layers = 0
+            self.spec_adaptive = False
+            self._spec_ladder_ks = []
+        self._spec_cur_k = self.spec_k
+        self._spec_ticks: Dict[int, Any] = {}   # ladder k -> compiled tick
+        self._last_tick_k = 0                   # k the last tick ran with
+        self._window_k = 0                      # k of the buffered window
+        # Windowed accept-rate telemetry: (drafted, accepted) per applied
+        # fetch — the adaptive-k controller and the accept-rate gauge both
+        # read it.
+        self._spec_window: deque = deque(
+            maxlen=max(1, _env_int("RAY_TPU_SPEC_WINDOW", 128)))
+        self._spec_probe_after = max(
+            1, _env_int("RAY_TPU_SPEC_PROBE_TICKS", 256))
+        self._spec_probe_countdown = self._spec_probe_after
+        self.spec_draft_tokens = 0      # cumulative drafted
+        self.spec_accepted_tokens = 0   # cumulative accepted by verify
+        self.spec_tick_count = 0        # spec-tick dispatches
+        self.base_tick_count = 0        # plain-tick dispatches
+        self.decoded_tokens = 0         # committed decode tokens (bench)
         # Prefill accounting (bench_serve.py reads these; the metric
         # counters mirror them into the TSDB). With the prefix cache on,
         # ``prefill_tokens`` counts only NOVEL (suffix) tokens; the
@@ -509,9 +913,26 @@ class ContinuousBatcher:
             config, jax.random.PRNGKey(seed))
         self.param_bytes = sum(
             x.nbytes for x in jax.tree_util.tree_leaves(self.params))
+        # Split out the non-layer params: a self-draft pass streams only
+        # the truncated layer fraction plus the embed/norm/head — the
+        # spec-aware tick_bytes_estimate prices drafts from these.
+        self._head_param_bytes = sum(
+            self.params[k].nbytes
+            for k in ("embed", "final_norm", "lm_head"))
+        self._layer_param_bytes = self.param_bytes - self._head_param_bytes
+        self._draft_param_bytes = (
+            sum(x.nbytes
+                for x in jax.tree_util.tree_leaves(self.drafter.params))
+            if self.spec_k and self.drafter.external else 0)
+        self._draft_cache = None
         self.token_callback = token_callback
         if self.paged:
-            self.max_blocks = -(-max_len // self.block_size)
+            # Table width covers max_len PLUS the spec look-ahead: a spec
+            # tick writes draft/verify K/V up to position p + spec_k, and
+            # those writes must stay inside the slot's own reservation
+            # (the garbage redirect is for overrun PAST it).
+            self.max_blocks = -(-(max_len + self.spec_k)
+                                // self.block_size)
             self.num_blocks = int(
                 num_blocks if num_blocks is not None
                 else num_slots * self.max_blocks + 1)
@@ -713,6 +1134,76 @@ class ContinuousBatcher:
         self._prefill = prefill
         self._tick = tick
 
+        if self.spec_k and self.drafter.external:
+            # The external drafter keeps its own dense per-slot cache;
+            # admission prefills the FULL prompt into it (the target's
+            # prefix cache shortens only the target's prefill), decode
+            # advances it inside the spec tick. No sampling: first
+            # tokens come from the target's prefill.
+            dcfg = self.drafter.config
+            self._draft_cache = KVCache.create(dcfg, num_slots, max_len)
+
+            @xla_monitor.instrument(name="cb_draft_prefill",
+                                    shape_policy="bucketed",
+                                    allowed_dims=prefill_dims,
+                                    donate_argnums=(2,))
+            def draft_prefill(dparams, tokens, dcache, slots):
+                positions = jnp.arange(tokens.shape[1])
+                slot_cache = KVCache(
+                    k=jnp.take(dcache.k, slots, axis=1),
+                    v=jnp.take(dcache.v, slots, axis=1))
+                _, sc = _forward_cached(dparams, tokens, positions,
+                                        slot_cache, dcfg)
+                return KVCache(k=dcache.k.at[:, slots].set(sc.k),
+                               v=dcache.v.at[:, slots].set(sc.v))
+
+            self._draft_prefill = draft_prefill
+        else:
+            self._draft_prefill = None
+
+    def _get_spec_tick(self, k: int):
+        """Compiled spec-tick program for ladder rung ``k`` (memoized:
+        one program per rung, all named cb_spec_tick). The window dims
+        k+1 for every rung join the bucketed whitelist so legitimate
+        ladder moves never raise ray_tpu_xla_retraces_total — the same
+        prefill_dims discipline the admission path uses."""
+        tick = self._spec_ticks.get(k)
+        if tick is not None:
+            return tick
+        cfg = self.config
+        use_kernel = self.use_decode_kernel
+        sampling_cfg = self.sampling
+        n_draft = self.spec_draft_layers
+        spec_dims = (self.max_len, self.num_slots, self.max_blocks)
+        spec_dims += tuple(kk + 1 for kk in self._spec_ladder_ks)
+        if self.drafter.external:
+            dcfg = self.drafter.config
+
+            @xla_monitor.instrument(name="cb_spec_tick",
+                                    shape_policy="bucketed",
+                                    allowed_dims=spec_dims,
+                                    donate_argnums=(5, 6))
+            def spec_tick(params, tokens, positions, tables, limits,
+                          cache, dcache, step, dparams):
+                return _spec_tick_paged(
+                    params, tokens, positions, tables, limits, cache,
+                    step, cfg, k, n_draft, use_kernel, sampling_cfg,
+                    draft_params=dparams, draft_cache=dcache,
+                    draft_config=dcfg)
+        else:
+            @xla_monitor.instrument(name="cb_spec_tick",
+                                    shape_policy="bucketed",
+                                    allowed_dims=spec_dims,
+                                    donate_argnums=(5,))
+            def spec_tick(params, tokens, positions, tables, limits,
+                          cache, step):
+                return _spec_tick_paged(
+                    params, tokens, positions, tables, limits, cache,
+                    step, cfg, k, n_draft, use_kernel, sampling_cfg)
+
+        self._spec_ticks[k] = spec_tick
+        return spec_tick
+
     def prefill_cache_misses(self) -> int:
         """Compiled prefill program count (one per (N, bucket) shape) —
         the admission-burst acceptance check reads this. Prefers jax's
@@ -789,6 +1280,9 @@ class ContinuousBatcher:
         tpot = None
         first = rec.get("first_token")
         if first is not None and tokens > 1:
+            # ``tokens`` is the COMMITTED count, not the tick count — a
+            # spec tick that lands 3 tokens divides the same wall time by
+            # 3, so TPOT stays honest under multi-token ticks.
             tpot = max(now - first, 0.0) / (tokens - 1)
             mdefs.SERVE_REQ_TPOT.observe(tpot, tags=tags)
         trace = rec.get("trace") or {}
@@ -841,6 +1335,13 @@ class ContinuousBatcher:
             # thresholds should use instead of raw free.
             "kv_blocks_cached": cached,
             "kv_blocks_total": (self.num_blocks - 1 if self.paged else 0),
+            # Draft look-ahead blocks are RESERVED capacity (the
+            # allocator already excludes them from kv_blocks_free — no
+            # phantom free arena for the admission gate or the arbiter
+            # SLO guard); this reports how much of the reservation is
+            # speculative head-room rather than committed tokens.
+            "kv_blocks_spec_lookahead": sum(
+                st.get("la_blocks", 0) for st in self._slots.values()),
             "inflight_prefill_tokens": sum(
                 len(r["prompt"]) for r in self._waiting),
         }
@@ -961,6 +1462,16 @@ class ContinuousBatcher:
         self._applied_steps = 0
         self._bw_window_t0 = None
         self._bw_window_ticks = 0
+        # Spec state restarts with the engine: the controller re-enters at
+        # the configured k and the external drafter's dense cache (donated
+        # by the spec tick like the main arena) is rebuilt alongside it.
+        self._spec_cur_k = self.spec_k
+        self._spec_window.clear()
+        self._spec_probe_countdown = self._spec_probe_after
+        self._window_k = 0
+        if self._draft_cache is not None:
+            self._draft_cache = KVCache.create(
+                self.drafter.config, self.num_slots, self.max_len)
         self._dirty = True
         return dropped
 
@@ -1005,13 +1516,23 @@ class ContinuousBatcher:
                 "live_tokens": live,
                 "frag_ratio": max(1.0 - live / cap, 0.0) if cap else 0.0}
 
-    def tick_bytes_estimate(self) -> int:
+    def tick_bytes_estimate(self, spec_k: Optional[int] = None) -> int:
         """HBM bytes one decode tick actually streams: the full parameter
         set plus the LIVE tokens' arena traffic (paged) or every slot's
         padded stripe (dense). This is the live-traffic figure the
         achieved-bandwidth gauges and bench_serve report — the compiled
         program's static cost analysis can only ever price the worst
-        case."""
+        case.
+
+        ``spec_k`` prices a SPECULATIVE tick (defaults to the k the
+        engine currently dispatches): each of the k draft passes streams
+        the truncated layer slice (or the external drafter's params +
+        cache) plus its share of the live arena, and the batched verify
+        streams the full params ONCE plus k+1 per-position arena passes
+        — live bytes actually read, so multi-token ticks don't inflate
+        the achieved-bandwidth gauges."""
+        if spec_k is None:
+            spec_k = self._spec_cur_k if self.spec_k else 0
         if self.paged:
             # The kernel streams WHOLE blocks (the run guard skips
             # compute, not the fetch), so round each slot's live prefix
@@ -1020,7 +1541,25 @@ class ContinuousBatcher:
             bs = self.block_size
             live = sum(-(-(st["pos"] + 1) // bs) * bs
                        for st in self._slots.values())
-            return self.param_bytes + live * self.cache.token_bytes()
+            live_bytes = live * self.cache.token_bytes()
+            total = self.param_bytes + live_bytes
+            if spec_k:
+                if self._draft_cache is not None:
+                    dcfg = self.drafter.config
+                    ditem = jnp.dtype(self._draft_cache.k.dtype).itemsize
+                    dstripes = (2 * dcfg.num_layers * self.num_slots
+                                * self.max_len * dcfg.num_kv_heads
+                                * dcfg.head_dim * ditem)
+                    draft_pass = self._draft_param_bytes + dstripes
+                else:
+                    frac = self.spec_draft_layers / self.config.num_layers
+                    draft_pass = (self._layer_param_bytes * frac
+                                  + self._head_param_bytes
+                                  + live_bytes * frac)
+                # k draft passes + k EXTRA verify query positions (the
+                # base figure already counts one arena pass).
+                total += spec_k * (draft_pass + live_bytes)
+            return total
         c = self.config
         itemsize = jnp.dtype(self.cache.k.dtype).itemsize
         per_slot = (2 * c.num_layers * self.max_len * c.num_kv_heads
@@ -1028,7 +1567,17 @@ class ContinuousBatcher:
         return self.param_bytes + self.num_slots * per_slot
 
     def _blocks_needed(self, prompt_len: int, max_new: int) -> int:
-        return -(-(prompt_len + max_new) // self.block_size)
+        # Spec decode needs spec_k look-ahead tokens past the committed
+        # length: rejected draft/verify writes must land inside the
+        # slot's own reservation, never a neighbor's block — reserved at
+        # admission, all-or-nothing, so free counts stay honest.
+        return -(-(prompt_len + max_new + self.spec_k)
+                 // self.block_size)
+
+    def _lookahead_blocks(self, prompt_len: int, max_new: int) -> int:
+        """Blocks of a reservation attributable to spec look-ahead."""
+        return (self._blocks_needed(prompt_len, max_new)
+                - -(-(prompt_len + max_new) // self.block_size))
 
     def _can_admit_head(self) -> bool:
         """True when the FIFO head could admit RIGHT NOW (free slot and,
@@ -1118,6 +1667,7 @@ class ContinuousBatcher:
         bs = self.block_size
         padded_cap = (self.max_blocks * bs if self.paged else self.max_len)
         groups: Dict[tuple, List] = {}
+        draft_pending: List = []   # (slot, prompt) for the ext. drafter
         while self._waiting and self._free:
             req = self._waiting[0]
             blocks: List[int] = []
@@ -1263,9 +1813,45 @@ class ContinuousBatcher:
                     "max_new": req["max_new"],
                     "pos": len(req["prompt"]),   # next decode writes here
                     "last": tok,
+                    # Reserved-but-speculative block head-room, reported
+                    # by pressure_snapshot (router congestion must see
+                    # it as occupied, not free).
+                    "la_blocks": (self._lookahead_blocks(
+                        len(req["prompt"]), req["max_new"])
+                        if self.paged else 0),
                 }
                 self._maybe_finish(slot)
+                if (self._draft_prefill is not None
+                        and slot in self._slots):
+                    draft_pending.append((slot, req["prompt"]))
+        if self._draft_prefill is not None and draft_pending:
+            self._run_draft_prefill(draft_pending)
         self._dirty = True  # device tokens/positions need re-upload
+
+    def _run_draft_prefill(self, admitted) -> None:
+        """Prefill the external drafter's dense cache for freshly
+        admitted slots — FULL prompts (the target's prefix cache only
+        shortens the target's prefill), grouped into the same pow-2
+        buckets as the main prefill so the program count stays
+        log-bounded. Padding garbage past each prompt is dead: the
+        drafter's first decode write at position p overwrites before
+        position p is ever attended."""
+        by_bucket: Dict[int, List] = {}
+        for slot, prompt in admitted:
+            blen = min(_bucket(len(prompt)), self.max_len)
+            by_bucket.setdefault(blen, []).append((slot, prompt))
+        for blen, grp in by_bucket.items():
+            n = len(grp)
+            n_pad = min(_bucket(n, floor=1), self.num_slots)
+            toks = np.zeros((n_pad, blen), np.int32)
+            slots_arr = np.zeros(n_pad, np.int32)
+            for i in range(n_pad):
+                slot, prompt = grp[min(i, n - 1)]
+                toks[i, :len(prompt)] = prompt
+                slots_arr[i] = slot
+            self._draft_cache = self._draft_prefill(
+                self.drafter.params, jnp.asarray(toks),
+                self._draft_cache, jnp.asarray(slots_arr))
 
     def _maybe_finish(self, slot: int) -> None:
         st = self._slots.get(slot)
@@ -1303,6 +1889,30 @@ class ContinuousBatcher:
         self._dirty = False
 
     def _run_tick(self):
+        """Dispatch one decode tick. Returns the device row to fetch:
+        a [B] token vector from the plain tick, or a
+        ``(committed [B, k+1], counts [B])`` pair from a spec tick. At
+        k = 0 — spec off, or the accept-rate controller parked at the
+        bottom rung — this dispatches the EXACT pre-spec ``cb_tick``
+        program: same jit, same arguments, same device sequence."""
+        k = self._spec_cur_k if (self.spec_k and self.paged) else 0
+        if k > 0:
+            tick = self._get_spec_tick(k)
+            if self._draft_cache is not None:
+                (committed, counts, self._d_tokens, self._d_positions,
+                 self.cache, self._draft_cache, self._d_step) = tick(
+                    self.params, self._d_tokens, self._d_positions,
+                    self._d_tables, self._d_limits, self.cache,
+                    self._draft_cache, self._d_step, self.drafter.params)
+            else:
+                (committed, counts, self._d_tokens, self._d_positions,
+                 self.cache, _, self._d_step) = tick(
+                    self.params, self._d_tokens, self._d_positions,
+                    self._d_tables, self._d_limits, self.cache,
+                    self._d_step)
+            self.spec_tick_count += 1
+            self._last_tick_k = k
+            return (committed, counts)
         if self.paged:
             (self._d_tokens, self._d_positions, self.cache,
              self._d_step) = self._tick(
@@ -1313,6 +1923,8 @@ class ContinuousBatcher:
              self._d_step) = self._tick(
                 self.params, self._d_tokens, self._d_positions,
                 self.cache, self._d_step)
+        self.base_tick_count += 1
+        self._last_tick_k = 0
         return self._d_tokens
 
     def _record_window_token(self, rid: int, entries: Dict[int, list],
@@ -1352,34 +1964,113 @@ class ContinuousBatcher:
         rides the apply loop, not a post-pass)."""
         finished_any = False
         applied = 0
+        drafted = 0
+        accepted = 0
         track = window is not None and self._traced_live > 0
         if track:
             w1 = window[1]
             w0 = window[0] if window[0] is not None else w1
             entries: Dict[int, list] = {}
+        # One device tick == one sampling step regardless of how many
+        # tokens it committed (spec windows burn exactly one step number),
+        # so the rewind counter advances per ROW, not per token.
         self._applied_steps += len(nxt_rows)
         for row in nxt_rows:
+            if isinstance(row, tuple):
+                toks, counts = row   # spec tick: ([B, k+1], [B]) committed
+            else:
+                toks, counts = row, None
             for slot, rid in membership:
                 st = self._slots.get(slot)
                 if st is None or st["rid"] != rid:
                     continue  # finished earlier in this batch: skip tail
-                tok = int(row[slot])
-                if self.token_callback is not None:
-                    self.token_callback(rid, tok)
-                st["out"].append(tok)
-                st["last"] = tok
-                st["pos"] += 1
-                applied += 1
-                if track:
-                    self._record_window_token(rid, entries, w0, w1)
-                self._maybe_finish(slot)
-                if slot not in self._slots:
-                    finished_any = True
-        if applied:
+                n = 1 if counts is None else int(counts[slot])
+                if counts is not None:
+                    drafted += toks.shape[1] - 1
+                    accepted += n - 1
+                for j in range(n):
+                    tok = int(toks[slot]) if counts is None else int(
+                        toks[slot, j])
+                    if self.token_callback is not None:
+                        self.token_callback(rid, tok)
+                    st["out"].append(tok)
+                    st["last"] = tok
+                    st["pos"] += 1
+                    applied += 1
+                    if track:
+                        self._record_window_token(rid, entries, w0, w1)
+                    self._maybe_finish(slot)
+                    if slot not in self._slots:
+                        # EOS / max_new mid-window: the rest of the
+                        # committed window is past the request's end —
+                        # drop it (device-side overrun rewinds with the
+                        # dirty re-upload the finish already forces).
+                        finished_any = True
+                        break
+        self.decoded_tokens += applied
+        if applied or drafted:
             from ray_tpu._private import metrics_defs as mdefs
 
-            mdefs.CB_DECODE_TOKENS.inc(applied, tags=self._mtags)
+            if applied:
+                mdefs.CB_DECODE_TOKENS.inc(applied, tags=self._mtags)
+            if drafted:
+                self.spec_draft_tokens += drafted
+                self.spec_accepted_tokens += accepted
+                self._spec_window.append((drafted, accepted))
+                mdefs.CB_SPEC_DRAFT_TOKENS.inc(drafted, tags=self._mtags)
+                mdefs.CB_SPEC_ACCEPTED_TOKENS.inc(accepted,
+                                                  tags=self._mtags)
         return finished_any
+
+    # Accept-rate controller thresholds: shrink k below LOW (drafts are
+    # wasting verify bandwidth), grow above HIGH (more look-ahead pays),
+    # hold in between. MIN_SAMPLE drafted tokens gate any move so one
+    # unlucky window can't thrash the rung.
+    _SPEC_RATE_LOW = 0.3
+    _SPEC_RATE_HIGH = 0.6
+    _SPEC_MIN_SAMPLE = 16
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Windowed draft accept rate: accepted / drafted over the last
+        ``RAY_TPU_SPEC_WINDOW`` spec rows (0.0 when no drafts yet)."""
+        drafted = sum(d for d, _ in self._spec_window)
+        if not drafted:
+            return 0.0
+        return sum(a for _, a in self._spec_window) / drafted
+
+    def _adapt_spec_k(self) -> None:
+        """Move the live draft depth along the rung ladder from the
+        windowed accept rate. Called ONLY at clean boundaries (sync path
+        per step; buffered path when no ticks are in flight), so a rung
+        change never mixes row widths inside one stacked fetch. At rung 0
+        the engine runs the exact pre-spec tick program; a probe
+        re-enters the bottom rung after ``RAY_TPU_SPEC_PROBE_TICKS``
+        base ticks so a workload whose accept rate recovers isn't parked
+        at 0 forever."""
+        if not (self.spec_k and self.spec_adaptive):
+            return
+        if self._spec_cur_k == 0:
+            self._spec_probe_countdown -= 1
+            if self._spec_probe_countdown <= 0:
+                self._spec_cur_k = self._spec_ladder_ks[0]
+                self._spec_window.clear()
+                self._spec_probe_countdown = self._spec_probe_after
+            return
+        drafted = sum(d for d, _ in self._spec_window)
+        if drafted < self._SPEC_MIN_SAMPLE:
+            return
+        rate = self.spec_accept_rate
+        idx = self._spec_ladder_ks.index(self._spec_cur_k)
+        if rate < self._SPEC_RATE_LOW:
+            self._spec_cur_k = (
+                self._spec_ladder_ks[idx - 1] if idx > 0 else 0)
+            self._spec_window.clear()
+            self._spec_probe_countdown = self._spec_probe_after
+        elif rate > self._SPEC_RATE_HIGH and (
+                idx + 1 < len(self._spec_ladder_ks)):
+            self._spec_cur_k = self._spec_ladder_ks[idx + 1]
+            self._spec_window.clear()
 
     def _emit_gauges(self) -> None:
         from ray_tpu._private import metrics_defs as mdefs
@@ -1399,6 +2090,10 @@ class ContinuousBatcher:
                                               tags=self._mtags)
                 mdefs.CB_KV_BLOCKS_SHARED.set(kv["shared"],
                                               tags=self._mtags)
+        if self.spec_k:
+            mdefs.CB_SPEC_ACCEPT_RATE.set(self.spec_accept_rate,
+                                          tags=self._mtags)
+            mdefs.CB_SPEC_K.set(self._spec_cur_k, tags=self._mtags)
 
     def step(self) -> Dict[int, List[int]]:
         """Admit waiting requests, run one decode tick over all active
@@ -1415,6 +2110,7 @@ class ContinuousBatcher:
             chaos.inject("serve_tick", engine=self._mtags["engine"])
         self._emit_gauges()
         if self.sync_every == 1:
+            self._adapt_spec_k()
             self._admit()
             if self._slots:
                 if self._dirty:
@@ -1422,7 +2118,10 @@ class ContinuousBatcher:
                 w0 = time.time() if self._traced_live else None
                 t0 = time.perf_counter()
                 nxt_dev = self._run_tick()
-                nxt = np.asarray(nxt_dev)  # 4 bytes/slot
+                if isinstance(nxt_dev, tuple):
+                    nxt = (np.asarray(nxt_dev[0]), np.asarray(nxt_dev[1]))
+                else:
+                    nxt = np.asarray(nxt_dev)  # 4 bytes/slot
                 # Per-tick sync: the fetch IS the device sync, so this is
                 # the honest tick latency (dispatch + compute + fetch) —
                 # also the denominator for the tick's achieved-FLOPs/
@@ -1435,10 +2134,15 @@ class ContinuousBatcher:
                 # prices every table entry as live); the dense program's
                 # own cost analysis is already accurate — including the
                 # kernel-off fp32 re-read traffic a hand estimate would
-                # miss — so dense keeps it.
-                self._tick.note_execution(
+                # miss — so dense keeps it. Spec ticks report against
+                # THEIR program (per-k instrumented jit) with the hint
+                # priced for k draft passes + the wider verify window.
+                tick_fn = (self._spec_ticks[self._last_tick_k]
+                           if self._last_tick_k else self._tick)
+                tick_fn.note_execution(
                     tick_wall,
-                    bytes_hint=(self.tick_bytes_estimate()
+                    bytes_hint=(self.tick_bytes_estimate(
+                        spec_k=self._last_tick_k)
                                 if self.paged else None))
                 if self._apply_tokens(
                         [nxt], [(s, st["rid"])
@@ -1454,6 +2158,10 @@ class ContinuousBatcher:
         # Admission only at a clean boundary (no speculative ticks in
         # flight): an upload mid-buffer would rewind the device sequence.
         if not self._buf and self._pending is None:
+            # Spec-k changes only ever land here (clean boundary): a
+            # mid-buffer rung switch would mix row widths in one stacked
+            # fetch and desync the replayed device sequence on rewind.
+            self._adapt_spec_k()
             self._admit()
             # Clean boundary: restart the bandwidth window so idle gaps
             # and admission prefill time never pollute the first
@@ -1483,6 +2191,11 @@ class ContinuousBatcher:
             mdefs.CB_TICK_MS.observe(
                 (time.perf_counter() - t0) * 1e3, tags=self._mtags)
             self._bw_window_ticks += 1
+            if not self._buf:
+                # k is frozen for the whole buffered window (adaptation
+                # happens at clean boundaries only) — remember which
+                # program produced these rows for the flush accounting.
+                self._window_k = self._last_tick_k
             self._buf.append(nxt_dev)
         want_admit = self._can_admit_head()
         if len(self._buf) >= self.sync_every or want_admit or (
@@ -1495,31 +2208,57 @@ class ContinuousBatcher:
         out, self._finished = self._finished, {}
         return out
 
+    @staticmethod
+    def _stack_buffer(buf):
+        """Stack buffered tick rows into one fetchable device value.
+        Plain rows ([B] vectors) stack to [T, B]; spec rows stack
+        componentwise to ([T, B, k+1], [T, B]) — k is constant across a
+        window, so the stack is uniform."""
+        if isinstance(buf[0], tuple):
+            return (jnp.stack([r[0] for r in buf]),
+                    jnp.stack([r[1] for r in buf]))
+        return jnp.stack(buf)
+
+    @staticmethod
+    def _rows_from_stacked(stacked):
+        """Fetch a stacked buffer to host and split it back into per-tick
+        rows for ``_apply_tokens`` (spec rows become (toks, counts)
+        pairs)."""
+        if isinstance(stacked, tuple):
+            toks = np.asarray(stacked[0])
+            counts = np.asarray(stacked[1])
+            return [(toks[i], counts[i]) for i in range(toks.shape[0])]
+        rows = np.asarray(stacked)
+        return list(rows)
+
     def _flush_buffered(self, force_boundary: bool = False) -> None:
         # 1. Apply the PRIOR pending fetch first — its transfer has been
         # overlapping the ticks just buffered. If it finished requests,
         # the current buffer is stale speculation over freed slots:
         # discard it and rewind (re-upload host state next step).
         if self._pending is not None:
-            stacked, membership, win0 = self._pending
+            stacked, membership, win0, wk = self._pending
             self._pending = None
-            rows = np.asarray(stacked)  # overlapped: usually ready
+            rows = self._rows_from_stacked(stacked)  # overlapped fetch
             # The fetch landing IS a device sync: backpressure makes the
             # wall time since the last sync cover the ticks dispatched in
             # between, so window/ticks is the steady-state per-tick cost.
             # Feed it (with the live-byte hint) to the achieved-bandwidth
             # gauges — buffered mode is the production remote-chip path,
             # and without this the gauges would price the paged tick at
-            # the compiled worst case instead of live tokens.
+            # the compiled worst case instead of live tokens. Spec
+            # windows report against their per-k program with the hint
+            # priced for the drafts + wider verify those ticks ran.
             now = time.perf_counter()
             if self._bw_window_t0 is not None and self._bw_window_ticks:
-                self._tick.note_execution(
+                tick_fn = self._spec_ticks[wk] if wk else self._tick
+                tick_fn.note_execution(
                     (now - self._bw_window_t0) / self._bw_window_ticks,
-                    bytes_hint=(self.tick_bytes_estimate()
+                    bytes_hint=(self.tick_bytes_estimate(spec_k=wk)
                                 if self.paged else None))
             self._bw_window_t0 = now
             self._bw_window_ticks = 0
-            if self._apply_tokens(list(rows), membership,
+            if self._apply_tokens(rows, membership,
                                   window=(win0, time.time())):
                 self._buf = []
                 self._dirty = True
@@ -1528,11 +2267,11 @@ class ContinuousBatcher:
             # A waiting request needs a clean boundary to admit: apply the
             # just-stacked-would-be buffer SYNCHRONOUSLY instead of
             # pipelining it, then rewind so the next step re-admits.
-            rows = np.asarray(jnp.stack(self._buf))
+            rows = self._rows_from_stacked(self._stack_buffer(self._buf))
             membership = [(s, st["rid"]) for s, st in self._slots.items()]
             self._buf = []
             win0, self._window_t0 = self._window_t0, None
-            self._apply_tokens(list(rows), membership,
+            self._apply_tokens(rows, membership,
                                window=(win0, time.time()))
             self._dirty = True
             return
@@ -1540,16 +2279,17 @@ class ContinuousBatcher:
             return
         # 2. Stack this buffer into ONE transfer and start it async; it
         # lands while the next K ticks run.
-        stacked = jnp.stack(self._buf)
+        stacked = self._stack_buffer(self._buf)
         self._buf = []
-        try:
-            stacked.copy_to_host_async()
-        except Exception:  # noqa: BLE001 — platform without async copy
-            pass
+        for part in (stacked if isinstance(stacked, tuple) else (stacked,)):
+            try:
+                part.copy_to_host_async()
+            except Exception:  # noqa: BLE001 — platform without async copy
+                pass
         self._pending = (stacked,
                          [(s, st["rid"])
                           for s, st in self._slots.items()],
-                         self._window_t0)
+                         self._window_t0, self._window_k)
         self._window_t0 = None
 
     def run_to_completion(self) -> Dict[int, List[int]]:

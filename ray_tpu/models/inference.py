@@ -40,6 +40,42 @@ class KVCache(NamedTuple):
                    v=jnp.zeros(shape, config.dtype))
 
 
+class SelfDrafter:
+    """Speculative-decode drafter that IS the target model, truncated: the
+    first ``draft_layers`` decoder layers plus the target's own final norm
+    and lm_head (:func:`llama.truncated`). Because those layers compute
+    bitwise the same K/V the target writes, the drafter reads and writes
+    the target's paged arena directly (layers [0:n)) — context K/V is
+    already resident, draft writes land where verify will rewrite the
+    identical bytes, and no second checkpoint or draft arena exists.
+
+    ``draft_layers=None`` defers to the engine default
+    (``RAY_TPU_SPEC_DRAFT_LAYERS``, else num_layers // 4)."""
+
+    external = False
+
+    def __init__(self, draft_layers: Optional[int] = None):
+        self.draft_layers = draft_layers
+
+
+class ExternalLlamaDrafter:
+    """Speculative-decode drafter backed by a separate (small) Llama
+    checkpoint sharing the target's vocabulary. Keeps its own dense
+    per-slot KV cache (``KVCache``), filled by a draft prefill of the full
+    prompt at admission and advanced by the spec tick's draft steps; the
+    engine's rewind (host-count re-upload) needs no drafter cooperation
+    because stale entries past the committed length are overwritten before
+    they are ever attended."""
+
+    external = True
+
+    def __init__(self, config: llama.LlamaConfig, params=None,
+                 seed: int = 0):
+        self.config = config
+        self.params = params if params is not None else llama.init_params(
+            config, jax.random.PRNGKey(seed))
+
+
 def _attend_cached(q, cache_k, cache_v, q_positions, scale):
     """q: [B, S, H, D] at absolute positions; cache: [B, S_max, KVH, D].
 

@@ -154,6 +154,28 @@ def init_params(config: LlamaConfig, key: jax.Array) -> Params:
     }
 
 
+def truncated(config: LlamaConfig, params: Params,
+              num_layers: int) -> Tuple[LlamaConfig, Params]:
+    """First-``num_layers`` view of a model: (config, params) where the
+    layer stack is sliced to the leading ``num_layers`` and the embedding,
+    final norm, and lm_head are shared (same arrays, zero copies).
+
+    This is the speculative-decode self-drafter (EAGLE/Medusa-style
+    truncated-depth draft): because the sliced stack computes bitwise the
+    SAME layer-0..n-1 activations and K/V as the full model, the drafter
+    can read and write the target's own paged KV arena for those layers —
+    no second checkpoint, no separate draft arena."""
+    if not 1 <= num_layers <= config.num_layers:
+        raise ValueError(
+            f"truncated depth must be in [1, {config.num_layers}], "
+            f"got {num_layers}")
+    cfg = dataclasses.replace(config, num_layers=num_layers)
+    sliced = dict(params)
+    sliced["layers"] = jax.tree.map(lambda a: a[:num_layers],
+                                    params["layers"])
+    return cfg, sliced
+
+
 def _select_attention(config: LlamaConfig, mesh: Optional[Mesh]):
     mode = config.attention
     if mode == "auto":

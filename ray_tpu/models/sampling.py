@@ -92,3 +92,101 @@ def step_key(seed: int, step, salt: int = 0):
     if salt:
         key = jax.random.fold_in(key, salt)
     return jax.random.fold_in(key, step)
+
+
+# Speculative-decode PRNG streams. Salt 0 is the plain decode tick and 1
+# the prefill sampler; spec ticks never draw from salt 0, so a deployment
+# that adapts k down to 0 re-enters the EXACT pre-spec sample sequence.
+SPEC_DRAFT_SALT = 2    # drafter's proposal draws (one fold_in(i) per draft)
+SPEC_ACCEPT_SALT = 3   # accept/reject uniforms
+SPEC_FIX_SALT = 4      # residual resamples + the bonus token
+
+
+def filtered_probs(logits, temperature: float, top_p: float):
+    """The exact post-temperature/top-p distribution ``sample_tokens``
+    draws from, as probability rows (softmax over the filtered scaled
+    logits). Axis-generic over leading dims: [..., V] -> [..., V].
+
+    Speculative rejection sampling needs the target's and drafter's
+    FILTERED distributions — acceptance ratios against the raw softmax
+    would not preserve what ``sample_tokens`` actually samples — so this
+    mirrors its masking math to the letter (exclusive-cumsum keep,
+    boundary ties kept)."""
+    scaled = logits / temperature
+    if top_p < 1.0:
+        sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_desc, jnp.inf),
+                         axis=-1, keepdims=True)
+        scaled = jnp.where(scaled >= cutoff, scaled, -jnp.inf)
+    return jax.nn.softmax(scaled, axis=-1)
+
+
+def spec_commit(draft_tokens, draft_probs, logits, step, sampling):
+    """Per-slot speculative acceptance, entirely in-device.
+
+    ``draft_tokens`` [B, k] int32 — the drafter's proposals d_1..d_k;
+    ``draft_probs`` [B, k, V] — the filtered proposal rows each d_i was
+    sampled from (ignored under greedy, pass None);
+    ``logits`` [B, k+1, V] fp32 — target logits at the k+1 verified
+    positions (window token i's logits condition on the committed prefix
+    plus drafts d_1..d_i).
+
+    Returns ``(committed [B, k+1] int32, counts [B] int32)`` with counts
+    in [1, k+1]: each slot commits its accepted draft prefix plus one
+    token the target produced itself (the correction at the first
+    mismatch, or the bonus token when every draft survived). Entries past
+    a slot's count are well-defined but meaningless; the host never reads
+    them.
+
+    Greedy: accept while d_i == argmax_i — the committed stream is the
+    target's own greedy stream by construction, bit-identical to spec-off.
+
+    Sampled (Leviathan et al. 2023): accept d_i with probability
+    min(1, p_i(d_i)/q_i(d_i)); on the first rejection resample from the
+    renormalized residual max(p_i - q_i, 0); a fully-accepted window
+    draws the bonus from p_{k+1}. Marginally every committed token is
+    distributed exactly as the target's own sampler — the drafter only
+    changes HOW MANY commit per tick. All draws are keyed off
+    (seed, step, salt) like the base tick, so buffered-engine rewinds
+    replay the same acceptances bit-identically.
+    """
+    b, k1, _ = logits.shape
+    k = k1 - 1
+    if sampling.greedy:
+        v = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if k == 0:
+            return v, jnp.ones((b,), jnp.int32)
+        match = (draft_tokens == v[:, :k]).astype(jnp.int32)
+        accepts = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        return v, accepts + 1
+
+    p = filtered_probs(logits, sampling.temperature, sampling.top_p)
+    p_d = p[:, :k]                                              # [B, k, V]
+    p_at = jnp.take_along_axis(
+        p_d, draft_tokens[..., None], axis=-1)[..., 0]          # [B, k]
+    q_at = jnp.take_along_axis(
+        draft_probs, draft_tokens[..., None], axis=-1)[..., 0]
+    u = jax.random.uniform(
+        step_key(sampling.seed, step, salt=SPEC_ACCEPT_SALT), shape=(b, k))
+    ratio = p_at / jnp.maximum(q_at, 1e-30)
+    acc = (u < jnp.minimum(ratio, 1.0)).astype(jnp.int32)
+    accepts = jnp.sum(jnp.cumprod(acc, axis=1), axis=1)         # [B]
+    # Correction tokens: the residual distribution at each draft position
+    # (p - q clipped and renormalized; degenerate q == p rows can only be
+    # reached with acceptance probability 1, so falling back to p there
+    # keeps the categorical finite without changing any outcome) and the
+    # target's own p at the bonus position.
+    resid = jnp.maximum(p_d - draft_probs, 0.0)
+    total = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(total > 0, resid / jnp.maximum(total, 1e-30), p_d)
+    fix_dist = jnp.concatenate([resid, p[:, k:]], axis=1)       # [B, k+1, V]
+    fix = jax.random.categorical(
+        step_key(sampling.seed, step, salt=SPEC_FIX_SALT),
+        jnp.log(jnp.maximum(fix_dist, 1e-38)), axis=-1).astype(jnp.int32)
+    idx = jnp.arange(k + 1)[None, :]
+    padded = jnp.pad(draft_tokens, ((0, 0), (0, 1)))
+    committed = jnp.where(idx < accepts[:, None], padded, fix)
+    return committed, accepts + 1

@@ -29,6 +29,25 @@ try:
 except Exception:
     pass
 
+# Per-run XLA compilation cache: many tests build engines that compile
+# IDENTICAL programs (the decode tick, prefill buckets, ...); the
+# persistent cache dedupes those within the run, which is most of the
+# suite's wall time on a small CI host. A fresh temp dir per run keeps
+# it hermetic — no cross-run state, nothing to go stale.
+if not os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    import atexit  # noqa: E402
+    import shutil  # noqa: E402
+    import tempfile  # noqa: E402
+
+    _cache_dir = tempfile.mkdtemp(prefix="ray_tpu_xla_cache_")
+    atexit.register(shutil.rmtree, _cache_dir, ignore_errors=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+
 import pytest  # noqa: E402
 
 

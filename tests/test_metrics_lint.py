@@ -119,6 +119,30 @@ def test_prefix_cache_series_are_cataloged():
             assert {"deployment", "decision"} <= set(m.tag_keys)
 
 
+def test_spec_decode_series_are_cataloged():
+    """The speculative-decode series (drafted/accepted token counters,
+    windowed accept-rate gauge, live draft depth k) ship described +
+    tagged in the catalog — the dashboard 'Serve / speculative decode'
+    panel and bench_serve's spec phase read them."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_cb_spec_draft_tokens_total",
+        "ray_tpu_cb_spec_accepted_tokens_total",
+        "ray_tpu_cb_spec_accept_rate",
+        "ray_tpu_cb_spec_k",
+    }
+    missing = required - names
+    assert not missing, (
+        f"speculative-decode series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name.startswith("ray_tpu_cb_spec_"):
+            assert m.description.strip() and "engine" in m.tag_keys
+    # The dashboard renders the plane beside the KV-arena panel.
+    from ray_tpu import dashboard
+
+    assert 'id="spec"' in dashboard._INDEX_HTML
+
+
 def test_serve_request_series_are_cataloged():
     """The request-path observability series (TTFT decomposition, TPOT,
     outcomes, event-buffer drops) ship described + tagged in the catalog
