@@ -1,0 +1,359 @@
+"""Control-plane soak bench: the simulated fleet against the REAL head.
+
+ROADMAP item 5c: before the head can be partitioned (5b) we need a
+standing bench that shows where one head process's capacity goes and at
+what fleet size it saturates. This harness runs a real in-process
+``GcsServer`` (WAL enabled) and drives its actual gRPC surface over
+loopback with a simulated fleet:
+
+* **stub nodes** — RegisterNode + a Heartbeat loop whose availability
+  toggles every beat, so each heartbeat exercises the real NODE_RES
+  pubsub fan-out path, not just the node table;
+* **replica pressure publishers** — KvPut/KvGet churn in the
+  ``__serve__`` namespace, the router pressure-mirror workload;
+* **subscribers** — real ``Subscribe`` streams on NODE_RES consuming
+  the fan-out (each holds a gRPC handler thread, like production
+  node managers);
+* **arbiter ticks** — a real :class:`PoolLedger` journaling through
+  :class:`GrpcKv` into the ``__pool__`` namespace: create -> advance
+  through the full lease state machine -> verify, per tick.
+
+Fleet size sweeps up a ladder until the server-side request queue-wait
+p95 diverges from the smallest-fleet baseline — that divergence point
+is the **saturation knee**, the headline regression number. Because the
+head runs in-process, per-phase p95s come from true histogram bucket
+diffs (``Histogram.bucket_snapshot``), which the cross-process TSDB
+cannot provide (it ships only ``_sum``/``_count``).
+
+Usage::
+
+    python bench_control.py --round 1              # full ladder
+    python bench_control.py --quick                # short ladder, CI
+
+Writes ``BENCH_CONTROL_r{round:02d}.json`` with sustained heartbeats/s,
+KV ops/s by namespace, pubsub fan-out p95, WAL fsync p95, and the knee.
+The tier-1 smoke (tests/test_head_observability.py) runs
+:func:`run_bench` at toy size.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ray_tpu._private import metrics_defs as md
+from ray_tpu._private import rpc
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+from ray_tpu.util.metrics import Histogram
+
+DEFAULT_LADDER = (50, 100, 200, 400, 800)
+# Queue-wait p95 divergence: the knee is the first fleet size whose p95
+# exceeds KNEE_FACTOR x the smallest-fleet baseline AND the absolute
+# floor (so a 20us -> 100us wiggle on an idle box is not a "knee").
+# 4x lines up with where heartbeat throughput rolls over in practice;
+# a stricter factor misses knees when the smallest rung is itself warm.
+KNEE_FACTOR = 4.0
+KNEE_FLOOR_S = 0.002
+
+
+def _stage(name: str) -> None:
+    print(f"[bench_control] {name}", file=sys.stderr, flush=True)
+
+
+# ------------------------------------------------------------ load loops
+def _node_loop(address: str, node_id: str, stop: threading.Event,
+               counts: Dict[str, int], hb_period: float) -> None:
+    stub = rpc.get_stub("GcsService", address)
+    avail = 8.0
+    while not stop.is_set():
+        avail = 7.0 if avail == 8.0 else 8.0  # toggle -> NODE_RES publish
+        try:
+            reply = stub.Heartbeat(pb.HeartbeatRequest(
+                node_id=node_id, available={"CPU": avail}), timeout=10.0)
+            if reply.ok:
+                counts["heartbeats"] += 1
+            else:
+                counts["rejected"] += 1
+        except Exception:  # noqa: BLE001 — saturation shows as errors
+            counts["errors"] += 1
+        stop.wait(hb_period)
+
+
+def _pressure_loop(address: str, idx: int, n_replicas: int,
+                   stop: threading.Event, counts: Dict[str, int],
+                   period: float) -> None:
+    stub = rpc.get_stub("GcsService", address)
+    payload = json.dumps({"replica": idx, "ongoing": 3, "queue_depth": 2,
+                          "kv_blocks_free": 11}).encode()
+    while not stop.is_set():
+        try:
+            stub.KvPut(pb.KvRequest(ns="__serve__",
+                                    key=f"pressure/{idx}", value=payload,
+                                    overwrite=True), timeout=10.0)
+            # The router side of the workload: read a peer's snapshot.
+            stub.KvGet(pb.KvRequest(
+                ns="__serve__", key=f"pressure/{(idx + 1) % n_replicas}"),
+                timeout=10.0)
+            counts["pressure_rounds"] += 1
+        except Exception:  # noqa: BLE001
+            counts["errors"] += 1
+        stop.wait(period)
+
+
+def _subscriber_loop(stream, stop: threading.Event,
+                     counts: Dict[str, int]) -> None:
+    try:
+        for _msg in stream:
+            counts["delivered"] += 1
+            if stop.is_set():
+                break
+    except Exception:  # noqa: BLE001 — cancelled at phase end
+        pass
+
+
+def _arbiter_loop(address: str, stop: threading.Event,
+                  counts: Dict[str, int], period: float) -> None:
+    from ray_tpu.autoscaler.arbiter import (COMMITTED, FREED, FREEING,
+                                            GRANTING, RETURN_FREEING,
+                                            RETURN_GRANTING, RETURNED,
+                                            GrpcKv, PoolLedger)
+
+    ledger = PoolLedger(kv=GrpcKv(address))
+    ledger.bootstrap(16, 16)
+    cycle = (FREEING, FREED, GRANTING, COMMITTED,
+             RETURN_FREEING, RETURN_GRANTING, RETURNED)
+    while not stop.is_set():
+        try:
+            lease = ledger.create_lease("serve", "train", 2, lease_s=60.0)
+            for stage in cycle:
+                lease = ledger.advance(lease, stage)
+            ledger.verify()
+            counts["arbiter_ticks"] += 1
+        except Exception:  # noqa: BLE001
+            counts["arbiter_errors"] += 1
+        stop.wait(period)
+
+
+# ------------------------------------------------------------ measuring
+def _hist_snap(hist: Histogram, tags=None):
+    bounds, counts, _total = hist.bucket_snapshot(tags)
+    return bounds, list(counts)
+
+
+def _hist_p95_since(hist: Histogram, before, tags=None) -> Optional[float]:
+    bounds, counts, _total = hist.bucket_snapshot(tags)
+    delta = [c - b for c, b in zip(counts, before[1])]
+    return Histogram.percentile_from(bounds, delta, 0.95)
+
+
+def _kv_rates_since(before: Dict, dur: float) -> Dict[str, float]:
+    after = {key: v for _n, key, v in md.GCS_KV_OPS.samples()}
+    out: Dict[str, float] = {}
+    for key, v in after.items():
+        tags = dict(key)
+        ns = tags.get("namespace", "?")
+        delta = v - before.get(key, 0.0)
+        if delta > 0:
+            out[ns] = out.get(ns, 0.0) + delta / dur
+    return out
+
+
+def _run_phase(server, address: str, fleet: int, phase_s: float,
+               hb_period: float, arbiters: int) -> Dict:
+    replicas = max(2, fleet // 2)
+    subscribers = min(16, max(4, fleet // 25))
+    counts: Dict[str, int] = {
+        "heartbeats": 0, "rejected": 0, "errors": 0,
+        "pressure_rounds": 0, "delivered": 0,
+        "arbiter_ticks": 0, "arbiter_errors": 0}
+    stub = rpc.get_stub("GcsService", address)
+    node_ids = [f"bench-node-{fleet}-{i}" for i in range(fleet)]
+    for nid in node_ids:
+        stub.RegisterNode(pb.RegisterNodeRequest(info=pb.NodeInfo(
+            node_id=nid, address="127.0.0.1:1", alive=True,
+            resources={"CPU": 8.0}, available={"CPU": 8.0})))
+    stop = threading.Event()
+    threads: List[threading.Thread] = []
+    streams = []
+    for i in range(subscribers):
+        stream = stub.Subscribe(pb.SubscribeRequest(
+            channels=["NODE_RES"], subscriber_id=f"bench-sub-{i}"),
+            timeout=3600.0)
+        streams.append(stream)
+        threads.append(threading.Thread(
+            target=_subscriber_loop, args=(stream, stop, counts),
+            daemon=True))
+    for nid in node_ids:
+        threads.append(threading.Thread(
+            target=_node_loop, args=(address, nid, stop, counts,
+                                     hb_period), daemon=True))
+    for i in range(replicas):
+        threads.append(threading.Thread(
+            target=_pressure_loop,
+            args=(address, i, replicas, stop, counts, hb_period * 2),
+            daemon=True))
+    for _ in range(arbiters):
+        threads.append(threading.Thread(
+            target=_arbiter_loop, args=(address, stop, counts, 0.2),
+            daemon=True))
+    for t in threads:
+        t.start()
+
+    # Warmup: let registration churn + first beats settle out of the
+    # measured window, then snapshot-and-measure.
+    time.sleep(min(1.0, phase_s / 4))
+    kv_before = {key: v for _n, key, v in md.GCS_KV_OPS.samples()}
+    fan_before = _hist_snap(md.GCS_PUBSUB_FANOUT_SECONDS)
+    fsync_before = _hist_snap(md.GCS_WAL_FSYNC_SECONDS)
+    qwait_before = _hist_snap(md.RPC_QUEUE_WAIT_SECONDS,
+                              {"service": "GcsService"})
+    hb_before = counts["heartbeats"]
+    t0 = time.perf_counter()
+    time.sleep(phase_s)
+    dur = time.perf_counter() - t0
+    hb_rate = (counts["heartbeats"] - hb_before) / dur
+    kv_rates = _kv_rates_since(kv_before, dur)
+    fan_p95 = _hist_p95_since(md.GCS_PUBSUB_FANOUT_SECONDS, fan_before)
+    fsync_p95 = _hist_p95_since(md.GCS_WAL_FSYNC_SECONDS, fsync_before)
+    qwait_p95 = _hist_p95_since(md.RPC_QUEUE_WAIT_SECONDS, qwait_before,
+                                {"service": "GcsService"})
+    occupancy = {dict(key).get("service"): v
+                 for _n, key, v in md.RPC_EXECUTOR_OCCUPANCY.samples()
+                 }.get("GcsService", 0.0)
+
+    stop.set()
+    for stream in streams:
+        try:
+            stream.cancel()
+        except Exception:  # noqa: BLE001
+            pass
+    for t in threads:
+        t.join(timeout=5.0)
+    for nid in node_ids:
+        try:
+            stub.DrainNode(pb.DrainNodeRequest(node_id=nid), timeout=10.0)
+        except Exception:  # noqa: BLE001
+            pass
+    phase = {
+        "fleet": fleet, "replicas": replicas,
+        "subscribers": subscribers, "duration_s": round(dur, 3),
+        "heartbeats_per_s": round(hb_rate, 1),
+        "kv_ops_per_s": {ns: round(r, 1)
+                         for ns, r in sorted(kv_rates.items())},
+        "pubsub_fanout_p95_s": fan_p95,
+        "wal_fsync_p95_s": fsync_p95,
+        "queue_wait_p95_s": qwait_p95,
+        "executor_occupancy": round(occupancy, 3),
+        "delivered_per_s": round(counts["delivered"] / dur, 1),
+        "arbiter_ticks": counts["arbiter_ticks"],
+        "errors": counts["errors"] + counts["arbiter_errors"],
+    }
+    _stage(f"fleet={fleet}: hb/s={phase['heartbeats_per_s']} "
+           f"queue_wait_p95={qwait_p95} occ={phase['executor_occupancy']}")
+    return phase
+
+
+def _find_knee(phases: List[Dict]) -> Optional[int]:
+    base = next((p["queue_wait_p95_s"] for p in phases
+                 if p["queue_wait_p95_s"] is not None), None)
+    if base is None:
+        return None
+    threshold = max(base * KNEE_FACTOR, KNEE_FLOOR_S)
+    for p in phases[1:]:
+        q = p["queue_wait_p95_s"]
+        if q is not None and q >= threshold:
+            return p["fleet"]
+    return None
+
+
+def run_bench(fleet_sizes=DEFAULT_LADDER, phase_s: float = 5.0,
+              hb_period: float = 0.05, arbiters: int = 1,
+              stop_at_knee: bool = True) -> Dict:
+    """Run the sweep against a fresh in-process GcsServer (WAL on) and
+    return the result dict (same shape as the JSON baseline)."""
+    from ray_tpu._private.gcs.server import GcsServer
+
+    # Saturated phases stall heartbeat threads past the default 3s node
+    # TTL; probing a fleet of fake addresses mid-phase would deregister
+    # the fleet under test. The TTL is read per health tick, so restore
+    # it afterwards (run_bench is importable from tests).
+    prev_ttl = os.environ.get("RAY_TPU_HEARTBEAT_TTL_S")
+    os.environ["RAY_TPU_HEARTBEAT_TTL_S"] = "3600"
+    phases: List[Dict] = []
+    try:
+        with tempfile.TemporaryDirectory() as tmp:
+            _stage("starting in-process GcsServer (WAL enabled)")
+            server = GcsServer(port=0,
+                               persist_path=os.path.join(tmp, "gcs_state"))
+            address = f"127.0.0.1:{server.port}"
+            try:
+                for fleet in fleet_sizes:
+                    phases.append(_run_phase(server, address, fleet,
+                                             phase_s, hb_period, arbiters))
+                    if stop_at_knee and _find_knee(phases) is not None:
+                        _stage("queue-wait diverged; stopping the sweep")
+                        break
+            finally:
+                server.shutdown()
+                rpc.drop_stub("GcsService", address)
+    finally:
+        if prev_ttl is None:
+            os.environ.pop("RAY_TPU_HEARTBEAT_TTL_S", None)
+        else:
+            os.environ["RAY_TPU_HEARTBEAT_TTL_S"] = prev_ttl
+    knee = _find_knee(phases)
+    peak = max(phases, key=lambda p: p["heartbeats_per_s"])
+    metrics = {
+        "control_knee_fleet": knee if knee is not None else 0,
+        "control_peak_heartbeats_per_s": peak["heartbeats_per_s"],
+        "control_peak_kv_ops_per_s": round(
+            max(sum(p["kv_ops_per_s"].values()) for p in phases), 1),
+        "control_fanout_p95_s": peak["pubsub_fanout_p95_s"],
+        "control_wal_fsync_p95_s": peak["wal_fsync_p95_s"],
+        "control_queue_wait_p95_s": phases[-1]["queue_wait_p95_s"],
+    }
+    return {"metrics": metrics, "phases": phases, "knee_fleet": knee}
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--round", type=int, default=1,
+                        help="baseline round number for the output name")
+    parser.add_argument("--quick", action="store_true",
+                        help="short ladder + short phases (CI smoke)")
+    parser.add_argument("--fleets", type=int, nargs="*",
+                        help="explicit fleet-size ladder")
+    parser.add_argument("--phase-s", type=float, default=None,
+                        help="seconds measured per fleet size")
+    parser.add_argument("--hb-period", type=float, default=0.05,
+                        help="per-node heartbeat period (s)")
+    parser.add_argument("--no-stop-at-knee", action="store_true",
+                        help="run the whole ladder even past divergence")
+    args = parser.parse_args(argv)
+    if args.fleets:
+        ladder = tuple(args.fleets)
+    elif args.quick:
+        ladder = (25, 100, 400)
+    else:
+        ladder = DEFAULT_LADDER
+    phase_s = args.phase_s or (2.0 if args.quick else 5.0)
+    result = run_bench(ladder, phase_s=phase_s, hb_period=args.hb_period,
+                       stop_at_knee=not args.no_stop_at_knee)
+    result["ts"] = time.time()
+    for k, v in result["metrics"].items():
+        print(json.dumps({"metric": k, "value": v}))
+    out = f"BENCH_CONTROL_r{args.round:02d}.json"
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    _stage(f"wrote {out} (knee at fleet="
+           f"{result['knee_fleet'] or 'not reached'})")
+
+
+if __name__ == "__main__":
+    main()

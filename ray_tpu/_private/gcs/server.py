@@ -30,6 +30,7 @@ from collections import defaultdict, deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set, Tuple
 
+from ray_tpu._private import metrics_defs as md
 from ray_tpu._private import rpc
 from ray_tpu.protobuf import ray_tpu_pb2 as pb
 
@@ -68,6 +69,18 @@ MAX_FREED_REMEMBERED = 65536
 # reconciled to FAILED (the client-side supervisor died with its process;
 # see job_submission.py + _reconcile_jobs).
 JOB_HEARTBEAT_TTL_S = 10.0
+
+
+class _SubEntry:
+    """One pubsub subscriber: its delivery queue plus the id publishes
+    attribute drops to (``SubscribeRequest.subscriber_id``, or a local
+    placeholder for anonymous streams)."""
+
+    __slots__ = ("q", "sub_id")
+
+    def __init__(self, q: "queue.Queue", sub_id: str):
+        self.q = q
+        self.sub_id = sub_id
 
 
 class GcsServer:
@@ -111,8 +124,13 @@ class GcsServer:
         # actors
         self._actors: Dict[bytes, pb.ActorInfo] = {}
         self._actor_names: Dict[Tuple[str, str], bytes] = {}
-        # pubsub
-        self._subscribers: Dict[str, List[queue.Queue]] = defaultdict(list)
+        # pubsub: channel -> subscriber entries (each one delivery queue
+        # + the subscriber's self-declared id for drop attribution). A
+        # subscriber whose queue reaches the cap stops receiving — the
+        # head must not buffer unboundedly for one wedged consumer.
+        self._subscribers: Dict[str, List["_SubEntry"]] = defaultdict(list)
+        self._pubsub_queue_max = int(os.environ.get(
+            "RAY_TPU_PUBSUB_QUEUE_MAX", 10000))
         # placement groups (+ ids with an in-flight _place_group run)
         self._pgroups: Dict[bytes, pb.PlacementGroupInfo] = {}
         self._placing: Set[bytes] = set()
@@ -444,8 +462,26 @@ class GcsServer:
     def _publish(self, channel: str, data: bytes):
         with self._lock:
             subs = list(self._subscribers.get(channel, []))
-        for q in subs:
-            q.put(pb.PubsubMessage(channel=channel, data=data))
+        md.GCS_PUBSUB_PUBLISHED.inc(1, tags={"channel": channel})
+        # Enqueue timestamp rides with the message; Subscribe observes
+        # the fan-out latency when the stream actually yields it.
+        t_enq = time.perf_counter()
+        deepest = 0
+        for ent in subs:
+            depth = ent.q.qsize()
+            if depth >= self._pubsub_queue_max:
+                # Slow-subscriber shed, attributed: dropping for ONE
+                # wedged consumer beats buffering the head into OOM or
+                # stalling every other subscriber's channel.
+                md.GCS_PUBSUB_DROPPED.inc(1, tags={
+                    "channel": channel, "subscriber": ent.sub_id})
+                if depth > deepest:  # a shedding queue is still deep
+                    deepest = depth
+                continue
+            if depth + 1 > deepest:
+                deepest = depth + 1
+            ent.q.put((t_enq, pb.PubsubMessage(channel=channel, data=data)))
+        md.GCS_PUBSUB_QUEUE_DEPTH.set(deepest, tags={"channel": channel})
 
     def _node_stub(self, node_id: str) -> Optional[rpc.Stub]:
         with self._lock:
@@ -563,6 +599,7 @@ class GcsServer:
         probe_backoff: Dict[str, float] = {}
         while not self._stop.wait(HEALTH_CHECK_PERIOD_S):
             tick += 1
+            t_tick = time.perf_counter()
             now = time.monotonic()
             lapsed = []
             stale_drivers = []
@@ -582,6 +619,8 @@ class GcsServer:
                 for hid, (_, _is_driver, seen) in self._holder_meta.items():
                     if now - seen > DRIVER_HOLDER_TTL_S:
                         stale_drivers.append(hid)
+            md.GCS_HEALTH_PROBE_BACKLOG.set(len(lapsed),
+                                            tags={"role": "head"})
             for node_id, address in lapsed:
                 # Lapsed heartbeats alone don't kill a node anymore: a
                 # direct liveness probe confirms first. Co-tenant CPU
@@ -620,6 +659,8 @@ class GcsServer:
                 self._reconcile_jobs()
             if tick % 120 == 0:  # ~minutely: ckpt TTLs are minutes
                 self._sweep_checkpoints()
+            md.GCS_HEALTH_TICK_SECONDS.observe(
+                time.perf_counter() - t_tick, tags={"role": "head"})
 
     def _probe_lapsed_node(self, node_id: str, address: str) -> None:
         """Confirm-then-reap: one cheap idempotent RPC against the
@@ -691,6 +732,7 @@ class GcsServer:
                     continue
                 self._kv[("job", job_id)] = value
                 self._wal_append(("kv", "job", job_id, value))
+            self._account_kv("put", "job", len(value))
             logger.warning("job %s reconciled to FAILED (client died)",
                            job_id)
             self._export_event("JOB_RECONCILED", job_id=job_id,
@@ -733,8 +775,10 @@ class GcsServer:
                 continue  # may still be filling in
             with self._lock:
                 for key, _ in entries:
-                    if self._kv.pop(("__ckpt__", key), None) is not None:
+                    old = self._kv.pop(("__ckpt__", key), None)
+                    if old is not None:
                         self._wal_append(("kv", "__ckpt__", key, None))
+                        self._account_kv("del", "__ckpt__", len(old))
                         deleted += 1
             run_step = prefix.rsplit("/", 1)
             self._export_event(
@@ -762,23 +806,42 @@ class GcsServer:
         self._on_node_dead(node_id)
 
     # ------------------------------------------------------------- kv
+    def _account_kv(self, op: str, ns: str, nbytes: int) -> None:
+        """THE KV accounting chokepoint (pinned by a tier-1 source lint):
+        every Kv* handler and every internal ``_kv`` mutation funnels its
+        op + payload bytes through here. Reserved ``__*__`` namespaces
+        keep their own label; everything else folds into ``user`` so the
+        tag stays bounded on clusters with arbitrary app namespaces."""
+        label = ns if (ns.startswith("__") and ns.endswith("__")) else "user"
+        md.GCS_KV_OPS.inc(1, tags={"op": op, "namespace": label})
+        if nbytes:
+            md.GCS_KV_BYTES.inc(nbytes, tags={"op": op, "namespace": label})
+
     def KvPut(self, request, context):
         if request.ns in ("__task_events__", "__memory__", "__events__",
                           "__metrics__"):
             # Reserved: reads in these namespaces serve the task-event ring
             # buffer / memory report, so stored values would be unreachable.
+            self._account_kv("put", request.ns, 0)
             return pb.KvReply(ok=False)
         key = (request.ns, request.key)
         with self._lock:
             if not request.overwrite and key in self._kv:
+                self._account_kv("put", request.ns, 0)
                 return pb.KvReply(ok=False)
             self._kv[key] = request.value
             # Inside the lock: the log order must match the apply order,
             # or replay can restore the losing value of a write race.
             self._wal_append(("kv", request.ns, request.key, request.value))
+        self._account_kv("put", request.ns, len(request.value))
         return pb.KvReply(ok=True)
 
     def KvGet(self, request, context):
+        reply = self._kv_get(request)
+        self._account_kv("get", request.ns, len(reply.value))
+        return reply
+
+    def _kv_get(self, request):
         if request.ns == "__task_events__":
             with self._lock:
                 events = list(self._task_events)
@@ -869,15 +932,18 @@ class GcsServer:
 
     def KvDel(self, request, context):
         with self._lock:
-            existed = self._kv.pop((request.ns, request.key), None) is not None
-            if existed:
+            old = self._kv.pop((request.ns, request.key), None)
+            if old is not None:
                 self._wal_append(("kv", request.ns, request.key, None))
-        return pb.KvReply(ok=existed)
+        self._account_kv("del", request.ns,
+                         len(old) if old is not None else 0)
+        return pb.KvReply(ok=old is not None)
 
     def KvKeys(self, request, context):
         with self._lock:
             keys = [k for ns, k in self._kv
                     if ns == request.ns and k.startswith(request.prefix)]
+        self._account_kv("keys", request.ns, sum(len(k) for k in keys))
         return pb.KvReply(keys=keys, ok=True)
 
     # ------------------------------------------------------------- actors
@@ -1184,22 +1250,28 @@ class GcsServer:
 
     def Subscribe(self, request, context):
         q: "queue.Queue" = queue.Queue()
+        ent = _SubEntry(q, request.subscriber_id or
+                        f"anon-{id(q) & 0xffffff:06x}")
         with self._lock:
             for ch in request.channels:
-                self._subscribers[ch].append(q)
+                self._subscribers[ch].append(ent)
         try:
             while not self._stop.is_set():
                 try:
-                    msg = q.get(timeout=0.5)
-                    yield msg
+                    t_enq, msg = q.get(timeout=0.5)
                 except queue.Empty:
                     if context is not None and not context.is_active():
                         break
+                    continue
+                md.GCS_PUBSUB_FANOUT_SECONDS.observe(
+                    time.perf_counter() - t_enq,
+                    tags={"channel": msg.channel})
+                yield msg
         finally:
             with self._lock:
                 for ch in request.channels:
-                    if q in self._subscribers.get(ch, []):
-                        self._subscribers[ch].remove(q)
+                    if ent in self._subscribers.get(ch, []):
+                        self._subscribers[ch].remove(ent)
 
     # ---------------------------------------------------- placement groups
     def CreatePlacementGroup(self, request, context):

@@ -32,6 +32,22 @@ logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<I")
 
+_MD: Any = None
+
+
+def _metrics():
+    """Lazy metric-catalog handle (import inside the first call: wal.py
+    sits below metrics_defs in the import graph and must load without
+    it, e.g. from standalone log-server tooling)."""
+    global _MD
+    if _MD is None:
+        try:
+            from ray_tpu._private import metrics_defs
+            _MD = metrics_defs
+        except Exception:  # noqa: BLE001 — metrics are optional here
+            _MD = False
+    return _MD or None
+
 
 def parse_records(data: bytes) -> Iterator[Tuple]:
     """Records from framed log bytes, tolerating a torn final record
@@ -83,6 +99,8 @@ class WriteAheadLog:
         # acknowledged durable by the backend.
         self._seq_queued = 0
         self._seq_durable = 0
+        self._backend_tag = type(self._backend).__name__
+        self._sync_timeout_logged = False
         self._size = len(self._backend.read_log())
         self._thread = threading.Thread(target=self._writer_loop,
                                         daemon=True, name="gcs-wal")
@@ -94,8 +112,15 @@ class WriteAheadLog:
         with self._cv:
             self._q.append(record)
             self._seq_queued += 1
-            if len(self._q) == 1:
+            depth = len(self._q)
+            lag = self._seq_queued - self._seq_durable
+            if depth == 1:
                 self._cv.notify()
+        m = _metrics()
+        if m is not None:
+            tags = {"backend": self._backend_tag}
+            m.GCS_WAL_QUEUE_DEPTH.set(depth, tags=tags)
+            m.GCS_WAL_WATERMARK_LAG.set(lag, tags=tags)
 
     def sync(self, timeout_s: float = 10.0) -> bool:
         """Block until every record queued BEFORE this call is durable in
@@ -109,6 +134,23 @@ class WriteAheadLog:
             while self._seq_durable < target:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    gap = target - self._seq_durable
+                    # Counted + logged inside sync() itself: most callers
+                    # ignore the bool, and a silent False here means the
+                    # caller may act on state the WAL never made durable.
+                    m = _metrics()
+                    if m is not None:
+                        m.GCS_WAL_SYNC_TIMEOUTS.inc(
+                            1, tags={"backend": self._backend_tag})
+                    if not self._sync_timeout_logged:
+                        self._sync_timeout_logged = True
+                        logger.warning(
+                            "WAL sync() timed out after %.1fs with %d "
+                            "record(s) queued but not durable (queued=%d "
+                            "durable=%d, backend=%s); further timeouts "
+                            "counted in ray_tpu_gcs_wal_sync_timeouts_total",
+                            timeout_s, gap, target, self._seq_durable,
+                            self._backend_tag)
                     return False
                 self._cv.wait(min(remaining, 0.05))
         return True
@@ -181,6 +223,7 @@ class WriteAheadLog:
             parts.append(_LEN.pack(len(blob)))
             parts.append(blob)
         data = b"".join(parts)
+        t0 = time.perf_counter()
         try:
             self._backend.append(data)
         except Exception:
@@ -193,14 +236,29 @@ class WriteAheadLog:
         self._size += len(data)
         with self._cv:
             self._seq_durable += len(batch)
+            depth = len(self._q)
+            lag = self._seq_queued - self._seq_durable
             self._cv.notify_all()  # wake sync() waiters
+        m = _metrics()
+        if m is not None:
+            tags = {"backend": self._backend_tag}
+            m.GCS_WAL_FSYNC_SECONDS.observe(time.perf_counter() - t0,
+                                            tags=tags)
+            m.GCS_WAL_QUEUE_DEPTH.set(depth, tags=tags)
+            m.GCS_WAL_WATERMARK_LAG.set(lag, tags=tags)
 
     def _compact(self) -> None:
         """Snapshot-then-truncate. Mutations racing the snapshot capture
         end up in both the snapshot and the next log batch — harmless,
         records are idempotent upserts."""
+        t0 = time.perf_counter()
         self._backend.install_snapshot(self._snapshot_fn())
         self._size = 0
+        m = _metrics()
+        if m is not None:
+            m.GCS_WAL_COMPACTION_SECONDS.observe(
+                time.perf_counter() - t0,
+                tags={"backend": self._backend_tag})
 
 
 __all__ = ["WriteAheadLog", "parse_records"]

@@ -583,3 +583,107 @@ PROFILE_CAPTURES = Counter(
     "ray_tpu_profile_captures_total",
     "jax.profiler trace captures executed by this process, by outcome",
     ("status",))
+
+# ------------------------------------- GCS head / control plane (L1 GCS)
+# Every global concern terminates on the head process; these series are
+# the measurement substrate for ROADMAP item 5 (head scale-out). The KV
+# namespace tag is bounded: reserved ``__*__`` namespaces keep their own
+# label, everything else folds into ``user``.
+GCS_KV_OPS = Counter(
+    "ray_tpu_gcs_kv_ops_total",
+    "GCS KV handler calls by operation (put/get/del/keys) and namespace "
+    "(reserved __*__ namespaces; all user namespaces fold into 'user')",
+    ("op", "namespace"))
+GCS_KV_BYTES = Counter(
+    "ray_tpu_gcs_kv_bytes_total",
+    "GCS KV payload bytes moved by operation and namespace (put = value "
+    "bytes written, get = value bytes returned, del = value bytes "
+    "released) — exact by construction, asserted by tier-1",
+    ("op", "namespace"))
+GCS_PUBSUB_PUBLISHED = Counter(
+    "ray_tpu_gcs_pubsub_published_total",
+    "Messages accepted by the head pubsub plane, per channel",
+    ("channel",))
+GCS_PUBSUB_FANOUT_SECONDS = Histogram(
+    "ray_tpu_gcs_pubsub_fanout_seconds",
+    "Publish -> subscriber-stream-delivery latency per channel (stamped "
+    "at enqueue inside Publish, observed when Subscribe yields the "
+    "message)",
+    boundaries=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+    tag_keys=("channel",))
+GCS_PUBSUB_QUEUE_DEPTH = Gauge(
+    "ray_tpu_gcs_pubsub_queue_depth",
+    "Deepest per-subscriber delivery queue per channel, sampled at "
+    "publish time (a growing depth names the slow consumer's channel)",
+    ("channel",))
+GCS_PUBSUB_DROPPED = Counter(
+    "ray_tpu_gcs_pubsub_dropped_total",
+    "Messages dropped for one slow subscriber whose delivery queue hit "
+    "RAY_TPU_PUBSUB_QUEUE_MAX, attributed to that subscriber id",
+    ("channel", "subscriber"))
+GCS_WAL_QUEUE_DEPTH = Gauge(
+    "ray_tpu_gcs_wal_queue_depth",
+    "Records buffered in the WAL append queue awaiting the writer "
+    "thread, by backend class",
+    ("backend",))
+GCS_WAL_WATERMARK_LAG = Gauge(
+    "ray_tpu_gcs_wal_watermark_lag",
+    "WAL queued-vs-durable sequence gap (records accepted but not yet "
+    "fsynced) — sustained growth means the drain cannot keep up",
+    ("backend",))
+GCS_WAL_FSYNC_SECONDS = Histogram(
+    "ray_tpu_gcs_wal_fsync_seconds",
+    "Wall time of one WAL drain batch write+fsync, by backend class",
+    boundaries=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5),
+    tag_keys=("backend",))
+GCS_WAL_COMPACTION_SECONDS = Histogram(
+    "ray_tpu_gcs_wal_compaction_seconds",
+    "Wall time of one WAL snapshot compaction (install_snapshot), by "
+    "backend class",
+    boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+    tag_keys=("backend",))
+GCS_WAL_SYNC_TIMEOUTS = Counter(
+    "ray_tpu_gcs_wal_sync_timeouts_total",
+    "WriteAheadLog.sync() calls that timed out before the durable "
+    "watermark caught up (callers that ignore the bool still get "
+    "counted here)",
+    ("backend",))
+GCS_HEALTH_TICK_SECONDS = Histogram(
+    "ray_tpu_gcs_health_tick_seconds",
+    "Wall time of one GCS health-loop tick (lapse scan + probe "
+    "scheduling + periodic reconcile/sweep work riding the tick)",
+    boundaries=(0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+    tag_keys=("role",))
+GCS_HEALTH_PROBE_BACKLOG = Gauge(
+    "ray_tpu_gcs_health_probe_backlog",
+    "Nodes with lapsed heartbeats pending a liveness probe, sampled "
+    "each health tick",
+    ("role",))
+
+# --------------------------------------- RPC saturation + client retries
+RPC_QUEUE_WAIT_SECONDS = Histogram(
+    "ray_tpu_rpc_queue_wait_seconds",
+    "Server-side request wait from executor enqueue to handler start, "
+    "per service — the saturation signal: diverges when the gRPC "
+    "thread pool is full",
+    boundaries=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0),
+    tag_keys=("service",))
+RPC_EXECUTOR_OCCUPANCY = Gauge(
+    "ray_tpu_rpc_executor_occupancy",
+    "Fraction of the service's gRPC thread pool currently running "
+    "handlers (1.0 = saturated; new requests queue)",
+    ("service",))
+RPC_ACTIVE_STREAMS = Gauge(
+    "ray_tpu_rpc_active_streams",
+    "Live server-streaming RPCs per service/method (Subscribe streams "
+    "hold a pool thread for their whole life)",
+    ("service", "method"))
+RPC_CLIENT_RETRIES = Counter(
+    "ray_tpu_rpc_client_retries_total",
+    "Client-stub retry attempts by service, method, and gRPC status "
+    "reason (an UNAVAILABLE storm against a restarting head shows up "
+    "here instead of as silent backoff)",
+    ("service", "method", "reason"))

@@ -96,6 +96,81 @@ def _latency_histogram():
         return _latency_hist
 
 
+_sat_metrics = None
+
+
+def _saturation_metrics():
+    """Lazy handle on the cataloged saturation/retry series (rpc.py sits
+    below metrics_defs in the import graph, so the import happens at
+    first use, same as :func:`_latency_histogram`)."""
+    global _sat_metrics
+    with _latency_lock:
+        if _sat_metrics is None:
+            try:
+                from ray_tpu._private import metrics_defs as md
+
+                _sat_metrics = {
+                    "queue_wait": md.RPC_QUEUE_WAIT_SECONDS,
+                    "occupancy": md.RPC_EXECUTOR_OCCUPANCY,
+                    "streams": md.RPC_ACTIVE_STREAMS,
+                    "retries": md.RPC_CLIENT_RETRIES,
+                }
+            except Exception:  # noqa: BLE001
+                return None
+        return _sat_metrics
+
+
+_stream_lock = threading.Lock()
+_stream_counts: Dict[tuple, int] = {}
+
+
+def _stream_delta(service: str, method: str, delta: int, gauge) -> None:
+    with _stream_lock:
+        key = (service, method)
+        n = _stream_counts.get(key, 0) + delta
+        _stream_counts[key] = n
+    gauge.set(n, tags={"service": service, "method": method})
+
+
+class _InstrumentedExecutor(futures.ThreadPoolExecutor):
+    """gRPC handler pool with saturation instrumentation: submit()
+    stamps its enqueue time and the wrapped work item observes the
+    enqueue->start queue-wait plus pool occupancy. Unlike the per-method
+    ``_timed`` wrapper this sees EVERY item the server runs — including
+    server-streaming handlers, which occupy a pool thread for the whole
+    stream life — so queue-wait divergence is the head's true
+    saturation signal."""
+
+    def __init__(self, max_workers: int, service_name: str):
+        super().__init__(max_workers=max_workers)
+        self._rt_service = service_name
+        self._rt_active = 0
+        self._rt_lock = threading.Lock()
+
+    def submit(self, fn, *args, **kwargs):
+        m = _saturation_metrics()
+        if m is None:
+            return super().submit(fn, *args, **kwargs)
+        t_enq = time.perf_counter()
+        tags = {"service": self._rt_service}
+
+        def run(*a, **kw):
+            with self._rt_lock:
+                self._rt_active += 1
+                active = self._rt_active
+            m["queue_wait"].observe(time.perf_counter() - t_enq, tags=tags)
+            m["occupancy"].set(active / self._max_workers, tags=tags)
+            try:
+                return fn(*a, **kw)
+            finally:
+                with self._rt_lock:
+                    self._rt_active -= 1
+                    active = self._rt_active
+                m["occupancy"].set(active / self._max_workers, tags=tags)
+
+        return super().submit(run, *args, **kwargs)
+
+
 def serve(service_name: str, handler_obj: Any, port: int = 0,
           host: str = "127.0.0.1", max_workers: int = 32):
     """Start a gRPC server exposing ``handler_obj``'s methods as ``service_name``.
@@ -125,9 +200,41 @@ def serve(service_name: str, handler_obj: Any, port: int = 0,
 
         return wrapper
 
+    def _timed_stream(fn, method_name):
+        """Server-streaming wrapper: ``_timed`` used to SKIP these, so
+        the head's longest-lived RPC (Subscribe) reported no latency or
+        count at all. Setup time (call -> iterator) lands in the latency
+        histogram — the stream body is the stream's whole life, not a
+        latency — and live streams are counted in
+        ray_tpu_rpc_active_streams."""
+        mtags = {"service": service_name, "method": method_name}
+
+        def wrapper(request, context):
+            t0 = time.perf_counter()
+            it = fn(request, context)
+            if latency is not None:
+                latency.observe(time.perf_counter() - t0, tags=mtags)
+            sat = _saturation_metrics()
+            if sat is None:
+                return it
+            _stream_delta(service_name, method_name, 1, sat["streams"])
+
+            def counted():
+                try:
+                    yield from it
+                finally:
+                    _stream_delta(service_name, method_name, -1,
+                                  sat["streams"])
+
+            return counted()
+
+        return wrapper
+
     for method in desc.methods:
         fn = getattr(handler_obj, method.name)
-        if not method.server_streaming:
+        if method.server_streaming:
+            fn = _timed_stream(fn, method.name)
+        else:
             fn = _timed(fn, method.name)
         in_cls = method.input_type._concrete_class
         out_cls = method.output_type._concrete_class
@@ -145,7 +252,7 @@ def serve(service_name: str, handler_obj: Any, port: int = 0,
             )
     generic = grpc.method_handlers_generic_handler(
         f"ray_tpu.rpc.{service_name}", handlers)
-    executor = futures.ThreadPoolExecutor(max_workers=max_workers)
+    executor = _InstrumentedExecutor(max_workers, service_name)
     server = grpc.server(
         executor,
         options=[("grpc.max_send_message_length", 512 * 1024 * 1024),
@@ -312,6 +419,14 @@ class Stub:
                     if code in retriable \
                             and attempt + 1 < self._max_attempts:
                         last = e
+                        sat = _saturation_metrics()
+                        if sat is not None:
+                            # Counted per retried attempt: an UNAVAILABLE
+                            # storm against a restarting head is visible
+                            # instead of silent backoff.
+                            sat["retries"].inc(1, tags={
+                                "service": self._service, "method": name,
+                                "reason": code.name.lower()})
                         time.sleep(min(0.05 * 2 ** attempt
                                        + random.uniform(0, 0.02), 1.0))
                         continue

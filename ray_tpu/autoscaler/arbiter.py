@@ -195,6 +195,43 @@ class InternalKv:
         return kv.internal_kv_list(prefix, namespace=self.namespace)
 
 
+class GrpcKv:
+    """The ``__pool__`` namespace over loopback gRPC: a PoolLedger
+    journaling straight against a GcsServer's Kv handlers, no runtime
+    required. This is how bench_control.py's arbiter ticks exercise the
+    REAL head KV/WAL path (and how an out-of-process arbiter would)."""
+
+    def __init__(self, address: str, namespace: str = POOL_KV_NS):
+        from ray_tpu._private import rpc
+
+        self.namespace = namespace
+        self._stub = rpc.get_stub("GcsService", address)
+
+    def get(self, key: str) -> Optional[bytes]:
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        reply = self._stub.KvGet(pb.KvRequest(ns=self.namespace, key=key))
+        return bytes(reply.value) if reply.found else None
+
+    def put(self, key: str, value: bytes) -> None:
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        self._stub.KvPut(pb.KvRequest(ns=self.namespace, key=key,
+                                      value=bytes(value), overwrite=True))
+
+    def delete(self, key: str) -> None:
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        self._stub.KvDel(pb.KvRequest(ns=self.namespace, key=key))
+
+    def keys(self, prefix: str = "") -> List[str]:
+        from ray_tpu.protobuf import ray_tpu_pb2 as pb
+
+        reply = self._stub.KvKeys(pb.KvRequest(ns=self.namespace,
+                                               prefix=prefix))
+        return list(reply.keys)
+
+
 # ----------------------------------------------------------------- ledger
 
 class PoolLedger:

@@ -103,6 +103,8 @@ step skew)</h2><div id="goodput"></div>
 <div id="elastic"></div>
 <h2>Pool / chip leases &amp; handoffs (serve&harr;train arbitration)</h2>
 <div id="pool"></div><table id="poolleases"></table>
+<h2>Head / control plane (KV by namespace, pubsub fan-out, WAL,
+RPC saturation)</h2><div id="head"></div>
 <h2>Cluster / flight recorder (causal control-plane events —
 ``ray-tpu why &lt;id&gt;`` walks a chain)</h2><table id="flight"></table>
 <h2>Metrics (last 5 min)</h2><div id="metrics"></div>
@@ -338,6 +340,19 @@ async function poolPanel(){
           .toLocaleTimeString():""})),
     ["lease","direction","chips","stage","deadline","since"]);
 }
+async function headPanel(){
+  // Head load plane: where the single control-plane process's capacity
+  // goes. KV ops/bytes by namespace name the chatty subsystem, pubsub
+  // fan-out latency + drops name the slow subscriber, WAL watermark lag
+  // says whether durability keeps up, and the rpc queue-wait/occupancy
+  // series are the saturation signal bench_control.py sweeps to a knee.
+  const gcs=await j("/api/v1/metrics/query?series=ray_tpu_gcs_*"+
+                    "&since=300&agg=avg&step=3&limit=40");
+  const rpc=await j("/api/v1/metrics/query?series=ray_tpu_rpc_*"+
+                    "&since=300&agg=avg&step=3&limit=20");
+  document.getElementById("head").innerHTML=
+    sparkRows(gcs.concat(rpc),60)||"(no head samples yet)";
+}
 async function flightPanel(){
   // Flight recorder: newest control-plane events (lease transitions,
   // drains, preemption notices, recoveries, chaos injections). The
@@ -432,6 +447,7 @@ async function refresh(){
     await goodputPanel();
     await elasticPanel();
     await poolPanel();
+    await headPanel();
     await flightPanel();
     await xlaPanel();
     document.getElementById("status").textContent=

@@ -720,6 +720,136 @@ def cmd_pool(args):
               f"{lease['stage']:<15} since {since}{deadline}{flight}")
 
 
+def cmd_head(args):
+    """``ray-tpu head top``: sorted live view of where the single head
+    process's capacity goes. Rates are window deltas over the
+    head-sampled TSDB series, so it works against any running cluster
+    with no support beyond the metric plane: KV ops+bytes/s by
+    namespace, pubsub publish rates / fan-out latency / slow-subscriber
+    drops, WAL queue+watermark health, and the gRPC saturation signals
+    (queue-wait, occupancy, active streams)."""
+    address = args.address or _auto_address()
+
+    def snapshot():
+        idx: dict = {}
+        for prefix in ("ray_tpu_gcs_*", "ray_tpu_rpc_*"):
+            for s in _metrics_kv(address, json.dumps(
+                    {"name": prefix, "since": args.since})):
+                idx.setdefault(s["name"], []).append(s)
+        return idx
+
+    def win(points):
+        """(window delta, window seconds, last value) for one series."""
+        if not points:
+            return 0.0, 0.0, 0.0
+        if len(points) == 1:
+            return 0.0, 0.0, points[0][1]
+        dv = max(points[-1][1] - points[0][1], 0.0)  # restart clamp
+        dt = points[-1][0] - points[0][0]
+        return dv, (dt if dt > 0 else 0.0), points[-1][1]
+
+    def rate(points):
+        dv, dt, _ = win(points)
+        return dv / dt if dt else 0.0
+
+    def rollup(idx, name, keys):
+        """tag-tuple -> (summed window rate, summed last value),
+        grouped by ``keys`` across pushing processes."""
+        out: dict = {}
+        for s in idx.get(name, ()):
+            k = tuple(s["labels"].get(t, "") for t in keys)
+            r, last = out.get(k, (0.0, 0.0))
+            out[k] = (r + rate(s["points"]), last + win(s["points"])[2])
+        return out
+
+    def mean_ms(idx, hist, keys):
+        """Windowed histogram mean (ms) per tag-tuple: rate(_sum) /
+        rate(_count); lifetime mean when the window saw nothing."""
+        sums = rollup(idx, hist + "_sum", keys)
+        counts = rollup(idx, hist + "_count", keys)
+        out = {}
+        for k, (cr, clast) in counts.items():
+            sr, slast = sums.get(k, (0.0, 0.0))
+            if cr > 0:
+                out[k] = sr / cr * 1000.0
+            elif clast > 0:
+                out[k] = slast / clast * 1000.0
+        return out
+
+    def section(title, rows):
+        if not rows:
+            return
+        print(title)
+        rows.sort(key=lambda r: -r[0])
+        for _, line in rows[:args.limit]:
+            print(line)
+
+    def show(idx):
+        print(f"head top @ {time.strftime('%H:%M:%S')}  "
+              f"(rate window {args.since:g}s)")
+        ops = rollup(idx, "ray_tpu_gcs_kv_ops_total", ("namespace", "op"))
+        byts = rollup(idx, "ray_tpu_gcs_kv_bytes_total",
+                      ("namespace", "op"))
+        section("kv (ops/s by namespace):", [
+            (r, f"  {ns:<14} {op:<5} {r:9.1f} ops/s "
+                f"{byts.get((ns, op), (0.0, 0.0))[0]:12,.0f} B/s  "
+                f"(lifetime {total:,.0f} ops)")
+            for (ns, op), (r, total) in ops.items()])
+        pub = rollup(idx, "ray_tpu_gcs_pubsub_published_total",
+                     ("channel",))
+        fan = mean_ms(idx, "ray_tpu_gcs_pubsub_fanout_seconds",
+                      ("channel",))
+        depth = rollup(idx, "ray_tpu_gcs_pubsub_queue_depth", ("channel",))
+        section("pubsub (published/s by channel):", [
+            (r, f"  {ch:<14} {r:9.1f} msg/s  "
+                f"fanout {fan.get((ch,), 0.0):8.2f} ms  "
+                f"queue {depth.get((ch,), (0, 0))[1]:.0f}")
+            for (ch,), (r, _t) in pub.items()])
+        drops = rollup(idx, "ray_tpu_gcs_pubsub_dropped_total",
+                       ("channel", "subscriber"))
+        section("pubsub drops (slow subscribers):", [
+            (total, f"  {ch:<14} {sub:<24} dropped {total:,.0f} "
+                    f"({r:.1f}/s)")
+            for (ch, sub), (r, total) in drops.items() if total > 0])
+        lag = rollup(idx, "ray_tpu_gcs_wal_watermark_lag", ("backend",))
+        fsync = mean_ms(idx, "ray_tpu_gcs_wal_fsync_seconds", ("backend",))
+        touts = rollup(idx, "ray_tpu_gcs_wal_sync_timeouts_total",
+                       ("backend",))
+        section("wal:", [
+            (lg, f"  {be:<20} watermark lag {lg:6.0f}  "
+                 f"fsync {fsync.get((be,), 0.0):8.2f} ms  "
+                 f"sync timeouts {touts.get((be,), (0, 0))[1]:.0f}")
+            for (be,), (_r, lg) in lag.items()])
+        qwait = mean_ms(idx, "ray_tpu_rpc_queue_wait_seconds",
+                        ("service",))
+        occ = rollup(idx, "ray_tpu_rpc_executor_occupancy", ("service",))
+        section("rpc (queue-wait by service):", [
+            (ms, f"  {svc:<20} queue-wait {ms:8.2f} ms  "
+                 f"occupancy {occ.get((svc,), (0, 0))[1]:.2f}")
+            for (svc,), ms in qwait.items()])
+        streams = rollup(idx, "ray_tpu_rpc_active_streams",
+                         ("service", "method"))
+        section("rpc streams:", [
+            (n, f"  {svc}.{meth:<18} active {n:.0f}")
+            for (svc, meth), (_r, n) in streams.items() if n > 0])
+        retries = rollup(idx, "ray_tpu_rpc_client_retries_total",
+                         ("service", "method", "reason"))
+        section("client retries:", [
+            (total, f"  {svc}.{meth} [{reason}]  {total:,.0f} ({r:.1f}/s)")
+            for (svc, meth, reason), (r, total) in retries.items()
+            if total > 0])
+
+    try:
+        while True:
+            show(snapshot())
+            if args.once:
+                return
+            time.sleep(args.interval)
+            print()
+    except KeyboardInterrupt:
+        return
+
+
 def cmd_logs(args):
     """Tail cluster logs (reference: ``ray logs`` + the dashboard log
     viewer over the LOG pubsub channel)."""
@@ -1090,6 +1220,21 @@ def main(argv=None):
     p.add_argument("--address")
     p.add_argument("--format", choices=["table", "json"], default="table")
     p.set_defaults(fn=cmd_pool)
+
+    p = sub.add_parser("head",
+                       help="head control-plane load: KV by namespace, "
+                            "pubsub fan-out, WAL health, RPC saturation")
+    p.add_argument("action", choices=["top"])
+    p.add_argument("--address")
+    p.add_argument("--since", type=float, default=60.0,
+                   help="rate window seconds (default 60)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="print one snapshot and exit")
+    p.add_argument("--limit", type=int, default=30,
+                   help="max rows per section (default 30)")
+    p.set_defaults(fn=cmd_head)
 
     p = sub.add_parser("logs", help="tail worker logs (or one job's logs)")
     p.add_argument("--address")

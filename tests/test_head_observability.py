@@ -1,0 +1,361 @@
+"""Control-plane observability: per-namespace KV accounting, pubsub
+fan-out + slow-subscriber shed, WAL watermark health, RPC saturation
+signals, the ``ray-tpu head top`` CLI, and the bench_control smoke.
+
+Everything here reads the REAL metric objects in
+``ray_tpu._private.metrics_defs`` via before/after deltas — the registry
+is process-global and other tests touch the same series, so absolute
+values are never asserted.
+"""
+
+import json
+import pickle
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import metrics_defs as md
+from ray_tpu._private import rpc
+from ray_tpu._private.gcs.server import GcsServer
+from ray_tpu.protobuf import ray_tpu_pb2 as pb
+from ray_tpu.util.metrics import Histogram
+
+
+def _val(metric, **tags):
+    """Current value of one (metric, tags) sample; 0.0 when unset."""
+    want = tuple(sorted(tags.items()))
+    for _name, key, value in metric.samples():
+        if tuple(sorted(key)) == want:
+            return value
+    return 0.0
+
+
+def _hist_count(hist: Histogram, tags=None) -> float:
+    _bounds, _counts, total = hist.bucket_snapshot(tags)
+    return total
+
+
+@pytest.fixture()
+def gcs():
+    server = GcsServer(port=0)
+    yield server
+    server.shutdown()
+
+
+# ------------------------------------------------------------------ KV
+def test_kv_namespace_accounting_exact(gcs):
+    """Byte counters must agree exactly with the bytes moved: puts count
+    the stored value, gets the returned value, dels the evicted value,
+    keys the returned key bytes. Internal namespaces keep their label;
+    arbitrary job namespaces collapse to "user" (cardinality bound)."""
+    ns = "__serve__"
+    ops0 = {op: _val(md.GCS_KV_OPS, op=op, namespace=ns)
+            for op in ("put", "get", "del", "keys")}
+    by0 = {op: _val(md.GCS_KV_BYTES, op=op, namespace=ns)
+           for op in ("put", "get", "del", "keys")}
+    value = b"x" * 100
+    assert gcs.KvPut(pb.KvRequest(ns=ns, key="acct", value=value,
+                                  overwrite=True), None).ok
+    reply = gcs.KvGet(pb.KvRequest(ns=ns, key="acct"), None)
+    assert reply.found and len(reply.value) == 100
+    keys = gcs.KvKeys(pb.KvRequest(ns=ns, prefix=""), None).keys
+    assert list(keys) == ["acct"]
+    assert gcs.KvDel(pb.KvRequest(ns=ns, key="acct"), None).ok
+
+    for op in ("put", "get", "del", "keys"):
+        assert _val(md.GCS_KV_OPS, op=op, namespace=ns) - ops0[op] == 1.0
+    for op in ("put", "get", "del"):
+        assert _val(md.GCS_KV_BYTES, op=op, namespace=ns) - by0[op] == 100.0
+    assert (_val(md.GCS_KV_BYTES, op="keys", namespace=ns)
+            - by0["keys"]) == float(len("acct"))
+
+    # Job namespaces are unbounded user input -> one "user" label.
+    user0 = _val(md.GCS_KV_OPS, op="put", namespace="user")
+    gcs.KvPut(pb.KvRequest(ns="job-20260807-abc", key="k", value=b"v",
+                           overwrite=True), None)
+    assert _val(md.GCS_KV_OPS, op="put", namespace="user") - user0 == 1.0
+    assert _val(md.GCS_KV_OPS, op="put", namespace="job-20260807-abc") \
+        == 0.0
+
+
+def test_kv_get_miss_accounts_zero_bytes(gcs):
+    ops0 = _val(md.GCS_KV_OPS, op="get", namespace="__serve__")
+    by0 = _val(md.GCS_KV_BYTES, op="get", namespace="__serve__")
+    assert not gcs.KvGet(pb.KvRequest(ns="__serve__", key="absent"),
+                         None).found
+    assert _val(md.GCS_KV_OPS, op="get", namespace="__serve__") - ops0 \
+        == 1.0
+    assert _val(md.GCS_KV_BYTES, op="get", namespace="__serve__") == by0
+
+
+# -------------------------------------------------------------- pubsub
+def test_pubsub_fanout_and_slow_subscriber_drops(gcs):
+    """One wedged subscriber sheds with per-subscriber attribution while
+    the fan-out latency of delivered messages is observed; the channel
+    depth gauge reports the wedged queue, not 0."""
+    gcs._pubsub_queue_max = 3
+    channel = "HEADOBS"
+    drops0 = _val(md.GCS_PUBSUB_DROPPED, channel=channel,
+                  subscriber="slow-sub")
+    pub0 = _val(md.GCS_PUBSUB_PUBLISHED, channel=channel)
+    fan0 = _hist_count(md.GCS_PUBSUB_FANOUT_SECONDS,
+                       {"channel": channel})
+
+    stream = gcs.Subscribe(pb.SubscribeRequest(
+        channels=[channel], subscriber_id="slow-sub"), None)
+    got = []
+    t = threading.Thread(target=lambda: got.append(next(stream)),
+                         daemon=True)
+    t.start()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        with gcs._lock:
+            if gcs._subscribers.get(channel):
+                break
+        time.sleep(0.01)
+    else:
+        pytest.fail("subscriber never registered")
+    gcs._publish(channel, b"m0")
+    t.join(timeout=5.0)
+    assert got and got[0].data == b"m0"
+
+    # The consumer is now suspended at the yield: its queue fills to
+    # queue_max, then every further publish sheds with attribution.
+    for i in range(10):
+        gcs._publish(channel, b"m%d" % i)
+    assert _val(md.GCS_PUBSUB_DROPPED, channel=channel,
+                subscriber="slow-sub") - drops0 == 7.0
+    assert _val(md.GCS_PUBSUB_PUBLISHED, channel=channel) - pub0 == 11.0
+    assert _hist_count(md.GCS_PUBSUB_FANOUT_SECONDS,
+                       {"channel": channel}) - fan0 >= 1.0
+    assert _val(md.GCS_PUBSUB_QUEUE_DEPTH, channel=channel) == 3.0
+    stream.close()
+    with gcs._lock:
+        assert not gcs._subscribers.get(channel)
+
+
+# ----------------------------------------------------------------- WAL
+class _StallBackend:
+    """WalBackend whose append blocks until released — a wedged disk or
+    unreachable remote log server."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.appended = []
+
+    def append(self, data):
+        assert self.release.wait(30.0), "stall never released"
+        self.appended.append(data)
+
+    def read_log(self):
+        return b"".join(self.appended)
+
+    def load_snapshot(self):
+        return None
+
+    def install_snapshot(self, blob):
+        pass
+
+    def close(self):
+        pass
+
+
+def test_wal_watermark_lag_and_sync_timeout_under_stalled_drain():
+    from ray_tpu._private.gcs.wal import WriteAheadLog
+    from ray_tpu._private.gcs.wal_backend import WalBackend
+
+    WalBackend.register(_StallBackend)
+    backend = _StallBackend()
+    t0 = _val(md.GCS_WAL_SYNC_TIMEOUTS, backend="_StallBackend")
+    fs0 = _hist_count(md.GCS_WAL_FSYNC_SECONDS,
+                      {"backend": "_StallBackend"})
+    wal = WriteAheadLog(backend, snapshot_fn=lambda: b"",
+                        compact_threshold=1 << 30)
+    try:
+        for i in range(5):
+            wal.append(("rec", i))
+        # Queued-vs-durable watermark diverges while the drain is wedged.
+        assert _val(md.GCS_WAL_WATERMARK_LAG,
+                    backend="_StallBackend") == 5.0
+        assert wal.sync(timeout_s=0.3) is False
+        assert _val(md.GCS_WAL_SYNC_TIMEOUTS,
+                    backend="_StallBackend") - t0 == 1.0
+        backend.release.set()
+        assert wal.sync(timeout_s=10.0) is True
+        assert _val(md.GCS_WAL_WATERMARK_LAG,
+                    backend="_StallBackend") == 0.0
+        assert _hist_count(md.GCS_WAL_FSYNC_SECONDS,
+                           {"backend": "_StallBackend"}) - fs0 >= 1.0
+        assert backend.appended, "released drain never reached backend"
+    finally:
+        backend.release.set()
+        wal.close()
+
+
+# ------------------------------------------------- RPC saturation plane
+class _SlowKvServicer:
+    """Only KvGet is real (slow on purpose); every other GcsService
+    method resolves to an unreachable stub so rpc.serve can bind the
+    full service descriptor."""
+
+    def KvGet(self, request, context):
+        time.sleep(0.2)
+        return pb.KvReply(found=False)
+
+    def __getattr__(self, name):
+        def _unimplemented(request, context):
+            raise NotImplementedError(name)
+
+        return _unimplemented
+
+
+def test_queue_wait_divergence_on_saturated_pool():
+    """6 concurrent 200ms handlers against a 2-thread pool: the last
+    arrivals wait ~2 service times in the queue, and that wait lands in
+    ray_tpu_rpc_queue_wait_seconds for the service."""
+    tags = {"service": "GcsService"}
+    bounds, before, _ = md.RPC_QUEUE_WAIT_SECONDS.bucket_snapshot(tags)
+    server, port = rpc.serve("GcsService", _SlowKvServicer(),
+                             max_workers=2)
+    address = f"127.0.0.1:{port}"
+    try:
+        stub = rpc.get_stub("GcsService", address)
+        errors = []
+
+        def call():
+            try:
+                stub.KvGet(pb.KvRequest(ns="t", key="k"), timeout=30.0)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=call) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        assert not errors
+    finally:
+        server.stop(grace=0.2)
+        rpc.drop_stub("GcsService", address)
+    bounds2, after, _ = md.RPC_QUEUE_WAIT_SECONDS.bucket_snapshot(tags)
+    delta = [a - b for a, b in zip(after, before)]
+    assert sum(delta) >= 6
+    p95 = Histogram.percentile_from(bounds2, delta, 0.95)
+    assert p95 is not None and p95 >= 0.05, \
+        f"queue-wait p95 {p95} shows no saturation"
+
+
+def test_streaming_rpcs_are_timed_and_counted(gcs):
+    """Satellite #1 regression: server-streaming handlers must appear in
+    the handler-latency histogram and the active-streams gauge."""
+    address = f"127.0.0.1:{gcs.port}"
+    hist = rpc._latency_histogram()
+    tags = {"service": "GcsService", "method": "Subscribe"}
+    n0 = _hist_count(hist, tags)
+    stub = rpc.get_stub("GcsService", address)
+    stream = stub.Subscribe(pb.SubscribeRequest(
+        channels=["HEADOBS2"], subscriber_id="count-me"), timeout=3600.0)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if _val(md.RPC_ACTIVE_STREAMS, service="GcsService",
+                method="Subscribe") >= 1.0:
+            break
+        time.sleep(0.02)
+    assert _val(md.RPC_ACTIVE_STREAMS, service="GcsService",
+                method="Subscribe") >= 1.0
+    assert _hist_count(hist, tags) - n0 >= 1.0
+    stream.cancel()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        with gcs._lock:
+            if not gcs._subscribers.get("HEADOBS2"):
+                break
+        time.sleep(0.02)
+    rpc.drop_stub("GcsService", address)
+
+
+def test_client_retries_counted_by_reason():
+    """Satellite #2: each retried attempt lands in
+    ray_tpu_rpc_client_retries_total with the gRPC code as the reason."""
+    before = _val(md.RPC_CLIENT_RETRIES, service="GcsService",
+                  method="KvGet", reason="unavailable")
+    address = "127.0.0.1:1"  # nothing listens: UNAVAILABLE every attempt
+    stub = rpc.get_stub("GcsService", address)
+    with pytest.raises(Exception):
+        stub.KvGet(pb.KvRequest(ns="t", key="k"), timeout=5.0)
+    rpc.drop_stub("GcsService", address)
+    # max_attempts - 1 retries minimum (idempotent accessor).
+    assert _val(md.RPC_CLIENT_RETRIES, service="GcsService",
+                method="KvGet", reason="unavailable") - before >= 2.0
+
+
+# ------------------------------------------------------ CLI + dashboard
+def test_head_top_cli_roundtrip(gcs, capsys):
+    """`ray-tpu head top --once` against a live head: handlers move
+    bytes, the head samples its own registry into the TSDB, and the CLI
+    renders per-namespace rates from the __metrics__ read path."""
+    from ray_tpu.scripts import cli
+    from ray_tpu.util import metrics
+
+    # Over real gRPC so the executor's queue-wait series exists before
+    # the ingest below (the CLI renders an rpc section from it).
+    stub = rpc.get_stub("GcsService", f"127.0.0.1:{gcs.port}")
+    stub.KvPut(pb.KvRequest(ns="__serve__", key="cli-probe",
+                            value=b"y" * 64, overwrite=True))
+    # Deterministic ingest (the sampler thread ticks on its own clock).
+    gcs._tsdb.ingest(metrics.collect_samples(), labels={"role": "head"},
+                     ts=time.time())
+    cli.main(["head", "top", "--once",
+              "--address", f"127.0.0.1:{gcs.port}"])
+    out = capsys.readouterr().out
+    assert "head top @" in out
+    assert "kv (ops/s by namespace):" in out
+    assert "__serve__" in out
+    assert "rpc (queue-wait by service):" in out
+    rpc.drop_stub("GcsService", f"127.0.0.1:{gcs.port}")
+
+
+def test_dashboard_head_panel_and_metrics_query_path(gcs):
+    """The dashboard's head panel exists and its query (prefix match on
+    ray_tpu_gcs_*) returns series through the __metrics__ KV path."""
+    from ray_tpu import dashboard
+    from ray_tpu.util import metrics
+
+    assert 'id="head"' in dashboard._INDEX_HTML
+    assert "headPanel" in dashboard._INDEX_HTML
+    assert "ray_tpu_gcs_*" in dashboard._INDEX_HTML
+    gcs.KvPut(pb.KvRequest(ns="__serve__", key="dash-probe", value=b"z",
+                           overwrite=True), None)
+    gcs._tsdb.ingest(metrics.collect_samples(), labels={"role": "head"},
+                     ts=time.time())
+    reply = gcs.KvGet(pb.KvRequest(ns="__metrics__", key=json.dumps(
+        {"name": "ray_tpu_gcs_*", "since": 300})), None)
+    assert reply.found
+    series = pickle.loads(reply.value)
+    names = {s["name"] for s in series}
+    assert any(n.startswith("ray_tpu_gcs_kv_ops_total") for n in names)
+
+
+# ------------------------------------------------------------ the bench
+def test_bench_control_smoke():
+    """Toy two-rung sweep over the real loopback paths: heartbeats flow,
+    both __serve__ and __pool__ namespaces take KV load, the arbiter
+    completes full lease cycles, and subscribers consume the fan-out."""
+    import bench_control
+
+    result = bench_control.run_bench((4, 8), phase_s=0.8, hb_period=0.1,
+                                     arbiters=1, stop_at_knee=False)
+    assert len(result["phases"]) == 2
+    for phase in result["phases"]:
+        assert phase["heartbeats_per_s"] > 0
+        assert phase["delivered_per_s"] > 0
+        assert phase["arbiter_ticks"] >= 1
+    last = result["phases"][-1]
+    assert "__serve__" in last["kv_ops_per_s"]
+    assert "__pool__" in last["kv_ops_per_s"]
+    for key in ("control_knee_fleet", "control_peak_heartbeats_per_s",
+                "control_peak_kv_ops_per_s", "control_fanout_p95_s",
+                "control_wal_fsync_p95_s", "control_queue_wait_p95_s"):
+        assert key in result["metrics"]
+    assert result["metrics"]["control_peak_heartbeats_per_s"] > 0

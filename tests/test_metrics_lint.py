@@ -633,3 +633,104 @@ def test_flight_recorder_series_and_emit_sites_are_pinned():
     from ray_tpu.scripts import cli
 
     assert callable(cli.cmd_why)
+
+
+def test_head_control_plane_series_are_cataloged():
+    """The head-load observability series (per-namespace KV accounting,
+    pubsub fan-out/drops, WAL health, RPC saturation + client retries)
+    ship described + tagged in the catalog — the dashboard 'Head /
+    control plane' panel, `ray-tpu head top`, and bench_control.py read
+    them."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_gcs_kv_ops_total",
+        "ray_tpu_gcs_kv_bytes_total",
+        "ray_tpu_gcs_pubsub_published_total",
+        "ray_tpu_gcs_pubsub_fanout_seconds",
+        "ray_tpu_gcs_pubsub_queue_depth",
+        "ray_tpu_gcs_pubsub_dropped_total",
+        "ray_tpu_gcs_wal_queue_depth",
+        "ray_tpu_gcs_wal_watermark_lag",
+        "ray_tpu_gcs_wal_fsync_seconds",
+        "ray_tpu_gcs_wal_compaction_seconds",
+        "ray_tpu_gcs_wal_sync_timeouts_total",
+        "ray_tpu_gcs_health_tick_seconds",
+        "ray_tpu_gcs_health_probe_backlog",
+        "ray_tpu_rpc_queue_wait_seconds",
+        "ray_tpu_rpc_executor_occupancy",
+        "ray_tpu_rpc_active_streams",
+        "ray_tpu_rpc_client_retries_total",
+    }
+    missing = required - names
+    assert not missing, (
+        f"head control-plane series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name.startswith("ray_tpu_gcs_kv_"):
+            assert {"op", "namespace"} <= set(m.tag_keys), m.name
+        if m.name.startswith("ray_tpu_gcs_pubsub_"):
+            assert "channel" in m.tag_keys, m.name
+        if m.name == "ray_tpu_gcs_pubsub_dropped_total":
+            # Slow-subscriber sheds must be attributable.
+            assert "subscriber" in m.tag_keys
+        if m.name.startswith("ray_tpu_gcs_wal_"):
+            assert "backend" in m.tag_keys, m.name
+        if m.name in ("ray_tpu_rpc_queue_wait_seconds",
+                      "ray_tpu_rpc_executor_occupancy"):
+            assert "service" in m.tag_keys, m.name
+        if m.name == "ray_tpu_rpc_client_retries_total":
+            assert {"service", "method", "reason"} <= set(m.tag_keys)
+    # The dashboard renders the plane and the CLI summarises it.
+    from ray_tpu import dashboard
+    from ray_tpu.scripts import cli
+
+    assert 'id="head"' in dashboard._INDEX_HTML
+    assert callable(cli.cmd_head)
+
+
+def test_gcs_kv_mutations_go_through_the_accounting_helper():
+    """Source lint: EVERY function in gcs/server.py that mutates the raw
+    ``self._kv`` dict must call ``self._account_kv(`` (or be a recovery
+    path that replays already-accounted history), and all four Kv*
+    handlers must account. A mutation outside the helper silently skews
+    the per-namespace ops/bytes ledger that capacity planning
+    (bench_control's knee) is read against."""
+    import pathlib
+    import re
+
+    import ray_tpu
+
+    path = pathlib.Path(ray_tpu.__file__).parent / "_private" / "gcs" / \
+        "server.py"
+    src = path.read_text()
+    # Recovery/bootstrap paths replay history whose original mutations
+    # were accounted when they first happened.
+    replay_allowed = {"__init__", "_load_snapshot", "_apply_wal_record"}
+    mutation = re.compile(
+        r"self\._kv\[[^\]]*\]\s*=|self\._kv\.(pop|setdefault|update|"
+        r"clear)\(")
+    bodies: dict = {}
+    current_def = "<module>"
+    for line in src.splitlines():
+        stripped = line.strip()
+        if stripped.startswith(("def ", "async def ")):
+            current_def = stripped.split("def ", 1)[1].split("(")[0]
+        bodies.setdefault(current_def, []).append(
+            stripped.split("#", 1)[0])
+    for fn, lines in bodies.items():
+        body = "\n".join(lines)
+        if not mutation.search(body):
+            continue
+        if fn in replay_allowed:
+            continue
+        assert "self._account_kv(" in body, (
+            f"gcs/server.py: {fn!r} mutates self._kv without calling "
+            f"self._account_kv — per-namespace accounting would drift")
+    # The four handlers all account (KvGet via its accounting wrapper).
+    for handler in ("KvPut", "KvGet", "KvDel", "KvKeys"):
+        assert handler in bodies, f"handler {handler} vanished"
+        assert "self._account_kv(" in "\n".join(bodies[handler]), (
+            f"{handler} no longer routes through self._account_kv")
+    # Namespace labels stay bounded: user namespaces collapse.
+    helper = "\n".join(bodies.get("_account_kv", []))
+    assert '"user"' in helper, (
+        "_account_kv lost the user-namespace cardinality collapse")
