@@ -101,6 +101,11 @@ Actions:
                     raises :class:`SimulatedProcessDeath`; the restarted
                     arbiter must resume (or roll back) every lease
                     mid-flight from the journal.
+``perturb_learner`` cooperative: the matched learner (``rank=N``) adds
+                    ``eps`` (default 1e-3) to the weights it REPORTS at
+                    the ``learner_weights`` site — silent replica
+                    divergence that the LearnerGroup's cross-learner
+                    bit-identity check must catch.
 =================  =========================================================
 
 Matching keys (all optional): ``rank``, ``step``, ``proc``, ``node``,
@@ -169,12 +174,15 @@ _ACTION_SITES = {
     "preempt_node": "pool_handoff",
     "kill_arbiter": "pool_tick",
     "fail_create_node": "provider_create",
+    # RL / learner-plane sites (ray_tpu/rllib, ray_tpu/rl): replica
+    # divergence faults.
+    "perturb_learner": "learner_weights",
 }
 _MATCH_KEYS = ("rank", "step", "proc", "node", "run", "phase", "token",
                "stage", "tick")
 _INT_PARAMS = ("rank", "step", "proc", "times", "resize", "world", "token",
                "tick")
-_FLOAT_PARAMS = ("secs", "p", "jitter")
+_FLOAT_PARAMS = ("secs", "p", "jitter", "eps")
 
 
 class ChaosRule:
@@ -448,6 +456,11 @@ def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
         directives["preempted_node"] = target
     elif action == "fail_create_node":
         raise RuntimeError(f"chaos fail_create_node at {coords}")
+    elif action == "perturb_learner":
+        # Cooperative: the matched learner nudges its reported weights by
+        # eps — the fault the LearnerGroup cross-learner bit-identity
+        # check exists to catch (silent replica divergence).
+        directives["perturb"] = float(rule.params.get("eps", 1e-3))
 
 
 def _publish_resize(world_target: int, reason: str) -> None:

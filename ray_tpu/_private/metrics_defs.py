@@ -508,6 +508,43 @@ CKPT_PREEMPT_NOTICES = Counter(
     "(local/publish/pubsub)",
     ("source",))
 
+# --------------------------------------------- RL weight-sync plane (rl/)
+RL_SYNC_SECONDS = Histogram(
+    "ray_tpu_rl_weight_sync_seconds",
+    "Wall time of one weight-sync hop, by path (publish: trainer manifest "
+    "build + checkpoint persist + channel write; subscribe: channel read + "
+    "crc verify + reshard; fallback: checkpoint-plane restore when the "
+    "fast path is unavailable)",
+    boundaries=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 30.0),
+    tag_keys=("run", "path"))
+RL_SYNC_BYTES = Counter(
+    "ray_tpu_rl_weight_sync_bytes_total",
+    "Weight bytes moved by the sync plane, by path "
+    "(publish/subscribe/fallback)",
+    ("run", "path"))
+RL_VERSION = Gauge(
+    "ray_tpu_rl_weight_sync_version",
+    "Latest weight version seen, by role (trainer: last published; "
+    "generator: version live in the serving engine) — the trainer/"
+    "generator gap is the sync lag in versions",
+    ("run", "role"))
+RL_ROLLOUT_STALENESS = Gauge(
+    "ray_tpu_rl_rollout_staleness",
+    "Worst sequence staleness (trainer version minus producing weight "
+    "version) in the most recent generation phase",
+    ("run",))
+RL_SWAPS = Counter(
+    "ray_tpu_rl_weight_swaps_total",
+    "Generator weight swaps applied at a tick boundary, by cause "
+    "(publish/fallback/restore)",
+    ("run", "cause"))
+RL_SYNC_SHED = Counter(
+    "ray_tpu_rl_weight_sync_shed_total",
+    "Published versions a lagging subscriber never acked before the "
+    "writer overwrote them (shed-with-attribution: the subscriber tag "
+    "names the laggard; it re-converges via the checkpoint fallback)",
+    ("run", "subscriber"))
+
 # --------------------------------------- autoscaler reconciler (L7)
 AUTOSCALER_ALLOC_FAILURES = Counter(
     "ray_tpu_autoscaler_allocation_failures_total",

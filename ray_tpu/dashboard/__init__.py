@@ -103,6 +103,8 @@ step skew)</h2><div id="goodput"></div>
 <div id="elastic"></div>
 <h2>Pool / chip leases &amp; handoffs (serve&harr;train arbitration)</h2>
 <div id="pool"></div><table id="poolleases"></table>
+<h2>RL / weight sync &amp; rollout (trainer&rarr;generator versions,
+staleness, swaps)</h2><div id="rl"></div>
 <h2>Head / control plane (KV by namespace, pubsub fan-out, WAL,
 RPC saturation)</h2><div id="head"></div>
 <h2>Cluster / flight recorder (causal control-plane events —
@@ -340,6 +342,18 @@ async function poolPanel(){
           .toLocaleTimeString():""})),
     ["lease","direction","chips","stage","deadline","since"]);
 }
+async function rlPanel(){
+  // RL post-training loop: the trainer/generator version gauges moving
+  // in lockstep say the sync plane is live (a widening gap IS the sync
+  // lag); sync seconds/bytes split by path (publish vs subscribe vs
+  // checkpoint fallback); rollout staleness is how off-policy the
+  // experience stream is; swaps_total{cause} says how each generator
+  // got its weights; shed_total{subscriber} names a lagging replica.
+  const series=await j("/api/v1/metrics/query?series=ray_tpu_rl_*"+
+                       "&since=300&agg=last&step=3&limit=30");
+  document.getElementById("rl").innerHTML=
+    sparkRows(series,40)||"(no RL weight-sync activity)";
+}
 async function headPanel(){
   // Head load plane: where the single control-plane process's capacity
   // goes. KV ops/bytes by namespace name the chatty subsystem, pubsub
@@ -447,6 +461,7 @@ async function refresh(){
     await goodputPanel();
     await elasticPanel();
     await poolPanel();
+    await rlPanel();
     await headPanel();
     await flightPanel();
     await xlaPanel();

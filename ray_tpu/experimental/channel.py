@@ -277,6 +277,32 @@ class Channel:
                 raise ChannelTimeout(f"read timed out on {self.name}")
             time.sleep(0.0001)
 
+    # ------------------------------------------------------ observability
+    def reader_acks(self) -> tuple:
+        """``(version, [ack_0 .. ack_{n-1}])`` snapshot of the header.
+
+        A reader whose ack trails ``version`` has not consumed the
+        current value. Works for both backends: the fallback reads its
+        own mmap; a native-handle holder re-reads the backing shm file
+        (identical byte layout) so no new C entry point is needed.
+        """
+        if self._mm is not None:
+            ver = self._fb_version()
+            acks = struct.unpack_from(f"<{self.n_readers}Q", self._mm,
+                                      _ACKS_OFF)
+        else:
+            with open(f"/dev/shm{self.name}", "rb") as f:
+                hdr = f.read(_ACKS_OFF + 8 * 16)
+            ver = struct.unpack_from("<Q", hdr, _VER_OFF)[0]
+            acks = struct.unpack_from(f"<{self.n_readers}Q", hdr, _ACKS_OFF)
+        return ver, list(acks[:self.n_readers])
+
+    def lagging_readers(self) -> List[int]:
+        """Reader indices that have not acked the latest written version
+        (shed attribution: who is holding the writer back)."""
+        ver, acks = self.reader_acks()
+        return [i for i, a in enumerate(acks) if a < ver]
+
     def close(self) -> None:
         """Writer-side: publish the closed sentinel to all readers."""
         if self._h is not None:

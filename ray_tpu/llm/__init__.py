@@ -205,6 +205,111 @@ class ContinuousLlamaDeployment:
         with self._lock:
             return self.batcher.pressure_snapshot()
 
+    # ---------------------------------------- RL weight-sync plane (rl/)
+    def weight_version(self) -> int:
+        """Version of the params currently serving (0 = cold-start)."""
+        return self.batcher.weight_version
+
+    def swap_weights(self, weights, version: Optional[int] = None,
+                     cause: str = "publish", manifest: Optional[dict] = None,
+                     run: Optional[str] = None) -> int:
+        """Swap the live params at a tick boundary.
+
+        Taking ``self._lock`` IS the tick-boundary guarantee: the tick
+        thread holds the same lock around ``batcher.step()``, so the swap
+        lands strictly between ticks — in-flight requests keep their KV
+        cache and continue under the new weights, un-dropped. Emits the
+        ``rl.weight_swap`` flight event (caused by the trainer's publish
+        event when a ``manifest`` is supplied, so ``ray-tpu why run``
+        reconstructs the publish→swap chain) and counts the swap by
+        cause. Returns the version now live."""
+        import time as _time
+
+        from ray_tpu._private import events as _events
+        from ray_tpu._private import metrics_defs as mdefs
+
+        manifest = manifest or {}
+        run = run or manifest.get("run") or "rl"
+        with self._lock:
+            v = self.batcher.swap_params(weights, version=version)
+        attrs = {"version": v, "swap_cause": cause}
+        if manifest.get("ts"):
+            # Trainer-publish → generator-live end-to-end latency.
+            attrs["e2e_seconds"] = round(
+                max(_time.time() - float(manifest["ts"]), 0.0), 6)
+        _events.emit("rl.weight_swap", cause=manifest.get("event_id", ""),
+                     subject={"run": run}, **attrs)
+        mdefs.RL_SWAPS.inc(tags={"run": run, "cause": cause})
+        mdefs.RL_VERSION.set(v, tags={"run": run, "role": "generator"})
+        return v
+
+    def enable_weight_sync(self, spec, run: str = "rl",
+                           poll_s: float = 0.05,
+                           target_shardings=None) -> None:
+        """Start the subscriber poll thread: fast path reads the trainer's
+        weight channel (``spec`` = a pickled channel reader attach-spec),
+        and when the fast path breaks (writer gone, shed while lagging)
+        the ladder falls back to the crc32-verified checkpoint manifest —
+        both land through :meth:`swap_weights`, never mid-tick."""
+        import logging
+        import threading
+        import time as _time
+
+        from ray_tpu.rl.weight_sync import WeightSubscriber
+
+        log = logging.getLogger(__name__)
+        sub = (spec if isinstance(spec, WeightSubscriber)
+               else WeightSubscriber(spec, run=run,
+                                     target_shardings=target_shardings))
+        self._subscriber = sub
+        self._sync_stop = threading.Event()
+
+        def _loop():
+            while not self._sync_stop.is_set():
+                try:
+                    got = sub.poll(timeout=poll_s)
+                except Exception:  # noqa: BLE001 — fast path down
+                    try:
+                        manifest, params = sub.restore_fallback()
+                        if int(manifest["version"]) > \
+                                self.batcher.weight_version:
+                            self.swap_weights(
+                                params, version=int(manifest["version"]),
+                                cause="fallback", manifest=manifest,
+                                run=run)
+                    except Exception:  # noqa: BLE001
+                        log.exception("rl: weight-sync fallback failed")
+                    _time.sleep(max(poll_s, 0.05))
+                    continue
+                if got is None:
+                    continue
+                manifest, params = got
+                self.swap_weights(params,
+                                  version=int(manifest["version"]),
+                                  cause="publish", manifest=manifest,
+                                  run=run)
+
+        t = threading.Thread(target=_loop, daemon=True,
+                             name="rl-weight-sync")
+        t.start()
+        self._sync_thread = t
+
+    def disable_weight_sync(self) -> None:
+        stop = getattr(self, "_sync_stop", None)
+        if stop is not None:
+            stop.set()
+
+    def score_logprobs(self, prompt_token_ids,
+                       token_ids) -> List[float]:
+        """Teacher-forced behavior logprobs of ``token_ids`` given
+        ``prompt_token_ids`` under the CURRENT live params (the RL
+        experience path's behavior policy). Under the engine lock so the
+        params can't swap mid-score."""
+        with self._lock:
+            lp = self.batcher.score_logprobs(list(prompt_token_ids),
+                                             list(token_ids))
+        return [float(x) for x in lp]
+
     def generate(self, prompt_token_ids,
                  max_tokens: int = 16):
         """Streaming generator of token ids (serve stream=True surface).

@@ -911,6 +911,11 @@ class ContinuousBatcher:
         self._pending: Optional[tuple] = None  # (stacked, [(slot, rid)])
         self.params = params if params is not None else llama.init_params(
             config, jax.random.PRNGKey(seed))
+        # Weight-sync plane (ray_tpu/rl): monotone version of the live
+        # params. 0 = the cold-start weights; every swap_params bumps it
+        # and each request records the version that admitted it.
+        self._weight_version = 0
+        self._score_fn = None  # lazy teacher-forced logprob jit
         self.param_bytes = sum(
             x.nbytes for x in jax.tree_util.tree_leaves(self.params))
         # Split out the non-layer params: a self-draft pass streams only
@@ -1294,6 +1299,7 @@ class ContinuousBatcher:
             "ttft_s": rec.get("ttft_s"), "tpot_s": tpot,
             "prefix_tokens": rec.get("prefix_tokens", 0),
             "prompt_tokens": rec.get("prompt_len", 0),
+            "weight_version": rec.get("weight_version"),
             "trace_id": trace.get("trace_id"),
             "request_id": trace.get("request_id")})
         if not rec["traced"]:
@@ -1347,6 +1353,80 @@ class ContinuousBatcher:
         }
 
     # ---------------------------------------------------------------- api
+    @property
+    def weight_version(self) -> int:
+        """Monotone version of the live params (0 = cold-start)."""
+        return self._weight_version
+
+    def swap_params(self, params, version: Optional[int] = None) -> int:
+        """Replace the live params between ticks — the ONLY sanctioned
+        post-init assignment of ``self.params`` (a tick-boundary source
+        lint enforces this). The caller must hold the engine's tick
+        exclusion (the serve deployment swaps under its engine lock, so
+        no compiled tick is in flight); the next ``_run_tick`` dispatch
+        reads the fresh tree. The KV cache and every in-flight request's
+        device state are untouched: in-flight generations continue
+        un-dropped under the new weights.
+
+        The new tree must match the old one structurally (same treedef,
+        same leaf shapes/dtypes) — the compiled tick programs were traced
+        against that signature and a silent mismatch would either retrace
+        per swap or miscompute. Returns the new weight version
+        (``version`` or the previous one + 1)."""
+        import jax
+
+        old_leaves, old_treedef = jax.tree_util.tree_flatten(self.params)
+        new_leaves, new_treedef = jax.tree_util.tree_flatten(params)
+        if new_treedef != old_treedef:
+            raise ValueError(
+                f"swap_params treedef mismatch: engine was built with "
+                f"{old_treedef}, swap brought {new_treedef}")
+        for i, (old, new) in enumerate(zip(old_leaves, new_leaves)):
+            if old.shape != new.shape or old.dtype != new.dtype:
+                raise ValueError(
+                    f"swap_params leaf {i} mismatch: engine has "
+                    f"{old.shape}/{old.dtype}, swap brought "
+                    f"{new.shape}/{new.dtype}")
+        self.params = params
+        self._weight_version = (int(version) if version is not None
+                                else self._weight_version + 1)
+        return self._weight_version
+
+    def score_logprobs(self, prompt_tokens: List[int],
+                       out_tokens: List[int]) -> np.ndarray:
+        """Per-token behavior logprobs of ``out_tokens`` given
+        ``prompt_tokens``, under the CURRENT live params — one
+        teacher-forced forward through the same model the decode ticks
+        run, so the RL experience path's importance ratios are priced
+        against the true generating policy. Returns ``[len(out_tokens)]``
+        float32."""
+        if not out_tokens:
+            return np.zeros((0,), np.float32)
+        if self._score_fn is None:
+            cfg = self.config
+
+            @xla_monitor.instrument(name="cb_score",
+                                    shape_policy="bucketed",
+                                    allowed_dims=(1, self.max_len))
+            def score(params, tokens):
+                logits = llama.forward(params, tokens, cfg)
+                return jax.nn.log_softmax(logits.astype(jnp.float32))
+
+            self._score_fn = score
+        full = list(prompt_tokens) + list(out_tokens)
+        if len(full) > self.max_len:
+            raise ValueError(
+                f"score_logprobs sequence ({len(full)} tokens) exceeds "
+                f"max_len={self.max_len}")
+        pad = min(_bucket(len(full)), self.max_len)
+        arr = np.zeros((1, pad), np.int32)
+        arr[0, :len(full)] = full
+        logp_all = np.asarray(self._score_fn(self.params,
+                                             jnp.asarray(arr)))[0]
+        start = len(prompt_tokens)
+        idx = np.arange(start - 1, start - 1 + len(out_tokens))
+        return logp_all[idx, np.asarray(out_tokens)].astype(np.float32)
+
     def submit(self, prompt_tokens: List[int],
                max_new_tokens: int = 32,
                trace: Optional[Dict[str, Any]] = None) -> int:
@@ -1381,6 +1461,7 @@ class ContinuousBatcher:
         self._req_meta[rid] = {
             "rid": rid, "submit": time.time(),
             "prompt_len": len(prompt_tokens),
+            "weight_version": self._weight_version,
             "trace": trace, "traced": traced, "windows": []}
         if traced:
             self._traced_live += 1

@@ -1,0 +1,27 @@
+"""ray_tpu.rl: the RL post-training loop — serve ↔ train weight sync.
+
+Three legs (ROADMAP item 1): the weight-sync plane
+(:mod:`ray_tpu.rl.weight_sync` — versioned crc32 manifests over
+compiled-DAG channels, 2PC checkpoint fallback), the rollout scheduler
+(:mod:`ray_tpu.rl.rollout` — generation/learner phases on one chip pool,
+staleness first-class), and the experience path
+(:mod:`ray_tpu.rl.experience` — engine logprobs into LearnerGroup-shaped
+batches, token-level PPO). The generator side lives on
+``ContinuousLlamaDeployment.swap_weights`` / ``enable_weight_sync``
+(tick-boundary swap) and ``ContinuousBatcher.swap_params``.
+"""
+
+from ray_tpu.rl.experience import (ExperienceBuffer, SequenceRecord,
+                                   TokenPPOLearner)
+from ray_tpu.rl.rollout import RolloutScheduler
+from ray_tpu.rl.weight_sync import (RL_KV_NS, WeightPublisher,
+                                    WeightSubscriber, WeightSyncError,
+                                    build_manifest, latest_manifest,
+                                    verify_manifest)
+
+__all__ = [
+    "ExperienceBuffer", "SequenceRecord", "TokenPPOLearner",
+    "RolloutScheduler", "RL_KV_NS", "WeightPublisher", "WeightSubscriber",
+    "WeightSyncError", "build_manifest", "latest_manifest",
+    "verify_manifest",
+]

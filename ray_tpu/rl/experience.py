@@ -1,0 +1,219 @@
+"""RL experience path: engine logprobs → LearnerGroup-compatible batches.
+
+Per-token behavior logprobs come from the serving engine itself
+(``ContinuousBatcher.score_logprobs`` — the same params and forward that
+generated the tokens), so the learner's importance ratios are against the
+TRUE behavior policy, tagged with the weight version that produced each
+sequence. :class:`ExperienceBuffer` accumulates sequences and emits the
+``[T, N]``-layout trajectory dicts ``LearnerGroup._shard`` already knows
+how to shard (env axis 1; every array [T, N] or [1, N]).
+
+:class:`TokenPPOLearner` closes the loop: a token-level PPO update over a
+toy llama policy, exposing the ``compute_gradients`` / ``apply_gradients``
+/ ``get_weights`` / ``set_weights`` quartet so it drops into
+``_LearnerActor`` and the LearnerGroup's bucketed-flat allreduce unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import numpy as np
+
+from ray_tpu._private import xla_monitor
+
+
+class SequenceRecord(NamedTuple):
+    """One generated sequence with everything the learner needs."""
+
+    prompt: List[int]
+    tokens: List[int]          # generated tokens
+    logprobs: np.ndarray       # behavior per-token logprobs, len(tokens)
+    reward: float              # terminal scalar reward
+    weight_version: int        # generator version that produced it
+    staleness: int             # trainer_version - weight_version at collect
+
+
+class ExperienceBuffer:
+    """Accumulates :class:`SequenceRecord`\\ s and packs them into the
+    ``[T, N]`` trajectory-dict layout (sequences along axis 1, token
+    positions along axis 0, right-padded with a mask)."""
+
+    def __init__(self, gamma: float = 1.0):
+        self.gamma = float(gamma)
+        self._records: List[SequenceRecord] = []
+
+    def add(self, record: SequenceRecord) -> None:
+        self._records.append(record)
+
+    def __len__(self) -> int:
+        return len(self._records)
+
+    def staleness(self) -> List[int]:
+        return [r.staleness for r in self._records]
+
+    def clear(self) -> None:
+        self._records = []
+
+    def to_batch(self, max_len: Optional[int] = None
+                 ) -> Dict[str, np.ndarray]:
+        """Pack to one trajectory dict. Shapes: ``tokens_full`` [S, N]
+        (prompt + generation, right-padded), ``actions`` /
+        ``behavior_logp`` / ``advantages`` / ``mask`` [T, N] over the
+        generated positions, ``prompt_len`` / ``weight_version`` /
+        ``staleness`` [1, N] (kept 2-D so the shard slice ``v[:, lo:hi]``
+        applies uniformly). Advantages are the reward broadcast over the
+        sequence's tokens, whitened across the batch."""
+        recs = self._records
+        if not recs:
+            raise ValueError("experience buffer is empty")
+        N = len(recs)
+        T = max(len(r.tokens) for r in recs)
+        S = max(len(r.prompt) + len(r.tokens) for r in recs)
+        if max_len is not None:
+            S = max(S, int(max_len))
+        tokens_full = np.zeros((S, N), np.int32)
+        actions = np.zeros((T, N), np.int32)
+        behavior_logp = np.zeros((T, N), np.float32)
+        mask = np.zeros((T, N), np.float32)
+        rewards = np.zeros((N,), np.float32)
+        prompt_len = np.zeros((1, N), np.int32)
+        version = np.zeros((1, N), np.int32)
+        staleness = np.zeros((1, N), np.int32)
+        for n, r in enumerate(recs):
+            full = list(r.prompt) + list(r.tokens)
+            tokens_full[:len(full), n] = full
+            t = len(r.tokens)
+            actions[:t, n] = r.tokens
+            behavior_logp[:t, n] = np.asarray(r.logprobs, np.float32)
+            mask[:t, n] = 1.0
+            rewards[n] = r.reward
+            prompt_len[0, n] = len(r.prompt)
+            version[0, n] = r.weight_version
+            staleness[0, n] = r.staleness
+        adv = rewards - rewards.mean()
+        std = rewards.std()
+        if std > 1e-6:
+            adv = adv / std
+        advantages = (adv[None, :] * mask).astype(np.float32)
+        return {
+            "tokens_full": tokens_full,
+            "actions": actions,
+            "behavior_logp": behavior_logp,
+            "advantages": advantages,
+            "mask": mask,
+            "prompt_len": prompt_len,
+            "weight_version": version,
+            "staleness": staleness,
+        }
+
+
+class TokenPPOLearner:
+    """Token-level PPO over a llama policy (the generator's own weights).
+
+    The clipped surrogate runs per generated token against the engine's
+    behavior logprobs; ``rho_clip`` additionally caps the importance
+    ratio IMPALA/APPO-style, bounding the correction applied to stale
+    (off-policy) sequences collected under an older weight version.
+    """
+
+    def __init__(self, config: Any, params: Any = None, lr: float = 1e-3,
+                 clip: float = 0.2, rho_clip: Optional[float] = None,
+                 entropy_coeff: float = 0.0, seed: int = 0):
+        import jax
+        import optax
+
+        from ray_tpu.models import llama
+
+        self.config = config
+        self.optimizer = optax.adam(lr)
+        if params is None:
+            params = llama.init_params(config, jax.random.PRNGKey(seed))
+        self.params = params
+        self.opt_state = self.optimizer.init(self.params)
+        clip_c, rho_c, ent_c = clip, rho_clip, entropy_coeff
+        cfg = config
+
+        def loss_fn(params, b):
+            import jax.numpy as jnp
+
+            # Teacher-forced forward over the full padded sequences:
+            # logits at position s predict the token at s+1, so the
+            # generated token t of sequence n is scored by the logits row
+            # at prompt_len[n] - 1 + t.
+            logits = llama.forward(params, b["tokens_full"].T, cfg)
+            logp_all = jax.nn.log_softmax(logits)          # [N, S, V]
+            T = b["actions"].shape[0]
+            pos = (b["prompt_len"][0][:, None] - 1
+                   + jnp.arange(T)[None, :])               # [N, T]
+            rows = jnp.take_along_axis(
+                logp_all, pos[:, :, None],
+                axis=1)                                    # [N, T, V]
+            logp = jnp.take_along_axis(
+                rows, b["actions"].T[:, :, None],
+                axis=2)[:, :, 0].T                         # [T, N]
+            ratio = jnp.exp(logp - b["behavior_logp"])
+            if rho_c is not None:
+                # Off-policy staleness correction: V-trace-style rho cap
+                # on top of PPO's two-sided clip.
+                ratio = jnp.minimum(ratio, rho_c)
+            adv = b["advantages"]
+            surr = jnp.minimum(
+                ratio * adv,
+                jnp.clip(ratio, 1.0 - clip_c, 1.0 + clip_c) * adv)
+            denom = jnp.maximum(b["mask"].sum(), 1.0)
+            pg_loss = -(surr * b["mask"]).sum() / denom
+            entropy = -((jnp.exp(rows) * rows).sum(-1).T
+                        * b["mask"]).sum() / denom
+            total = pg_loss - ent_c * entropy
+            return total, {"policy_loss": pg_loss, "entropy": entropy,
+                           "mean_ratio": (ratio * b["mask"]).sum() / denom}
+
+        self._grad_fn = xla_monitor.instrument(
+            lambda p, b: jax.value_and_grad(loss_fn, has_aux=True)(p, b),
+            name="rl_ppo_grad")
+
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply_fn = xla_monitor.instrument(apply_fn,
+                                                name="rl_ppo_apply")
+
+    @staticmethod
+    def _to_device(batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        return {k: jnp.asarray(v) for k, v in batch.items()
+                if k not in ("weight_version", "staleness")}
+
+    def compute_gradients(self, batch: Dict[str, np.ndarray]):
+        (loss, metrics), grads = self._grad_fn(self.params,
+                                               self._to_device(batch))
+        metrics = {k: float(v) for k, v in metrics.items()}
+        metrics["total_loss"] = float(loss)
+        return grads, metrics
+
+    def apply_gradients(self, grads) -> None:
+        self.params, self.opt_state = self._apply_fn(
+            self.params, self.opt_state, grads)
+
+    def update_from_batch(self, batch) -> Dict[str, float]:
+        grads, metrics = self.compute_gradients(batch)
+        self.apply_gradients(grads)
+        return metrics
+
+    def get_weights(self):
+        import jax
+
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(jnp.asarray, weights)
+
+
+__all__ = ["SequenceRecord", "ExperienceBuffer", "TokenPPOLearner"]

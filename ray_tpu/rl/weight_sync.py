@@ -1,0 +1,360 @@
+"""RL weight-sync plane: trainer mesh → live generator replicas.
+
+The trainer publishes a versioned :class:`WeightManifest` (per-leaf spec +
+crc32, mirrored into the GCS ``__rl__`` KV namespace) after every N
+optimizer steps; each generator replica holds a :class:`WeightSubscriber`
+that streams the full host-side param pytree over a compiled-DAG shared
+memory channel (``ray_tpu/experimental/channel.py``) and re-shards on
+arrival with ``jax.device_put`` — the same elastic-reassembly contract as
+the checkpoint plane's ``restore(target_shardings)``. When the fast path is
+unavailable (channel gone, crc mismatch, publisher dead) the subscriber
+falls back to the crc32-verified 2PC checkpoint manifest the publisher
+wrote alongside, so fast path ≡ slow path bit-for-bit.
+
+Backpressure is the channel's single-in-flight protocol: a publish blocks
+until every subscriber acked the previous version, and past
+``publish_timeout_s`` the publish SHEDS — with attribution, naming the
+lagging reader indices read straight from the channel header — rather than
+stalling the optimizer or buffering unboundedly (the PR 18
+shed-with-attribution pattern).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+# Reserved GCS KV namespace mirroring the manifest chain (keys
+# ``<run>/manifest/<version>`` + ``<run>/latest``), so any process with a
+# cluster connection can answer "what weight version is current for run X"
+# without holding the channel.
+RL_KV_NS = "__rl__"
+
+DEFAULT_CHANNEL_CAPACITY = 64 << 20  # params ride as one pickled payload
+
+
+class WeightSyncError(RuntimeError):
+    """A received payload failed manifest verification (crc/leaf-count)."""
+
+
+def _kv():
+    """The cluster KV when this process is connected, else ``None``
+    (the checkpoint plane's idiom: KV mirroring is an accelerant, never a
+    requirement)."""
+    try:
+        from ray_tpu._private import worker as worker_mod
+
+        if worker_mod.global_worker_or_none() is None:
+            return None
+        from ray_tpu.experimental import internal_kv
+
+        return internal_kv
+    except Exception:  # noqa: BLE001 — no runtime in this process
+        return None
+
+
+def _host_leaves(params: Any) -> Tuple[List[np.ndarray], Any]:
+    """Flatten to host numpy leaves + treedef (deterministic jax order —
+    the crc32 manifest indexes leaves by this order on both sides)."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(params)
+    return [np.asarray(leaf) for leaf in leaves], treedef
+
+
+def build_manifest(run: str, version: int, step: int,
+                   leaves: List[np.ndarray],
+                   ckpt_root: Optional[str] = None,
+                   ckpt_run: Optional[str] = None) -> Dict[str, Any]:
+    """Versioned weight manifest: per-leaf shape/dtype/crc32 + the slow
+    path pointer (checkpoint plane root/run) the fallback ladder ends at."""
+    return {
+        "run": run,
+        "version": int(version),
+        "step": int(step),
+        "ts": time.time(),
+        "leaves": [{
+            "shape": list(a.shape),
+            "dtype": str(a.dtype),
+            "crc32": zlib.crc32(np.ascontiguousarray(a).tobytes()),
+        } for a in leaves],
+        "bytes": int(sum(a.nbytes for a in leaves)),
+        "ckpt_root": ckpt_root,
+        "ckpt_run": ckpt_run,
+    }
+
+
+def verify_manifest(manifest: Dict[str, Any],
+                    leaves: List[np.ndarray]) -> None:
+    """Integrity gate on arrival: leaf count + per-leaf crc32 against the
+    manifest. Raises :class:`WeightSyncError` — the caller's cue to drop
+    the payload and take the checkpoint fallback."""
+    specs = manifest.get("leaves", [])
+    if len(specs) != len(leaves):
+        raise WeightSyncError(
+            f"weight payload has {len(leaves)} leaves but manifest "
+            f"v{manifest.get('version')} declares {len(specs)}")
+    for i, (spec, leaf) in enumerate(zip(specs, leaves)):
+        crc = zlib.crc32(np.ascontiguousarray(leaf).tobytes())
+        if crc != spec["crc32"]:
+            raise WeightSyncError(
+                f"leaf {i} crc mismatch for weight version "
+                f"{manifest.get('version')}: got {crc:#010x}, manifest "
+                f"says {spec['crc32']:#010x}")
+
+
+def _kv_put_manifest(manifest: Dict[str, Any]) -> None:
+    kv = _kv()
+    if kv is None:
+        return
+    try:
+        run, version = manifest["run"], manifest["version"]
+        raw = json.dumps(manifest).encode()
+        kv.internal_kv_put(f"{run}/manifest/{version:010d}", raw,
+                           overwrite=True, namespace=RL_KV_NS)
+        kv.internal_kv_put(f"{run}/latest", raw, overwrite=True,
+                           namespace=RL_KV_NS)
+    except Exception:  # noqa: BLE001 — mirroring is best-effort
+        logger.debug("rl: KV manifest mirror failed", exc_info=True)
+
+
+def latest_manifest(run: str) -> Optional[Dict[str, Any]]:
+    """Newest published manifest for ``run`` from the ``__rl__`` KV
+    mirror (``None`` with no cluster or no publish yet)."""
+    kv = _kv()
+    if kv is None:
+        return None
+    try:
+        raw = kv.internal_kv_get(f"{run}/latest", namespace=RL_KV_NS)
+        return json.loads(raw) if raw else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class WeightPublisher:
+    """Trainer-side half of the sync plane.
+
+    Owns the channel (created eagerly so subscriber attach-specs exist
+    before the first publish) and the version counter. ``publish_every``
+    turns :meth:`maybe_publish` into the "every N optimizer steps" gate;
+    ``ckpt_plane`` (a ``CheckpointPlane``) makes every publish also write
+    the 2PC checkpoint manifest that backs the slow path — and the
+    fast ≡ slow bit-identity acceptance check.
+    """
+
+    def __init__(self, run: str = "rl", n_subscribers: int = 1,
+                 capacity: int = DEFAULT_CHANNEL_CAPACITY,
+                 publish_every: int = 1,
+                 publish_timeout_s: float = 5.0,
+                 ckpt_plane: Any = None):
+        from ray_tpu.experimental.channel import Channel
+
+        self.run = run
+        self.publish_every = max(int(publish_every), 1)
+        self.publish_timeout_s = float(publish_timeout_s)
+        self.ckpt_plane = ckpt_plane
+        self.version = 0
+        self._steps_since = 0
+        self._chan = Channel(capacity=capacity, n_readers=n_subscribers)
+        self._mtags = {"run": run}
+
+    def subscriber_spec(self, idx: int):
+        """Picklable attach-spec for subscriber ``idx`` — ship it into
+        the generator replica (actor init kwarg / method arg) and hand it
+        to :class:`WeightSubscriber`."""
+        return self._chan.reader(idx)
+
+    def maybe_publish(self, params: Any, step: int,
+                      cause: str = "") -> Optional[Dict[str, Any]]:
+        """Publish iff ``publish_every`` optimizer steps elapsed since
+        the last publish. Returns the manifest when one went out."""
+        self._steps_since += 1
+        if self._steps_since < self.publish_every:
+            return None
+        self._steps_since = 0
+        return self.publish(params, step, cause=cause)
+
+    def publish(self, params: Any, step: int,
+                cause: str = "") -> Dict[str, Any]:
+        """Version, checksum, mirror, checkpoint, and push one weight
+        snapshot. On subscriber backpressure past the timeout the publish
+        is SHED (``manifest["shed"]`` lists the lagging reader indices)
+        instead of blocking the optimizer."""
+        from ray_tpu._private import events as _events
+        from ray_tpu._private import metrics_defs as mdefs
+
+        t0 = time.perf_counter()
+        self.version += 1
+        leaves, _treedef = _host_leaves(params)
+        host_params = _host_tree(params)
+        manifest = build_manifest(
+            self.run, self.version, step, leaves,
+            ckpt_root=getattr(self.ckpt_plane, "root", None),
+            ckpt_run=getattr(self.ckpt_plane, "run", None))
+        if self.ckpt_plane is not None:
+            # Slow-path source of truth: the crc32-verified 2PC manifest
+            # a cold-started or fallen-back replica restores from. Saved
+            # BEFORE the channel push so a subscriber that misses the
+            # fast path never sees a version without a checkpoint.
+            self.ckpt_plane.save(self.version, host_params)
+        _kv_put_manifest(manifest)
+        event_id = _events.emit(
+            "rl.manifest_publish", cause=cause,
+            subject={"run": self.run},
+            version=self.version, step=int(step),
+            bytes=manifest["bytes"])
+        manifest["event_id"] = event_id
+        try:
+            self._chan.write((manifest, host_params),
+                             timeout=self.publish_timeout_s)
+        except Exception as e:  # noqa: BLE001 — shed, don't stall training
+            lagging = self.lagging_subscribers()
+            manifest["shed"] = lagging or [-1]
+            for idx in (lagging or [-1]):
+                mdefs.RL_SYNC_SHED.inc(
+                    tags={"run": self.run, "subscriber": str(idx)})
+            _events.emit("rl.publish_shed", cause=event_id,
+                         subject={"run": self.run},
+                         version=self.version, lagging=str(lagging),
+                         error=type(e).__name__)
+            logger.warning(
+                "rl: publish v%d shed (lagging subscribers %s): %s",
+                self.version, lagging, e)
+        else:
+            mdefs.RL_SYNC_BYTES.inc(manifest["bytes"],
+                                    tags={**self._mtags, "path": "publish"})
+        mdefs.RL_SYNC_SECONDS.observe(time.perf_counter() - t0,
+                                      tags={**self._mtags,
+                                            "path": "publish"})
+        mdefs.RL_VERSION.set(self.version,
+                             tags={**self._mtags, "role": "trainer"})
+        return manifest
+
+    def lagging_subscribers(self) -> List[int]:
+        """Subscriber indices that have not acked the latest channel
+        version — the shed-attribution readback."""
+        try:
+            return self._chan.lagging_readers()
+        except Exception:  # noqa: BLE001
+            return []
+
+    def close(self) -> None:
+        try:
+            self._chan.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+    def destroy(self) -> None:
+        try:
+            self._chan.destroy()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _host_tree(params: Any) -> Any:
+    import jax
+
+    return jax.tree.map(np.asarray, params)
+
+
+class WeightSubscriber:
+    """Generator-side half: non-blocking poll for the next published
+    version, crc-verified, optionally re-sharded onto this replica's
+    layout, with the checkpoint manifest as the fallback ladder's
+    last rung."""
+
+    def __init__(self, spec: Any, run: str = "rl",
+                 target_shardings: Any = None):
+        self.run = run
+        self._chan = spec
+        self._shardings = target_shardings
+        self.version = 0
+        self._mtags = {"run": run}
+
+    def poll(self, timeout: float = 0.05
+             ) -> Optional[Tuple[Dict[str, Any], Any]]:
+        """One fast-path receive attempt. Returns ``(manifest, params)``
+        when a fresh verified version arrived, ``None`` on timeout.
+        Raises :class:`WeightSyncError` on verification failure and
+        ``ChannelClosed`` when the publisher is gone — both are the
+        caller's cue to fall back to :meth:`restore_fallback`."""
+        from ray_tpu._private import metrics_defs as mdefs
+        from ray_tpu.experimental.channel import ChannelTimeout
+
+        t0 = time.perf_counter()
+        try:
+            manifest, params = self._chan.read(timeout=timeout)
+        except ChannelTimeout:
+            return None
+        leaves, _ = _host_leaves(params)
+        verify_manifest(manifest, leaves)
+        params = self._reshard(params)
+        self.version = int(manifest["version"])
+        mdefs.RL_SYNC_BYTES.inc(manifest["bytes"],
+                                tags={**self._mtags, "path": "subscribe"})
+        mdefs.RL_SYNC_SECONDS.observe(time.perf_counter() - t0,
+                                      tags={**self._mtags,
+                                            "path": "subscribe"})
+        return manifest, params
+
+    def restore_fallback(self, manifest: Optional[Dict[str, Any]] = None
+                         ) -> Tuple[Dict[str, Any], Any]:
+        """Slow path: restore the manifest's version from its 2PC
+        checkpoint (``load_latest`` — crc32-verified, filesystem-only).
+        With no manifest in hand, the ``__rl__`` KV mirror supplies the
+        newest one."""
+        from ray_tpu._private import metrics_defs as mdefs
+        from ray_tpu.checkpoint import load_latest
+
+        t0 = time.perf_counter()
+        if manifest is None:
+            manifest = latest_manifest(self.run)
+        if not manifest or not manifest.get("ckpt_root"):
+            raise WeightSyncError(
+                f"no checkpoint fallback available for run {self.run!r} "
+                f"(manifest={manifest})")
+        params = load_latest(manifest["ckpt_root"],
+                             run=manifest.get("ckpt_run"),
+                             step=int(manifest["version"]))
+        params = getattr(params, "params", params)
+        leaves, _ = _host_leaves(params)
+        verify_manifest(manifest, leaves)
+        params = self._reshard(params)
+        self.version = int(manifest["version"])
+        mdefs.RL_SYNC_BYTES.inc(manifest["bytes"],
+                                tags={**self._mtags, "path": "fallback"})
+        mdefs.RL_SYNC_SECONDS.observe(time.perf_counter() - t0,
+                                      tags={**self._mtags,
+                                            "path": "fallback"})
+        return manifest, params
+
+    def _reshard(self, params: Any) -> Any:
+        """Trainer layout → this replica's layout: ``jax.device_put``
+        every leaf onto the target sharding (the checkpoint plane's
+        elastic-reshard contract, applied to a live payload)."""
+        if self._shardings is None:
+            return params
+        import jax
+
+        leaves, treedef = jax.tree.flatten(params)
+        shardings = jax.tree.flatten(self._shardings)[0]
+        if len(shardings) != len(leaves):
+            raise WeightSyncError(
+                f"target shardings have {len(shardings)} leaves but the "
+                f"payload has {len(leaves)}")
+        return jax.tree.unflatten(
+            treedef, [jax.device_put(a, s)
+                      for a, s in zip(leaves, shardings)])
+
+
+__all__ = [
+    "RL_KV_NS", "WeightPublisher", "WeightSubscriber", "WeightSyncError",
+    "build_manifest", "verify_manifest", "latest_manifest",
+]

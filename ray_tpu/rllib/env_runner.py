@@ -17,6 +17,20 @@ import ray_tpu
 from ray_tpu.rllib.core import PPOModule, SampleBatch, compute_gae
 
 
+def _record_weights_version(runner, version) -> int:
+    """Stamp a runner with the version of the weights it just received.
+
+    ``None`` auto-increments (legacy callers that don't version their
+    broadcasts still get a monotone counter); explicit versions come from
+    EnvRunnerGroup so a respawned runner reports the version it was
+    re-synced with, not a reset-to-zero counter."""
+    if version is None:
+        runner.weights_version = getattr(runner, "weights_version", 0) + 1
+    else:
+        runner.weights_version = int(version)
+    return runner.weights_version
+
+
 class SingleAgentEnvRunner:
     def __init__(self, env_creator: Callable, module_spec: Dict[str, Any],
                  num_envs: int = 1, seed: int = 0,
@@ -40,11 +54,14 @@ class SingleAgentEnvRunner:
         self._episode_returns = np.zeros(num_envs, dtype=np.float64)
         self._finished_returns: List[float] = []
 
-    def set_weights(self, weights):
+    def set_weights(self, weights, version=None):
         import jax.numpy as jnp
 
         self.params = self._jax.tree.map(jnp.asarray, weights)
-        return True
+        return _record_weights_version(self, version)
+
+    def get_weights_version(self) -> int:
+        return getattr(self, "weights_version", 0)
 
     def sample(self, num_steps: int) -> Tuple[SampleBatch, List[float]]:
         """Collect ``num_steps`` per env; returns batch + episode returns."""
@@ -106,6 +123,7 @@ class EnvRunnerGroup:
         from ray_tpu.rllib.actor_manager import FaultTolerantActorManager
 
         self._weights = None
+        self._weights_version = 0
 
         def factory(seed: int):
             return ray_tpu.remote(SingleAgentEnvRunner).remote(
@@ -113,9 +131,20 @@ class EnvRunnerGroup:
                 gamma, lam)
 
         def on_replace(actor):
+            # A fresh replacement starts from version 0 — re-push the
+            # last broadcast WITH its version so the respawned runner
+            # reports the same weights generation as its peers (the
+            # stale-weights re-sync fix), and journal the resync.
             if self._weights is not None:
-                ray_tpu.get(actor.set_weights.remote(self._weights),
-                            timeout=120)
+                from ray_tpu._private import events as _events
+
+                got = ray_tpu.get(
+                    actor.set_weights.remote(self._weights,
+                                             self._weights_version),
+                    timeout=120)
+                _events.emit("rl.runner_resync",
+                             subject={"group": "env_runners"},
+                             version=int(got))
 
         self._mgr = FaultTolerantActorManager(factory, num_runners,
                                               on_replace=on_replace)
@@ -124,9 +153,28 @@ class EnvRunnerGroup:
     def runners(self):
         return self._mgr.actors
 
-    def sync_weights(self, weights):
+    @property
+    def weights_version(self) -> int:
+        return self._weights_version
+
+    def sync_weights(self, weights, version=None) -> int:
+        """Broadcast ``weights`` to every runner, stamped with a version
+        (auto-incremented when the caller doesn't supply one). The stored
+        (weights, version) pair is what ``on_replace`` re-pushes, so a
+        runner respawned mid-iteration can never sample under silently
+        stale weights while claiming to be current."""
+        from ray_tpu._private import events as _events
+
         self._weights = weights
-        self._mgr.foreach("set_weights", weights, timeout_s=120)
+        self._weights_version = (int(version) if version is not None
+                                 else self._weights_version + 1)
+        self._mgr.foreach("set_weights", weights, self._weights_version,
+                          timeout_s=120)
+        _events.emit("rl.weights_broadcast",
+                     subject={"group": "env_runners"},
+                     version=self._weights_version,
+                     runners=len(self._mgr.actors))
+        return self._weights_version
 
     def sample(self, num_steps: int):
         results = self._mgr.foreach("sample", num_steps)
@@ -161,11 +209,14 @@ class TrajectoryEnvRunner:
         self._episode_returns = np.zeros(num_envs, dtype=np.float64)
         self._finished_returns: List[float] = []
 
-    def set_weights(self, weights):
+    def set_weights(self, weights, version=None):
         import jax.numpy as jnp
 
         self.params = self._jax.tree.map(jnp.asarray, weights)
-        return True
+        return _record_weights_version(self, version)
+
+    def get_weights_version(self) -> int:
+        return getattr(self, "weights_version", 0)
 
     def sample(self, num_steps: int):
         T, N = num_steps, self.num_envs
@@ -289,11 +340,14 @@ class ContinuousEnvRunner(_TransitionCollector):
         self._key = jax.random.PRNGKey(seed)
         self._sample_fn = jax.jit(self.module.sample_action)
 
-    def set_weights(self, weights):
+    def set_weights(self, weights, version=None):
         import jax.numpy as jnp
 
         self.params = self._jax.tree.map(jnp.asarray, weights)
-        return True
+        return _record_weights_version(self, version)
+
+    def get_weights_version(self) -> int:
+        return getattr(self, "weights_version", 0)
 
     def _select(self, obs):
         self._key, sub = self._jax.random.split(self._key)
@@ -323,11 +377,14 @@ class TransitionEnvRunner(_TransitionCollector):
         self._jax = jax
         self._q = jax.jit(self.module.q_values)
 
-    def set_weights(self, weights):
+    def set_weights(self, weights, version=None):
         import jax.numpy as jnp
 
         self.params = self._jax.tree.map(jnp.asarray, weights)
-        return True
+        return _record_weights_version(self, version)
+
+    def get_weights_version(self) -> int:
+        return getattr(self, "weights_version", 0)
 
     def set_epsilon(self, epsilon: float):
         self.epsilon = float(epsilon)

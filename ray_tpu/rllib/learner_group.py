@@ -66,7 +66,24 @@ class _LearnerActor:
         return metrics
 
     def get_weights(self):
-        return self.learner.get_weights()
+        from ray_tpu._private import chaos
+
+        weights = self.learner.get_weights()
+        if chaos.enabled():
+            # Cooperative divergence fault: a matched rank hands back
+            # weights nudged by eps. The learner's OWN replica stays
+            # intact — the fault is in what it reports, exactly the kind
+            # of silent skew the group-level bit-identity check targets.
+            directive = chaos.inject("learner_weights", rank=self.rank)
+            if directive and "perturb" in directive:
+                import jax
+
+                eps = directive["perturb"]
+                weights = jax.tree.map(
+                    lambda a: np.asarray(a) + np.asarray(eps,
+                                                         np.asarray(a).dtype),
+                    weights)
+        return weights
 
     def set_weights(self, weights):
         self.learner.set_weights(weights)
@@ -142,8 +159,51 @@ class LearnerGroup:
         return metrics[0]
 
     def get_weights(self):
-        return ray_tpu.get(self.learners[0].get_weights.remote(),
-                           timeout=120)
+        """Weights of the logical learner.
+
+        The allreduce invariant makes every learner's replica
+        bit-identical, so one read (learner 0) suffices on the fast path.
+        In debug/chaos mode that invariant is VERIFIED, not assumed: all
+        learners are read and compared leaf-by-leaf bitwise, so a
+        silently diverged replica (lost collective round, perturbed
+        reporter) fails loudly here instead of training on skewed
+        weights. Enable via ``RAY_TPU_RL_DEBUG=1`` or any active chaos
+        plan."""
+        import os
+
+        from ray_tpu._private import chaos
+
+        if not (chaos.enabled() or os.environ.get("RAY_TPU_RL_DEBUG")):
+            return ray_tpu.get(self.learners[0].get_weights.remote(),
+                               timeout=120)
+        all_weights = self.get_all_weights()
+        self._check_bit_identity(all_weights)
+        return all_weights[0]
+
+    def _check_bit_identity(self, all_weights: List[Any]) -> None:
+        import jax
+
+        from ray_tpu._private import events as _events
+
+        ref_leaves, ref_treedef = jax.tree.flatten(all_weights[0])
+        for rank, weights in enumerate(all_weights[1:], start=1):
+            leaves, treedef = jax.tree.flatten(weights)
+            if treedef != ref_treedef:
+                raise RuntimeError(
+                    f"learner {rank} weight tree structure diverged from "
+                    f"learner 0")
+            for i, (a, b) in enumerate(zip(ref_leaves, leaves)):
+                a, b = np.asarray(a), np.asarray(b)
+                if a.shape != b.shape or a.dtype != b.dtype \
+                        or a.tobytes() != b.tobytes():
+                    _events.emit("rl.learner_divergence",
+                                 subject={"group": "learners"},
+                                 rank=rank, leaf=i)
+                    raise RuntimeError(
+                        f"learner {rank} weights diverged from learner 0 "
+                        f"at leaf {i} (shape {b.shape}, dtype {b.dtype}) "
+                        f"— the allreduce bit-identity invariant is "
+                        f"broken")
 
     def get_all_weights(self) -> List[Any]:
         return ray_tpu.get([a.get_weights.remote() for a in self.learners],

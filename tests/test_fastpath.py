@@ -171,3 +171,65 @@ def test_channel_hop_latency_sane():
     finally:
         c.close()
         c.destroy()
+
+
+def test_channel_multi_mb_payload_roundtrip():
+    """Weight-sync-sized traffic: a multi-MB pytree payload survives the
+    hop byte-for-byte for every reader, and an over-capacity payload is
+    rejected up front instead of corrupting the ring."""
+    import numpy as np
+
+    from ray_tpu.experimental import channel as chan
+
+    c = chan.Channel(capacity=16 << 20, n_readers=2)
+    readers = [c.reader(0), c.reader(1)]
+    try:
+        rng = np.random.default_rng(0)
+        payload = {"step": 7,
+                   "w": rng.standard_normal((1024, 1024)),   # 8 MB
+                   "b": rng.standard_normal(4096).astype(np.float32)}
+        c.write(payload, timeout=5)
+        for r in readers:
+            got = r.read(timeout=5)
+            assert got["step"] == 7
+            assert np.array_equal(got["w"], payload["w"])
+            assert np.array_equal(got["b"], payload["b"])
+        with pytest.raises(ValueError, match="capacity"):
+            c.write(np.zeros(32 << 20, np.uint8), timeout=5)
+    finally:
+        c.close()
+        c.destroy()
+
+
+def test_channel_reader_death_mid_stream_blocks_then_attributes():
+    """Single-in-flight backpressure: a reader that dies mid-stream
+    stalls the NEXT write (bounded buffering — no unbounded queue grows
+    behind a dead consumer); the writer's timeout turns the stall into a
+    shed decision with the laggard NAMED by the header ack readback
+    (``reader_acks`` / ``lagging_readers``)."""
+    from ray_tpu.experimental import channel as chan
+
+    c = chan.Channel(n_readers=2)
+    alive, doomed = c.reader(0), c.reader(1)
+    try:
+        c.write("v1", timeout=5)
+        assert alive.read(timeout=5) == "v1"
+        assert doomed.read(timeout=5) == "v1"
+        c.write("v2", timeout=5)        # both acked v1: lands
+        assert alive.read(timeout=5) == "v2"
+        # Reader 1 dies mid-stream (never consumes v2): the next write
+        # blocks on its stale ack and times out without writing.
+        with pytest.raises(chan.ChannelTimeout):
+            c.write("v3", timeout=0.3)
+        assert c.lagging_readers() == [1]
+        ver, acks = c.reader_acks()
+        assert acks[0] == ver and acks[1] < ver
+        # The timed-out write left the ring intact: the laggard can
+        # still consume v2, after which the stream resumes.
+        assert doomed.read(timeout=5) == "v2"
+        c.write("v3", timeout=5)
+        assert alive.read(timeout=5) == "v3"
+        assert doomed.read(timeout=5) == "v3"
+    finally:
+        c.close()
+        c.destroy()

@@ -687,7 +687,109 @@ def test_head_control_plane_series_are_cataloged():
     assert callable(cli.cmd_head)
 
 
-def test_gcs_kv_mutations_go_through_the_accounting_helper():
+def test_rl_weight_sync_series_are_cataloged():
+    """The RL post-training loop's series (sync latency/bytes by path,
+    trainer/generator version gauges, rollout staleness, tick-boundary
+    swaps by cause, shed-with-attribution) ship described + tagged in
+    the catalog — the dashboard 'RL / weight sync & rollout' panel and
+    bench.py's rl_loop phase read them."""
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_rl_weight_sync_seconds",
+        "ray_tpu_rl_weight_sync_bytes_total",
+        "ray_tpu_rl_weight_sync_version",
+        "ray_tpu_rl_rollout_staleness",
+        "ray_tpu_rl_weight_swaps_total",
+        "ray_tpu_rl_weight_sync_shed_total",
+    }
+    missing = required - names
+    assert not missing, (
+        f"RL weight-sync series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if not m.name.startswith("ray_tpu_rl_"):
+            continue
+        assert m.description.strip() and "run" in m.tag_keys, m.name
+        if m.name in ("ray_tpu_rl_weight_sync_seconds",
+                      "ray_tpu_rl_weight_sync_bytes_total"):
+            # Fast vs slow path attribution (publish/subscribe/fallback).
+            assert "path" in m.tag_keys, m.name
+        if m.name == "ray_tpu_rl_weight_sync_version":
+            # Trainer-vs-generator version gap IS the sync lag.
+            assert "role" in m.tag_keys
+        if m.name == "ray_tpu_rl_weight_swaps_total":
+            assert "cause" in m.tag_keys
+        if m.name == "ray_tpu_rl_weight_sync_shed_total":
+            # Sheds must name the lagging subscriber.
+            assert "subscriber" in m.tag_keys
+    # The dashboard renders the plane.
+    from ray_tpu import dashboard
+
+    assert 'id="rl"' in dashboard._INDEX_HTML
+
+
+def test_generator_param_swaps_ride_the_tick_boundary():
+    """Source lint: the serving engine's live params may be assigned only
+    at init and through ``ContinuousBatcher.swap_params`` (which callers
+    must invoke holding tick exclusion), and the only swap_params call
+    site in the serve/llm/rllib planes is
+    ``ContinuousLlamaDeployment.swap_weights`` — the lock-holding
+    tick-boundary entry point. A mid-tick params write would hand one
+    decode tick a torn weight set; this pins the invariant the RL sync
+    plane's in-flight-requests-survive guarantee rests on."""
+    import pathlib
+    import re
+
+    import ray_tpu
+
+    root = pathlib.Path(ray_tpu.__file__).parent
+    # 1) Engine side: every `self.params` store in the batcher module
+    # lives in __init__ or swap_params.
+    engine_path = root / "models" / "continuous_batching.py"
+    allowed = {"__init__", "swap_params"}
+    current_def = "<module>"
+    store = re.compile(r"self\.params\s*=[^=]")
+    for i, line in enumerate(engine_path.read_text().splitlines()):
+        stripped = line.strip()
+        if stripped.startswith(("def ", "async def ")):
+            current_def = stripped.split("def ", 1)[1].split("(")[0]
+        if store.search(stripped.split("#", 1)[0]):
+            assert current_def in allowed, (
+                f"continuous_batching.py:{i + 1} assigns self.params in "
+                f"{current_def!r} — live params may only change through "
+                f"swap_params under tick exclusion")
+    # 2) Caller side: serve/, llm/ and rllib/ reach swap_params only
+    # through the deployment's lock-holding swap_weights.
+    for sub in ("serve", "llm", "rllib"):
+        for path in sorted((root / sub).rglob("*.py")):
+            current_def = "<module>"
+            for i, line in enumerate(path.read_text().splitlines()):
+                stripped = line.strip()
+                if stripped.startswith(("def ", "async def ")):
+                    current_def = stripped.split(
+                        "def ", 1)[1].split("(")[0]
+                code = stripped.split("#", 1)[0]
+                if ".swap_params(" in code or \
+                        re.search(r"\.batcher\.params\s*=", code):
+                    assert current_def == "swap_weights", (
+                        f"{sub}/{path.name}:{i + 1} swaps generator "
+                        f"params in {current_def!r} — route it through "
+                        f"ContinuousLlamaDeployment.swap_weights (the "
+                        f"tick-boundary entry point)")
+    # The entry points themselves exist and hold the contract.
+    from ray_tpu.llm import ContinuousLlamaDeployment
+    from ray_tpu.models.continuous_batching import ContinuousBatcher
+
+    assert callable(ContinuousBatcher.swap_params)
+    cls = getattr(ContinuousLlamaDeployment, "_cls_or_fn",
+                  ContinuousLlamaDeployment)
+    assert callable(getattr(cls, "swap_weights"))
+    llm_src = (root / "llm" / "__init__.py").read_text()
+    swap_body = llm_src.split("def swap_weights(", 1)[1]
+    lock_at = swap_body.index("with self._lock:")
+    call_at = swap_body.index("swap_params(")
+    assert lock_at < call_at, (
+        "swap_weights no longer takes the engine lock before "
+        "swap_params — the tick-boundary guarantee is gone")
     """Source lint: EVERY function in gcs/server.py that mutates the raw
     ``self._kv`` dict must call ``self._account_kv(`` (or be a recovery
     path that replays already-accounted history), and all four Kv*

@@ -1,0 +1,601 @@
+"""RL post-training loop: the serve↔train weight-sync plane end to end.
+
+Three layers, mirroring ``ray_tpu/rl/``:
+
+- unit: manifest crc gating, experience-buffer [T, N] packing and its
+  ``LearnerGroup._shard`` compatibility, rollout staleness clipping,
+  publisher shed-with-attribution.
+- engine: tick-boundary ``swap_params`` with a request in flight
+  (un-dropped, version-tagged), and the fast-path ≡ slow-path greedy
+  bit-identity acceptance (channel-synced weights vs a cold start from
+  the same checkpoint manifest).
+- e2e (chaos, REAL serve + trainer): PPO on a toy llama THROUGH the
+  serving engine and the LearnerGroup — weight versions advance without
+  dropping streams, a replica killed mid-loop recovers (journal resume
+  + slow-path weight restore), and the publish→swap chain reconstructs
+  through the flight recorder (``ray-tpu why run <id>``).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu._private import chaos
+from ray_tpu._private import events as flight
+from ray_tpu._private import metrics_defs as mdefs
+from ray_tpu.checkpoint import CheckpointPlane, load_latest
+from ray_tpu.models import llama
+from ray_tpu.models.continuous_batching import ContinuousBatcher
+from ray_tpu.rl import (ExperienceBuffer, RolloutScheduler, SequenceRecord,
+                        TokenPPOLearner, WeightPublisher, WeightSubscriber,
+                        WeightSyncError, build_manifest, verify_manifest)
+
+pytestmark = pytest.mark.chaos
+
+CFG = llama.LlamaConfig.tiny()
+
+
+def _tiny_params(seed: int = 0, scale: float = 1.0):
+    import jax
+
+    params = llama.init_params(CFG, jax.random.PRNGKey(seed))
+    if scale != 1.0:
+        params = jax.tree.map(lambda a: (a * scale).astype(a.dtype), params)
+    return params
+
+
+def _host(params):
+    import jax
+
+    return jax.tree.map(np.asarray, params)
+
+
+def _leaves(params):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree.leaves(params)]
+
+
+def _assert_tree_equal(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert x.dtype == y.dtype and x.shape == y.shape
+        assert np.array_equal(x, y)
+
+
+def _counter_value(metric, **want):
+    total = 0.0
+    for _, tags, v in metric.samples():
+        td = dict(tags)
+        if all(td.get(k) == v2 for k, v2 in want.items()):
+            total += v
+    return total
+
+
+# -------------------------------------------------------- unit: manifests
+
+def test_manifest_roundtrip_and_crc_gate():
+    leaves = _leaves(_tiny_params())
+    manifest = build_manifest("r", version=3, step=7, leaves=leaves)
+    assert manifest["version"] == 3 and manifest["step"] == 7
+    assert manifest["bytes"] == sum(a.nbytes for a in leaves)
+    verify_manifest(manifest, leaves)  # clean payload passes
+    corrupted = [a.copy() for a in leaves]
+    corrupted[0].flat[0:1] = corrupted[0].flat[0:1] + 1
+    with pytest.raises(WeightSyncError, match="crc mismatch"):
+        verify_manifest(manifest, corrupted)
+    with pytest.raises(WeightSyncError, match="leaves"):
+        verify_manifest(manifest, leaves[:-1])
+
+
+def test_publisher_subscriber_fast_path(tmp_path):
+    """One publish lands in the subscriber crc-verified AND on disk as a
+    committed checkpoint at step=version (the slow path's source)."""
+    plane = CheckpointPlane(str(tmp_path), run="rlsync",
+                            process_index=0, process_count=1)
+    pub = WeightPublisher(run="rlsync", n_subscribers=1, ckpt_plane=plane)
+    try:
+        sub = WeightSubscriber(pub.subscriber_spec(0), run="rlsync")
+        assert sub.poll(timeout=0.05) is None  # nothing published yet
+        params = _tiny_params()
+        manifest = pub.publish(params, step=12)
+        assert manifest["version"] == 1 and "shed" not in manifest
+        got = sub.poll(timeout=5.0)
+        assert got is not None
+        m, received = got
+        assert m["version"] == 1 and sub.version == 1
+        _assert_tree_equal(_host(params), received)
+        # Slow path twin: the same version restores from the filesystem.
+        cold = load_latest(str(tmp_path), run="rlsync", step=1)
+        cold = getattr(cold, "params", cold)
+        _assert_tree_equal(_host(params), cold)
+    finally:
+        pub.destroy()
+        plane.close()
+
+
+def test_publish_shed_names_the_lagging_subscriber():
+    """Backpressure is bounded: with a subscriber sitting on the previous
+    value, the next publish sheds past the timeout — attributing the
+    laggard by index — instead of stalling the optimizer."""
+    pub = WeightPublisher(run="shed", n_subscribers=1,
+                          publish_timeout_s=0.2)
+    try:
+        params = _tiny_params()
+        before = _counter_value(mdefs.RL_SYNC_SHED, run="shed")
+        m1 = pub.publish(params, step=0)   # lands (nothing to ack yet)
+        assert "shed" not in m1
+        m2 = pub.publish(params, step=1)   # nobody read v1
+        assert m2["shed"] == [0], "shed must name subscriber 0"
+        assert pub.lagging_subscribers() == [0]
+        assert _counter_value(mdefs.RL_SYNC_SHED, run="shed",
+                              subscriber="0") == before + 1
+        sheds = flight.local_events(types=["rl.publish_shed"])
+        assert any(e["subject"].get("run") == "shed" for e in sheds)
+    finally:
+        pub.destroy()
+
+
+# ------------------------------------------------- unit: experience + PPO
+
+def _records():
+    return [
+        SequenceRecord(prompt=[1, 2, 3], tokens=[7, 8],
+                       logprobs=np.array([-1.0, -2.0], np.float32),
+                       reward=1.0, weight_version=2, staleness=0),
+        SequenceRecord(prompt=[4, 5], tokens=[9, 10, 11],
+                       logprobs=np.array([-0.5, -0.25, -3.0], np.float32),
+                       reward=0.0, weight_version=1, staleness=1),
+    ]
+
+
+def test_experience_buffer_packs_learner_group_layout():
+    buf = ExperienceBuffer()
+    for r in _records():
+        buf.add(r)
+    batch = buf.to_batch()
+    # S = max(prompt + generated) = 5, T = max(generated) = 3, N = 2.
+    assert batch["tokens_full"].shape == (5, 2)
+    assert batch["actions"].shape == (3, 2)
+    assert batch["mask"].tolist() == [[1, 1], [1, 1], [0, 1]]
+    assert batch["prompt_len"].tolist() == [[3, 2]]
+    assert batch["weight_version"].tolist() == [[2, 1]]
+    assert batch["staleness"].tolist() == [[0, 1]]
+    assert batch["tokens_full"][:, 0].tolist() == [1, 2, 3, 7, 8]
+    assert batch["tokens_full"][:, 1].tolist() == [4, 5, 9, 10, 11]
+    # Whitened advantages: reward 1 above the mean, reward 0 below.
+    assert batch["advantages"][0, 0] > 0 > batch["advantages"][0, 1]
+    # LearnerGroup._shard slices axis 1 uniformly — [1, N] scalars ride.
+    from ray_tpu.rllib.learner_group import LearnerGroup
+
+    shards = LearnerGroup._shard(batch, 2)
+    assert len(shards) == 2
+    assert shards[0]["actions"].shape == (3, 1)
+    assert shards[1]["weight_version"].tolist() == [[1]]
+
+
+def test_token_ppo_learner_descends_its_surrogate():
+    """Gradient sanity: repeated updates on one fixed batch reduce the
+    PPO surrogate (convergence in its most deterministic form)."""
+    buf = ExperienceBuffer()
+    rng = np.random.default_rng(0)
+    for n in range(4):
+        toks = [int(t) for t in rng.integers(1, 32, size=4)]
+        buf.add(SequenceRecord(
+            prompt=[1 + n, 2], tokens=toks,
+            logprobs=np.full(4, -np.log(CFG.vocab_size), np.float32),
+            reward=float(n % 2), weight_version=0, staleness=0))
+    batch = buf.to_batch()
+    learner = TokenPPOLearner(CFG, params=_tiny_params(), lr=1e-2)
+    losses = [learner.update_from_batch(batch)["total_loss"]]
+    assert np.isfinite(losses[0])
+    for _ in range(5):
+        losses.append(learner.update_from_batch(batch)["total_loss"])
+    assert losses[-1] < losses[0], f"surrogate did not descend: {losses}"
+
+
+def test_rollout_scheduler_staleness_clip_and_metrics():
+    def fake_generate(prompt, max_new):
+        return [5] * max_new, np.zeros(max_new, np.float32), 1
+
+    sched = RolloutScheduler(fake_generate, trainer_version_fn=lambda: 4,
+                             run="clip", staleness_clip=2)
+    admitted = sched.collect([[1], [2]], 3, lambda p, t: 1.0)
+    assert admitted == 0 and sched.dropped_stale == 2  # staleness 3 > 2
+    assert len(sched.buffer) == 0
+    clips = [e for e in flight.local_events(types=["rl.rollout_clip"])
+             if e["subject"].get("run") == "clip"]
+    assert clips and clips[-1]["attrs"]["staleness"] == 3
+    # Within the clip: admitted and tagged with its staleness.
+    sched2 = RolloutScheduler(fake_generate, trainer_version_fn=lambda: 2,
+                              run="clip2", staleness_clip=2)
+    assert sched2.collect([[1]], 3, lambda p, t: 1.0) == 1
+    assert sched2.buffer.staleness() == [1]
+
+
+# ------------------------------------- engine: tick-boundary weight swap
+
+def _drive(eng, rid, max_ticks=400):
+    """Step the engine until ``rid`` finishes; return its tokens."""
+    for _ in range(max_ticks):
+        finished = eng.step()
+        if rid in finished:
+            return finished[rid]
+    raise AssertionError("request never finished")
+
+
+def test_swap_params_mid_request_is_tick_boundary_and_tagged():
+    eng = ContinuousBatcher(CFG, num_slots=2, max_len=64)
+    assert eng.weight_version == 0
+    rid = eng.submit(list(range(1, 6)), max_new_tokens=8)
+    for _ in range(3):
+        eng.step()  # a few tokens land under v0
+    v = eng.swap_params(_tiny_params(scale=0.5), version=None)
+    assert v == 1 and eng.weight_version == 1
+    tokens = _drive(eng, rid)
+    # The in-flight request survived the swap un-dropped, full budget.
+    assert len(tokens) == 8
+    rec = [b for b in eng.request_breakdowns if b["rid"] == rid][-1]
+    assert rec["outcome"] == "finished"
+    # Version tagging: the request records the version that ADMITTED it.
+    assert rec["weight_version"] == 0
+    rid2 = eng.submit([1, 2, 3], max_new_tokens=2)
+    _drive(eng, rid2)
+    rec2 = [b for b in eng.request_breakdowns if b["rid"] == rid2][-1]
+    assert rec2["weight_version"] == 1
+
+
+def test_swap_params_rejects_mismatched_trees():
+    import jax
+
+    eng = ContinuousBatcher(CFG, num_slots=2, max_len=64)
+    bad = jax.tree.map(lambda a: np.zeros((1,), np.float32), eng.params)
+    with pytest.raises(ValueError, match="mismatch"):
+        eng.swap_params(bad)
+    assert eng.weight_version == 0  # failed swap must not bump
+
+
+def test_fast_path_equals_slow_path_bit_identical(tmp_path):
+    """Acceptance: greedy generation under a freshly channel-synced
+    version is bit-identical to a cold-started engine restored from the
+    SAME version's checkpoint manifest (fast ≡ slow)."""
+    plane = CheckpointPlane(str(tmp_path), run="fastslow",
+                            process_index=0, process_count=1)
+    pub = WeightPublisher(run="fastslow", n_subscribers=1,
+                          ckpt_plane=plane)
+    try:
+        sub = WeightSubscriber(pub.subscriber_spec(0), run="fastslow")
+        trained = _tiny_params(seed=3, scale=0.9)
+        manifest = pub.publish(trained, step=1)
+        m, received = sub.poll(timeout=5.0)
+
+        fast = ContinuousBatcher(CFG, num_slots=2, max_len=64)
+        fast.swap_params(received, version=int(m["version"]))
+        cold_params = load_latest(str(tmp_path), run="fastslow",
+                                  step=int(manifest["version"]))
+        cold_params = getattr(cold_params, "params", cold_params)
+        slow = ContinuousBatcher(CFG, num_slots=2, max_len=64,
+                                 params=cold_params)
+
+        prompt = list(range(1, 9))
+        out_fast = _drive(fast, fast.submit(prompt, max_new_tokens=12))
+        out_slow = _drive(slow, slow.submit(prompt, max_new_tokens=12))
+        assert out_fast == out_slow, "fast path diverged from slow path"
+        # And both score identically (the behavior-logprob surface).
+        lp_fast = fast.score_logprobs(prompt, out_fast)
+        lp_slow = slow.score_logprobs(prompt, out_slow)
+        assert np.array_equal(np.asarray(lp_fast), np.asarray(lp_slow))
+    finally:
+        pub.destroy()
+        plane.close()
+
+
+# --------------------------------------------------------------- cluster
+
+@pytest.fixture(scope="module")
+def ray_session():
+    if ray_tpu.is_initialized():
+        ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    yield
+    chaos.configure(None)
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    yield
+    chaos.configure(None)
+
+
+class _ToyLearner:
+    """Minimal LearnerGroup-compatible learner for the divergence test."""
+
+    def __init__(self):
+        self.params = {"w": np.ones(4, np.float32)}
+
+    def compute_gradients(self, batch):
+        return {"w": np.zeros(4, np.float32)}, {"loss": 0.0}
+
+    def apply_gradients(self, grads):
+        pass
+
+    def get_weights(self):
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    def set_weights(self, weights):
+        self.params = {k: np.asarray(v) for k, v in weights.items()}
+
+
+def test_learner_group_bit_identity_check_catches_perturbation(
+        ray_session):
+    """Satellite: ``get_weights()`` in chaos/debug mode verifies
+    cross-learner bit-identity — and the ``perturb_learner`` chaos site
+    proves the check fires when one learner's REPORTED weights drift."""
+    from ray_tpu.rllib.learner_group import LearnerGroup
+
+    group = LearnerGroup(_ToyLearner, num_learners=2)
+    # Chaos plan armed but firing 0 times: the verified read agrees.
+    chaos.configure("perturb_learner:rank=1,eps=0.5,times=0")
+    w = group.get_weights()
+    assert np.array_equal(w["w"], np.ones(4, np.float32))
+    # Now the fault: rank 1 reports perturbed weights exactly once.
+    chaos.configure("perturb_learner:rank=1,eps=0.5")
+    with pytest.raises(RuntimeError, match="diverged"):
+        group.get_weights()
+    fired = [e for e in chaos.injection_log()
+             if e["action"] == "perturb_learner"]
+    assert fired and fired[-1]["coords"]["rank"] == 1
+    divs = flight.local_events(types=["rl.learner_divergence"])
+    assert divs and divs[-1]["attrs"]["rank"] == 1
+    # The fault was in the REPORT, not the replica: with the rule spent,
+    # the verified read converges again.
+    w2 = group.get_weights()
+    assert np.array_equal(w2["w"], np.ones(4, np.float32))
+
+
+def test_env_runner_group_resync_carries_version(ray_session):
+    """Satellite: a respawned env runner is re-pushed the LAST broadcast
+    weights WITH their version — it reports the same weights generation
+    as its peers instead of silently sampling stale."""
+    gym = pytest.importorskip("gymnasium")
+    import jax
+
+    from ray_tpu.rllib.core import PPOModule
+    from ray_tpu.rllib.env_runner import EnvRunnerGroup
+
+    spec = dict(obs_dim=4, num_actions=2, hidden=(8,))
+    group = EnvRunnerGroup(lambda: gym.make("CartPole-v1"), spec,
+                           num_runners=2, num_envs_per_runner=1,
+                           gamma=0.99, lam=0.95)
+    weights = PPOModule(**spec).init(jax.random.PRNGKey(0))
+    v1 = group.sync_weights(weights)
+    assert v1 == 1 and group.weights_version == 1
+    versions = [ray_tpu.get(r.get_weights_version.remote(), timeout=30)
+                for r in group.runners]
+    assert versions == [1, 1]
+    broadcasts = flight.local_events(types=["rl.weights_broadcast"])
+    assert broadcasts and broadcasts[-1]["attrs"]["version"] == 1
+    # Kill a runner; the next sample notices, replaces, and on_replace
+    # re-pushes the stored (weights, version) pair.
+    ray_tpu.kill(group.runners[0])
+    group.sample(2)
+    versions = [ray_tpu.get(r.get_weights_version.remote(), timeout=30)
+                for r in group.runners]
+    assert versions == [1, 1], f"respawned runner stale: {versions}"
+    resyncs = flight.local_events(types=["rl.runner_resync"])
+    assert resyncs and resyncs[-1]["attrs"]["version"] == 1
+
+
+# ------------------------------------------------- e2e: PPO through serve
+
+LLM = "ContinuousLlamaDeployment"
+RUN = "ppo-e2e"
+
+
+def _replicas():
+    controller = ray_tpu.get_actor("__serve_controller__")
+    return ray_tpu.get(controller.get_replicas.remote(LLM), timeout=30)
+
+
+def _replica_call(r, method, *args, **kwargs):
+    return ray_tpu.get(r.handle_request.remote(method, args, kwargs),
+                       timeout=120)
+
+
+def _wait_replicas(n, timeout_s=90):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        reps = _replicas()
+        if len(reps) == n:
+            try:
+                for r in reps:
+                    ray_tpu.get(r.health.remote(), timeout=10)
+                return reps
+            except Exception:  # noqa: BLE001 — dead/starting: keep waiting
+                pass
+        time.sleep(0.2)
+    raise AssertionError(f"never reached {n} routed replicas of {LLM}")
+
+
+def _stream(payload):
+    from ray_tpu.serve.proxy import _Router
+
+    s = _Router().stream(LLM, "generate", payload)
+    s._timeout = 120.0
+    return s
+
+
+def _replica_versions():
+    out = []
+    for r in _replicas():
+        try:
+            out.append(int(_replica_call(r, "weight_version")))
+        except Exception:  # noqa: BLE001 — mid-respawn
+            out.append(-1)
+    return out
+
+
+def _build_ppo_learner():
+    # Default seed 0 == the deployment's cold-start params: trainer and
+    # generator begin on the SAME weights (version 0 on both sides).
+    return TokenPPOLearner(CFG, params=None, lr=5e-3, rho_clip=2.0)
+
+
+def _target_token_reward(prompt, tokens):
+    # A learnable scalar: fraction of generated tokens in the low band.
+    return float(sum(1 for t in tokens if t < 16)) / max(len(tokens), 1)
+
+
+def test_ppo_loop_through_real_serve_engine_with_chaos(ray_session,
+                                                       tmp_path):
+    """The tentpole acceptance run: generate through the REAL continuous-
+    batching serve engine, learn through the REAL LearnerGroup, sync
+    trained weights back over the channel plane. Versions advance
+    without dropping streams; a replica killed mid-generation recovers
+    (journal resume) and is brought current again (slow-path restore
+    from the publish's own checkpoint manifest); fast-path swaps chain
+    causally to their publish (``ray-tpu why run``-reconstructable)."""
+    from ray_tpu.llm import build_continuous_llama_app
+    from ray_tpu.rllib.learner_group import LearnerGroup
+
+    app = build_continuous_llama_app(config=CFG, num_replicas=2,
+                                     num_slots=4, max_len=64)
+    serve.run(app, name="llm")
+    plane = CheckpointPlane(str(tmp_path), run=RUN,
+                            process_index=0, process_count=1)
+    pub = WeightPublisher(run=RUN, n_subscribers=2, ckpt_plane=plane,
+                          publish_timeout_s=2.0)
+    try:
+        reps = _wait_replicas(2)
+        for i, r in enumerate(reps):
+            _replica_call(r, "enable_weight_sync", pub.subscriber_spec(i),
+                          run=RUN, poll_s=0.02)
+
+        def generate(prompt, max_new):
+            payload = {"prompt_token_ids": list(prompt),
+                       "max_tokens": max_new}
+            tokens = [int(t) for t in _stream(payload)]
+            # Behavior logprobs from a live replica's CURRENT params
+            # (post-sync, all replicas hold the same version).
+            last = None
+            for r in _replicas():
+                try:
+                    lp = np.asarray(
+                        _replica_call(r, "score_logprobs", list(prompt),
+                                      tokens), np.float32)
+                    version = int(_replica_call(r, "weight_version"))
+                    return tokens, lp, version
+                except Exception as e:  # noqa: BLE001 — mid-respawn
+                    last = e
+            raise last
+
+        def converge(manifest):
+            """Wait for every replica to reach the manifest's version; a
+            replica that lost its channel slot (respawned after a kill)
+            is brought current through the slow path — restore from the
+            SAME publish's checkpoint manifest and swap at the tick
+            boundary."""
+            version = int(manifest["version"])
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if all(v == version for v in _replica_versions()):
+                    return
+                time.sleep(0.05)
+            for r in _replicas():
+                try:
+                    if int(_replica_call(r, "weight_version")) >= version:
+                        continue
+                    params = load_latest(manifest["ckpt_root"],
+                                         run=manifest["ckpt_run"],
+                                         step=version)
+                    params = getattr(params, "params", params)
+                    _replica_call(r, "swap_weights", params,
+                                  version=version, cause="fallback",
+                                  manifest=manifest, run=RUN)
+                except Exception:  # noqa: BLE001 — still respawning
+                    pass
+            vs = _replica_versions()
+            assert all(v == version for v in vs), \
+                f"replicas stuck at {vs}, want {version}"
+
+        learner = LearnerGroup(_build_ppo_learner, num_learners=1)
+        sched = RolloutScheduler(generate, lambda: pub.version, run=RUN)
+        prompts = [[1, 2, 3], [4, 5, 6], [7, 8], [9, 10, 11, 12]]
+
+        losses = []
+        kills = []
+        resumes_before = _counter_value(mdefs.SERVE_REPLICA_RESUMES,
+                                        deployment=LLM)
+        for rnd in range(3):
+            if rnd == 1:
+                # Mid-loop fault: a replica dies 2 tokens into a stream.
+                chaos.configure("kill_replica:phase=decode,token=2",
+                                seed=11)
+            admitted = sched.collect(prompts, 6, _target_token_reward,
+                                     cause=f"round-{rnd}")
+            kills += [e for e in chaos.injection_log()
+                      if e["action"] == "kill_replica"]
+            chaos.configure(None)
+            assert admitted == len(prompts), \
+                "a stream dropped out of the learner feed"
+            batch = sched.drain_batch()
+            metrics = sched.learner_phase(
+                lambda b=batch: learner.update(b), cause=f"round-{rnd}")
+            assert np.isfinite(metrics["total_loss"])
+            losses.append(metrics["total_loss"])
+            manifest = pub.publish(learner.get_weights(), step=rnd,
+                                   cause=f"round-{rnd}")
+            if rnd == 0:
+                # Pre-kill: both subscribers live, fast path only.
+                assert "shed" not in manifest, manifest.get("shed")
+            converge(manifest)
+
+        # The loop learned through real plumbing: versions 1..3 landed on
+        # every replica, in order, and the loss stream stayed intact.
+        assert pub.version == 3
+        assert _replica_versions() == [3, 3]
+        assert len(losses) == 3 and all(np.isfinite(x) for x in losses)
+        # The mid-loop kill was REAL and the journal recovered it.
+        assert kills, "the chaos kill never fired"
+        assert _counter_value(mdefs.SERVE_REPLICA_RESUMES,
+                              deployment=LLM) > resumes_before
+
+        # Swap-chain observability: every applied version emitted
+        # rl.weight_swap{version, swap_cause} on subject run=RUN, caused
+        # by its publish event — `ray-tpu why run <id>` walks the chain.
+        swaps = [e for e in flight.local_events(types=["rl.weight_swap"])
+                 if e["subject"].get("run") == RUN]
+        assert {e["attrs"]["version"] for e in swaps} >= {1, 2, 3}
+        assert any(e["attrs"]["swap_cause"] == "publish" for e in swaps)
+        pubs = [e for e in
+                flight.local_events(types=["rl.manifest_publish"])
+                if e["subject"].get("run") == RUN]
+        pub_ids = {e["event_id"] for e in pubs}
+        chained = [e for e in swaps if e["cause"] in pub_ids]
+        assert chained, "no weight_swap chained to its publish event"
+        chain_ids = {rec["event_id"] for rec in flight.causal_chain(
+            flight.local_events(limit=100000), [chained[0]["cause"]])}
+        assert chained[0]["event_id"] in chain_ids
+        # Counters the dashboard "rl" panel reads all moved.
+        assert _counter_value(mdefs.RL_SWAPS, run=RUN) >= 3
+        assert _counter_value(mdefs.RL_SYNC_BYTES, run=RUN,
+                              path="publish") > 0
+
+        for r in _replicas():
+            try:
+                _replica_call(r, "disable_weight_sync")
+            except Exception:  # noqa: BLE001
+                pass
+    finally:
+        chaos.configure(None)
+        try:
+            serve.delete(LLM)
+        finally:
+            pub.destroy()
+            plane.close()
