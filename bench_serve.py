@@ -25,7 +25,11 @@ climb curve, not one point. A ``spec_phase`` section (r06+) runs the
 speculative-decoding ladder — committed decode tokens/s at ``spec_k``
 in {0, 2, 4} with accept rates — since a spec tick commits a variable
 number of tokens, all throughput figures here are COMMITTED tokens
-over wall time, never ticks times slots.
+over wall time, never ticks times slots. A ``disagg_phase`` section
+(r07+) A/Bs colocated against split prefill/decode engines on a mixed
+long-prefill/long-decode backlog — TTFT/TPOT each way, KV-transfer
+bytes/s over the real shm-channel path, and the export/channel/import
+handoff breakdown, which must sum to the measured handoff wall.
 
 Criterion (v5e HBM roofline): every decode tick must read the full
 parameter set plus the active KV prefixes from HBM, so
@@ -293,6 +297,206 @@ def _spec_phase(config, params, num_slots, max_len, prompt_len, ticks,
     return out
 
 
+def _disagg_phase(config, params, num_slots, max_len, block_size,
+                  long_prompt, short_prompt, long_new, short_new,
+                  rounds) -> dict:
+    """Disaggregated prefill/decode A/B (ISSUE-20 tentpole): the same
+    mixed workload — alternating long-prefill requests (``long_prompt``
+    tokens, ``short_new`` generated) and long-decode requests
+    (``short_prompt`` tokens, ``long_new`` generated) — run colocated
+    (one ``role="both"`` engine) and split (a ``role="prefill"`` engine
+    handing finished KV blocks to a ``role="decode"`` engine over the
+    REAL shm-channel path, ``kv_transfer.send_handoff`` →
+    ``receive_handoff``). Client-visible TTFT for the split leg closes
+    when ``receive_handoff`` returns: that is the moment the prefill's
+    first token lands in a live decode slot and streams out. Reported:
+    TTFT p50/p95 and TPOT each way, transfer bytes/s over the handoff
+    wall, and the handoff latency breakdown (export/channel/import)
+    from the decode engine's ``request_breakdowns`` — whose components
+    must sum to the measured handoff wall
+    (``breakdown_cover_frac`` ~ 1.0). Acceptance: split TTFT p95 <=
+    colocated TTFT p95 on this mixed shape (long decodes hold
+    colocated slots hostage; the dedicated prefill engine never
+    waits on them)."""
+    import numpy as _np
+
+    from ray_tpu.models.continuous_batching import ContinuousBatcher
+    from ray_tpu.serve import kv_transfer
+
+    rng = _np.random.default_rng(23)
+
+    # 1-in-4 requests is prefill-heavy, the rest decode-heavy: the
+    # chat-fleet shape disaggregation exists for — long generations
+    # hold colocated slots hostage while fresh prompts queue behind
+    # them, which is exactly the contention the split topology removes.
+    def _mixed(n):
+        reqs = []
+        for i in range(n):
+            if i % 4 == 0:
+                size, new = long_prompt, short_new   # prefill-heavy
+            else:
+                size, new = short_prompt, long_new   # decode-heavy
+            reqs.append((list(map(int, rng.integers(
+                1, config.vocab_size, size=size))), new))
+        return reqs
+
+    # Warm-up replays the exact workload shape with its own prompts:
+    # same backlog size, same max_new mix — so every admission-batch
+    # and prefill bucket the timed run hits is compiled, and the radix
+    # cache cannot splice the timed prefills on either leg.
+    warm = _mixed(rounds * num_slots)
+    sched = _mixed(rounds * num_slots)
+
+    def _pair(vals):
+        v = sorted(vals)
+        return (round(_pct(v, 0.50) * 1e3, 2),
+                round(_pct(v, 0.95) * 1e3, 2))
+
+    out = {"requests": len(sched),
+           "long_prompt": long_prompt, "short_prompt": short_prompt,
+           "long_new": long_new, "short_new": short_new}
+
+    # ---- colocated leg: one engine does both phases; long decodes and
+    # incoming prefills contend for the same slots and ticks.
+    submit_ts = {}
+    ttft = []
+
+    def on_token(rid, _tok):
+        t0 = submit_ts.pop(rid, None)
+        if t0 is not None:
+            ttft.append(time.perf_counter() - t0)
+
+    colo = ContinuousBatcher(config, params=params, role="both",
+                             num_slots=num_slots, max_len=max_len,
+                             sync_every=1, paged=True,
+                             block_size=block_size,
+                             token_callback=on_token)
+    def _run_colo(reqs):
+        t0 = time.perf_counter()
+        for prompt, n in reqs:  # full backlog up front, same both legs
+            rid = colo.submit(list(prompt), max_new_tokens=n)
+            submit_ts[rid] = time.perf_counter()
+        while colo.has_work():
+            colo.step()
+        return time.perf_counter() - t0
+
+    _run_colo(warm)
+    ttft.clear()
+    submit_ts.clear()
+    colo.request_breakdowns.clear()
+    colo_wall = _run_colo(sched)
+    colo_p50, colo_p95 = _pair(ttft)
+    colo_tpot = sorted(b["tpot_s"] for b in colo.request_breakdowns
+                       if b.get("tpot_s") is not None)
+    out["colocated"] = {
+        "ttft_p50_ms": colo_p50, "ttft_p95_ms": colo_p95,
+        "tpot_p50_ms": round(_pct(colo_tpot, 0.50) * 1e3, 3),
+        "wall_s": round(colo_wall, 3)}
+    del colo
+
+    # ---- split leg: dedicated prefill engine exports each parked
+    # request through a real shm channel into the decode engine, gated
+    # on a free decode slot (production pre-reserves; the bench polls).
+    pre = ContinuousBatcher(config, params=params, role="prefill",
+                            num_slots=num_slots, max_len=max_len,
+                            sync_every=1, paged=True,
+                            block_size=block_size)
+    # Role-specific sizing is one of disaggregation's levers: a decode
+    # slot costs arena blocks, not prefill compute, so a decode-role
+    # engine runs more concurrent generations than a colocated engine
+    # (which must bound admission by prefill interference).
+    decode_slots = 2 * num_slots
+    dec = ContinuousBatcher(config, params=params, role="decode",
+                            num_slots=decode_slots, max_len=max_len,
+                            sync_every=1, paged=True,
+                            block_size=block_size)
+    submit_ts.clear()
+    split_ttft = []
+    handoff_walls = []
+    xfer_bytes = 0
+
+    def _run_split(reqs):
+        nonlocal xfer_bytes
+        inflight = []  # sent manifests waiting on a free decode slot
+        t0 = time.perf_counter()
+        for prompt, n in reqs:
+            rid = pre.submit(list(prompt), max_new_tokens=n)
+            submit_ts[rid] = time.perf_counter()
+        while (pre.has_work() or pre.handoff_ready() or inflight
+               or dec.has_work()):
+            if pre.has_work():
+                pre.step()
+            for rid in list(pre.handoff_ready()):
+                # Send frees the prefill slot/blocks immediately: the
+                # bytes wait in the shm channel, never on the prefill
+                # engine, so the next admission wave starts now.
+                ts0 = time.perf_counter()
+                m = kv_transfer.send_handoff(pre, rid,
+                                             deployment="bench")
+                m["journaled"] = True  # bench drives the transfer
+                inflight.append(
+                    (m, rid, time.perf_counter() - ts0))
+            while inflight and dec._free:
+                m, rid, send_s = inflight.pop(0)
+                tr0 = time.perf_counter()
+                kv_transfer.receive_handoff(dec, m, deployment="bench")
+                now = time.perf_counter()
+                # Transfer wall = send + receive durations; channel
+                # queue time (waiting on a decode slot) is admission
+                # pressure, not transfer cost.
+                handoff_walls.append(send_s + (now - tr0))
+                split_ttft.append(now - submit_ts.pop(rid))
+                xfer_bytes += m["nbytes"]
+            if dec.has_work():
+                dec.step()
+        return time.perf_counter() - t0
+
+    _run_split(warm)
+    submit_ts.clear()
+    split_ttft.clear()
+    handoff_walls.clear()
+    xfer_bytes = 0
+    pre.request_breakdowns.clear()
+    dec.request_breakdowns.clear()
+    split_wall = _run_split(sched)
+    split_p50, split_p95 = _pair(split_ttft)
+    split_tpot = sorted(b["tpot_s"] for b in dec.request_breakdowns
+                        if b.get("tpot_s") is not None)
+    comps = [b["handoff"] for b in dec.request_breakdowns
+             if b.get("handoff")]
+    breakdown = {}
+    comp_total = 0.0
+    for leg in ("export_s", "channel_s", "import_s"):
+        vals = [c.get(leg, 0.0) for c in comps]
+        comp_total += sum(vals)
+        p50, p95 = _pair(vals)
+        breakdown[leg.replace("_s", "_p50_ms")] = p50
+        breakdown[leg.replace("_s", "_p95_ms")] = p95
+    wall_total = sum(handoff_walls)
+    out["split"] = {
+        "decode_slots": decode_slots,
+        "ttft_p50_ms": split_p50, "ttft_p95_ms": split_p95,
+        "tpot_p50_ms": round(_pct(split_tpot, 0.50) * 1e3, 3),
+        "wall_s": round(split_wall, 3),
+        "transfer": {
+            "handoffs": len(handoff_walls),
+            "bytes_total": xfer_bytes,
+            "bytes_per_s": round(xfer_bytes / max(wall_total, 1e-9), 1),
+            "handoff_wall_p50_ms": _pair(handoff_walls)[0],
+            "handoff_wall_p95_ms": _pair(handoff_walls)[1],
+            "breakdown": breakdown,
+            # export_s + channel_s + import_s over the measured wall —
+            # the acceptance check that the breakdown accounts for the
+            # handoff, not a fraction of it.
+            "breakdown_cover_frac": round(
+                comp_total / max(wall_total, 1e-9), 3),
+        }}
+    out["split_vs_colocated_ttft_p95"] = round(
+        split_p95 / max(colo_p95, 1e-9), 3)
+    kv_transfer.reap_channels(force=True)
+    return out
+
+
 def main() -> None:
     from ray_tpu.models import llama
     from ray_tpu.models.continuous_batching import ContinuousBatcher
@@ -401,6 +605,24 @@ def main() -> None:
                                  draft_layers_full=config.num_layers,
                                  draft_layers_cheap=1)
 
+    # Phase 2e — disaggregated prefill/decode A/B (ISSUE-20 tentpole):
+    # the same mixed long-prefill/long-decode backlog colocated vs
+    # split over the KV-block channel plane. Acceptance: split TTFT
+    # p95 <= colocated TTFT p95, breakdown components sum to the
+    # handoff wall.
+    if on_tpu:
+        disagg_phase = _disagg_phase(config, eng.params, num_slots,
+                                     max_len=512, block_size=64,
+                                     long_prompt=256, short_prompt=32,
+                                     long_new=128, short_new=8,
+                                     rounds=2)
+    else:
+        disagg_phase = _disagg_phase(config, eng.params, num_slots=4,
+                                     max_len=128, block_size=16,
+                                     long_prompt=40, short_prompt=8,
+                                     long_new=80, short_new=4,
+                                     rounds=3)
+
     # Phase 3 — steady-state decode at full occupancy. No per-tick
     # device sync: the buffered engine's whole point is overlapping
     # fetches with compute, so the wall clock over the window is the
@@ -466,6 +688,7 @@ def main() -> None:
         "ttft_breakdown": ttft_breakdown,
         "prefix_phase": prefix_phase,
         "spec_phase": spec_phase,
+        "disagg_phase": disagg_phase,
         "prefill_tokens_per_s": round(prefill_tokens / prefill_wall, 1),
         # Live-token accounting is the headline figure (it is what the
         # achieved-BW gauges use); the static cost-analysis figure rides

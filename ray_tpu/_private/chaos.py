@@ -96,6 +96,17 @@ Actions:
                     loop — a drain that takes real time, under which the
                     arbiter's FREEING stage (and its deadline handling)
                     must hold.
+``kill_transfer``   uncooperative replica death MID-KV-TRANSFER at the
+                    disaggregated handoff site (``kv_transfer``,
+                    matchable on ``stage=export|import``): ``export``
+                    kills the prefill replica while it materializes the
+                    KV payload, ``import`` kills the decode replica
+                    after the handoff was journaled but before decode
+                    streams — the two exactly-once legs of the
+                    disaggregated failure plane.
+``delay_transfer``  sleeps ``secs`` at the ``kv_transfer`` site (same
+                    ``stage=`` matching) — a slow handoff under which
+                    streams and transfer timeouts must hold.
 ``kill_arbiter``    uncooperative chip-pool-arbiter death at its tick
                     boundary (``pool_tick``, matchable on ``tick=N``) —
                     raises :class:`SimulatedProcessDeath`; the restarted
@@ -169,6 +180,10 @@ _ACTION_SITES = {
     "drop_pressure": "serve_pressure",
     "delay_tick": "serve_tick",
     "delay_drain": "serve_drain",
+    # Disaggregated prefill/decode: deaths and delays mid-KV-transfer
+    # (matchable on stage=export|import — which side of the handoff).
+    "kill_transfer": "kv_transfer",
+    "delay_transfer": "kv_transfer",
     # Chip-pool / autoscaler sites (ray_tpu/autoscaler): handoff and
     # provider faults.
     "preempt_node": "pool_handoff",
@@ -395,7 +410,8 @@ def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
            event_id: str = "") -> None:
     action = rule.action
     logger.warning("chaos: injecting %s at %s %s", action, site, coords)
-    if action in ("kill_worker", "kill_replica", "kill_arbiter"):
+    if action in ("kill_worker", "kill_replica", "kill_arbiter",
+                  "kill_transfer"):
         resize = rule.params.get("resize")
         if resize:
             _publish_resize(int(resize), reason="chaos-node-lost")
@@ -431,11 +447,12 @@ def _apply(plan: ChaosPlan, rule: ChaosRule, site: str,
         directives["drop"] = True
     elif action == "delay_heartbeat":
         directives["delay_s"] = float(rule.params.get("secs", 1.0))
-    elif action in ("delay_tick", "delay_drain"):
-        # Delayed engine tick / drain wait: the serve decode loop (or a
-        # replica's drain) stutters without any request dying — drives
-        # drain-under-load, streaming-timeout and slow-FREEING paths
-        # with requests genuinely still in flight.
+    elif action in ("delay_tick", "delay_drain", "delay_transfer"):
+        # Delayed engine tick / drain wait / KV handoff: the serve
+        # decode loop (or a replica's drain, or a prefill→decode
+        # KV-block transfer) stutters without any request dying — drives
+        # drain-under-load, streaming-timeout, slow-FREEING and
+        # slow-handoff paths with requests genuinely still in flight.
         delay = float(rule.params.get("secs", 0.05))
         time.sleep(delay)
         directives["slept_s"] = delay

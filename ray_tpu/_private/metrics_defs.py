@@ -190,7 +190,9 @@ SERVE_AUTOSCALE_DECISIONS = Counter(
 # histogram within bookkeeping noise), and TPOT is the steady decode
 # cadence after the first token. Tagged per deployment and per tenant
 # (the multiplexed model id) so one noisy tenant is attributable.
-_REQ_TAGS = ("deployment", "tenant", "engine")
+# ``role`` carries the engine's disaggregation role
+# (prefill/decode/both) so split fleets' TTFT/TPOT separate cleanly.
+_REQ_TAGS = ("deployment", "tenant", "engine", "role")
 SERVE_REQ_TTFT = Histogram(
     "ray_tpu_serve_request_ttft_seconds",
     "Time to first token: engine submit to first-token fetch "
@@ -227,8 +229,41 @@ SERVE_REQ_TPOT = Histogram(
 SERVE_REQ_OUTCOMES = Counter(
     "ray_tpu_serve_request_outcomes_total",
     "Engine request terminations by outcome "
-    "(finished/evicted/aborted)",
+    "(finished/evicted/aborted/prefilled — prefilled is a prefill-role "
+    "engine parking the request for KV handoff at its first token)",
     _REQ_TAGS + ("outcome",))
+
+# ------------------------------- disaggregated prefill/decode handoff (L6)
+# The KV-block transfer plane between prefill and decode replicas: every
+# cross-replica export/import rides the journal-gated helper in
+# ray_tpu/serve/kv_transfer.py (a source lint pins the call sites), and
+# these series are observed there. ``direction`` partitions the handoff
+# wall into its three legs: export (arena gather -> host staging),
+# channel (shm channel write->read, absent on the in-process fast path),
+# import (crc verify + arena scatter + radix insert).
+_KV_TRANSFER_TAGS = ("deployment", "direction")
+SERVE_KV_TRANSFER_SECONDS = Histogram(
+    "ray_tpu_serve_kv_transfer_seconds",
+    "KV handoff leg wall time, by direction (export/channel/import)",
+    boundaries=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0),
+    tag_keys=_KV_TRANSFER_TAGS)
+SERVE_KV_TRANSFER_BYTES = Counter(
+    "ray_tpu_serve_kv_transfer_bytes_total",
+    "Staging-buffer bytes moved by KV handoffs, by direction",
+    _KV_TRANSFER_TAGS)
+SERVE_KV_TRANSFER_BLOCKS = Counter(
+    "ray_tpu_serve_kv_transfer_blocks_total",
+    "Arena blocks moved by KV handoffs, by direction",
+    _KV_TRANSFER_TAGS)
+SERVE_HANDOFFS = Counter(
+    "ray_tpu_serve_handoff_total",
+    "Prefill->decode handoffs by outcome (ok: imported and streaming; "
+    "prefill_died: death before the manifest — resubmitted, cause="
+    "resubmit; decode_died: death after the journaled handoff — "
+    "replayed as a fresh prefill, cause=resume; crc_mismatch: payload "
+    "failed verification on import)",
+    ("deployment", "outcome"))
 
 # ------------------------------------------------ event/span buffer drops
 EVENTS_DROPPED = Counter(

@@ -95,6 +95,8 @@ prefill; TPOT)</h2><div id="reqlat"></div>
 <h2>Serve / replica pressure</h2><table id="pressure"></table>
 <h2>Serve / replica lifecycle (drains, deaths, resumes)</h2>
 <div id="lifecycle"></div>
+<h2>Serve / disaggregated prefill&rarr;decode (KV handoffs)</h2>
+<div id="disagg"></div>
 <h2>Train / input pipeline (stall, prefetch occupancy, bytes/s)</h2>
 <div id="ingest"></div>
 <h2>Train / goodput &amp; stragglers (wall-clock attribution, per-rank
@@ -399,6 +401,22 @@ async function lifecyclePanel(){
   document.getElementById("lifecycle").innerHTML=
     sparkRows(reps.concat(drain),40)||"(no replica lifecycle events)";
 }
+async function disaggPanel(){
+  // Disaggregated serving: handoff_total{outcome} is the exactly-once
+  // ledger (ok vs prefill_died/decode_died recoveries vs crc_mismatch
+  // — any nonzero mismatch is an escalation), kv_transfer_bytes/blocks
+  // {direction} are the export→channel→import volume (the legs should
+  // track each other; a gap means orphaned channels), and the transfer
+  // seconds histogram is the handoff's latency contribution to TTFT.
+  const hand=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_serve_handoff_total&since=300&agg=last&step=3"+
+    "&limit=20");
+  const xfer=await j("/api/v1/metrics/query?"+
+    "series=ray_tpu_serve_kv_transfer_*&since=300&agg=avg&step=3"+
+    "&limit=30");
+  document.getElementById("disagg").innerHTML=
+    sparkRows(hand.concat(xfer),40)||"(no KV handoffs yet)";
+}
 async function xlaPanel(){
   // Compile/retrace table per (node, program) from the xla series the
   // push plane lands in the TSDB, plus the registered profiler captures.
@@ -457,6 +475,7 @@ async function refresh(){
     await prefixPanel();
     await requestLatencyPanel();
     await lifecyclePanel();
+    await disaggPanel();
     await ingestPanel();
     await goodputPanel();
     await elasticPanel();

@@ -98,7 +98,8 @@ class ContinuousLlamaDeployment:
                  spec_k: Optional[int] = None,
                  spec_draft_layers: Optional[int] = None,
                  spec_adaptive: Optional[bool] = None,
-                 checkpoint_path: Optional[str] = None):
+                 checkpoint_path: Optional[str] = None,
+                 role: Optional[str] = None):
         """Engine knobs (``num_slots``, ``max_len``, ``sync_every``,
         ``use_decode_kernel``, and the paged-KV plane's ``paged`` /
         ``block_size`` / ``kv_dtype`` / ``num_blocks`` / ``sampling``)
@@ -118,9 +119,18 @@ class ContinuousLlamaDeployment:
         ``spec_adaptive`` lets the accept-rate controller ladder k (down
         to 0 = the plain tick). All three are ordinary ``init_kwargs``
         overrides, so a YAML deploy config can turn speculation on per
-        deployment."""
+        deployment.
+
+        ``role`` (or ``RAY_TPU_SERVE_ROLE``) makes this a disaggregated
+        replica: ``"prefill"`` replicas serve :meth:`prefill` (admission
+        + paged prefill, then export the KV handoff), ``"decode"``
+        replicas serve :meth:`decode_from` / :meth:`reserve_kv` (import
+        the handoff and run the decode ticks) — plus every colocated
+        entry point. The default ``"both"`` is the ordinary colocated
+        engine."""
         import queue
         import threading
+        import uuid
 
         from ray_tpu.models.continuous_batching import ContinuousBatcher
 
@@ -140,7 +150,12 @@ class ContinuousLlamaDeployment:
             num_blocks=num_blocks, prefix_cache=prefix_cache,
             sampling=sampling, spec_k=spec_k,
             spec_draft_layers=spec_draft_layers,
-            spec_adaptive=spec_adaptive)
+            spec_adaptive=spec_adaptive, role=role)
+        # Reservation tickets are engine-local ids; the nonce scopes a
+        # ticket to THIS replica so a router whose reserve and
+        # decode_from calls landed on different replicas cannot spend
+        # one replica's ticket against another's arena.
+        self._nonce = uuid.uuid4().hex[:16]
         threading.Thread(target=self._tick_loop, daemon=True,
                          name="llm-ticks").start()
 
@@ -382,6 +397,151 @@ class ContinuousLlamaDeployment:
                 with self._lock:
                     self.batcher.cancel(rid)
 
+    # ------------------------------------ disaggregated prefill/decode
+    def _req_deployment(self) -> str:
+        from ray_tpu.serve.context import get_request_context
+
+        rctx = get_request_context()
+        return (rctx or {}).get("deployment", "")
+
+    def prefill(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Prefill-role unary: admission + paged prefill for the
+        payload, then export the finished arena blocks as a KV handoff.
+        Returns the transfer MANIFEST (staging bytes already staged in
+        a shm channel; the manifest carries the reader attach-spec) —
+        the router journals it and opens the decode stream. Requests
+        that finish AT the first token (``max_tokens == 1``, an EOS
+        first token, or a resumed prompt already ending in EOS) return
+        ``{"done": [...]}`` instead: the whole completion happened
+        here, nothing to hand off.
+
+        Chaos: ``serve_replica``/``phase=prefill`` before the submit
+        (nothing journaled — the router resubmits) and
+        ``kv_transfer``/``stage=export`` inside the transfer helper
+        (prefill death mid-export — same resubmit leg)."""
+        from ray_tpu._private import chaos
+        from ray_tpu.serve import kv_transfer
+
+        prompt = list(payload["prompt_token_ids"])
+        max_tokens = int(payload.get("max_tokens", 16))
+        resumed_tokens = int(payload.get("resumed_tokens", 0) or 0)
+        if resumed_tokens and self.batcher.eos_token is not None \
+                and prompt and prompt[-1] == self.batcher.eos_token:
+            # Mid-decode resume whose last delivered token was EOS: the
+            # generation had finished — only the end-of-stream sentinel
+            # died with the replica (see generate()).
+            return {"done": []}
+        trace = self._request_trace()
+        if chaos.enabled():
+            chaos.inject("serve_replica", phase="prefill",
+                         tokens=len(prompt))
+        q = self._queue_mod.Queue()
+        with self._lock:
+            rid = self.batcher.submit(prompt,
+                                      max_new_tokens=max_tokens,
+                                      trace=trace)
+            self._queues[rid] = q
+        self._work.set()
+        tokens: List[int] = []
+        try:
+            while True:
+                item = q.get(timeout=300)
+                if item is None:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                tokens.append(item)
+        finally:
+            self._queues.pop(rid, None)
+        with self._lock:
+            if rid not in self.batcher.handoff_ready():
+                # Finished entirely at prefill — a complete (short)
+                # generation, not a handoff.
+                return {"done": tokens}
+            return kv_transfer.send_handoff(
+                self.batcher, rid, deployment=self._req_deployment())
+
+    def reserve_kv(self, prompt_len: int, max_new: int):
+        """Pre-reserve decode arena blocks for an incoming handoff (the
+        router calls this BEFORE dispatching prefill). Returns a
+        replica-scoped ticket, or None when the arena cannot cover it
+        (the import then allocates on arrival). Unspent tickets expire
+        engine-side (``RAY_TPU_KV_RESERVE_TTL_S``)."""
+        with self._lock:
+            res = self.batcher.reserve_import(int(prompt_len),
+                                              int(max_new))
+        if res is None:
+            return None
+        return {"res_id": res, "nonce": self._nonce}
+
+    def cancel_reserve(self, ticket) -> bool:
+        if not isinstance(ticket, dict) or \
+                ticket.get("nonce") != self._nonce:
+            return False
+        with self._lock:
+            return self.batcher.cancel_reservation(ticket["res_id"])
+
+    def decode_from(self, request: Dict[str, Any]):
+        """Decode-role streaming entry: collect the journaled KV
+        handoff named by ``request["manifest"]`` (shm channel read, crc
+        verify, table-scatter into reserved blocks, radix insert) and
+        stream EVERY token — the prefill-produced first token included.
+        It reaches the caller only through this stream (the unary
+        prefill response carries it solely inside the manifest), so the
+        router's journal stays the single delivery ledger and greedy
+        decode remains exactly-once across deaths.
+
+        Chaos: ``kv_transfer``/``stage=import`` inside the transfer
+        helper (decode death after the journaled handoff — the router
+        replays as a fresh prefill, ``cause=resume``) and the usual
+        ``serve_replica``/``phase=decode`` per-token site."""
+        from ray_tpu._private import chaos
+        from ray_tpu.serve import kv_transfer
+
+        manifest = request["manifest"]
+        ticket = request.get("reservation")
+        res_id = None
+        if isinstance(ticket, dict) and \
+                ticket.get("nonce") == self._nonce:
+            res_id = ticket.get("res_id")
+        trace = self._request_trace()
+        q = self._queue_mod.Queue()
+        with self._lock:
+            # The engine fires its first-token callback during the
+            # import, before any queue could be registered under the
+            # fresh rid — the manifest's first_token is delivered
+            # explicitly below instead.
+            rid = kv_transfer.receive_handoff(
+                self.batcher, manifest, reservation=res_id,
+                trace=trace, deployment=self._req_deployment())
+            self._queues[rid] = q
+        self._work.set()
+        done = False
+        emitted = 0
+        try:
+            if chaos.enabled():
+                chaos.inject("serve_replica", phase="decode", token=0)
+            emitted = 1
+            yield int(manifest["first_token"])
+            while True:
+                token = q.get(timeout=300)
+                if token is None:
+                    done = True
+                    return
+                if isinstance(token, Exception):
+                    done = True
+                    raise token
+                if chaos.enabled():
+                    chaos.inject("serve_replica", phase="decode",
+                                 token=emitted)
+                emitted += 1
+                yield token
+        finally:
+            self._queues.pop(rid, None)
+            if not done:
+                with self._lock:
+                    self.batcher.cancel(rid)
+
     def __call__(self, request: Dict[str, Any]) -> Dict[str, Any]:
         """Non-streaming completion."""
         tokens = list(self.generate(request["prompt_token_ids"],
@@ -417,7 +577,47 @@ def build_continuous_llama_app(config: Optional[llama.LlamaConfig] = None,
                     checkpoint_path=checkpoint_path)
 
 
-__all__ += ["ContinuousLlamaDeployment", "build_continuous_llama_app"]
+def build_disagg_llama_apps(name: str = "llm",
+                            config: Optional[llama.LlamaConfig] = None,
+                            num_prefill: int = 1, num_decode: int = 1,
+                            **engine_kwargs):
+    """(prefill_app, decode_app) Application pair for disaggregated
+    serving, named ``<name>-prefill`` / ``<name>-decode``: the same
+    engine knobs on both sides (geometry MUST match — the import
+    rejects mismatched block_size/kv_dtype/model dims), the paged-KV
+    plane forced on (roles require an arena to hand off). Deploy both
+    and declare the role group, or use :func:`deploy_disagg_llama`
+    which does all three."""
+    engine_kwargs.setdefault("paged", True)
+    prefill = ContinuousLlamaDeployment.options(
+        name=f"{name}-prefill", num_replicas=num_prefill).bind(
+        config=config, role="prefill", **engine_kwargs)
+    decode = ContinuousLlamaDeployment.options(
+        name=f"{name}-decode", num_replicas=num_decode).bind(
+        config=config, role="decode", **engine_kwargs)
+    return prefill, decode
+
+
+def deploy_disagg_llama(name: str = "llm",
+                        config: Optional[llama.LlamaConfig] = None,
+                        num_prefill: int = 1, num_decode: int = 1,
+                        **engine_kwargs) -> Dict[str, str]:
+    """Deploy a disaggregated (prefill, decode) pair and register the
+    role group under the logical ``name`` — streaming requests to
+    ``/<name>/stream/...`` classify-and-split at the ingress from then
+    on. Returns the group mapping."""
+    prefill_app, decode_app = build_disagg_llama_apps(
+        name=name, config=config, num_prefill=num_prefill,
+        num_decode=num_decode, **engine_kwargs)
+    serve.run(prefill_app, name=f"{name}-prefill")
+    serve.run(decode_app, name=f"{name}-decode")
+    serve.register_role_group(name, prefill=f"{name}-prefill",
+                              decode=f"{name}-decode")
+    return {"prefill": f"{name}-prefill", "decode": f"{name}-decode"}
+
+
+__all__ += ["ContinuousLlamaDeployment", "build_continuous_llama_app",
+            "build_disagg_llama_apps", "deploy_disagg_llama"]
 
 from ray_tpu.llm.batch import LLMBatchWorker, batch_generate  # noqa: E402
 

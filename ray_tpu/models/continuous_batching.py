@@ -46,6 +46,7 @@ from __future__ import annotations
 import itertools
 import os
 import time
+import zlib
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -660,6 +661,29 @@ def _resolve_prefix_cache(prefix_cache: Optional[bool]) -> bool:
     return bool(prefix_cache)
 
 
+# Versioned wire format of an exported KV handoff payload. Bump when the
+# staging layout / manifest fields change: import refuses mismatched
+# versions instead of scattering misinterpreted bytes into the arena.
+HANDOFF_MANIFEST_VERSION = 1
+
+_ROLES = ("prefill", "decode", "both")
+
+
+def _resolve_role(role: Optional[str]) -> str:
+    """Disaggregation role: explicit arg > RAY_TPU_SERVE_ROLE env >
+    "both" (the colocated engine). "prefill" engines run admission +
+    paged prefill only and park each request's finished arena blocks
+    for export at its first token; "decode" engines additionally accept
+    imported KV payloads but otherwise behave like "both"."""
+    if role is None:
+        role = os.environ.get("RAY_TPU_SERVE_ROLE", "").strip() or "both"
+    role = str(role).lower()
+    if role not in _ROLES:
+        raise ValueError(
+            f"role must be one of {_ROLES}, got {role!r}")
+    return role
+
+
 def _resolve_decode_kernel(config: llama.LlamaConfig, max_len: int,
                            use_decode_kernel: Optional[bool],
                            paged: bool = False,
@@ -751,7 +775,8 @@ class ContinuousBatcher:
                  spec_k: Optional[int] = None,
                  spec_draft_layers: Optional[int] = None,
                  spec_adaptive: Optional[bool] = None,
-                 drafter=None):
+                 drafter=None,
+                 role: Optional[str] = None):
         """``token_callback(rid, token)`` fires for every generated token
         as it is produced (serving streams ride this).
 
@@ -820,7 +845,21 @@ class ContinuousBatcher:
         dispatches the EXACT pre-spec tick program. Greedy outputs are
         bit-identical spec-on/off; sampled acceptance is rejection
         sampling that preserves the target distribution and replays
-        deterministically across buffered rewinds."""
+        deterministically across buffered rewinds.
+
+        DISAGGREGATED ROLES (``role`` / ``RAY_TPU_SERVE_ROLE``; paged
+        engines only): ``"prefill"`` runs admission + prefill and parks
+        each request at its FIRST token with its arena blocks retained
+        for :meth:`export_kv_payload` — the engine never decode-ticks,
+        so a long prefill burst cannot stall anyone's TPOT.
+        ``"decode"`` accepts :meth:`import_kv_payload` of an exported
+        prefix (scattered into reserved blocks through the same
+        table-scatter path prefill uses, indexed into the radix tree on
+        arrival) and enters the decode tick directly. ``"both"`` (the
+        default) is the colocated engine. Greedy outputs are
+        bit-identical split vs colocated: the exported bytes are the
+        exact arena blocks (int8 scales included) the colocated decode
+        would have attended."""
         self.config = config
         self.num_slots = num_slots
         self.max_len = max_len
@@ -828,6 +867,12 @@ class ContinuousBatcher:
         self.sync_every = max(1, int(sync_every))
         self.sampling = SamplingParams.coerce(sampling)
         self.paged = _resolve_paged(paged)
+        self.role = _resolve_role(role)
+        if self.role != "both" and not self.paged:
+            raise ValueError(
+                "disaggregated prefill/decode roles need the paged KV "
+                "plane (block-granular export/import); use paged=True "
+                "or role='both'")
         self.block_size = int(block_size)
         if self.paged and (self.block_size < 8
                            or self.block_size & (self.block_size - 1)):
@@ -976,6 +1021,16 @@ class ContinuousBatcher:
         self._waiting: deque = deque()
         self._rid = itertools.count()
         self._finished: Dict[int, List[int]] = {}
+        # Disaggregation state: prefill-role engines park each request's
+        # retained arena blocks here between its first token and the
+        # export call; decode-role engines hold pre-reserved import
+        # blocks (the router reserves the decode slot BEFORE dispatching
+        # prefill so the payload never arrives to a full arena).
+        self._handoff_ready: Dict[int, Dict[str, Any]] = {}
+        self._import_reservations: Dict[int, Dict[str, Any]] = {}
+        self._reservation_ids = itertools.count()
+        self.handoff_exports = 0    # payloads exported (bench/tests)
+        self.handoff_imports = 0    # payloads imported (bench/tests)
         # Request-path telemetry: one lifecycle record per live request
         # (submit/admit/prefill/first-token/finish timestamps + the
         # caller's trace context). TTFT decomposition histograms are
@@ -1224,7 +1279,8 @@ class ContinuousBatcher:
         t = rec.get("trace") or {}
         return {"deployment": str(t.get("deployment", "")),
                 "tenant": str(t.get("tenant", "")),
-                "engine": self._mtags["engine"]}
+                "engine": self._mtags["engine"],
+                "role": self.role}
 
     def _span_common(self, rec: Dict[str, Any]) -> Dict[str, Any]:
         t = rec.get("trace") or {}
@@ -1301,7 +1357,11 @@ class ContinuousBatcher:
             "prompt_tokens": rec.get("prompt_len", 0),
             "weight_version": rec.get("weight_version"),
             "trace_id": trace.get("trace_id"),
-            "request_id": trace.get("request_id")})
+            "request_id": trace.get("request_id"),
+            # Disaggregated imports carry the handoff latency split
+            # (export_s / channel_s / import_s) — bench_serve's
+            # disagg_phase sums these against the handoff wall.
+            "handoff": rec.get("handoff")})
         if not rec["traced"]:
             return
         common = self._span_common(rec)
@@ -1350,6 +1410,21 @@ class ContinuousBatcher:
                 st.get("la_blocks", 0) for st in self._slots.values()),
             "inflight_prefill_tokens": sum(
                 len(r["prompt"]) for r in self._waiting),
+            # Role-aware fields (disaggregated prefill/decode): the
+            # router classifier and the autoscaler/arbiter read these so
+            # prefill and decode fleets scale independently.
+            "role": self.role,
+            # Prompt tokens queued for admission PLUS parked exports —
+            # a prefill fleet's backlog is both.
+            "prefill_queue_tokens": (
+                sum(len(r["prompt"]) for r in self._waiting)
+                + sum(len(e["prompt"])
+                      for e in self._handoff_ready.values())),
+            # Arena capacity an import could land in right now: free
+            # blocks plus LRU-cached ones _alloc_blocks would reclaim.
+            "kv_blocks_importable": free_blocks + cached,
+            "handoff_ready": len(self._handoff_ready),
+            "import_reservations": len(self._import_reservations),
         }
 
     # ---------------------------------------------------------------- api
@@ -1502,6 +1577,9 @@ class ContinuousBatcher:
                 self._finish_request(rid, "evicted",
                                      tokens=len(st["out"]))
                 return True
+        # A parked handoff's retained blocks must not outlive the
+        # request (the first token already sits in _finished).
+        self.abandon_handoff(rid)
         return self._finished.pop(rid, None) is not None
 
     def reset(self) -> List[int]:
@@ -1523,6 +1601,10 @@ class ContinuousBatcher:
         self._finished.clear()
         self._buf = []
         self._pending = None
+        # Parked handoffs and import reservations die with the arena
+        # (allocator.reset below reclaims their blocks wholesale).
+        self._handoff_ready.clear()
+        self._import_reservations.clear()
         # The prefill/tick jits donate the pooled cache; after a mid-step
         # failure the old buffers may already be deleted, so rebuild the
         # pool or every later step would raise "Array has been deleted".
@@ -1570,6 +1652,264 @@ class ContinuousBatcher:
     def has_work(self) -> bool:
         return bool(self._slots or self._waiting or self._finished
                     or self._buf or self._pending)
+
+    # --------------------------------------------- disaggregated handoff
+    def _park_for_handoff(self, slot: int, req: Dict[str, Any]) -> None:
+        """Prefill-role terminal edge: the request just produced its
+        first token — free the SLOT (the next admission group can use
+        it) but retain the arena blocks until :meth:`export_kv_payload`
+        ships them. The first token joins ``_finished`` so the serving
+        layer observes it through the normal step() results."""
+        st = self._slots.pop(slot, None)
+        if st is None:
+            return  # finished at the first token: nothing to hand off
+        rid = st["rid"]
+        self._free.append(slot)
+        self._handoff_ready[rid] = {
+            "prompt": list(req["prompt"]),
+            "first": st["out"][0],
+            "max_new": st["max_new"],
+            "blocks": self._slot_blocks.pop(slot, []),
+            "nodes": self._slot_nodes.pop(slot, []),
+        }
+        self._finished[rid] = list(st["out"])
+        self._finish_request(rid, "prefilled", tokens=len(st["out"]))
+        self._dirty = True
+
+    def _release_handoff_blocks(self, entry: Dict[str, Any]) -> None:
+        """Return a parked handoff's blocks to the arena. Indexed blocks
+        deref into the LRU "cached" state (a resubmitted twin re-matches
+        them instead of re-prefilling), exclusives free outright."""
+        blocks, nodes = entry["blocks"], entry["nodes"]
+        if nodes:
+            self._prefix.release(nodes)
+            shared = {nd.block for nd in nodes}
+            blocks = [b for b in blocks if b not in shared]
+        if blocks:
+            self.allocator.free(blocks)
+
+    def handoff_ready(self) -> List[int]:
+        """Request ids parked with exported-ready KV (prefill role)."""
+        return list(self._handoff_ready)
+
+    def abandon_handoff(self, rid: int) -> bool:
+        """Drop a parked handoff without exporting (client gone, or the
+        decode side never came for it): frees the retained blocks."""
+        entry = self._handoff_ready.pop(rid, None)
+        if entry is None:
+            return False
+        self._release_handoff_blocks(entry)
+        return True
+
+    def export_kv_payload(self, rid: int) -> Dict[str, Any]:
+        """Materialize a parked request's KV handoff: gather its
+        prompt-covering arena blocks (K/V plus int8 scale sidecars) to
+        host as ZERO-COPY VIEWS of one contiguous staging buffer, with
+        a crc32 manifest over the staging bytes. Only the
+        ``ceil(prompt/block_size)`` prompt blocks ship — the decode side
+        sizes its own reservation for the full generation — and the
+        retained blocks release on return (indexed ones park in the
+        LRU, so a resubmit after a lost transfer re-matches them).
+
+        Call through ``ray_tpu.serve.kv_transfer`` — the journal-gated
+        helper every cross-replica transfer must ride (a source lint
+        pins this)."""
+        if self.role == "decode":
+            raise ValueError("decode-role engines do not export KV")
+        entry = self._handoff_ready.pop(rid, None)
+        if entry is None:
+            raise KeyError(
+                f"request {rid} has no handoff-ready KV (not prefilled "
+                f"by a prefill-role engine, or already exported)")
+        prompt = entry["prompt"]
+        nb = -(-len(prompt) // self.block_size)
+        blocks = list(entry["blocks"][:nb])
+        staging, layout = self.cache.gather_blocks(blocks)
+        payload = {
+            "version": HANDOFF_MANIFEST_VERSION,
+            "rid": rid,
+            "prompt": prompt,
+            "chunks": prompt_chunks(prompt, self.block_size),
+            "first_token": int(entry["first"]),
+            "max_new": int(entry["max_new"]),
+            "block_size": self.block_size,
+            "kv_dtype": self.kv_dtype,
+            "num_layers": self.config.num_layers,
+            "num_kv_heads": self.config.num_kv_heads,
+            "head_dim": self.config.head_dim,
+            "num_blocks": nb,
+            "layout": layout,
+            "staging": staging,
+            "nbytes": int(staging.nbytes),
+            "crc32": zlib.crc32(staging),
+        }
+        self._release_handoff_blocks(entry)
+        self.handoff_exports += 1
+        return payload
+
+    def reserve_import(self, prompt_len: int,
+                       max_new: int) -> Optional[int]:
+        """Pre-reserve the arena blocks a future import will need (the
+        router reserves the decode slot BEFORE dispatching prefill, so
+        the payload never races arena pressure on arrival). Returns a
+        reservation id, or None when the arena cannot cover it."""
+        if self.role == "prefill":
+            raise ValueError("prefill-role engines do not import KV")
+        self.sweep_reservations()
+        got = self._alloc_blocks(self._blocks_needed(prompt_len, max_new))
+        if got is None:
+            return None
+        res_id = next(self._reservation_ids)
+        self._import_reservations[res_id] = {
+            "blocks": got, "prompt_len": prompt_len, "max_new": max_new,
+            "ts": time.monotonic()}
+        return res_id
+
+    def sweep_reservations(self, ttl_s: Optional[float] = None) -> int:
+        """Expire import reservations whose handoff never arrived (the
+        router's reserve and decode dispatch landed on different
+        replicas, or the prefill side died before exporting) — a stale
+        ticket must not pin arena blocks forever. TTL from
+        ``RAY_TPU_KV_RESERVE_TTL_S`` (default 30s)."""
+        if not self._import_reservations:
+            return 0
+        if ttl_s is None:
+            ttl_s = float(os.environ.get("RAY_TPU_KV_RESERVE_TTL_S",
+                                         "30"))
+        cutoff = time.monotonic() - ttl_s
+        stale = [r for r, ent in self._import_reservations.items()
+                 if ent.get("ts", 0.0) < cutoff]
+        for res_id in stale:
+            self.allocator.free(
+                self._import_reservations.pop(res_id)["blocks"])
+        return len(stale)
+
+    def cancel_reservation(self, res_id: int) -> bool:
+        """Release a pre-reservation (prefill died and the request is
+        resubmitting elsewhere, or the client disconnected)."""
+        ent = self._import_reservations.pop(res_id, None)
+        if ent is None:
+            return False
+        self.allocator.free(ent["blocks"])
+        return True
+
+    def import_kv_payload(self, payload: Dict[str, Any],
+                          reservation: Optional[int] = None,
+                          trace: Optional[Dict[str, Any]] = None,
+                          breakdown: Optional[Dict[str, float]] = None
+                          ) -> int:
+        """Land an exported KV payload in THIS engine's arena and enter
+        decode directly: crc-verify the staging bytes, scatter them into
+        reserved blocks through the same table-scatter path prefill
+        uses, insert the transferred prefix into the radix index
+        (shareable immediately, read-only refcounted like any matched
+        prefix), and create a live decode slot continuing from the
+        prefill's first token. Greedy decode from here is bit-identical
+        to the colocated engine: the imported bytes ARE the blocks the
+        colocated decode would have attended.
+
+        Returns the LOCAL request id (the import is a fresh request on
+        this engine's id stream). Call through
+        ``ray_tpu.serve.kv_transfer`` — the journal-gated helper every
+        cross-replica transfer must ride (a source lint pins this)."""
+        if self.role == "prefill":
+            raise ValueError("prefill-role engines do not import KV")
+        if payload.get("version") != HANDOFF_MANIFEST_VERSION:
+            raise ValueError(
+                f"KV handoff version mismatch: payload "
+                f"v{payload.get('version')}, engine expects "
+                f"v{HANDOFF_MANIFEST_VERSION}")
+        staging = payload["staging"]
+        crc = zlib.crc32(staging)
+        if crc != payload["crc32"]:
+            raise ValueError(
+                f"KV handoff crc mismatch (got {crc:#010x}, manifest "
+                f"says {payload['crc32']:#010x}): payload corrupted in "
+                f"transit")
+        for field, mine in (("block_size", self.block_size),
+                            ("kv_dtype", self.kv_dtype),
+                            ("num_layers", self.config.num_layers),
+                            ("num_kv_heads", self.config.num_kv_heads),
+                            ("head_dim", self.config.head_dim)):
+            if payload[field] != mine:
+                raise ValueError(
+                    f"KV handoff geometry mismatch on {field}: payload "
+                    f"{payload[field]!r} vs engine {mine!r}")
+        t0 = time.time()
+        prompt = list(payload["prompt"])
+        plen = len(prompt)
+        max_new = int(payload["max_new"])
+        if plen + max_new > self.max_len:
+            raise ValueError(
+                f"imported request ({plen}+{max_new} tokens) exceeds "
+                f"this engine's max_len={self.max_len}")
+        need = self._blocks_needed(plen, max_new)
+        blocks: Optional[List[int]] = None
+        if reservation is not None:
+            ent = self._import_reservations.pop(reservation, None)
+            if ent is not None:
+                if len(ent["blocks"]) >= need:
+                    blocks = ent["blocks"][:need]
+                    if ent["blocks"][need:]:
+                        self.allocator.free(ent["blocks"][need:])
+                else:
+                    # Reservation was sized for a different request:
+                    # return it and fall through to a fresh grab.
+                    self.allocator.free(ent["blocks"])
+        if blocks is None:
+            blocks = self._alloc_blocks(need)
+        if blocks is None:
+            raise RuntimeError(
+                f"decode arena cannot cover the import ({need} blocks "
+                f"needed, {self.allocator.free_count} free); reserve "
+                f"ahead with reserve_import")
+        if not self._free:
+            self.allocator.free(blocks)
+            raise RuntimeError("no free decode slot for the import")
+        nb = int(payload["num_blocks"])
+        self.cache = self.cache.scatter_blocks(
+            blocks[:nb], payload["staging"], payload["layout"])
+        rid = next(self._rid)
+        traced = trace is not None and tracing.enabled()
+        meta = {
+            "rid": rid, "submit": t0, "prompt_len": plen,
+            "weight_version": self._weight_version,
+            "trace": trace, "traced": traced, "windows": [],
+            "admit": t0, "blocks": len(blocks),
+            "prefix_tokens": plen,  # the whole prompt arrived prefilled
+        }
+        if breakdown:
+            meta["handoff"] = dict(breakdown)
+        self._req_meta[rid] = meta
+        if traced:
+            self._traced_live += 1
+        slot = self._free.pop()
+        self._slot_blocks[slot] = blocks
+        if self._prefix is not None and payload["chunks"]:
+            created = self._prefix.insert(
+                [tuple(c) for c in payload["chunks"]], blocks)
+            if created:
+                self._slot_nodes[slot] = created
+        first = int(payload["first_token"])
+        now = time.time()
+        self._note_first_token(meta, t0, now)
+        if meta.get("handoff") is not None:
+            meta["handoff"]["import_s"] = now - t0
+        if self.token_callback is not None:
+            self.token_callback(rid, first)
+        self._slots[slot] = {
+            "rid": rid, "out": [first], "max_new": max_new,
+            "pos": plen, "last": first,
+            "la_blocks": self._lookahead_blocks(plen, max_new),
+        }
+        self._maybe_finish(slot)
+        if self._draft_prefill is not None and slot in self._slots:
+            # The external drafter's dense cache never transferred: it
+            # re-prefills the full prompt locally (cheap vs the target).
+            self._run_draft_prefill([(slot, prompt)])
+        self._dirty = True
+        self.handoff_imports += 1
+        return rid
 
     # ------------------------------------------------------------ paged kv
     def kv_block_stats(self) -> Dict[str, float]:
@@ -1730,6 +2070,10 @@ class ContinuousBatcher:
         return blocks + [tail] * (self.max_blocks - len(blocks))
 
     def _admit(self) -> None:
+        if self._import_reservations:
+            # Stale import tickets (handoff never arrived) must not
+            # starve local admission out of the same arena.
+            self.sweep_reservations()
         if not (self._waiting and self._free):
             return
         from ray_tpu._private import metrics_defs as mdefs
@@ -1902,6 +2246,13 @@ class ContinuousBatcher:
                         if self.paged else 0),
                 }
                 self._maybe_finish(slot)
+                if self.role == "prefill":
+                    # Prefill-role engines stop at the first token: park
+                    # the slot's blocks for export instead of entering
+                    # the decode tick (a request _maybe_finish already
+                    # completed — max_new=1 / immediate EOS — has
+                    # nothing to hand off and stays finished).
+                    self._park_for_handoff(slot, req)
                 if (self._draft_prefill is not None
                         and slot in self._slots):
                     draft_pending.append((slot, req["prompt"]))

@@ -120,6 +120,54 @@ class PagedKVCache(NamedTuple):
             n += 2 * layers * kvh * 4
         return n
 
+    def _parts(self):
+        parts = [("k", self.k), ("v", self.v)]
+        if self.quantized:
+            parts += [("k_scale", self.k_scale),
+                      ("v_scale", self.v_scale)]
+        return parts
+
+    def gather_blocks(self, blocks: Sequence[int]):
+        """Fetch the named arena blocks (K/V plus int8 scale sidecars)
+        to host, packed into ONE contiguous uint8 staging buffer.
+        Returns ``(staging, layout)`` where ``layout`` is
+        ``[(name, dtype_str, shape, offset, nbytes), ...]`` — the
+        per-array regions are zero-copy VIEWS of the staging buffer, so
+        a transfer plane ships one buffer + a small manifest, never a
+        pickle of the arena (see :func:`unpack_staging`)."""
+        import numpy as np
+
+        idx = jnp.asarray(list(blocks), dtype=jnp.int32)
+        host = [(name, np.asarray(arr[:, idx]))
+                for name, arr in self._parts()]
+        staging = np.empty(sum(a.nbytes for _, a in host), np.uint8)
+        layout = []
+        off = 0
+        for name, a in host:
+            end = off + a.nbytes
+            staging[off:end].view(a.dtype).reshape(a.shape)[...] = a
+            layout.append((name, str(a.dtype), a.shape, off, a.nbytes))
+            off = end
+        return staging, layout
+
+    def scatter_blocks(self, blocks: Sequence[int], staging,
+                       layout) -> "PagedKVCache":
+        """Land a :meth:`gather_blocks` staging buffer in THIS arena's
+        ``blocks`` through the same ``.at[:, idx].set`` table-scatter
+        path prefill write-back uses. Returns the new cache value."""
+        views = unpack_staging(staging, layout)
+        idx = jnp.asarray(list(blocks), dtype=jnp.int32)
+        fields = {}
+        for name, arr in self._parts():
+            src = views[name]
+            if src.shape[1] != len(blocks):
+                raise ValueError(
+                    f"scatter_blocks: payload carries {src.shape[1]} "
+                    f"blocks for {name}, caller named {len(blocks)}")
+            fields[name] = arr.at[:, idx].set(
+                jnp.asarray(src, dtype=arr.dtype))
+        return PagedKVCache(**fields)
+
 
 class BlockAllocator:
     """Host-side free-list over arena block ids. Block 0 (GARBAGE_BLOCK)
@@ -343,6 +391,22 @@ class RadixBlockIndex:
         self._by_block.pop(node.block, None)
         if node.parent is not None:
             node.parent.children.pop(node.chunk, None)
+
+
+def unpack_staging(staging, layout):
+    """Reconstruct the per-array views of a gather_blocks staging
+    buffer: ``{name: ndarray}``, each a zero-copy view into
+    ``staging``. The buffer may have crossed a process boundary (shm
+    channel read) — only the bytes moved, never a per-array pickle."""
+    import numpy as np
+
+    buf = np.frombuffer(memoryview(staging), np.uint8) \
+        if not isinstance(staging, np.ndarray) else staging
+    out = {}
+    for name, dtype, shape, off, nbytes in layout:
+        out[name] = buf[off:off + nbytes].view(np.dtype(dtype)) \
+            .reshape(shape)
+    return out
 
 
 def prompt_chunks(prompt_tokens: Sequence[int],

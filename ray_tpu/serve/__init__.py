@@ -9,12 +9,15 @@ from ray_tpu.serve.api import (
     deployment,
     drain,
     get_deployment_handle,
+    get_role_group,
+    register_role_group,
     run,
     shutdown,
     start_grpc,
     start_http,
     stop_grpc,
     stop_http,
+    unregister_role_group,
 )
 from ray_tpu.serve.api import DeploymentResponseGenerator
 from ray_tpu.serve.batching import batch
@@ -27,8 +30,10 @@ __all__ = [
     "DeploymentResponseGenerator", "batch", "build_config", "delete",
     "deploy_config_data", "deploy_config_dict", "deploy_config_file",
     "deployment", "drain", "get_deployment_handle",
-    "get_multiplexed_model_id", "multiplexed", "run", "shutdown",
+    "get_multiplexed_model_id", "get_role_group", "multiplexed",
+    "register_role_group", "run", "shutdown",
     "start_grpc", "start_http", "stop_grpc", "stop_http",
+    "unregister_role_group",
 ]
 
 from ray_tpu._private.usage import record_library_usage as _rlu

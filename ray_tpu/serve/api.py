@@ -112,7 +112,50 @@ DEFAULT_AUTOSCALING = {
     "target_queue_depth": 4.0,
     "kv_starvation_upscale": True,
     "shed_upscale": True,
+    # Disaggregated-role signals (0/off by default — generic
+    # deployments never pay them): prefill fleets scale on waiting
+    # prompt tokens per replica; decode fleets add a replica when EVERY
+    # engine's importable-block headroom (free + LRU-reclaimable)
+    # drops under the floor — the next KV handoff's reservation is
+    # about to fail.
+    "target_prefill_queue_tokens": 0.0,
+    "importable_floor": 0.0,
 }
+
+
+# ------------------------------------------------------------ role groups
+# Disaggregated prefill/decode topology: a LOGICAL deployment name maps
+# to its (prefill, decode) deployment pair. The ingress consults this to
+# classify-and-split requests; everything else (autoscaler, pool
+# arbiter, pressure fan-out) sees two ordinary deployments that scale
+# independently. Registered in the ingress/router process (the only
+# consumer) — `serve.run` the two deployments first, then declare the
+# group; the YAML deploy path does both from a `role_groups:` section.
+_ROLE_GROUPS: Dict[str, Dict[str, str]] = {}
+_ROLE_GROUPS_LOCK = threading.Lock()
+
+
+def register_role_group(name: str, *, prefill: str, decode: str) -> None:
+    """Declare ``name`` as a disaggregated role group: streaming LLM
+    requests to ``name`` are classified at the ingress and either split
+    (prefill on ``prefill``, KV handoff, decode on ``decode``) or sent
+    to ``decode`` whole (its engines run colocated admission too)."""
+    if not prefill or not decode:
+        raise ValueError("role group needs both a prefill and a decode "
+                         "deployment name")
+    with _ROLE_GROUPS_LOCK:
+        _ROLE_GROUPS[name] = {"prefill": prefill, "decode": decode}
+
+
+def get_role_group(name: str) -> Optional[Dict[str, str]]:
+    with _ROLE_GROUPS_LOCK:
+        g = _ROLE_GROUPS.get(name)
+        return dict(g) if g else None
+
+
+def unregister_role_group(name: str) -> bool:
+    with _ROLE_GROUPS_LOCK:
+        return _ROLE_GROUPS.pop(name, None) is not None
 
 
 class Replica:
@@ -464,6 +507,31 @@ class ServeController:
                 # EVERY engine replica has nothing left to admit with:
                 # one more replica, even when queue counters look calm.
                 desired, signal = current + 1, "kv"
+        tpt = float(cfg.get("target_prefill_queue_tokens") or 0)
+        if tpt > 0:
+            # Prefill-role fleets: waiting prompt tokens (admission
+            # queue + parked handoffs) are the work unit, not request
+            # count — one 4k-token prompt loads a replica like dozens
+            # of short ones.
+            ptoks = sum(float(s.get("prefill_queue_tokens") or 0)
+                        for s in snaps)
+            d_p = math.ceil(ptoks / tpt)
+            if d_p > desired:
+                desired, signal = d_p, "prefill_tokens"
+        imp_floor = float(cfg.get("importable_floor") or 0)
+        if imp_floor > 0:
+            # Decode-role fleets: when EVERY engine's importable-block
+            # headroom is under the floor, the next handoff's
+            # reservation is about to fail — add a replica before the
+            # transfer plane starts bouncing.
+            engines = [s for s in snaps
+                       if float(s.get("kv_blocks_total") or 0) > 0]
+            low = [s for s in engines
+                   if float(s.get("kv_blocks_importable") or 0)
+                   < imp_floor]
+            if engines and len(low) == len(engines) and \
+                    current + 1 > desired:
+                desired, signal = current + 1, "importable"
         if cfg.get("shed_upscale"):
             sheds = self._shed_total(name)
             last = self._shed_seen.setdefault(name, sheds)

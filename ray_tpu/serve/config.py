@@ -25,6 +25,10 @@ Config shape::
               sampling:              # / num_blocks / sampling)
                 temperature: 0.7
                 top_p: 0.9
+    role_groups:                     # disaggregated prefill/decode: a
+      - name: llm                    # LOGICAL name mapping to deployed
+        prefill: llm-prefill         # (prefill, decode) deployments —
+        decode: llm-decode           # the ingress classifies + splits
 """
 
 from __future__ import annotations
@@ -91,7 +95,8 @@ def deploy_config_file(path: str) -> List[str]:
 
 
 def deploy_config_dict(cfg: Dict[str, Any]) -> List[str]:
-    from ray_tpu.serve.api import Application, Deployment, run
+    from ray_tpu.serve.api import (Application, Deployment,
+                                   register_role_group, run)
 
     deployed: List[str] = []
     for app_cfg in cfg.get("applications", []):
@@ -114,6 +119,13 @@ def deploy_config_dict(cfg: Dict[str, Any]) -> List[str]:
         deployed.append(dep.name)
         logger.info("deployed %s from %s", dep.name,
                     app_cfg["import_path"])
+    for group in cfg.get("role_groups", []):
+        # Declared AFTER the applications deploy so the pair the group
+        # names already exists when the first classified request lands.
+        register_role_group(group["name"], prefill=group["prefill"],
+                            decode=group["decode"])
+        logger.info("registered role group %s -> prefill=%s decode=%s",
+                    group["name"], group["prefill"], group["decode"])
     return deployed
 
 

@@ -1,0 +1,240 @@
+"""KV-block transfer plane for disaggregated prefill/decode serving.
+
+Every cross-replica movement of paged-KV arena blocks goes through THIS
+module — a tier-1 source lint (tests/test_metrics_lint.py) pins the
+engine's ``export_kv_payload`` / ``import_kv_payload`` call sites to it,
+so no bare channel write of arena bytes can creep in beside the journal.
+
+The transfer is staged:
+
+* **export** (prefill replica): :func:`export_kv` materializes the
+  parked request's prompt blocks (K/V + int8 scale sidecars) into one
+  host staging buffer with a crc32 manifest — zero-copy views of the
+  staging bytes, never a pickle of the arena;
+* **channel** (:func:`send_handoff` → :func:`receive_handoff`): the
+  staging bytes ride a compiled-DAG shm channel
+  (``experimental/channel.py``) created per handoff; the small manifest
+  — everything except the staging bytes, plus the channel's reader
+  attach-spec — returns through the ordinary control plane. When both
+  engines live in one process, :func:`transfer_inproc` skips the
+  channel entirely;
+* **import** (decode replica): :func:`import_kv` crc-verifies the
+  bytes, scatters them into (pre-)reserved arena blocks, inserts the
+  prefix into the radix index, and enters the decode tick.
+
+**Journal gating**: :func:`receive_handoff` refuses a manifest the
+router has not stamped ``journaled`` (``RequestJournal.note_handoff``)
+— an un-journaled transfer could bill a request twice after a death on
+either side. Chaos sites ``kill_transfer`` / ``delay_transfer``
+(matchable on ``stage=export|import``) fire inside the owning replica
+process, so an injected death IS a real actor death the journal must
+recover from.
+
+Knobs: ``RAY_TPU_KV_TRANSFER_TIMEOUT_S`` (channel read wait, default
+30), ``RAY_TPU_KV_TRANSFER_TTL_S`` (orphaned-channel reap, default
+120).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["export_kv", "import_kv", "send_handoff", "receive_handoff",
+           "transfer_inproc", "reap_channels", "transfer_timeout_s"]
+
+#: Manifest keys that never ride the shm channel (the staging bytes go
+#: alone; everything else IS the manifest).
+_BODY_KEY = "staging"
+
+
+def transfer_timeout_s() -> float:
+    """Channel-read wait for the staging bytes (read per transfer so
+    tests/operators retune live)."""
+    return float(os.environ.get("RAY_TPU_KV_TRANSFER_TIMEOUT_S", "30"))
+
+
+def _channel_ttl_s() -> float:
+    return float(os.environ.get("RAY_TPU_KV_TRANSFER_TTL_S", "120"))
+
+
+# Writer-side channels awaiting their (single) reader. The decode side
+# unlinks the segment after reading (name-based destroy works from the
+# reader); entries here only matter when the decode side never comes —
+# a death mid-handoff, a dropped manifest — and are reaped past the TTL
+# so orphaned shm segments cannot accumulate.
+_PENDING: List[Tuple[Any, float]] = []
+_PENDING_LOCK = threading.Lock()
+
+
+def reap_channels(force: bool = False) -> int:
+    """Destroy writer-side channels whose reader never came (or all of
+    them with ``force=True`` — replica shutdown). Returns the count
+    reaped. Destroying an already-unlinked segment is a no-op."""
+    now = time.monotonic()
+    reaped = 0
+    with _PENDING_LOCK:
+        keep = []
+        for ch, deadline in _PENDING:
+            if force or now >= deadline:
+                try:
+                    ch.destroy()
+                except Exception:  # noqa: BLE001 — reader already unlinked
+                    pass
+                reaped += 1
+            else:
+                keep.append((ch, deadline))
+        _PENDING[:] = keep
+    return reaped
+
+
+def _observe(direction: str, deployment: str, seconds: float,
+             nbytes: int, blocks: int) -> None:
+    from ray_tpu._private import metrics_defs as mdefs
+
+    tags = {"deployment": deployment, "direction": direction}
+    mdefs.SERVE_KV_TRANSFER_SECONDS.observe(seconds, tags=tags)
+    mdefs.SERVE_KV_TRANSFER_BYTES.inc(max(int(nbytes), 0), tags=tags)
+    mdefs.SERVE_KV_TRANSFER_BLOCKS.inc(max(int(blocks), 0), tags=tags)
+
+
+# ---------------------------------------------------------------- export
+def export_kv(engine, rid: int, *, deployment: str = "") -> Dict[str, Any]:
+    """Export a parked request's KV blocks from a prefill-role engine as
+    the versioned, crc32-manifested payload (staging bytes inline).
+    Chaos site ``kv_transfer``/``stage=export`` fires BEFORE the gather,
+    inside the prefill replica's process — an injected kill is a real
+    prefill death mid-transfer. The caller holds the engine lock."""
+    from ray_tpu._private import chaos
+
+    if chaos.enabled():
+        chaos.inject("kv_transfer", stage="export", deployment=deployment,
+                     rid=rid)
+    t0 = time.perf_counter()
+    payload = engine.export_kv_payload(rid)
+    dt = time.perf_counter() - t0
+    payload["breakdown"] = {"export_s": dt}
+    _observe("export", deployment, dt, payload["nbytes"],
+             payload["num_blocks"])
+    return payload
+
+
+# ---------------------------------------------------------------- import
+def import_kv(engine, payload: Dict[str, Any], *,
+              reservation: Optional[int] = None,
+              trace: Optional[Dict[str, Any]] = None,
+              deployment: str = "") -> int:
+    """Land an exported payload in a decode-role engine's arena (crc
+    verified, radix-inserted, decode slot live). Chaos site
+    ``kv_transfer``/``stage=import`` fires BEFORE the scatter, inside
+    the decode replica's process. Returns the engine-local request id.
+    The caller holds the engine lock."""
+    from ray_tpu._private import chaos, metrics_defs as mdefs
+
+    if chaos.enabled():
+        chaos.inject("kv_transfer", stage="import", deployment=deployment,
+                     rid=payload.get("rid"))
+    t0 = time.perf_counter()
+    try:
+        rid = engine.import_kv_payload(
+            payload, reservation=reservation, trace=trace,
+            breakdown=payload.get("breakdown"))
+    except ValueError as e:
+        if "crc" in str(e):
+            mdefs.SERVE_HANDOFFS.inc(tags={
+                "deployment": deployment, "outcome": "crc_mismatch"})
+        raise
+    dt = time.perf_counter() - t0
+    _observe("import", deployment, dt, payload.get("nbytes", 0),
+             payload.get("num_blocks", 0))
+    return rid
+
+
+# --------------------------------------------------------------- channel
+def send_handoff(engine, rid: int, *,
+                 deployment: str = "") -> Dict[str, Any]:
+    """Export + stage into a fresh shm channel. Returns the MANIFEST:
+    the payload minus the staging bytes, plus the channel's reader
+    attach-spec under ``"channel"``. The manifest crosses the ordinary
+    control plane (it is small); the bytes wait in the channel until
+    :func:`receive_handoff` collects them. The first write to a fresh
+    channel never blocks, so the prefill replica is free the moment
+    this returns. NOT yet importable: the router must journal the
+    handoff and stamp ``manifest["journaled"]`` first."""
+    from ray_tpu.experimental.channel import Channel
+
+    reap_channels()
+    payload = export_kv(engine, rid, deployment=deployment)
+    staging = payload.pop(_BODY_KEY)
+    t0 = time.perf_counter()
+    ch = Channel(capacity=int(staging.nbytes) + (64 << 10), n_readers=1)
+    ch.write(staging)
+    dt = time.perf_counter() - t0
+    with _PENDING_LOCK:
+        _PENDING.append((ch, time.monotonic() + _channel_ttl_s()))
+    payload["breakdown"]["channel_s"] = dt
+    payload["channel"] = ch.reader(0)
+    _observe("channel", deployment, dt, payload["nbytes"],
+             payload["num_blocks"])
+    return payload
+
+
+def receive_handoff(engine, manifest: Dict[str, Any], *,
+                    reservation: Optional[int] = None,
+                    trace: Optional[Dict[str, Any]] = None,
+                    deployment: str = "",
+                    timeout_s: Optional[float] = None) -> int:
+    """Collect a journaled handoff on the decode side: attach to the
+    manifest's channel, read the staging bytes (accounted as the
+    ``channel`` direction end-to-end — write + queue + read), unlink
+    the segment, and import. Refuses manifests the router never
+    journaled — the journal gate IS the exactly-once guarantee, so an
+    un-stamped manifest is a programming error, not a retryable one."""
+    if not manifest.get("journaled"):
+        raise RuntimeError(
+            "KV handoff manifest was not journaled: every cross-replica "
+            "transfer must pass through RequestJournal.note_handoff "
+            "(DisaggRecoverableStream) before import")
+    ch = manifest["channel"]
+    t0 = time.perf_counter()
+    staging = ch.read(timeout=timeout_s if timeout_s is not None
+                      else transfer_timeout_s())
+    try:
+        ch.destroy()          # consumed: unlink the shm segment
+    except Exception:  # noqa: BLE001 — writer may have reaped first
+        pass
+    dt = time.perf_counter() - t0
+    payload = {k: v for k, v in manifest.items()
+               if k not in ("channel", "journaled")}
+    payload[_BODY_KEY] = staging
+    payload.setdefault("breakdown", {})
+    payload["breakdown"]["channel_s"] = \
+        payload["breakdown"].get("channel_s", 0.0) + dt
+    _observe("channel", deployment, dt, payload.get("nbytes", 0),
+             payload.get("num_blocks", 0))
+    return import_kv(engine, payload, reservation=reservation,
+                     trace=trace, deployment=deployment)
+
+
+# ------------------------------------------------------------- fast path
+def transfer_inproc(src_engine, dst_engine, rid: int, *,
+                    reservation: Optional[int] = None,
+                    trace: Optional[Dict[str, Any]] = None,
+                    deployment: str = "", journal=None) -> int:
+    """Direct in-process handoff for colocated engines: export →
+    (journal) → import with no channel hop — the staging buffer passes
+    by reference. When a ``journal`` is supplied the handoff is noted
+    on it exactly like the cross-replica path; unit/parity tests use
+    this entry so the journal ledger shape matches production."""
+    payload = export_kv(src_engine, rid, deployment=deployment)
+    if journal is not None:
+        journal.note_handoff({
+            "crc32": payload.get("crc32"),
+            "nbytes": payload.get("nbytes"),
+            "num_blocks": payload.get("num_blocks"),
+            "attempt": journal.resumes,
+        })
+    return import_kv(dst_engine, payload, reservation=reservation,
+                     trace=trace, deployment=deployment)

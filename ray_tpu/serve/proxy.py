@@ -138,7 +138,14 @@ class AdmissionGate:
         if threshold <= 0:
             return None
         try:
-            snaps = self._router.handle(deployment)._fetch_shared_pressure()
+            from ray_tpu.serve import api as serve_api
+
+            # A role-group (disaggregated) name has no replicas of its
+            # own: the decode group's pressure is the admission signal
+            # (its arena is where every request ultimately lives).
+            group = serve_api.get_role_group(deployment)
+            target = group["decode"] if group else deployment
+            snaps = self._router.handle(target)._fetch_shared_pressure()
         except Exception:  # noqa: BLE001 — no controller: fail open
             return None
         reachable = [s for s in snaps
@@ -154,12 +161,21 @@ class AdmissionGate:
 class _Router:
     """Shared deployment-handle cache for every ingress."""
 
+    #: Recently-dispatched prefix fingerprints the classifier treats as
+    #: probably-cached on the decode side (bounded LRU).
+    FP_SEEN_CAP = 512
+
     def __init__(self):
         self._handles: Dict[str, object] = {}
         self._lock = threading.Lock()
         # One admission gate per router: HTTP and gRPC ingresses share
         # its (handle-cached) pressure view and tenant buckets.
         self.gate = AdmissionGate(self)
+        # Fingerprint → last-seen order, for the disagg classifier's
+        # net-prefill estimate (OrderedDict as LRU).
+        from collections import OrderedDict
+
+        self._fp_seen: "OrderedDict[str, None]" = OrderedDict()
 
     def handle(self, name: str):
         from ray_tpu.serve.api import DeploymentHandle
@@ -177,10 +193,74 @@ class _Router:
         if method and method.startswith("_"):
             raise LookupError("method not found")
 
+    # ------------------------------------------- disaggregated classify
+    def _note_fp(self, fp: str) -> None:
+        with self._lock:
+            self._fp_seen.pop(fp, None)
+            self._fp_seen[fp] = None
+            while len(self._fp_seen) > self.FP_SEEN_CAP:
+                self._fp_seen.popitem(last=False)
+
+    def _classify_disagg(self, group: Dict[str, str], payload) -> bool:
+        """True → split dispatch (prefill replica → KV handoff → decode
+        replica); False → the decode group runs the request colocated.
+        The estimate: NET prefill cost = prompt tokens minus the
+        fingerprint-matched prefix a decode replica likely already
+        holds (a seen fingerprint means its block-aligned head is hot
+        in some radix cache — re-prefilling it locally is cheap, so it
+        doesn't justify a transfer). Split when the net cost clears
+        ``RAY_TPU_DISAGG_PREFILL_THRESHOLD`` tokens (default 128; <=0
+        splits every LLM request — the parity/chaos tests' mode), or
+        when the LIVE pressure feed shows every decode replica already
+        queueing ``RAY_TPU_DISAGG_QUEUE_TOKENS`` prefill tokens (>0
+        enables) — colocated admission would stall their decode ticks
+        regardless of this prompt's size."""
+        prompt = payload.get("prompt_token_ids") or ()
+        plen = len(prompt)
+        fp = prefix_fingerprint(payload)
+        covered = 0
+        if fp:
+            chunk = int(os.environ.get("RAY_TPU_PREFIX_FP_CHUNK", "64"))
+            max_chunks = int(os.environ.get("RAY_TPU_PREFIX_FP_CHUNKS",
+                                            "4"))
+            with self._lock:
+                seen = fp in self._fp_seen
+            if seen:
+                covered = min(plen,
+                              min(max_chunks, plen // max(chunk, 1))
+                              * chunk)
+            self._note_fp(fp)
+        net_prefill = plen - covered
+        threshold = float(os.environ.get(
+            "RAY_TPU_DISAGG_PREFILL_THRESHOLD", "128"))
+        if net_prefill >= threshold:
+            return True
+        floor = float(os.environ.get("RAY_TPU_DISAGG_QUEUE_TOKENS",
+                                     "0") or 0)
+        if floor > 0:
+            try:
+                snaps = self.handle(
+                    group["decode"])._fetch_shared_pressure()
+            except Exception:  # noqa: BLE001 — no feed: size-only rule
+                snaps = []
+            live = [s for s in snaps if s and not s.get("unreachable")]
+            if live and all(
+                    float(s.get("prefill_queue_tokens") or 0) >= floor
+                    for s in live):
+                return True
+        return False
+
     def call(self, name: str, method: Optional[str], payload,
              model_id: str = "", timeout_s: float = 60.0,
              request_ctx: Optional[Dict[str, Any]] = None):
+        from ray_tpu.serve import api as serve_api
+
         self._check_public(method)
+        group = serve_api.get_role_group(name)
+        if group is not None:
+            # Unary completions run colocated on the decode group (its
+            # engines accept plain submits); only streams split.
+            name = group["decode"]
         h = self.handle(name).options(
             method, multiplexed_model_id=model_id,
             request_context=request_ctx,
@@ -195,11 +275,32 @@ class _Router:
         resubmit; mid-decode LLM requests resume as prompt + emitted
         tokens, exactly-once under greedy decoding) and drain rejects
         re-route for free. The iterator's ``.journal`` tells the ingress
-        whether to surface the ``x-ray-tpu-resumed`` marker."""
-        from ray_tpu.serve.recovery import (RecoverableStream,
-                                            RequestJournal)
+        whether to surface the ``x-ray-tpu-resumed`` marker.
+
+        A name registered as a ROLE GROUP classifies first: requests
+        whose estimated net prefill cost justifies the transfer split
+        across the (prefill, decode) pair with a journaled KV handoff
+        (:class:`~ray_tpu.serve.recovery.DisaggRecoverableStream`);
+        the rest run colocated on the decode group."""
+        from ray_tpu.serve import api as serve_api
+        from ray_tpu.serve.recovery import (DisaggRecoverableStream,
+                                            RecoverableStream,
+                                            RequestJournal,
+                                            is_llm_payload)
 
         self._check_public(method)
+        group = serve_api.get_role_group(name)
+        if group is not None:
+            if is_llm_payload(payload) and \
+                    self._classify_disagg(group, payload):
+                journal = RequestJournal(name, method, payload,
+                                         model_id=model_id,
+                                         request_ctx=request_ctx)
+                return DisaggRecoverableStream(
+                    self.handle(group["prefill"]),
+                    self.handle(group["decode"]),
+                    journal, per_item_timeout_s=60.0)
+            name = group["decode"]
         journal = RequestJournal(name, method, payload,
                                  model_id=model_id,
                                  request_ctx=request_ctx)

@@ -158,6 +158,13 @@ class RequestJournal:
         self.resumes = 0          # death recoveries (budgeted)
         self.drain_rejects = 0    # clean re-routes (not budgeted)
         self.resumed_midstream = False
+        # Disaggregated prefill/decode: one ledger entry per KV handoff
+        # the router committed on this request's behalf (crc32, bytes,
+        # attempt). Exactly-once billing hangs off this list — a clean
+        # split request journals EXACTLY ONE handoff, and a decode death
+        # after the noted handoff recovers as a "resume" (the first
+        # token crossed replicas) rather than an invisible resubmit.
+        self.handoffs: List[Dict[str, Any]] = []
 
     # ------------------------------------------------------------ queries
     @property
@@ -176,6 +183,21 @@ class RequestJournal:
 
     def record(self, item: Any) -> None:
         self.emitted.append(item)
+
+    def note_handoff(self, meta: Dict[str, Any]) -> Dict[str, Any]:
+        """Journal one prefill→decode KV handoff — idempotent PER
+        ATTEMPT, so a retried bookkeeping call cannot double-bill the
+        transfer (the double-billing regression asserts a clean split
+        request ends with exactly one ledger entry). The entry is the
+        manifest's billing-relevant core: crc32, byte/block counts, and
+        the attempt that shipped it."""
+        attempt = int(meta.get("attempt", self.resumes))
+        for entry in self.handoffs:
+            if entry.get("attempt") == attempt:
+                return entry
+        entry = {**meta, "attempt": attempt}
+        self.handoffs.append(entry)
+        return entry
 
     def tags(self, engine: str = "router") -> Dict[str, str]:
         return {"deployment": self.deployment, "tenant": self.model_id,
@@ -269,6 +291,13 @@ class RecoverableStream:
                 pass
             self._replica = None
 
+    def _death_cause(self) -> str:
+        """Recovery tag for a replica death: "resume" once items reached
+        the caller, else an invisible "resubmit". The disaggregated
+        stream overrides this — a decode death after the journaled
+        handoff is a resume even before the first token streamed."""
+        return "resume" if self.journal.emitted else "resubmit"
+
     # ------------------------------------------------------------ recover
     def _reroute_drained(self) -> None:
         """The chosen replica is draining (clean reject — it did no
@@ -314,7 +343,7 @@ class RecoverableStream:
             mdefs.SERVE_REQ_OUTCOMES.inc(tags={
                 **j.tags(), "outcome": "resume_exhausted"})
             raise exhausted_error(j.deployment, j.resumes) from err
-        cause = "resume" if j.emitted else "resubmit"
+        cause = self._death_cause()
         j.resumes += 1
         if j.emitted:
             j.resumed_midstream = True
@@ -365,6 +394,188 @@ class RecoverableStream:
             return item
 
 
+class DisaggRecoverableStream(RecoverableStream):
+    """Recoverable stream over a (prefill, decode) ROLE-GROUP pair —
+    the disaggregated twin of :class:`RecoverableStream`. Dispatch is
+    staged: pre-reserve the decode slot, run the unary ``prefill`` on
+    the prefill group (it returns the KV handoff manifest; the staging
+    bytes ride the shm channel named inside it), journal the handoff,
+    then open the ``decode_from`` stream on the decode group. Every
+    token — including the prefill-produced first one — reaches the
+    caller only through the decode stream, so the journal's ``emitted``
+    ledger stays the single source of delivery truth.
+
+    Death on either side lands in the SAME journal:
+
+    * **prefill death** (unary — nothing delivered, nothing journaled):
+      the submission resubmits verbatim to another prefill replica
+      (``cause="resubmit"``, budgeted like any death retry);
+    * **decode death after the handoff**: replay from the journal as a
+      fresh prefill wherever capacity exists (``cause="resume"`` — the
+      first token crossed replicas, so the recovery is visible state,
+      not an invisible reroute). The journaled handoff means the
+      request is never billed twice: the replay journals a NEW attempt
+      entry, and :meth:`RequestJournal.note_handoff` refuses duplicate
+      entries for the same attempt.
+
+    This class is the only place the disaggregated router path handles
+    ``ActorDiedError`` (the same source lint that pins the colocated
+    path to this module covers it)."""
+
+    def __init__(self, prefill_handle, decode_handle,
+                 journal: RequestJournal,
+                 per_item_timeout_s: Optional[float] = 60.0):
+        super().__init__(decode_handle, journal, per_item_timeout_s)
+        self._prefill_handle = prefill_handle
+        # True between note_handoff and clean stream end: a death in
+        # that window is a decode death AFTER the handoff.
+        self._handoff_live = False
+
+    def _death_cause(self) -> str:
+        return ("resume" if (self.journal.emitted or self._handoff_live)
+                else "resubmit")
+
+    def _resume_after_death(self, err: BaseException) -> None:
+        from ray_tpu._private import metrics_defs as mdefs
+
+        handoff_was_live = self._handoff_live
+        if handoff_was_live:
+            mdefs.SERVE_HANDOFFS.inc(tags={
+                "deployment": self.journal.deployment,
+                "outcome": "decode_died"})
+        pre = self.journal.resumes
+        super()._resume_after_death(err)
+        if handoff_was_live and self.journal.resumes > pre:
+            # The death post-dates a journaled handoff: the first token
+            # crossed replicas, so even with zero tokens DELIVERED the
+            # replay is visible state — a sampled request must carry
+            # the resumed marker to the client.
+            self.journal.resumed_midstream = True
+
+    # ---------------------------------------------------------- dispatch
+    def _prefill_attempt(self, payload: Any, rctx, fp: str):
+        """One journaled prefill attempt: returns the manifest, or None
+        when the chosen prefill replica died/drained (the journal was
+        advanced and the caller retries)."""
+        import ray_tpu
+        from ray_tpu._private import metrics_defs as mdefs
+
+        j = self.journal
+        h = self._prefill_handle.options(
+            "prefill", multiplexed_model_id=j.model_id,
+            request_context=rctx, prefix_key=fp)
+        resp = h.remote(payload)
+        try:
+            return ray_tpu.get(resp._ref, timeout=self._timeout)
+        except exceptions.ReplicaDrainingError:
+            # Clean reject — free reroute, bounded by the shared cap.
+            j.drain_rejects += 1
+            if j.drain_rejects > DRAIN_REJECT_CAP:
+                raise exceptions.ReplicaDrainingError(
+                    f"every prefill replica of {j.deployment!r} rejected "
+                    f"the request as draining ({j.drain_rejects} rejects)")
+            try:
+                self._prefill_handle._evict(resp._replica)
+            except Exception:  # noqa: BLE001 — eviction is best-effort
+                pass
+            mdefs.SERVE_REPLICA_RESUMES.inc(tags={
+                "deployment": j.deployment, "cause": "drain_reject"})
+            _flight_resume(j, "drain_reject")
+            return None
+        except exceptions.ActorDiedError as e:
+            # Prefill death: ZERO bytes reached the caller and no
+            # handoff was journaled, so the immutable submission
+            # resubmits to another prefill replica — budgeted.
+            try:
+                self._prefill_handle._evict(resp._replica)
+            except Exception:  # noqa: BLE001
+                pass
+            mdefs.SERVE_HANDOFFS.inc(tags={
+                "deployment": j.deployment, "outcome": "prefill_died"})
+            if j.resumes >= max_resumes():
+                mdefs.SERVE_REQ_OUTCOMES.inc(tags={
+                    **j.tags(), "outcome": "resume_exhausted"})
+                raise exhausted_error(j.deployment, j.resumes) from e
+            j.resumes += 1
+            mdefs.SERVE_REPLICA_RESUMES.inc(tags={
+                "deployment": j.deployment, "cause": "resubmit"})
+            _flight_resume(j, "resubmit")
+            logger.warning(
+                "serve: resubmitting prefill for %r after replica death "
+                "(attempt %d/%d)", j.deployment, j.resumes, max_resumes())
+            return None
+
+    def _dispatch(self, payload: Any) -> None:
+        import ray_tpu
+        from ray_tpu._private import metrics_defs as mdefs
+        from ray_tpu.serve.proxy import prefix_fingerprint
+
+        j = self.journal
+        self._handoff_live = False
+        fp = prefix_fingerprint(payload)
+        prompt = (payload.get("prompt_token_ids") or ()
+                  if isinstance(payload, dict) else ())
+        try:
+            budget = int(payload.get("max_tokens", 16)) \
+                if isinstance(payload, dict) else 16
+        except (TypeError, ValueError):
+            budget = 16
+        # (1) PRE-RESERVE the decode slot before any prefill work: the
+        # payload must never race arena pressure on arrival. Best-effort
+        # — a miss (arena full, replica mismatch) just means the import
+        # allocates on arrival; the replica-nonce inside the ticket
+        # keeps a ticket from one decode replica from being spent on
+        # another, and unspent tickets expire engine-side (TTL).
+        reservation = None
+        try:
+            reservation = ray_tpu.get(
+                self._handle.options(
+                    "reserve_kv", multiplexed_model_id=j.model_id,
+                    prefix_key=fp).remote(len(prompt), budget)._ref,
+                timeout=5)
+        except Exception:  # noqa: BLE001 — reservation is advisory
+            reservation = None
+        # (2) PREFILL (journaled unary retry loop).
+        while True:
+            rctx = j.request_ctx
+            if rctx is not None and (j.resumes or j.drain_rejects):
+                rctx = {**rctx, "attempt": j.resumes + j.drain_rejects}
+            manifest = self._prefill_attempt(payload, rctx, fp)
+            if manifest is not None:
+                break
+        if isinstance(manifest, dict) and "done" in manifest:
+            # The request finished entirely at prefill (max_tokens == 1,
+            # EOS at the first token, or a resumed prompt already ending
+            # in EOS): nothing to hand off — the completed tokens stream
+            # straight out and are journaled like any other items.
+            self._replica = None
+            self._inner = iter(list(manifest["done"]))
+            return
+        # (3) JOURNAL the handoff before the decode side can touch it:
+        # the manifest only becomes importable once stamped (the
+        # transfer helper refuses unstamped manifests), so a request
+        # can never be billed for an un-journaled transfer.
+        j.note_handoff({
+            "crc32": manifest.get("crc32"),
+            "nbytes": manifest.get("nbytes"),
+            "num_blocks": manifest.get("num_blocks"),
+            "attempt": j.resumes,
+        })
+        manifest = {**manifest, "journaled": True}
+        self._handoff_live = True
+        # (4) DECODE stream: every token (first included) arrives here.
+        dh = self._handle.options(
+            "decode_from", stream=True, multiplexed_model_id=j.model_id,
+            request_context=rctx, prefix_key=fp)
+        gen = dh.remote({"manifest": manifest,
+                         "reservation": reservation})
+        gen._timeout = self._timeout
+        self._replica = getattr(gen, "_replica", None)
+        self._inner = iter(gen)
+        mdefs.SERVE_HANDOFFS.inc(tags={
+            "deployment": j.deployment, "outcome": "ok"})
+
+
 def note_unary_resumed(deployment: str, tenant: str) -> None:
     """Metrics for a unary call that completed after >=1 death retry
     (the ``serve/api.py`` unary journal path)."""
@@ -390,7 +601,8 @@ def note_unary_retry(deployment: str, cause: str) -> None:
         "deployment": deployment, "cause": cause})
 
 
-__all__ = ["COMPLETE", "DRAIN_REJECT_CAP", "RESUMED_MARKER",
+__all__ = ["COMPLETE", "DRAIN_REJECT_CAP", "DisaggRecoverableStream",
+           "RESUMED_MARKER",
            "RecoverableStream", "RequestJournal", "exhausted_error",
            "is_llm_payload", "is_sampled", "max_resumes",
            "note_unary_exhausted", "note_unary_resumed",

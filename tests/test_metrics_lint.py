@@ -270,7 +270,8 @@ def test_router_dispatch_paths_handle_actor_death_through_the_journal():
         "api.py": {"result",            # unary journal-gated retry
                    "_reconcile_locked",  # controller death accounting
                    "_advance_drains"},   # died-while-draining accounting
-        "recovery.py": {"__next__"},     # streaming journal
+        "recovery.py": {"__next__",      # streaming journal
+                        "_prefill_attempt"},  # disagg unary prefill leg
     }
     for path in sorted(root.glob("*.py")):
         src = path.read_text().splitlines()
@@ -300,6 +301,72 @@ def test_router_dispatch_paths_handle_actor_death_through_the_journal():
     assert "RecoverableStream" in inspect.getsource(proxy_mod._Router.stream)
     assert callable(recovery.max_resumes)
     assert hasattr(recovery.RequestJournal, "resume_payload")
+
+
+def test_disagg_kv_transfer_series_are_cataloged_and_pinned():
+    """The disaggregated prefill/decode handoff plane (ISSUE 20): the
+    KV-transfer series ship described + tagged with the hop direction,
+    the handoff ledger counter carries the outcome taxonomy, request
+    histograms carry the role tag, and a SOURCE LINT pins every
+    cross-replica export/import call site to the journal-gated helper
+    (serve/kv_transfer.py) — a bare channel write of arena bytes beside
+    the journal would break exactly-once billing silently."""
+    import inspect
+    import pathlib
+
+    import ray_tpu
+
+    names = {m.name for m in _framework_metrics()}
+    required = {
+        "ray_tpu_serve_kv_transfer_seconds",
+        "ray_tpu_serve_kv_transfer_bytes_total",
+        "ray_tpu_serve_kv_transfer_blocks_total",
+        "ray_tpu_serve_handoff_total",
+    }
+    missing = required - names
+    assert not missing, (
+        f"disagg KV-transfer series missing from the catalog: {missing}")
+    for m in _framework_metrics():
+        if m.name.startswith("ray_tpu_serve_kv_transfer_"):
+            # export / channel / import: the three legs of the hop.
+            assert m.description.strip() and "direction" in m.tag_keys, \
+                m.name
+        if m.name == "ray_tpu_serve_handoff_total":
+            # ok / prefill_died / decode_died / crc_mismatch.
+            assert "outcome" in m.tag_keys
+        if m.name == "ray_tpu_serve_request_ttft_seconds":
+            # Role-sliced latency: prefill vs decode vs colocated fleets.
+            assert "role" in m.tag_keys
+    # Source lint: the engine's export_kv_payload / import_kv_payload
+    # are called ONLY from serve/kv_transfer.py (besides their own
+    # definitions) — every transfer rides the journal-gated helper.
+    root = pathlib.Path(ray_tpu.__file__).parent
+    exempt = {"models/continuous_batching.py",  # defines them
+              "serve/kv_transfer.py"}           # the one legal caller
+    offenders = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel in exempt:
+            continue
+        src = path.read_text()
+        for site in ("export_kv_payload", "import_kv_payload"):
+            if site in src:
+                offenders.append(f"{rel}: {site}")
+    assert not offenders, (
+        f"KV arena bytes must cross replicas only through "
+        f"serve/kv_transfer.py: {offenders}")
+    # The helper enforces the journal gate, and the router's streaming
+    # path classifies into the disagg journal stream.
+    from ray_tpu.serve import kv_transfer
+    from ray_tpu.serve import proxy as proxy_mod
+
+    assert "journaled" in inspect.getsource(kv_transfer.receive_handoff)
+    assert "DisaggRecoverableStream" in \
+        inspect.getsource(proxy_mod._Router.stream)
+    # The dashboard renders the plane.
+    from ray_tpu import dashboard
+
+    assert 'id="disagg"' in dashboard._INDEX_HTML
 
 
 def test_train_elasticity_series_are_cataloged():
